@@ -1,0 +1,27 @@
+"""kubernetes_tpu — a TPU-native batch scheduling framework.
+
+A from-scratch re-design of the Kubernetes scheduler (reference: upstream
+v1.16-era kube-scheduler, see SURVEY.md) for TPU hardware: cluster state is
+mirrored into device-resident tensors, incrementally patched from a
+list+watch event stream, and scheduling decisions are computed as vectorized
+pods x nodes boolean-mask / score matrices in JAX/XLA, finished by a batched
+assignment solve.
+
+Layout (mirrors SURVEY.md section 7 build plan):
+  api/        typed Pod/Node objects, resource.Quantity, label selectors
+  state/      interner, cluster cache (assumed-pod state machine), queue,
+              tensorization layer (generation-patched device arrays)
+  ops/        device kernels: filters (predicates), scores (priorities),
+              topology (spread + inter-pod affinity), solver (assignment)
+  parallel/   device-mesh sharding of the solve (shard_map over node axis)
+  framework/  plugin extension points (QueueSort..PostBind, CycleState)
+  scheduler/  driver loop, event handlers, factory/config, preemption
+  apiserver/  in-process fake apiserver with list+watch, informer client
+  extender/   HTTP SchedulerExtender server (extender/v1 wire format)
+  metrics/    Prometheus-text metrics registry + scheduler series
+  utils/      trace, backoff, leader election, feature gates
+  models/     workload/cluster generators (scheduler_perf & kubemark style)
+  oracle/     scalar Python reference semantics used for parity testing
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,112 @@
+"""Kubernetes resource.Quantity arithmetic.
+
+Re-implements the subset of `k8s.io/apimachinery/pkg/api/resource` the
+scheduler depends on (reference: staging/src/k8s.io/apimachinery/pkg/api/resource/quantity.go):
+parsing of decimal/binary-SI suffixed strings and the two accessors the
+scheduler hot path uses — `Value()` (ceil to integer units, used for memory
+bytes) and `MilliValue()` (ceil to 1/1000 units, used for CPU millicores).
+
+Values are held exactly as integer-scaled decimals (mantissa x 10^exp or
+mantissa x 2^exp for binary suffixes), so round-tripping and comparisons are
+exact like the reference's inf.Dec-backed implementation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+
+_DECIMAL_SUFFIXES = {
+    "n": Fraction(1, 10**9),
+    "u": Fraction(1, 10**6),
+    "m": Fraction(1, 10**3),
+    "": Fraction(1),
+    "k": Fraction(10**3),
+    "M": Fraction(10**6),
+    "G": Fraction(10**9),
+    "T": Fraction(10**12),
+    "P": Fraction(10**15),
+    "E": Fraction(10**18),
+}
+_BINARY_SUFFIXES = {
+    "Ki": Fraction(2**10),
+    "Mi": Fraction(2**20),
+    "Gi": Fraction(2**30),
+    "Ti": Fraction(2**40),
+    "Pi": Fraction(2**50),
+    "Ei": Fraction(2**60),
+}
+
+
+class QuantityError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Quantity:
+    """An exact decimal quantity of a resource."""
+
+    value_exact: Fraction
+
+    @staticmethod
+    def parse(s: "str | int | float | Quantity") -> "Quantity":
+        if isinstance(s, Quantity):
+            return s
+        if isinstance(s, int):
+            return Quantity(Fraction(s))
+        if isinstance(s, float):
+            return Quantity(Fraction(str(s)))
+        text = s.strip()
+        if not text:
+            raise QuantityError("empty quantity")
+        # Split the numeric part from the suffix.
+        i = 0
+        if text[i] in "+-":
+            i += 1
+        seen_digit = False
+        while i < len(text) and (text[i].isdigit() or text[i] == "."):
+            if text[i].isdigit():
+                seen_digit = True
+            i += 1
+        num, suffix = text[:i], text[i:]
+        if not seen_digit:
+            raise QuantityError(f"invalid quantity {s!r}")
+        if suffix in _BINARY_SUFFIXES:
+            mult = _BINARY_SUFFIXES[suffix]
+        elif suffix in _DECIMAL_SUFFIXES:
+            mult = _DECIMAL_SUFFIXES[suffix]
+        elif suffix.startswith(("e", "E")) and suffix[1:].lstrip("+-").isdigit():
+            mult = Fraction(10) ** int(suffix[1:])
+        else:
+            raise QuantityError(f"invalid quantity suffix {suffix!r} in {s!r}")
+        try:
+            base = Fraction(num)
+        except (ValueError, ZeroDivisionError) as e:
+            raise QuantityError(f"invalid quantity {s!r}") from e
+        return Quantity(base * mult)
+
+    def value(self) -> int:
+        """Integer units, rounded up (Quantity.Value semantics)."""
+        v = self.value_exact
+        return -((-v.numerator) // v.denominator)  # ceil for positives, matches Go rounding up
+
+    def milli_value(self) -> int:
+        """1/1000 units, rounded up (Quantity.MilliValue semantics)."""
+        v = self.value_exact * 1000
+        return -((-v.numerator) // v.denominator)
+
+    def is_zero(self) -> bool:
+        return self.value_exact == 0
+
+    def __add__(self, other: "Quantity") -> "Quantity":
+        return Quantity(self.value_exact + other.value_exact)
+
+    def __lt__(self, other: "Quantity") -> bool:
+        return self.value_exact < other.value_exact
+
+    def __repr__(self) -> str:
+        return f"Quantity({str(self.value_exact)})"
+
+
+def parse_quantity(s) -> Quantity:
+    return Quantity.parse(s)

@@ -1,0 +1,112 @@
+"""Label and node-selector matching semantics (host-side / oracle).
+
+Implements the exact matching rules the scheduler relies on:
+- labels.SelectorFromSet / metav1.LabelSelectorAsSelector
+  (staging/src/k8s.io/apimachinery/pkg/labels, .../apis/meta/v1/helpers.go)
+- v1helper.MatchNodeSelectorTerms (pkg/apis/core/v1/helper/helpers.go), as
+  called from predicates.go:925 nodeMatchesNodeSelectorTerms.
+
+These are the single source of truth for string-world matching; the device
+kernels operate on interned ids compiled from the same structures and are
+parity-tested against these functions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .types import (
+    LabelSelector,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+)
+
+
+def match_label_selector(selector: Optional[LabelSelector], labels: Dict[str, str]) -> bool:
+    """metav1.LabelSelectorAsSelector: nil selector matches nothing; empty
+    selector matches everything; matchLabels AND matchExpressions all must hold."""
+    if selector is None:
+        return False
+    for k, v in selector.match_labels.items():
+        if labels.get(k) != v:
+            return False
+    for req in selector.match_expressions:
+        if not _match_label_requirement(req.key, req.operator, req.values, labels):
+            return False
+    return True
+
+
+def _match_label_requirement(key: str, op: str, values: List[str], labels: Dict[str, str]) -> bool:
+    present = key in labels
+    if op == "In":
+        return present and labels[key] in values
+    if op == "NotIn":
+        # labels.Requirement: NotIn is satisfied when the key is absent OR the
+        # value is not in the list.
+        return not present or labels[key] not in values
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return not present
+    raise ValueError(f"invalid label selector operator {op!r}")
+
+
+def match_node_selector_requirement(req: NodeSelectorRequirement, labels: Dict[str, str]) -> bool:
+    """nodeSelectorRequirementsAsSelector semantics, incl. Gt/Lt which parse
+    the node label value as an integer (labels.Requirement ParseInt64)."""
+    present = req.key in labels
+    op = req.operator
+    if op == "In":
+        return present and labels[req.key] in req.values
+    if op == "NotIn":
+        return not present or labels[req.key] not in req.values
+    if op == "Exists":
+        return present
+    if op == "DoesNotExist":
+        return not present
+    if op in ("Gt", "Lt"):
+        if not present or len(req.values) != 1:
+            return False
+        try:
+            lbl = int(labels[req.key])
+            val = int(req.values[0])
+        except ValueError:
+            return False
+        return lbl > val if op == "Gt" else lbl < val
+    raise ValueError(f"invalid node selector operator {op!r}")
+
+
+def match_node_selector_term(
+    term: NodeSelectorTerm, labels: Dict[str, str], fields: Optional[Dict[str, str]] = None
+) -> bool:
+    """A term with no (nil/empty) requirements matches nothing
+    (predicates.go:959-966 commentary); matchExpressions AND matchFields."""
+    if not term.match_expressions and not term.match_fields:
+        return False
+    for req in term.match_expressions:
+        if not match_node_selector_requirement(req, labels):
+            return False
+    for req in term.match_fields:
+        # NodeSelectorRequirementsAsFieldSelector (pkg/apis/core/v1/helper/helpers.go)
+        # supports only In/NotIn with exactly one value; any other op or
+        # cardinality is a conversion error, which makes the term match nothing.
+        if req.operator not in ("In", "NotIn") or len(req.values) != 1:
+            return False
+        if not match_node_selector_requirement(req, fields or {}):
+            return False
+    return True
+
+
+def match_node_selector_terms(
+    terms: List[NodeSelectorTerm], labels: Dict[str, str], fields: Optional[Dict[str, str]] = None
+) -> bool:
+    """Terms are ORed; an empty list matches nothing (predicates.go:922)."""
+    return any(match_node_selector_term(t, labels, fields) for t in terms)
+
+
+def node_matches_node_selector(ns: Optional[NodeSelector], node_labels: Dict[str, str], node_name: str = "") -> bool:
+    if ns is None:
+        return True
+    fields = {"metadata.name": node_name} if node_name else {}
+    return match_node_selector_terms(ns.node_selector_terms, node_labels, fields)

@@ -1,0 +1,57 @@
+"""String interning: the device-side representation of label strings.
+
+The reference matches label strings directly (labels.Set, predicates.go:979
+and friends). On TPU, strings can't live in kernels, so every distinct string
+(label key, "key=value" pair, taint triple, image name, topology value...)
+is assigned a dense int32 id by this interner. Matching becomes exact integer
+equality — no hash collisions by construction, unlike feature hashing.
+
+Id 0 is reserved as ABSENT/padding everywhere; real ids start at 1. The
+interner only grows; ids are stable for the life of the process, so device
+tensors patched incrementally across events never need re-encoding.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional
+
+ABSENT = 0
+
+
+class StringInterner:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._to_id: Dict[str, int] = {}
+        self._from_id: List[Optional[str]] = [None]  # index 0 = ABSENT
+
+    def intern(self, s: str) -> int:
+        with self._lock:
+            i = self._to_id.get(s)
+            if i is None:
+                i = len(self._from_id)
+                self._to_id[s] = i
+                self._from_id.append(s)
+            return i
+
+    def intern_kv(self, key: str, value: str) -> int:
+        # \x00 cannot appear in valid label keys/values, so this is injective.
+        return self.intern(key + "\x00" + value)
+
+    def lookup(self, s: str) -> int:
+        """Like intern but read-only: unknown string -> ABSENT."""
+        return self._to_id.get(s, ABSENT)
+
+    def lookup_kv(self, key: str, value: str) -> int:
+        return self._to_id.get(key + "\x00" + value, ABSENT)
+
+    def intern_all(self, strs: Iterable[str]) -> List[int]:
+        return [self.intern(s) for s in strs]
+
+    def string(self, i: int) -> Optional[str]:
+        if 0 <= i < len(self._from_id):
+            return self._from_id[i]
+        return None
+
+    def __len__(self) -> int:
+        return len(self._from_id) - 1

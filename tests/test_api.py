@@ -1,0 +1,230 @@
+import math
+
+import pytest
+
+from kubernetes_tpu.api import (
+    LabelSelector,
+    LabelSelectorRequirement,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Taint,
+    Toleration,
+    match_label_selector,
+    match_node_selector_terms,
+    parse_quantity,
+    pod_from_k8s,
+    pod_to_k8s,
+    node_from_k8s,
+    node_to_k8s,
+)
+from kubernetes_tpu.api.types import Container, ContainerPort, Pod
+from kubernetes_tpu.state.interner import ABSENT, StringInterner
+
+
+class TestQuantity:
+    @pytest.mark.parametrize(
+        "s,value",
+        [
+            ("1", 1),
+            ("100m", 1),  # 0.1 rounds up to 1
+            ("1500m", 2),
+            ("1Ki", 1024),
+            ("1Mi", 1 << 20),
+            ("2Gi", 2 << 30),
+            ("1k", 1000),
+            ("1G", 10**9),
+            ("1e3", 1000),
+            ("0.5", 1),
+        ],
+    )
+    def test_value_rounds_up(self, s, value):
+        assert parse_quantity(s).value() == value
+
+    @pytest.mark.parametrize(
+        "s,milli",
+        [("100m", 100), ("1", 1000), ("2.5", 2500), ("250m", 250), ("1m", 1), ("0.0001", 1)],
+    )
+    def test_milli_value(self, s, milli):
+        assert parse_quantity(s).milli_value() == milli
+
+    def test_invalid(self):
+        for bad in ["", "abc", "1Q", "--1"]:
+            with pytest.raises(ValueError):
+                parse_quantity(bad)
+
+
+class TestSelectors:
+    def test_label_selector_nil_matches_nothing(self):
+        assert not match_label_selector(None, {"a": "b"})
+
+    def test_label_selector_empty_matches_everything(self):
+        assert match_label_selector(LabelSelector(), {})
+        assert match_label_selector(LabelSelector(), {"a": "b"})
+
+    def test_match_labels(self):
+        sel = LabelSelector(match_labels={"app": "web"})
+        assert match_label_selector(sel, {"app": "web", "x": "y"})
+        assert not match_label_selector(sel, {"app": "db"})
+
+    def test_expressions(self):
+        sel = LabelSelector(
+            match_expressions=[
+                LabelSelectorRequirement("tier", "In", ["fe", "be"]),
+                LabelSelectorRequirement("canary", "DoesNotExist"),
+            ]
+        )
+        assert match_label_selector(sel, {"tier": "fe"})
+        assert not match_label_selector(sel, {"tier": "fe", "canary": "y"})
+        assert not match_label_selector(sel, {"tier": "mid"})
+
+    def test_notin_absent_key_matches(self):
+        sel = LabelSelector(match_expressions=[LabelSelectorRequirement("a", "NotIn", ["x"])])
+        assert match_label_selector(sel, {})
+
+    def test_node_selector_terms_ored_empty_matches_nothing(self):
+        assert not match_node_selector_terms([], {"a": "b"})
+        t1 = NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("zone", "In", ["us-a"])])
+        t2 = NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("zone", "In", ["us-b"])])
+        assert match_node_selector_terms([t1, t2], {"zone": "us-b"})
+        assert not match_node_selector_terms([t1, t2], {"zone": "us-c"})
+
+    def test_empty_term_matches_nothing(self):
+        assert not match_node_selector_terms([NodeSelectorTerm()], {"a": "b"})
+
+    def test_gt_lt(self):
+        gt = NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("cores", "Gt", ["8"])])
+        assert match_node_selector_terms([gt], {"cores": "16"})
+        assert not match_node_selector_terms([gt], {"cores": "8"})
+        assert not match_node_selector_terms([gt], {"cores": "abc"})
+        assert not match_node_selector_terms([gt], {})
+
+
+class TestTolerations:
+    def test_exists_empty_key_tolerates_all(self):
+        t = Toleration(operator="Exists")
+        assert t.tolerates(Taint("any", "v", "NoSchedule"))
+        assert t.tolerates(Taint("other", "", "NoExecute"))
+
+    def test_equal(self):
+        t = Toleration(key="k", operator="Equal", value="v", effect="NoSchedule")
+        assert t.tolerates(Taint("k", "v", "NoSchedule"))
+        assert not t.tolerates(Taint("k", "w", "NoSchedule"))
+        assert not t.tolerates(Taint("k", "v", "NoExecute"))
+
+    def test_empty_effect_matches_all_effects(self):
+        t = Toleration(key="k", operator="Exists")
+        assert t.tolerates(Taint("k", "v", "NoExecute"))
+
+
+class TestPodResources:
+    def test_max_of_init_and_sum_of_containers(self):
+        pod = Pod(
+            name="p",
+            containers=[
+                Container(requests={"cpu": parse_quantity("100m"), "memory": parse_quantity("1Gi")}),
+                Container(requests={"cpu": parse_quantity("200m")}),
+            ],
+            init_containers=[Container(requests={"cpu": parse_quantity("250m"), "memory": parse_quantity("2Gi")})],
+        )
+        req = pod.resource_request()
+        assert req["cpu"] == 300  # sum(100,200) > init 250
+        assert req["memory"] == 2 << 30  # init container dominates
+
+    def test_host_ports(self):
+        pod = Pod(
+            name="p",
+            containers=[
+                Container(ports=[ContainerPort(host_port=80, protocol="TCP"), ContainerPort(container_port=8080)])
+            ],
+        )
+        assert pod.host_ports() == [("TCP", "0.0.0.0", 80)]
+
+
+class TestRoundTrip:
+    def test_pod_round_trip(self):
+        obj = {
+            "metadata": {"name": "p1", "namespace": "ns", "labels": {"app": "x"}},
+            "spec": {
+                "priority": 10,
+                "nodeSelector": {"disk": "ssd"},
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "nginx:1.2",
+                        "resources": {"requests": {"cpu": "500m", "memory": "128Mi"}},
+                        "ports": [{"hostPort": 80, "containerPort": 80, "protocol": "TCP"}],
+                    }
+                ],
+                "tolerations": [{"key": "k", "operator": "Exists", "effect": "NoSchedule"}],
+                "affinity": {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a"]}]}
+                            ]
+                        }
+                    },
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {"matchLabels": {"app": "x"}},
+                                "topologyKey": "kubernetes.io/hostname",
+                            }
+                        ]
+                    },
+                },
+                "topologySpreadConstraints": [
+                    {
+                        "maxSkew": 2,
+                        "topologyKey": "zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": "x"}},
+                    }
+                ],
+            },
+        }
+        pod = pod_from_k8s(obj)
+        assert pod.get_priority() == 10
+        assert pod.resource_request() == {"cpu": 500, "memory": 128 << 20}
+        assert pod.affinity.pod_anti_affinity.required[0].topology_key == "kubernetes.io/hostname"
+        assert pod.topology_spread_constraints[0].max_skew == 2
+        pod2 = pod_from_k8s(pod_to_k8s(pod))
+        assert pod2.resource_request() == pod.resource_request()
+        assert pod2.node_selector == pod.node_selector
+        assert pod2.tolerations == pod.tolerations
+        assert pod2.affinity == pod.affinity
+
+    def test_node_round_trip(self):
+        obj = {
+            "metadata": {"name": "n1", "labels": {"zone": "a"}},
+            "spec": {"unschedulable": True, "taints": [{"key": "k", "value": "v", "effect": "NoSchedule"}]},
+            "status": {
+                "allocatable": {"cpu": "4", "memory": "16Gi", "pods": "110"},
+                "capacity": {"cpu": "4", "memory": "16Gi", "pods": "110"},
+                "images": [{"names": ["nginx:1.2"], "sizeBytes": 100000}],
+            },
+        }
+        node = node_from_k8s(obj)
+        assert node.unschedulable
+        assert node.allocatable_int() == {"cpu": 4000, "memory": 16 << 30, "pods": 110}
+        node2 = node_from_k8s(node_to_k8s(node))
+        assert node2.taints == node.taints
+        assert node2.allocatable_int() == node.allocatable_int()
+
+
+class TestInterner:
+    def test_basic(self):
+        it = StringInterner()
+        a = it.intern("app")
+        b = it.intern("tier")
+        assert a != b and a != ABSENT and b != ABSENT
+        assert it.intern("app") == a
+        assert it.lookup("app") == a
+        assert it.lookup("nope") == ABSENT
+        assert it.string(a) == "app"
+        assert len(it) == 2
+
+    def test_kv_injective(self):
+        it = StringInterner()
+        assert it.intern_kv("a", "b=c") != it.intern_kv("a=b", "c")
+        assert it.lookup_kv("a", "b=c") == it.intern_kv("a", "b=c")
