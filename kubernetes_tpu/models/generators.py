@@ -1,0 +1,292 @@
+"""Randomized cluster/workload generators.
+
+The TPU-native replacement for test/utils/runners.go's prepare strategies
+(TrivialNodePrepareStrategy, NewCustomCreatePodStrategy, ...) and the
+scheduler_perf config matrix (test/integration/scheduler_perf/
+scheduler_bench_test.go:52-283): seeded, property-based generators producing
+clusters that exercise every predicate/priority, used both for oracle-vs-
+device parity tests and for benchmark population.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from ..api.quantity import Quantity
+from ..api.types import (
+    Affinity,
+    Container,
+    ContainerImage,
+    ContainerPort,
+    LabelSelector,
+    LabelSelectorRequirement,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PreferredSchedulingTerm,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+
+ZONES = ["zone-a", "zone-b", "zone-c"]
+REGIONS = ["region-1", "region-2"]
+APP_NAMES = ["web", "db", "cache", "queue", "batch"]
+ENV_VALUES = ["prod", "staging", "dev"]
+NAMESPACES = ["default", "kube-system", "team-a", "team-b"]
+TAINT_KEYS = ["dedicated", "gpu", "spot"]
+IMAGES = [f"registry.local/app-{i}:v1" for i in range(8)]
+
+
+def q(v) -> Quantity:
+    return Quantity.parse(v)
+
+
+def make_node(
+    name: str,
+    cpu_milli: int = 4000,
+    mem: int = 16 * 2**30,
+    pods: int = 110,
+    labels: Optional[Dict[str, str]] = None,
+    taints: Optional[List[Taint]] = None,
+    unschedulable: bool = False,
+    images: Optional[List[ContainerImage]] = None,
+) -> Node:
+    alloc = {
+        RESOURCE_CPU: Quantity.parse(f"{cpu_milli}m"),
+        RESOURCE_MEMORY: Quantity.parse(mem),
+        RESOURCE_PODS: Quantity.parse(pods),
+    }
+    return Node(
+        name=name,
+        labels=dict(labels or {}),
+        taints=list(taints or []),
+        unschedulable=unschedulable,
+        capacity=dict(alloc),
+        allocatable=alloc,
+        images=list(images or []),
+    )
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    cpu_milli: int = 100,
+    mem: int = 128 * 2**20,
+    labels: Optional[Dict[str, str]] = None,
+    node_name: str = "",
+    **kwargs,
+) -> Pod:
+    requests = {}
+    if cpu_milli:
+        requests[RESOURCE_CPU] = Quantity.parse(f"{cpu_milli}m")
+    if mem:
+        requests[RESOURCE_MEMORY] = Quantity.parse(mem)
+    return Pod(
+        name=name,
+        namespace=namespace,
+        labels=dict(labels or {}),
+        node_name=node_name,
+        containers=[Container(name="main", image=IMAGES[0], requests=requests)],
+        **kwargs,
+    )
+
+
+class ClusterGen:
+    """Seeded random cluster generator exercising all scheduling features."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+
+    def node(self, i: int, feature_rate: float = 0.3) -> Node:
+        rng = self.rng
+        labels = {
+            "kubernetes.io/hostname": f"node-{i}",
+            "failure-domain.beta.kubernetes.io/zone": rng.choice(ZONES),
+            "failure-domain.beta.kubernetes.io/region": rng.choice(REGIONS),
+            "instance-type": rng.choice(["small", "medium", "large"]),
+        }
+        if rng.random() < feature_rate:
+            labels["disk"] = rng.choice(["ssd", "hdd"])
+        if rng.random() < feature_rate / 2:
+            labels["cores"] = str(rng.randint(1, 64))
+        taints = []
+        if rng.random() < feature_rate / 2:
+            taints.append(
+                Taint(
+                    key=rng.choice(TAINT_KEYS),
+                    value=rng.choice(["true", "team-a", ""]),
+                    effect=rng.choice(["NoSchedule", "PreferNoSchedule", "NoExecute"]),
+                )
+            )
+        images = []
+        for img in IMAGES:
+            if rng.random() < 0.3:
+                images.append(ContainerImage(names=[img], size_bytes=rng.randint(10, 900) * 2**20))
+        return make_node(
+            f"node-{i}",
+            cpu_milli=rng.choice([2000, 4000, 8000, 16000]),
+            mem=rng.choice([4, 8, 16, 32]) * 2**30,
+            pods=rng.choice([32, 64, 110]),
+            labels=labels,
+            taints=taints,
+            unschedulable=rng.random() < 0.03,
+            images=images,
+        )
+
+    def _label_selector(self) -> LabelSelector:
+        rng = self.rng
+        if rng.random() < 0.6:
+            return LabelSelector(match_labels={"app": rng.choice(APP_NAMES)})
+        return LabelSelector(
+            match_expressions=[
+                LabelSelectorRequirement(
+                    key=rng.choice(["app", "env"]),
+                    operator=rng.choice(["In", "NotIn", "Exists", "DoesNotExist"]),
+                    values=[rng.choice(APP_NAMES + ENV_VALUES)],
+                )
+            ]
+        )
+
+    def _affinity_term(self) -> PodAffinityTerm:
+        rng = self.rng
+        return PodAffinityTerm(
+            label_selector=self._label_selector(),
+            namespaces=[rng.choice(NAMESPACES)] if rng.random() < 0.3 else [],
+            topology_key=rng.choice(
+                [
+                    "kubernetes.io/hostname",
+                    "failure-domain.beta.kubernetes.io/zone",
+                    "failure-domain.beta.kubernetes.io/region",
+                ]
+            ),
+        )
+
+    def pod(
+        self,
+        i: int,
+        feature_rate: float = 0.3,
+        namespace: Optional[str] = None,
+        node_name: str = "",
+    ) -> Pod:
+        rng = self.rng
+        labels = {"app": rng.choice(APP_NAMES), "env": rng.choice(ENV_VALUES)}
+        pod = make_pod(
+            f"pod-{i}",
+            namespace=namespace if namespace is not None else rng.choice(NAMESPACES),
+            cpu_milli=rng.choice([0, 50, 100, 250, 500, 1000]),
+            mem=rng.choice([0, 64, 128, 256, 512]) * 2**20,
+            labels=labels,
+            node_name=node_name,
+            priority=rng.choice([None, 0, 100, 1000]),
+        )
+        pod.containers[0].image = rng.choice(IMAGES)
+        if rng.random() < feature_rate:
+            pod.node_selector = {"instance-type": rng.choice(["small", "medium", "large"])}
+        if rng.random() < feature_rate / 2:
+            pod.containers[0].ports = [
+                ContainerPort(
+                    host_port=rng.choice([8080, 9090, 9091]),
+                    container_port=8080,
+                    protocol=rng.choice(["TCP", "UDP"]),
+                    host_ip=rng.choice(["", "0.0.0.0", "127.0.0.1"]),
+                )
+            ]
+        if rng.random() < feature_rate:
+            pod.tolerations = [
+                Toleration(
+                    key=rng.choice(TAINT_KEYS + [""]),
+                    operator=rng.choice(["Equal", "Exists"]),
+                    value=rng.choice(["true", "team-a", ""]),
+                    effect=rng.choice(["NoSchedule", "NoExecute", "PreferNoSchedule", ""]),
+                )
+            ]
+        affinity = Affinity()
+        has_affinity = False
+        if rng.random() < feature_rate:
+            has_affinity = True
+            req = None
+            if rng.random() < 0.7:
+                req = NodeSelector(
+                    node_selector_terms=[
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key=rng.choice(["disk", "instance-type", "cores"]),
+                                    operator=rng.choice(
+                                        ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"]
+                                    ),
+                                    values=[rng.choice(["ssd", "hdd", "small", "large", "8", "32"])],
+                                )
+                            ]
+                        )
+                    ]
+                )
+            affinity.node_affinity = NodeAffinity(
+                required=req,
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=rng.randint(1, 100),
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    key="instance-type", operator="In", values=[rng.choice(["small", "large"])]
+                                )
+                            ]
+                        ),
+                    )
+                ]
+                if rng.random() < 0.5
+                else [],
+            )
+        if rng.random() < feature_rate / 2:
+            has_affinity = True
+            term = self._affinity_term()
+            wterm = WeightedPodAffinityTerm(weight=rng.randint(1, 100), pod_affinity_term=self._affinity_term())
+            if rng.random() < 0.5:
+                affinity.pod_affinity = PodAffinity(
+                    required=[term] if rng.random() < 0.6 else [],
+                    preferred=[wterm] if rng.random() < 0.6 else [],
+                )
+            else:
+                affinity.pod_anti_affinity = PodAntiAffinity(
+                    required=[term] if rng.random() < 0.6 else [],
+                    preferred=[wterm] if rng.random() < 0.6 else [],
+                )
+        if has_affinity:
+            pod.affinity = affinity
+        if rng.random() < feature_rate / 2:
+            pod.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=rng.randint(1, 3),
+                    topology_key=rng.choice(
+                        ["failure-domain.beta.kubernetes.io/zone", "kubernetes.io/hostname"]
+                    ),
+                    when_unsatisfiable=rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                    label_selector=self._label_selector(),
+                )
+            ]
+        return pod
+
+    def cluster(
+        self, n_nodes: int, n_existing: int, feature_rate: float = 0.3
+    ) -> tuple[List[Node], List[Pod]]:
+        nodes = [self.node(i, feature_rate) for i in range(n_nodes)]
+        existing = []
+        for i in range(n_existing):
+            node = self.rng.choice(nodes)
+            existing.append(
+                self.pod(i, feature_rate, node_name=node.name)
+            )
+        return nodes, existing

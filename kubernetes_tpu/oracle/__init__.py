@@ -1,0 +1,28 @@
+"""Scalar Python reference semantics ("the oracle") for parity testing.
+
+This package re-states the reference scheduler's exact Filter/Score
+semantics in plain Python (see predicates.py, priorities.py). Device kernels
+in kubernetes_tpu/ops are validated bit-for-bit against these functions on
+randomized clusters (SURVEY.md section 4 "Implication for the build").
+"""
+
+from .nodeinfo import NodeInfo, Snapshot, get_zone_key
+from .predicates import (
+    PredicateMetadata,
+    compute_predicate_metadata,
+    find_nodes_that_fit,
+    pod_fits_on_node,
+)
+from .priorities import MAX_NODE_SCORE, prioritize_nodes
+
+__all__ = [
+    "NodeInfo",
+    "Snapshot",
+    "get_zone_key",
+    "PredicateMetadata",
+    "compute_predicate_metadata",
+    "find_nodes_that_fit",
+    "pod_fits_on_node",
+    "MAX_NODE_SCORE",
+    "prioritize_nodes",
+]

@@ -1,0 +1,205 @@
+"""Host-side cluster snapshot views used by the oracle (scalar reference
+semantics) and by the tensorization layer.
+
+Mirrors the role of pkg/scheduler/nodeinfo/node_info.go: a per-node aggregate
+of the scheduling-relevant state (requested resources, pod list, used host
+ports, pods with affinity), plus a Snapshot keyed by node name like
+nodeinfo.Snapshot (pkg/scheduler/nodeinfo/snapshot.go:22).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api.types import (
+    Node,
+    Pod,
+    RESOURCE_CPU,
+    RESOURCE_EPHEMERAL_STORAGE,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+)
+
+DEFAULT_BIND_ALL_HOST_IP = "0.0.0.0"
+
+# Kubernetes zone/region label keys (v1.LabelZoneFailureDomain / LabelZoneRegion).
+LABEL_ZONE_FAILURE_DOMAIN = "failure-domain.beta.kubernetes.io/zone"
+LABEL_ZONE_REGION = "failure-domain.beta.kubernetes.io/region"
+
+
+def get_zone_key(node: Node) -> str:
+    """utilnode.GetZoneKey (pkg/util/node/node.go): region + zone combined;
+    empty string when neither label is present."""
+    region = node.labels.get(LABEL_ZONE_REGION, "")
+    zone = node.labels.get(LABEL_ZONE_FAILURE_DOMAIN, "")
+    if not region and not zone:
+        return ""
+    return region + ":\x00:" + zone
+
+
+def pod_has_affinity_constraints(pod: Pod) -> bool:
+    """nodeinfo tracks podsWithAffinity = pods with affinity OR anti-affinity
+    (node_info.go AddPod -> hasPodAffinityConstraints)."""
+    a = pod.affinity
+    return a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None)
+
+
+@dataclass
+class NodeInfo:
+    """Per-node scheduling aggregate (reference: nodeinfo/node_info.go:48)."""
+
+    node: Node
+    pods: List[Pod] = field(default_factory=list)
+
+    def pods_with_affinity(self) -> List[Pod]:
+        return [p for p in self.pods if pod_has_affinity_constraints(p)]
+
+    def requested(self) -> Dict[str, int]:
+        """RequestedResource per calculateResource (node_info.go): sum of
+        container requests + overhead — NOTE: unlike the incoming pod's
+        GetResourceRequest, init-container maxima are NOT included."""
+        total: Dict[str, int] = {}
+        for p in self.pods:
+            for name, v in accumulated_request(p).items():
+                total[name] = total.get(name, 0) + v
+        return total
+
+    def non_zero_requested(self) -> Tuple[int, int]:
+        """nonzeroRequest (milliCPU, memoryBytes): per container,
+        max(request, default 100m / 200Mi) — priorityutil.GetNonzeroRequests;
+        plus overhead when present (calculateResource, node_info.go)."""
+        cpu = 0
+        mem = 0
+        for p in self.pods:
+            c, m = pod_non_zero_request(p)
+            cpu += c
+            mem += m
+        return cpu, mem
+
+    def allowed_pod_number(self) -> int:
+        q = self.node.allocatable.get(RESOURCE_PODS)
+        return q.value() if q is not None else 0
+
+    def used_host_ports(self) -> Set[Tuple[str, str, int]]:
+        """(protocol, hostIP, hostPort) triples across pods (HostPortInfo)."""
+        used: Set[Tuple[str, str, int]] = set()
+        for p in self.pods:
+            used.update(p.host_ports())  # host_ports() already defaults proto/ip
+        return used
+
+    def host_port_conflict(self, pod: Pod) -> bool:
+        """HostPortInfo.CheckConflict semantics (nodeinfo/host_ports.go):
+        0.0.0.0 conflicts with every IP for the same (protocol, port)."""
+        used = self.used_host_ports()
+        for proto, ip, port in pod.host_ports():
+            if port <= 0:
+                continue
+            if ip == DEFAULT_BIND_ALL_HOST_IP:
+                if any(u_port == port and u_proto == proto for u_proto, _, u_port in used):
+                    return True
+            else:
+                for u_proto, u_ip, u_port in used:
+                    if u_port == port and u_proto == proto and u_ip in (DEFAULT_BIND_ALL_HOST_IP, ip):
+                        return True
+        return False
+
+    def image_sizes(self) -> Dict[str, int]:
+        """image name -> size (nodeinfo imageStates, keyed by normalized name)."""
+        out: Dict[str, int] = {}
+        for img in self.node.images:
+            for name in img.names:
+                out[normalized_image_name(name)] = img.size_bytes
+        return out
+
+
+# Defaults for pods with no explicit cpu/memory request, used only for
+# scoring (priorityutil non_zero.go:26-29).
+DEFAULT_MILLI_CPU_REQUEST = 100
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
+
+
+def accumulated_request(pod: Pod) -> Dict[str, int]:
+    """calculateResource's `res` (node_info.go): container request sums +
+    overhead; init containers excluded (unlike GetResourceRequest)."""
+    total: Dict[str, int] = {}
+    for c in pod.containers:
+        for name, q in c.requests.items():
+            v = q.milli_value() if name == RESOURCE_CPU else q.value()
+            total[name] = total.get(name, 0) + v
+    for name, q in pod.overhead.items():
+        v = q.milli_value() if name == RESOURCE_CPU else q.value()
+        total[name] = total.get(name, 0) + v
+    return total
+
+
+def pod_non_zero_request(pod: Pod) -> Tuple[int, int]:
+    """(milliCPU, memBytes) with per-container defaulting of unset requests."""
+    cpu = 0
+    mem = 0
+    for c in pod.containers:
+        q = c.requests.get(RESOURCE_CPU)
+        cpu += q.milli_value() if q is not None else DEFAULT_MILLI_CPU_REQUEST
+        q = c.requests.get(RESOURCE_MEMORY)
+        mem += q.value() if q is not None else DEFAULT_MEMORY_REQUEST
+    q = pod.overhead.get(RESOURCE_CPU)
+    if q is not None:
+        cpu += q.milli_value()
+    q = pod.overhead.get(RESOURCE_MEMORY)
+    if q is not None:
+        mem += q.value()
+    return cpu, mem
+
+
+def normalized_image_name(name: str) -> str:
+    """parsers.ParseImageName default-tag normalization: bare names get :latest
+    (pkg/util/parsers; used by image_locality.go normalizedImageName)."""
+    if ":" not in name.split("/")[-1] and "@" not in name:
+        return name + ":latest"
+    return name
+
+
+class Snapshot:
+    """Cluster snapshot: node name -> NodeInfo; the oracle's equivalent of
+    nodeNameToInfo maps passed through the reference algorithm."""
+
+    def __init__(self, nodes: Optional[List[Node]] = None, pods: Optional[List[Pod]] = None):
+        self.node_infos: Dict[str, NodeInfo] = {}
+        for n in nodes or []:
+            self.add_node(n)
+        for p in pods or []:
+            if p.node_name:
+                self.assign(p)
+
+    def add_node(self, node: Node) -> NodeInfo:
+        ni = NodeInfo(node=node)
+        self.node_infos[node.name] = ni
+        return ni
+
+    def assign(self, pod: Pod) -> None:
+        ni = self.node_infos.get(pod.node_name)
+        if ni is None:
+            # pods on unknown nodes are tracked nowhere in the snapshot
+            # (reference keeps a headless NodeInfo; scheduling never sees it)
+            return
+        ni.pods.append(pod)
+
+    def get(self, name: str) -> Optional[NodeInfo]:
+        return self.node_infos.get(name)
+
+    def nodes(self) -> List[Node]:
+        return [ni.node for ni in self.node_infos.values()]
+
+    def all_pods(self) -> List[Pod]:
+        out: List[Pod] = []
+        for ni in self.node_infos.values():
+            out.extend(ni.pods)
+        return out
+
+    def total_image_nodes(self) -> Dict[str, int]:
+        """image name -> number of nodes that have it (ImageStateSummary.NumNodes)."""
+        counts: Dict[str, int] = {}
+        for ni in self.node_infos.values():
+            for name in ni.image_sizes():
+                counts[name] = counts.get(name, 0) + 1
+        return counts

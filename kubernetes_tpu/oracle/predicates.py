@@ -1,0 +1,400 @@
+"""Oracle predicates: scalar reference semantics for every Filter.
+
+Re-implements, in plain Python over the typed API objects, the exact
+feasibility semantics of pkg/scheduler/algorithm/predicates/predicates.go.
+This module is the single source of truth the vectorized device kernels
+(kubernetes_tpu/ops/filters.py, topology.py) are parity-tested against.
+
+Where the reference has two code paths (precomputed predicateMetadata vs the
+slow path), this oracle implements the METADATA path — that is what runs in
+the production scheduler (GetPredicateMetadata is always installed by the
+default algorithm provider) and what the vectorized kernels model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api.selectors import (
+    match_label_selector,
+    node_matches_node_selector,
+)
+from ..api.types import (
+    Affinity,
+    Pod,
+    PodAffinityTerm,
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    TAINT_NODE_UNSCHEDULABLE,
+    Taint,
+    TopologySpreadConstraint,
+    DO_NOT_SCHEDULE,
+    tolerations_tolerate_taint,
+)
+from .nodeinfo import NodeInfo, Snapshot
+
+# Failure reason strings (mirror predicates.Err* for debuggability).
+ERR_NODE_UNSCHEDULABLE = "NodeUnschedulable"
+ERR_POD_NOT_FIT_HOST = "PodFitsHost"
+ERR_POD_NOT_FIT_PORTS = "PodFitsHostPorts"
+ERR_NODE_SELECTOR_NOT_MATCH = "MatchNodeSelector"
+ERR_INSUFFICIENT = "Insufficient {}"
+ERR_TAINTS = "PodToleratesNodeTaints"
+ERR_TOPOLOGY_SPREAD = "EvenPodsSpreadNotMatch"
+ERR_POD_AFFINITY = "MatchInterPodAffinity"
+
+
+# ---------------------------------------------------------------------------
+# Simple per-node predicates
+# ---------------------------------------------------------------------------
+
+def check_node_unschedulable(pod: Pod, node_info: NodeInfo) -> bool:
+    """CheckNodeUnschedulablePredicate (predicates.go:1584): unschedulable
+    nodes pass only if the pod tolerates the unschedulable taint."""
+    if not node_info.node.unschedulable:
+        return True
+    return tolerations_tolerate_taint(
+        pod.tolerations,
+        Taint(key=TAINT_NODE_UNSCHEDULABLE, effect=TAINT_NO_SCHEDULE),
+    )
+
+
+def pod_fits_host(pod: Pod, node_info: NodeInfo) -> bool:
+    """PodFitsHost (predicates.go:991): spec.nodeName pinning."""
+    if not pod.node_name:
+        return True
+    return pod.node_name == node_info.node.name
+
+
+def pod_fits_host_ports(pod: Pod, node_info: NodeInfo) -> bool:
+    """PodFitsHostPorts (predicates.go:1161) via HostPortInfo conflicts."""
+    if not pod.host_ports():
+        return True
+    return not node_info.host_port_conflict(pod)
+
+
+def pod_match_node_selector(pod: Pod, node_info: NodeInfo) -> bool:
+    """PodMatchNodeSelector (predicates.go:979) =
+    PodMatchesNodeSelectorAndAffinityTerms: spec.nodeSelector (all labels
+    must match) AND nodeAffinity.required terms (ORed)."""
+    node = node_info.node
+    for k, v in pod.node_selector.items():
+        if node.labels.get(k) != v:
+            return False
+    aff = pod.affinity
+    if aff is not None and aff.node_affinity is not None and aff.node_affinity.required is not None:
+        return node_matches_node_selector(aff.node_affinity.required, node.labels, node.name)
+    return True
+
+
+def pod_fits_resources(pod: Pod, node_info: NodeInfo) -> bool:
+    """PodFitsResources (predicates.go:854): pod count always checked; cpu,
+    memory, ephemeral-storage and scalar resources checked only if the pod
+    requests anything at all."""
+    if len(node_info.pods) + 1 > node_info.allowed_pod_number():
+        return False
+    req = pod.resource_request()
+    interesting = {k: v for k, v in req.items() if v != 0}
+    if not interesting:
+        return True
+    alloc = node_info.node.allocatable_int()
+    used = node_info.requested()
+    for name, r in interesting.items():
+        if name == "pods":
+            continue
+        if alloc.get(name, 0) < r + used.get(name, 0):
+            return False
+    return True
+
+
+def pod_tolerates_node_taints(pod: Pod, node_info: NodeInfo) -> bool:
+    """PodToleratesNodeTaints (predicates.go:1604): only NoSchedule/NoExecute
+    taints matter; every such taint must be tolerated."""
+    for taint in node_info.node.taints:
+        if taint.effect not in (TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE):
+            continue
+        if not tolerations_tolerate_taint(pod.tolerations, taint):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# EvenPodsSpread (hard topology spread constraints)
+# ---------------------------------------------------------------------------
+
+def get_hard_spread_constraints(pod: Pod) -> List[TopologySpreadConstraint]:
+    return [c for c in pod.topology_spread_constraints if c.when_unsatisfiable == DO_NOT_SCHEDULE]
+
+
+def get_soft_spread_constraints(pod: Pod) -> List[TopologySpreadConstraint]:
+    return [c for c in pod.topology_spread_constraints if c.when_unsatisfiable != DO_NOT_SCHEDULE]
+
+
+def pod_matches_spread_constraint(pod_labels: Dict[str, str], c: TopologySpreadConstraint) -> bool:
+    """PodMatchesSpreadConstraint (metadata.go:499): nil selector matches
+    nothing (LabelSelectorAsSelector of nil -> Nothing)."""
+    return match_label_selector(c.label_selector, pod_labels)
+
+
+def node_labels_match_spread_constraints(
+    node_labels: Dict[str, str], constraints: List[TopologySpreadConstraint]
+) -> bool:
+    """metadata.go:511: node must carry ALL topology keys."""
+    return all(c.topology_key in node_labels for c in constraints)
+
+
+@dataclass
+class EvenPodsSpreadMetadata:
+    """getEvenPodsSpreadMetadata (metadata.go:399): per-(key,value) counts of
+    same-namespace pods matching each constraint's selector, over candidate
+    nodes (nodes passing the incoming pod's node selector/affinity and
+    carrying all topology keys), plus the per-key global minimum."""
+
+    tp_pair_to_match_num: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    tp_key_min_match: Dict[str, int] = field(default_factory=dict)
+
+
+def compute_even_pods_spread_metadata(pod: Pod, snapshot: Snapshot) -> Optional[EvenPodsSpreadMetadata]:
+    constraints = get_hard_spread_constraints(pod)
+    if not constraints:
+        return None
+    m = EvenPodsSpreadMetadata()
+    for ni in snapshot.node_infos.values():
+        node = ni.node
+        if not pod_match_node_selector(pod, ni):
+            continue
+        if not node_labels_match_spread_constraints(node.labels, constraints):
+            continue
+        for c in constraints:
+            match_total = sum(
+                1
+                for ep in ni.pods
+                if ep.namespace == pod.namespace and pod_matches_spread_constraint(ep.labels, c)
+            )
+            pair = (c.topology_key, node.labels[c.topology_key])
+            m.tp_pair_to_match_num[pair] = m.tp_pair_to_match_num.get(pair, 0) + match_total
+    for (key, _), num in m.tp_pair_to_match_num.items():
+        cur = m.tp_key_min_match.get(key)
+        if cur is None or num < cur:
+            m.tp_key_min_match[key] = num
+    return m
+
+
+def even_pods_spread_predicate(
+    pod: Pod, node_info: NodeInfo, meta: Optional[EvenPodsSpreadMetadata]
+) -> bool:
+    """EvenPodsSpreadPredicate (predicates.go:1778): per hard constraint,
+    matchNum(node's pair) + selfMatch - minMatchNum(key) <= maxSkew; node must
+    carry the topology key."""
+    constraints = get_hard_spread_constraints(pod)
+    if not constraints:
+        return True
+    if meta is None or not meta.tp_pair_to_match_num:
+        return True
+    node = node_info.node
+    for c in constraints:
+        tp_val = node.labels.get(c.topology_key)
+        if tp_val is None:
+            return False
+        self_match = 1 if pod_matches_spread_constraint(pod.labels, c) else 0
+        if c.topology_key not in meta.tp_key_min_match:
+            continue  # "error which should not happen" branch: skip constraint
+        min_match = meta.tp_key_min_match[c.topology_key]
+        match_num = meta.tp_pair_to_match_num.get((c.topology_key, tp_val), 0)
+        if match_num + self_match - min_match > c.max_skew:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity
+# ---------------------------------------------------------------------------
+
+def get_pod_affinity_terms(affinity: Optional[Affinity]) -> List[PodAffinityTerm]:
+    """GetPodAffinityTerms: required terms only."""
+    if affinity is None or affinity.pod_affinity is None:
+        return []
+    return list(affinity.pod_affinity.required)
+
+
+def get_pod_anti_affinity_terms(affinity: Optional[Affinity]) -> List[PodAffinityTerm]:
+    if affinity is None or affinity.pod_anti_affinity is None:
+        return []
+    return list(affinity.pod_anti_affinity.required)
+
+
+def term_namespaces(owner: Pod, term: PodAffinityTerm) -> Set[str]:
+    """priorityutil.GetNamespacesFromPodAffinityTerm: empty -> owner's ns."""
+    return set(term.namespaces) if term.namespaces else {owner.namespace}
+
+
+def pod_matches_term(target: Pod, owner: Pod, term: PodAffinityTerm) -> bool:
+    """PodMatchesTermsNamespaceAndSelector for one term."""
+    if target.namespace not in term_namespaces(owner, term):
+        return False
+    return match_label_selector(term.label_selector, target.labels)
+
+
+def pod_matches_all_term_properties(target: Pod, owner: Pod, terms: List[PodAffinityTerm]) -> bool:
+    """podMatchesAllAffinityTermProperties: target must match (ns, selector)
+    of every term. Empty terms -> False (getAffinityTermProperties of [])."""
+    if not terms:
+        return False
+    return all(pod_matches_term(target, owner, t) for t in terms)
+
+
+@dataclass
+class PodAffinityMetadata:
+    """podAffinityMetadata (metadata.go:~360): three topology-pair sets."""
+
+    # (key, value) pairs where scheduling the incoming pod violates an
+    # EXISTING pod's required anti-affinity. Node fails if any of its own
+    # labels is in this set.
+    existing_anti_pairs: Set[Tuple[str, str]] = field(default_factory=set)
+    # (key, value) pairs from existing pods matching ALL of the incoming
+    # pod's required affinity terms' properties.
+    incoming_affinity_pairs: Set[Tuple[str, str]] = field(default_factory=set)
+    # (key, value) pairs from existing pods matching each of the incoming
+    # pod's required anti-affinity terms.
+    incoming_anti_pairs: Set[Tuple[str, str]] = field(default_factory=set)
+
+
+def compute_pod_affinity_metadata(pod: Pod, snapshot: Snapshot) -> PodAffinityMetadata:
+    m = PodAffinityMetadata()
+    affinity_terms = get_pod_affinity_terms(pod.affinity)
+    anti_terms = get_pod_anti_affinity_terms(pod.affinity)
+
+    for ni in snapshot.node_infos.values():
+        node = ni.node
+        # Existing pods' required anti-affinity vs the incoming pod
+        # (getTPMapMatchingExistingAntiAffinity).
+        for ep in ni.pods_with_affinity():
+            for term in get_pod_anti_affinity_terms(ep.affinity):
+                if pod_matches_term(pod, ep, term):
+                    if term.topology_key in node.labels:
+                        m.existing_anti_pairs.add((term.topology_key, node.labels[term.topology_key]))
+        # Incoming pod's terms vs existing pods
+        # (getTPMapMatchingIncomingAffinityAntiAffinity).
+        if affinity_terms or anti_terms:
+            for ep in ni.pods:
+                if affinity_terms and pod_matches_all_term_properties(ep, pod, affinity_terms):
+                    for term in affinity_terms:
+                        if term.topology_key in node.labels:
+                            m.incoming_affinity_pairs.add(
+                                (term.topology_key, node.labels[term.topology_key])
+                            )
+                for term in anti_terms:
+                    if pod_matches_term(ep, pod, term):
+                        if term.topology_key in node.labels:
+                            m.incoming_anti_pairs.add(
+                                (term.topology_key, node.labels[term.topology_key])
+                            )
+    return m
+
+
+def inter_pod_affinity_matches(
+    pod: Pod, node_info: NodeInfo, meta: PodAffinityMetadata
+) -> bool:
+    """InterPodAffinityMatches (predicates.go:1269), metadata path."""
+    node = node_info.node
+    # 1. satisfiesExistingPodsAntiAffinity: any of the node's own label pairs
+    # present in the existing-anti set -> fail.
+    for k, v in node.labels.items():
+        if (k, v) in meta.existing_anti_pairs:
+            return False
+
+    affinity = pod.affinity
+    if affinity is None or (affinity.pod_affinity is None and affinity.pod_anti_affinity is None):
+        return True
+
+    # 2. Pod's own required affinity: node must match topology of ALL terms.
+    affinity_terms = get_pod_affinity_terms(affinity)
+    if affinity_terms:
+        match_exists = all(
+            term.topology_key in node.labels
+            and (term.topology_key, node.labels[term.topology_key]) in meta.incoming_affinity_pairs
+            for term in affinity_terms
+        )
+        if not match_exists:
+            # First-pod-in-series escape (generic_scheduler commentary at
+            # satisfiesPodsAffinityAntiAffinity): allowed only when no pod in
+            # the cluster matches and the pod matches its own terms.
+            if not (
+                not meta.incoming_affinity_pairs
+                and pod_matches_all_term_properties(pod, pod, affinity_terms)
+            ):
+                return False
+
+    # 3. Pod's own required anti-affinity: node matching ANY term -> fail.
+    for term in get_pod_anti_affinity_terms(affinity):
+        if (
+            term.topology_key in node.labels
+            and (term.topology_key, node.labels[term.topology_key]) in meta.incoming_anti_pairs
+        ):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Combined runner (findNodesThatFit semantics for one pod)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PredicateMetadata:
+    """GetPredicateMetadata (metadata.go:333) equivalent: the per-cycle
+    precomputation for one incoming pod against a snapshot."""
+
+    even_pods_spread: Optional[EvenPodsSpreadMetadata]
+    pod_affinity: PodAffinityMetadata
+
+
+def compute_predicate_metadata(pod: Pod, snapshot: Snapshot) -> PredicateMetadata:
+    return PredicateMetadata(
+        even_pods_spread=compute_even_pods_spread_metadata(pod, snapshot),
+        pod_affinity=compute_pod_affinity_metadata(pod, snapshot),
+    )
+
+
+def pod_fits_on_node(
+    pod: Pod,
+    node_info: NodeInfo,
+    meta: Optional[PredicateMetadata] = None,
+    snapshot: Optional[Snapshot] = None,
+) -> Tuple[bool, List[str]]:
+    """All default-provider predicates in predicates.Ordering()
+    (predicates.go:147-153), short-circuiting like podFitsOnNode
+    (core/generic_scheduler.go:612 with alwaysCheckAllPredicates=false).
+    Volume predicates (NoVolumeZoneConflict, MaxVolumeCounts, NoDiskConflict,
+    CheckVolumeBinding) are vacuously true until volumes are modeled."""
+    if meta is None:
+        assert snapshot is not None, "need snapshot to compute metadata"
+        meta = compute_predicate_metadata(pod, snapshot)
+    checks = [
+        (ERR_NODE_UNSCHEDULABLE, lambda: check_node_unschedulable(pod, node_info)),
+        (ERR_POD_NOT_FIT_HOST, lambda: pod_fits_host(pod, node_info)),
+        (ERR_POD_NOT_FIT_PORTS, lambda: pod_fits_host_ports(pod, node_info)),
+        (ERR_NODE_SELECTOR_NOT_MATCH, lambda: pod_match_node_selector(pod, node_info)),
+        (ERR_INSUFFICIENT.format("resources"), lambda: pod_fits_resources(pod, node_info)),
+        (ERR_TAINTS, lambda: pod_tolerates_node_taints(pod, node_info)),
+        (
+            ERR_TOPOLOGY_SPREAD,
+            lambda: even_pods_spread_predicate(pod, node_info, meta.even_pods_spread),
+        ),
+        (ERR_POD_AFFINITY, lambda: inter_pod_affinity_matches(pod, node_info, meta.pod_affinity)),
+    ]
+    for reason, fn in checks:
+        if not fn():
+            return False, [reason]
+    return True, []
+
+
+def find_nodes_that_fit(pod: Pod, snapshot: Snapshot) -> List[str]:
+    """findNodesThatFit (core/generic_scheduler.go:457) without adaptive
+    sampling: full feasibility set, deterministic node order."""
+    meta = compute_predicate_metadata(pod, snapshot)
+    return [
+        name
+        for name, ni in snapshot.node_infos.items()
+        if pod_fits_on_node(pod, ni, meta=meta)[0]
+    ]

@@ -1,0 +1,490 @@
+"""Table-driven unit tests for the oracle (reference semantics).
+
+Scenario structure mirrors the reference's predicates_test.go /
+priorities *_test.go tables.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.quantity import Quantity
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.oracle import Snapshot, find_nodes_that_fit, pod_fits_on_node
+from kubernetes_tpu.oracle.predicates import (
+    check_node_unschedulable,
+    even_pods_spread_predicate,
+    compute_even_pods_spread_metadata,
+    compute_pod_affinity_metadata,
+    inter_pod_affinity_matches,
+    pod_fits_host_ports,
+    pod_fits_resources,
+    pod_match_node_selector,
+    pod_tolerates_node_taints,
+)
+from kubernetes_tpu.oracle.priorities import (
+    MAX_NODE_SCORE,
+    balanced_resource_allocation,
+    inter_pod_affinity_priority,
+    least_requested_priority,
+    node_affinity_priority,
+    selector_spread_priority,
+    taint_toleration_priority,
+)
+
+
+def snap_of(nodes, pods=()):
+    return Snapshot(list(nodes), list(pods))
+
+
+class TestPodFitsResources:
+    def test_fits_empty_node(self):
+        node = make_node("n1", cpu_milli=1000, mem=2**30)
+        snap = snap_of([node])
+        pod = make_pod("p", cpu_milli=500, mem=2**29)
+        assert pod_fits_resources(pod, snap.get("n1"))
+
+    def test_cpu_exceeded_by_existing(self):
+        node = make_node("n1", cpu_milli=1000, mem=2**30)
+        existing = make_pod("e", cpu_milli=800, mem=0, node_name="n1")
+        snap = snap_of([node], [existing])
+        pod = make_pod("p", cpu_milli=300, mem=0)
+        assert not pod_fits_resources(pod, snap.get("n1"))
+
+    def test_zero_request_pod_always_fits_resources(self):
+        node = make_node("n1", cpu_milli=100, mem=1)
+        existing = make_pod("e", cpu_milli=100, mem=1, node_name="n1")
+        snap = snap_of([node], [existing])
+        pod = make_pod("p", cpu_milli=0, mem=0)
+        # zero-request pod skips cpu/mem checks (predicates.go:878-884)
+        assert pod_fits_resources(pod, snap.get("n1"))
+
+    def test_pod_count_limit(self):
+        node = make_node("n1", pods=1)
+        existing = make_pod("e", node_name="n1")
+        snap = snap_of([node], [existing])
+        pod = make_pod("p", cpu_milli=0, mem=0)
+        assert not pod_fits_resources(pod, snap.get("n1"))
+
+    def test_init_container_max_counts_for_incoming_only(self):
+        node = make_node("n1", cpu_milli=1000, mem=2**30)
+        # existing pod with big init container: init requests do NOT
+        # accumulate into node requested (calculateResource)
+        existing = make_pod("e", cpu_milli=100, mem=0, node_name="n1")
+        existing.init_containers = [
+            Container(name="i", requests={"cpu": Quantity.parse("900m")})
+        ]
+        snap = snap_of([node], [existing])
+        # incoming pod with big init container: its request IS max(init, sum)
+        pod = make_pod("p", cpu_milli=100, mem=0)
+        pod.init_containers = [Container(name="i", requests={"cpu": Quantity.parse("950m")})]
+        assert not pod_fits_resources(pod, snap.get("n1"))
+        pod2 = make_pod("p2", cpu_milli=100, mem=0)
+        pod2.init_containers = [Container(name="i", requests={"cpu": Quantity.parse("800m")})]
+        assert pod_fits_resources(pod2, snap.get("n1"))
+
+    def test_extended_resource(self):
+        node = make_node("n1")
+        node.allocatable["example.com/gpu"] = Quantity.parse(2)
+        e = make_pod("e", node_name="n1")
+        e.containers[0].requests["example.com/gpu"] = Quantity.parse(2)
+        snap = snap_of([node], [e])
+        pod = make_pod("p")
+        pod.containers[0].requests["example.com/gpu"] = Quantity.parse(1)
+        assert not pod_fits_resources(pod, snap.get("n1"))
+
+
+class TestNodeSelectorAndTaints:
+    def test_node_selector(self):
+        node = make_node("n1", labels={"disk": "ssd"})
+        snap = snap_of([node])
+        pod = make_pod("p")
+        pod.node_selector = {"disk": "ssd"}
+        assert pod_match_node_selector(pod, snap.get("n1"))
+        pod.node_selector = {"disk": "hdd"}
+        assert not pod_match_node_selector(pod, snap.get("n1"))
+
+    def test_required_node_affinity_terms_ored(self):
+        node = make_node("n1", labels={"disk": "ssd"})
+        snap = snap_of([node])
+        pod = make_pod("p")
+        pod.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=NodeSelector(
+                    node_selector_terms=[
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(key="disk", operator="In", values=["hdd"])
+                            ]
+                        ),
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(key="disk", operator="Exists")
+                            ]
+                        ),
+                    ]
+                )
+            )
+        )
+        assert pod_match_node_selector(pod, snap.get("n1"))
+
+    def test_empty_term_list_matches_nothing(self):
+        node = make_node("n1")
+        snap = snap_of([node])
+        pod = make_pod("p")
+        pod.affinity = Affinity(
+            node_affinity=NodeAffinity(required=NodeSelector(node_selector_terms=[]))
+        )
+        assert not pod_match_node_selector(pod, snap.get("n1"))
+
+    def test_match_fields_metadata_name(self):
+        node = make_node("n1")
+        snap = snap_of([node])
+        pod = make_pod("p")
+        pod.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=NodeSelector(
+                    node_selector_terms=[
+                        NodeSelectorTerm(
+                            match_fields=[
+                                NodeSelectorRequirement(
+                                    key="metadata.name", operator="In", values=["n1"]
+                                )
+                            ]
+                        )
+                    ]
+                )
+            )
+        )
+        assert pod_match_node_selector(pod, snap.get("n1"))
+
+    def test_gt_lt_operators(self):
+        node = make_node("n1", labels={"cores": "16"})
+        snap = snap_of([node])
+        pod = make_pod("p")
+        pod.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=NodeSelector(
+                    node_selector_terms=[
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(key="cores", operator="Gt", values=["8"])
+                            ]
+                        )
+                    ]
+                )
+            )
+        )
+        assert pod_match_node_selector(pod, snap.get("n1"))
+
+    def test_taints(self):
+        node = make_node("n1", taints=[Taint(key="dedicated", value="gpu", effect="NoSchedule")])
+        snap = snap_of([node])
+        pod = make_pod("p")
+        assert not pod_tolerates_node_taints(pod, snap.get("n1"))
+        pod.tolerations = [Toleration(key="dedicated", operator="Equal", value="gpu", effect="NoSchedule")]
+        assert pod_tolerates_node_taints(pod, snap.get("n1"))
+        # PreferNoSchedule taints never block
+        node2 = make_node("n2", taints=[Taint(key="x", value="", effect="PreferNoSchedule")])
+        snap2 = snap_of([node2])
+        assert pod_tolerates_node_taints(make_pod("q"), snap2.get("n2"))
+
+    def test_exists_empty_key_tolerates_everything(self):
+        node = make_node("n1", taints=[Taint(key="any", value="v", effect="NoExecute")])
+        snap = snap_of([node])
+        pod = make_pod("p")
+        pod.tolerations = [Toleration(key="", operator="Exists")]
+        assert pod_tolerates_node_taints(pod, snap.get("n1"))
+
+    def test_unschedulable_node(self):
+        node = make_node("n1", unschedulable=True)
+        snap = snap_of([node])
+        pod = make_pod("p")
+        assert not check_node_unschedulable(pod, snap.get("n1"))
+        pod.tolerations = [
+            Toleration(key="node.kubernetes.io/unschedulable", operator="Exists", effect="NoSchedule")
+        ]
+        assert check_node_unschedulable(pod, snap.get("n1"))
+
+
+class TestHostPorts:
+    def _pod_with_port(self, name, port, proto="TCP", ip="", node_name=""):
+        p = make_pod(name, node_name=node_name)
+        p.containers[0].ports = [
+            ContainerPort(host_port=port, container_port=port, protocol=proto, host_ip=ip)
+        ]
+        return p
+
+    def test_conflict_same_port(self):
+        node = make_node("n1")
+        snap = snap_of([node], [self._pod_with_port("e", 8080, node_name="n1")])
+        assert not pod_fits_host_ports(self._pod_with_port("p", 8080), snap.get("n1"))
+        assert pod_fits_host_ports(self._pod_with_port("p2", 8081), snap.get("n1"))
+
+    def test_protocol_disambiguates(self):
+        node = make_node("n1")
+        snap = snap_of([node], [self._pod_with_port("e", 8080, proto="TCP", node_name="n1")])
+        assert pod_fits_host_ports(self._pod_with_port("p", 8080, proto="UDP"), snap.get("n1"))
+
+    def test_wildcard_ip_conflicts_with_specific(self):
+        node = make_node("n1")
+        snap = snap_of([node], [self._pod_with_port("e", 8080, ip="127.0.0.1", node_name="n1")])
+        assert not pod_fits_host_ports(self._pod_with_port("p", 8080, ip="0.0.0.0"), snap.get("n1"))
+
+    def test_different_specific_ips_no_conflict(self):
+        node = make_node("n1")
+        snap = snap_of([node], [self._pod_with_port("e", 8080, ip="127.0.0.1", node_name="n1")])
+        assert pod_fits_host_ports(self._pod_with_port("p", 8080, ip="10.0.0.1"), snap.get("n1"))
+
+
+class TestEvenPodsSpread:
+    def _constraint(self, key="zone", max_skew=1, when="DoNotSchedule"):
+        return TopologySpreadConstraint(
+            max_skew=max_skew,
+            topology_key=key,
+            when_unsatisfiable=when,
+            label_selector=LabelSelector(match_labels={"app": "web"}),
+        )
+
+    def test_skew_enforced(self):
+        nodes = [
+            make_node("n1", labels={"zone": "a"}),
+            make_node("n2", labels={"zone": "b"}),
+        ]
+        existing = [
+            make_pod("e1", labels={"app": "web"}, node_name="n1"),
+            make_pod("e2", labels={"app": "web"}, node_name="n1"),
+        ]
+        snap = snap_of(nodes, existing)
+        pod = make_pod("p", labels={"app": "web"})
+        pod.topology_spread_constraints = [self._constraint()]
+        meta = compute_even_pods_spread_metadata(pod, snap)
+        # zone a has 2, zone b has 0 -> min=0; placing on n1: 2+1-0=3 > 1
+        assert not even_pods_spread_predicate(pod, snap.get("n1"), meta)
+        assert even_pods_spread_predicate(pod, snap.get("n2"), meta)
+
+    def test_node_missing_topology_key_fails(self):
+        nodes = [make_node("n1", labels={"zone": "a"}), make_node("n3", labels={})]
+        snap = snap_of(nodes, [make_pod("e1", labels={"app": "web"}, node_name="n1")])
+        pod = make_pod("p", labels={"app": "web"})
+        pod.topology_spread_constraints = [self._constraint()]
+        meta = compute_even_pods_spread_metadata(pod, snap)
+        assert not even_pods_spread_predicate(pod, snap.get("n3"), meta)
+
+    def test_namespace_scoped_counting(self):
+        nodes = [make_node("n1", labels={"zone": "a"}), make_node("n2", labels={"zone": "b"})]
+        # matching pods but in a different namespace -> not counted
+        existing = [
+            make_pod("e1", namespace="other", labels={"app": "web"}, node_name="n1"),
+            make_pod("e2", namespace="other", labels={"app": "web"}, node_name="n1"),
+        ]
+        snap = snap_of(nodes, existing)
+        pod = make_pod("p", namespace="default", labels={"app": "web"})
+        pod.topology_spread_constraints = [self._constraint()]
+        meta = compute_even_pods_spread_metadata(pod, snap)
+        assert even_pods_spread_predicate(pod, snap.get("n1"), meta)
+
+
+class TestInterPodAffinity:
+    def _term(self, app, key="zone", namespaces=()):
+        return PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            namespaces=list(namespaces),
+            topology_key=key,
+        )
+
+    def test_required_affinity(self):
+        nodes = [make_node("n1", labels={"zone": "a"}), make_node("n2", labels={"zone": "b"})]
+        existing = [make_pod("e", labels={"app": "db"}, node_name="n1")]
+        snap = snap_of(nodes, existing)
+        pod = make_pod("p")
+        pod.affinity = Affinity(pod_affinity=PodAffinity(required=[self._term("db")]))
+        meta = compute_pod_affinity_metadata(pod, snap)
+        assert inter_pod_affinity_matches(pod, snap.get("n1"), meta)
+        assert not inter_pod_affinity_matches(pod, snap.get("n2"), meta)
+
+    def test_required_anti_affinity(self):
+        nodes = [make_node("n1", labels={"zone": "a"}), make_node("n2", labels={"zone": "b"})]
+        existing = [make_pod("e", labels={"app": "db"}, node_name="n1")]
+        snap = snap_of(nodes, existing)
+        pod = make_pod("p")
+        pod.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[self._term("db")]))
+        meta = compute_pod_affinity_metadata(pod, snap)
+        assert not inter_pod_affinity_matches(pod, snap.get("n1"), meta)
+        assert inter_pod_affinity_matches(pod, snap.get("n2"), meta)
+
+    def test_existing_pod_anti_affinity_blocks(self):
+        nodes = [make_node("n1", labels={"zone": "a"}), make_node("n2", labels={"zone": "b"})]
+        blocker = make_pod("e", labels={"app": "db"}, node_name="n1")
+        blocker.affinity = Affinity(
+            pod_anti_affinity=PodAntiAffinity(required=[self._term("web")])
+        )
+        snap = snap_of(nodes, [blocker])
+        pod = make_pod("p", labels={"app": "web"})
+        meta = compute_pod_affinity_metadata(pod, snap)
+        assert not inter_pod_affinity_matches(pod, snap.get("n1"), meta)
+        assert inter_pod_affinity_matches(pod, snap.get("n2"), meta)
+
+    def test_first_pod_self_affinity_escape(self):
+        nodes = [make_node("n1", labels={"zone": "a"})]
+        snap = snap_of(nodes, [])
+        pod = make_pod("p", labels={"app": "web"})
+        pod.affinity = Affinity(pod_affinity=PodAffinity(required=[self._term("web")]))
+        meta = compute_pod_affinity_metadata(pod, snap)
+        # no pods anywhere match, but pod matches its own selector -> allowed
+        assert inter_pod_affinity_matches(pod, snap.get("n1"), meta)
+        # pod NOT matching its own selector -> still blocked
+        pod2 = make_pod("p2", labels={"app": "web"})
+        pod2.affinity = Affinity(pod_affinity=PodAffinity(required=[self._term("db")]))
+        meta2 = compute_pod_affinity_metadata(pod2, snap)
+        assert not inter_pod_affinity_matches(pod2, snap.get("n1"), meta2)
+
+    def test_namespace_defaulting(self):
+        nodes = [make_node("n1", labels={"zone": "a"})]
+        existing = [make_pod("e", namespace="other", labels={"app": "db"}, node_name="n1")]
+        snap = snap_of(nodes, existing)
+        pod = make_pod("p", namespace="default")
+        pod.affinity = Affinity(pod_affinity=PodAffinity(required=[self._term("db")]))
+        meta = compute_pod_affinity_metadata(pod, snap)
+        # term namespaces default to the POD's namespace -> "other" not seen
+        assert not inter_pod_affinity_matches(pod, snap.get("n1"), meta)
+        pod.affinity.pod_affinity.required[0].namespaces = ["other"]
+        meta = compute_pod_affinity_metadata(pod, snap)
+        assert inter_pod_affinity_matches(pod, snap.get("n1"), meta)
+
+
+class TestPriorities:
+    def test_least_requested(self):
+        n1 = make_node("n1", cpu_milli=1000, mem=1000)
+        n2 = make_node("n2", cpu_milli=1000, mem=1000)
+        e = make_pod("e", cpu_milli=500, mem=500, node_name="n1")
+        snap = snap_of([n1, n2], [e])
+        pod = make_pod("p", cpu_milli=0, mem=0)
+        scores = least_requested_priority(pod, snap)
+        assert scores["n2"] > scores["n1"]
+
+    def test_least_requested_formula(self):
+        # capacity 1000m cpu / 1000 bytes mem; pod explicit 200m/200
+        n1 = make_node("n1", cpu_milli=1000, mem=1000)
+        snap = snap_of([n1])
+        pod = make_pod("p", cpu_milli=200, mem=200)
+        scores = least_requested_priority(pod, snap)
+        # cpu: (1000-200)*10/1000 = 8 ; mem: (1000-200)*10/1000 = 8 -> 8
+        assert scores["n1"] == 8
+
+    def test_nonzero_defaulting(self):
+        # pod with NO requests gets 100m/200Mi defaults in scoring
+        n1 = make_node("n1", cpu_milli=1000, mem=400 * 2**20)
+        snap = snap_of([n1])
+        pod = make_pod("p", cpu_milli=0, mem=0)
+        # make_pod with zeros -> no request entries at all
+        assert not pod.containers[0].requests
+        scores = least_requested_priority(pod, snap)
+        # cpu: (1000-100)*10/1000 = 9 ; mem: (400Mi-200Mi)*10/400Mi = 5 -> (9+5)/2 = 7
+        assert scores["n1"] == 7
+
+    def test_balanced_allocation(self):
+        n1 = make_node("n1", cpu_milli=1000, mem=1000)
+        snap = snap_of([n1])
+        pod = make_pod("p", cpu_milli=500, mem=500)
+        scores = balanced_resource_allocation(pod, snap)
+        assert scores["n1"] == MAX_NODE_SCORE  # perfectly balanced
+
+    def test_node_affinity_priority(self):
+        n1 = make_node("n1", labels={"disk": "ssd"})
+        n2 = make_node("n2", labels={"disk": "hdd"})
+        snap = snap_of([n1, n2])
+        pod = make_pod("p")
+        pod.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=10,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(key="disk", operator="In", values=["ssd"])
+                            ]
+                        ),
+                    )
+                ]
+            )
+        )
+        scores = node_affinity_priority(pod, snap)
+        assert scores["n1"] == MAX_NODE_SCORE
+        assert scores["n2"] == 0
+
+    def test_taint_toleration_priority(self):
+        n1 = make_node("n1", taints=[Taint(key="a", value="", effect="PreferNoSchedule")])
+        n2 = make_node("n2")
+        snap = snap_of([n1, n2])
+        pod = make_pod("p")
+        scores = taint_toleration_priority(pod, snap)
+        assert scores["n2"] == MAX_NODE_SCORE
+        assert scores["n1"] == 0
+
+    def test_selector_spread(self):
+        n1 = make_node("n1")
+        n2 = make_node("n2")
+        sel = LabelSelector(match_labels={"app": "web"})
+        e1 = make_pod("e1", labels={"app": "web"}, node_name="n1")
+        snap = snap_of([n1, n2], [e1])
+        pod = make_pod("p", labels={"app": "web"})
+        scores = selector_spread_priority(pod, snap, [sel])
+        assert scores["n2"] == MAX_NODE_SCORE
+        assert scores["n1"] == 0
+
+    def test_interpod_affinity_preferred(self):
+        n1 = make_node("n1", labels={"zone": "a"})
+        n2 = make_node("n2", labels={"zone": "b"})
+        e = make_pod("e", labels={"app": "db"}, node_name="n1")
+        snap = snap_of([n1, n2], [e])
+        pod = make_pod("p")
+        pod.affinity = Affinity(
+            pod_affinity=PodAffinity(
+                preferred=[
+                    __import__(
+                        "kubernetes_tpu.api.types", fromlist=["WeightedPodAffinityTerm"]
+                    ).WeightedPodAffinityTerm(
+                        weight=50,
+                        pod_affinity_term=PodAffinityTerm(
+                            label_selector=LabelSelector(match_labels={"app": "db"}),
+                            topology_key="zone",
+                        ),
+                    )
+                ]
+            )
+        )
+        scores = inter_pod_affinity_priority(pod, snap)
+        assert scores["n1"] == MAX_NODE_SCORE
+        assert scores["n2"] == 0
+
+
+class TestEndToEnd:
+    def test_find_nodes_that_fit_runs(self):
+        from kubernetes_tpu.models.generators import ClusterGen
+
+        g = ClusterGen(7)
+        nodes, existing = g.cluster(30, 100)
+        snap = Snapshot(nodes, existing)
+        for i in range(10):
+            pod = g.pod(10_000 + i)
+            fits = find_nodes_that_fit(pod, snap)
+            for name in fits:
+                ok, _ = pod_fits_on_node(pod, snap.get(name), snapshot=snap)
+                assert ok
