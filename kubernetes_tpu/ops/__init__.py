@@ -1,0 +1,11 @@
+"""Device kernels: vectorized Filter/Score/solve over pods x nodes tensors.
+
+Resource quantities are exact int64 (memory bytes exceed int32), so x64 mode
+is enabled at import. Kernels keep everything else int32/bool/float32 — the
+int64 use is confined to elementwise compares on [N, R]-sized arrays where
+TPU's emulated 64-bit integer cost is negligible.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,215 @@
+"""Vectorized Filter kernels: the pods x nodes feasibility mask.
+
+The reference evaluates predicates one (pod, node) pair at a time inside
+ParallelizeUntil(16, checkNode) (core/generic_scheduler.go:523, predicates
+ordered per predicates.go:147-153, short-circuiting in podFitsOnNode:612).
+Here the ENTIRE pods x nodes boolean matrix is computed in one fused XLA
+program over the padded tensor encoding (state/tensors.py): every predicate
+is a broadcasted integer-compare reduction, so XLA fuses them into a single
+pass over the node axis with no interpreter in the loop.
+
+Covered (the non-topology predicates — topology ones live in topology.py):
+  CheckNodeUnschedulable, PodFitsHost, PodFitsHostPorts, PodMatchNodeSelector
+  (incl. required NodeAffinity with In/NotIn/Exists/DoesNotExist/Gt/Lt and
+  metadata.name matchFields), PodFitsResources, PodToleratesNodeTaints.
+
+Parity: tests/test_filter_parity.py asserts bit-for-bit agreement with
+kubernetes_tpu.oracle.predicates on randomized clusters.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..state.tensors import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PAD,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NAME_IN,
+    OP_NAME_NOT_IN,
+    OP_NEVER,
+    OP_NOT_IN,
+    OP_PAD,
+    TOL_EXISTS,
+)
+
+Arrays = Dict[str, jnp.ndarray]
+
+
+def _tolerates(pods: Arrays, taint_key, taint_val, taint_effect):
+    """Broadcast Toleration.ToleratesTaint over a taint tensor.
+
+    pods tol_* arrays are [B, TL]; taint_* are [..., T] (any leading shape
+    broadcastable against B). Returns [..., T] bool: taint tolerated by ANY
+    of the pod's tolerations. Semantics (api/core/v1/toleration.go):
+      effect: empty toleration effect matches all; else exact match
+      key: empty toleration key matches all; else exact match
+      operator Exists: value ignored; Equal: values must be equal
+    """
+    # shapes: tol [B, 1, TL], taint [B-or-1, T, 1]
+    tk = taint_key[..., :, None]
+    tv = taint_val[..., :, None]
+    te = taint_effect[..., :, None]
+    ok_effect = (pods["tol_effect"][:, None, :] == 0) | (pods["tol_effect"][:, None, :] == te)
+    ok_key = (pods["tol_key"][:, None, :] == 0) | (pods["tol_key"][:, None, :] == tk)
+    is_exists = pods["tol_op"][:, None, :] == TOL_EXISTS
+    ok_value = is_exists | (pods["tol_val"][:, None, :] == tv)
+    match = pods["tol_valid"][:, None, :] & ok_effect & ok_key & ok_value
+    return jnp.any(match, axis=-1)
+
+
+def check_node_unschedulable(nodes: Arrays, pods: Arrays, ids: Arrays) -> jnp.ndarray:
+    """CheckNodeUnschedulablePredicate (predicates.go:1584)."""
+    b = pods["valid"].shape[0]
+    taint_key = jnp.broadcast_to(ids["unschedulable_key"], (b, 1))
+    taint_val = jnp.broadcast_to(ids["empty_val"], (b, 1))
+    taint_effect = jnp.full((b, 1), EFFECT_NO_SCHEDULE, jnp.int32)
+    tol = _tolerates(pods, taint_key, taint_val, taint_effect)[:, 0]  # [B]
+    return (~nodes["unschedulable"])[None, :] | tol[:, None]
+
+
+def pod_fits_host(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """PodFitsHost (predicates.go:991)."""
+    pinned = pods["node_name_id"][:, None]
+    return (pinned == 0) | (pinned == nodes["name_id"][None, :])
+
+
+def pod_fits_resources(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """PodFitsResources (predicates.go:854): pod-count always; resource rows
+    only when the pod requests anything."""
+    count_ok = (nodes["pod_count"] + 1 <= nodes["allowed_pods"])[None, :]
+    free = nodes["alloc"] - nodes["requested"]  # [N, R]
+    ok = pods["req"][:, None, :] <= free[None, :, :]  # [B, N, R]
+    # slots 0..2 (cpu/mem/ephemeral) are checked unconditionally; scalar
+    # slots only when requested (reference predicates.go:886-907)
+    r = free.shape[-1]
+    always = jnp.arange(r) < 3
+    checked = always[None, None, :] | (pods["req"][:, None, :] > 0)
+    fits = jnp.all(ok | ~checked, axis=-1)
+    return count_ok & (fits | ~pods["req_any"][:, None])
+
+
+def pod_fits_host_ports(nodes: Arrays, pods: Arrays, ids: Arrays) -> jnp.ndarray:
+    """PodFitsHostPorts (predicates.go:1161) / HostPortInfo.CheckConflict:
+    same (protocol, port) conflicts when either IP is 0.0.0.0 or they're
+    equal."""
+    pp = pods["port_num"][:, None, :, None]  # [B, 1, PP, 1]
+    np_ = nodes["port_num"][None, :, None, :]  # [1, N, 1, P]
+    proto_eq = pods["port_proto"][:, None, :, None] == nodes["port_proto"][None, :, None, :]
+    pip = pods["port_ip"][:, None, :, None]
+    nip = nodes["port_ip"][None, :, None, :]
+    wild = ids["wildcard_ip"]
+    ip_clash = (pip == wild) | (nip == wild) | (pip == nip)
+    conflict = (pp > 0) & (np_ > 0) & (pp == np_) & proto_eq & ip_clash
+    return ~jnp.any(conflict, axis=(2, 3))
+
+
+def _eval_requirements(nodes: Arrays, op, slot, vals, num) -> jnp.ndarray:
+    """Evaluate compiled node-selector requirements against every node.
+
+    op/slot/num: [B, T, R]; vals: [B, T, R, V]. Returns [B, T, R, N] bool
+    (PAD requirements evaluate True so they AND away)."""
+    slot_c = jnp.clip(slot, 0, nodes["label_vals"].shape[1] - 1)
+    # node label value id at the requirement's key slot: [B, T, R, N]
+    node_val = nodes["label_vals"].T[slot_c]  # label_vals.T is [K, N]
+    known = slot >= 0
+    present = known[..., None] & (node_val != 0)
+    node_num = nodes["label_num"].T[slot_c]
+    node_num_ok = nodes["label_num_ok"].T[slot_c] & known[..., None]
+    in_set = jnp.any(node_val[..., None, :] == vals[..., :, None], axis=-2)
+    name_eq = nodes["name_id"][None, None, None, :] == vals[..., 0:1]
+
+    res = jnp.ones_like(present)
+    opx = op[..., None]
+    res = jnp.where(opx == OP_IN, present & in_set, res)
+    res = jnp.where(opx == OP_NOT_IN, ~present | ~in_set, res)
+    res = jnp.where(opx == OP_EXISTS, present, res)
+    res = jnp.where(opx == OP_DOES_NOT_EXIST, ~present, res)
+    res = jnp.where(opx == OP_GT, node_num_ok & (node_num > num[..., None]), res)
+    res = jnp.where(opx == OP_LT, node_num_ok & (node_num < num[..., None]), res)
+    res = jnp.where(opx == OP_NAME_IN, name_eq, res)
+    res = jnp.where(opx == OP_NAME_NOT_IN, ~name_eq, res)
+    res = jnp.where(opx == OP_NEVER, jnp.zeros_like(res), res)
+    return res
+
+
+def pod_match_node_selector(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """PodMatchNodeSelector (predicates.go:979): nodeSelector map pairs ANDed
+    with required node-affinity terms (terms ORed; reqs in a term ANDed;
+    empty/absent term list matches nothing when required != nil)."""
+    # nodeSelector map: [B, NSP] pairs
+    slot = pods["sel_pair_slot"]
+    slot_c = jnp.clip(slot, 0, nodes["label_vals"].shape[1] - 1)
+    node_val = nodes["label_vals"].T[slot_c]  # [B, NSP, N]
+    pair_ok = (slot[..., None] < 0) | (node_val == pods["sel_pair_val"][..., None])
+    map_ok = jnp.all(pair_ok, axis=1)  # [B, N]
+
+    req_ok = _eval_requirements(
+        nodes, pods["term_req_op"], pods["term_req_slot"], pods["term_req_vals"], pods["term_req_num"]
+    )  # [B, TERMS, REQS, N]
+    term_ok = pods["term_valid"][..., None] & jnp.all(req_ok, axis=2)  # [B, TERMS, N]
+    affinity_ok = jnp.any(term_ok, axis=1) | ~pods["has_required"][:, None]
+    return map_ok & affinity_ok
+
+
+def pod_tolerates_node_taints(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """PodToleratesNodeTaints (predicates.go:1604): every NoSchedule/NoExecute
+    taint must be tolerated."""
+    blocking = (nodes["taint_effect"] == EFFECT_NO_SCHEDULE) | (
+        nodes["taint_effect"] == EFFECT_NO_EXECUTE
+    )  # [N, T]
+    tol = _tolerates(
+        pods,
+        nodes["taint_key"][None, :, :].reshape(1, -1),
+        nodes["taint_val"][None, :, :].reshape(1, -1),
+        nodes["taint_effect"][None, :, :].reshape(1, -1),
+    )  # [B, N*T]
+    n, t = nodes["taint_key"].shape
+    tol = tol.reshape(-1, n, t)
+    return jnp.all(~blocking[None, :, :] | tol, axis=-1)
+
+
+@jax.jit
+def filter_masks(nodes: Arrays, pods: Arrays, ids: Arrays) -> Dict[str, jnp.ndarray]:
+    """All non-topology predicate masks, individually (for parity tests and
+    failure-reason reporting) — callers normally use combined_mask."""
+    return {
+        "unschedulable": check_node_unschedulable(nodes, pods, ids),
+        "host": pod_fits_host(nodes, pods),
+        "ports": pod_fits_host_ports(nodes, pods, ids),
+        "selector": pod_match_node_selector(nodes, pods),
+        "resources": pod_fits_resources(nodes, pods),
+        "taints": pod_tolerates_node_taints(nodes, pods),
+    }
+
+
+@jax.jit
+def combined_mask(nodes: Arrays, pods: Arrays, ids: Arrays) -> jnp.ndarray:
+    """findNodesThatFit's feasibility matrix [B, N]: AND of all predicates,
+    masked by row/col validity."""
+    m = filter_masks(nodes, pods, ids)
+    out = m["unschedulable"] & m["host"] & m["ports"] & m["selector"] & m["resources"] & m["taints"]
+    # nodes whose structures overflowed the encoding are excluded from the
+    # fast path entirely (conservative; the driver may oracle-check them)
+    ok_nodes = nodes["valid"] & ~nodes.get("fallback", jnp.zeros_like(nodes["valid"]))
+    return out & ok_nodes[None, :] & pods["valid"][:, None]
+
+
+def make_ids(vocab) -> Dict[str, jnp.ndarray]:
+    """Interned constants the kernels need, as device scalars."""
+    from ..api.types import TAINT_NODE_UNSCHEDULABLE
+
+    return {
+        "wildcard_ip": jnp.int32(vocab.wildcard_ip),
+        "unschedulable_key": jnp.int32(vocab.id(TAINT_NODE_UNSCHEDULABLE)),
+        "empty_val": jnp.int32(vocab.id("")),
+    }

@@ -1,0 +1,190 @@
+"""Vectorized Score kernels: pods x nodes priority matrices.
+
+The reference computes priorities with per-node goroutine map/reduce
+(PrioritizeNodes, core/generic_scheduler.go:699-830). Here every priority is
+a broadcasted [B, N] arithmetic expression over the tensor encoding, fused by
+XLA; normalization reduces ride the node axis.
+
+MaxNodeScore = 10 (framework/v1alpha1/interface.go:77). Integer divisions
+replicate Go's truncating semantics on non-negative operands; Balanced
+allocation uses float64 like the reference, then truncates.
+
+Covered here (non-topology): LeastRequested, MostRequested,
+BalancedResourceAllocation, NodeAffinity(preferred), TaintToleration
+(PreferNoSchedule), NodePreferAvoidPods, ImageLocality. Topology-coupled
+priorities (SelectorSpread, EvenPodsSpread-soft, InterPodAffinity) live in
+topology.py. Parity: tests/test_score_parity.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..state.tensors import EFFECT_PREFER_NO_SCHEDULE, TOL_EXISTS
+from .filters import _eval_requirements
+
+Arrays = Dict[str, jnp.ndarray]
+
+MAX_NODE_SCORE = 10
+
+# image_locality.go thresholds
+_MB = 1024 * 1024
+IMAGE_MIN = 23 * _MB
+IMAGE_MAX = 1000 * _MB
+
+
+def normalize_reduce(scores: jnp.ndarray, node_valid: jnp.ndarray, reverse: bool) -> jnp.ndarray:
+    """NormalizeReduce (priorities/reduce.go): scale each row to [0, 10] by
+    its max over valid nodes; all-zero rows stay 0 (or become 10 reversed)."""
+    masked = jnp.where(node_valid[None, :], scores, 0)
+    row_max = jnp.max(masked, axis=1, keepdims=True)
+    scaled = jnp.where(row_max > 0, MAX_NODE_SCORE * scores // jnp.maximum(row_max, 1), 0)
+    if reverse:
+        scaled = jnp.where(row_max > 0, MAX_NODE_SCORE - scaled, MAX_NODE_SCORE)
+    return scaled
+
+
+def _requested_both(nodes: Arrays, pods: Arrays):
+    """allocatable and (non-zero accumulated + incoming scoring) requested for
+    cpu/mem (calculateResourceAllocatableRequest)."""
+    alloc_cpu = nodes["alloc"][:, 0][None, :]
+    alloc_mem = nodes["alloc"][:, 1][None, :]
+    req_cpu = nodes["nonzero_req"][:, 0][None, :] + pods["scoring_req"][:, 0][:, None]
+    req_mem = nodes["nonzero_req"][:, 1][None, :] + pods["scoring_req"][:, 1][:, None]
+    return alloc_cpu, req_cpu, alloc_mem, req_mem
+
+
+def _least_score(req, cap):
+    ok = (cap > 0) & (req <= cap)
+    return jnp.where(ok, (cap - req) * MAX_NODE_SCORE // jnp.maximum(cap, 1), 0)
+
+
+def _most_score(req, cap):
+    ok = (cap > 0) & (req <= cap)
+    return jnp.where(ok, req * MAX_NODE_SCORE // jnp.maximum(cap, 1), 0)
+
+
+def least_requested(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    ac, rc, am, rm = _requested_both(nodes, pods)
+    return (_least_score(rc, ac) + _least_score(rm, am)) // 2
+
+
+def most_requested(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    ac, rc, am, rm = _requested_both(nodes, pods)
+    return (_most_score(rc, ac) + _most_score(rm, am)) // 2
+
+
+def balanced_allocation(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """balanced_resource_allocation.go: (1 - |cpuFrac - memFrac|) * 10
+    truncated; 0 when either fraction >= 1; missing capacity -> fraction 1."""
+    ac, rc, am, rm = _requested_both(nodes, pods)
+    cpu_frac = jnp.where(ac > 0, rc.astype(jnp.float64) / jnp.maximum(ac, 1), 1.0)
+    mem_frac = jnp.where(am > 0, rm.astype(jnp.float64) / jnp.maximum(am, 1), 1.0)
+    diff = jnp.abs(cpu_frac - mem_frac)
+    score = ((1.0 - diff) * MAX_NODE_SCORE).astype(jnp.int64)
+    return jnp.where((cpu_frac >= 1) | (mem_frac >= 1), 0, score)
+
+
+def node_affinity(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """CalculateNodeAffinityPriorityMap + NormalizeReduce(10, false): sum of
+    weights of matching preferred terms; a term with no expressions matches
+    everywhere (plain selector semantics)."""
+    req_ok = _eval_requirements(
+        nodes, pods["pref_req_op"], pods["pref_req_slot"], pods["pref_req_vals"], pods["pref_req_num"]
+    )  # [B, PT, REQS, N]
+    term_ok = jnp.all(req_ok, axis=2) & pods["pref_valid"][..., None]  # [B, PT, N]
+    counts = jnp.sum(term_ok * pods["pref_weight"][..., None], axis=1)  # [B, N]
+    return normalize_reduce(counts.astype(jnp.int64), nodes["valid"], reverse=False)
+
+
+def taint_toleration(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """ComputeTaintTolerationPriorityMap + NormalizeReduce(10, true): count of
+    intolerable PreferNoSchedule taints, inverted. Only tolerations with
+    empty or PreferNoSchedule effect participate."""
+    prefer = nodes["taint_effect"] == EFFECT_PREFER_NO_SCHEDULE  # [N, T]
+    # eligible tolerations: effect in {all(0), PreferNoSchedule}
+    tol_eligible = pods["tol_valid"] & (
+        (pods["tol_effect"] == 0) | (pods["tol_effect"] == EFFECT_PREFER_NO_SCHEDULE)
+    )  # [B, TL]
+    tk = nodes["taint_key"][None, :, :, None]  # [1, N, T, 1]
+    tv = nodes["taint_val"][None, :, :, None]
+    te = nodes["taint_effect"][None, :, :, None]
+    pk = pods["tol_key"][:, None, None, :]  # [B, 1, 1, TL]
+    pv = pods["tol_val"][:, None, None, :]
+    pe = pods["tol_effect"][:, None, None, :]
+    po = pods["tol_op"][:, None, None, :]
+    ok = (
+        tol_eligible[:, None, None, :]
+        & ((pe == 0) | (pe == te))
+        & ((pk == 0) | (pk == tk))
+        & ((po == TOL_EXISTS) | (pv == tv))
+    )
+    tolerated = jnp.any(ok, axis=-1)  # [B, N, T]
+    intolerable = jnp.sum(prefer[None, :, :] & ~tolerated, axis=-1)  # [B, N]
+    return normalize_reduce(intolerable.astype(jnp.int64), nodes["valid"], reverse=True)
+
+
+def prefer_avoid_pods(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """CalculateNodePreferAvoidPodsPriorityMap: 0 when the node's
+    preferAvoidPods signatures name the pod's RC/RS controller, else 10."""
+    kind = pods["ctrl_kind"][:, None, None]  # [B, 1, 1]
+    uid = pods["ctrl_uid"][:, None, None]
+    hit = (nodes["avoid_kind"][None, :, :] == kind) & (nodes["avoid_uid"][None, :, :] == uid)
+    avoided = (kind[..., 0] > 0) & jnp.any(hit, axis=-1)
+    return jnp.where(avoided, 0, MAX_NODE_SCORE).astype(jnp.int64)
+
+
+def image_locality(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """ImageLocalityPriorityMap: gather spread-scaled image sizes per
+    (pod image, node), clamp to [23MB, 1000MB], map to [0, 10]."""
+    table = nodes["image_scaled"]  # [N, V_img]
+    img = jnp.clip(pods["image_ids"], 0, table.shape[1] - 1)  # [B, CI]
+    sums = jnp.sum(table[:, img], axis=-1)  # [N, B] (gather then sum CI)
+    total = sums.T  # [B, N]
+    clamped = jnp.clip(total, IMAGE_MIN, IMAGE_MAX)
+    return MAX_NODE_SCORE * (clamped - IMAGE_MIN) // (IMAGE_MAX - IMAGE_MIN)
+
+
+# default-provider weights (algorithmprovider/defaults/defaults.go:128)
+DEFAULT_WEIGHTS = {
+    "least_requested": 1,
+    "balanced_allocation": 1,
+    "node_affinity": 1,
+    "taint_toleration": 1,
+    "prefer_avoid_pods": 10000,
+    "image_locality": 1,
+}
+
+
+@jax.jit
+def score_matrix(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """Weighted sum of the non-topology priorities → [B, N] int64. The
+    topology scores (topology.py) are added by the solver before argmax."""
+    total = (
+        DEFAULT_WEIGHTS["least_requested"] * least_requested(nodes, pods)
+        + DEFAULT_WEIGHTS["balanced_allocation"] * balanced_allocation(nodes, pods)
+        + DEFAULT_WEIGHTS["node_affinity"] * node_affinity(nodes, pods)
+        + DEFAULT_WEIGHTS["taint_toleration"] * taint_toleration(nodes, pods)
+        + DEFAULT_WEIGHTS["prefer_avoid_pods"] * prefer_avoid_pods(nodes, pods)
+    )
+    if "image_scaled" in nodes:
+        total = total + DEFAULT_WEIGHTS["image_locality"] * image_locality(nodes, pods)
+    return total
+
+
+@jax.jit
+def score_components(nodes: Arrays, pods: Arrays) -> Dict[str, jnp.ndarray]:
+    out = {
+        "least_requested": least_requested(nodes, pods),
+        "most_requested": most_requested(nodes, pods),
+        "balanced_allocation": balanced_allocation(nodes, pods),
+        "node_affinity": node_affinity(nodes, pods),
+        "taint_toleration": taint_toleration(nodes, pods),
+        "prefer_avoid_pods": prefer_avoid_pods(nodes, pods),
+    }
+    if "image_scaled" in nodes:
+        out["image_locality"] = image_locality(nodes, pods)
+    return out

@@ -1,0 +1,414 @@
+"""Topology kernels: EvenPodsSpread, InterPodAffinity, SelectorSpread.
+
+These are the reference's quadratic (pod x pod) plugins — its known
+bottleneck (predicates.go:1269/:1778, interpod_affinity.go, metadata.go
+topology-pair maps). The TPU formulation:
+
+* Terms are SPARSE rows (state/terms.py). Matching a term against all
+  existing pods / the incoming batch is one broadcasted compare.
+* Per-topology-value aggregation uses segment_sum/segment_max keyed by the
+  DENSE value index (NodeBank.label_dense), vmapped over the term axis.
+* The symmetric direction (existing pods' terms vs incoming pods) becomes a
+  [B, ET] @ [ET, N] matmul over term-match and same-topology incidence
+  matrices — this is what the MXU is for.
+* Per-owner combining (a pod's terms AND/OR/sum together) uses scatter
+  (.at[owner].min/max/add), which XLA turns into on-chip scatters.
+
+Semantics parity-tested bit-for-bit against the oracle in
+tests/test_topology_parity.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..state.terms import (
+    AFF_PREF,
+    AFF_REQ,
+    ANTI_PREF,
+    ANTI_REQ,
+    SEL_SPREAD,
+    SPREAD_HARD,
+    SPREAD_SOFT,
+)
+from ..state.tensors import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NEVER,
+    OP_NOT_IN,
+    OP_PAD,
+)
+
+Arrays = Dict[str, jnp.ndarray]
+
+MAX_NODE_SCORE = 10
+_BIG = 2**30  # plain int: no device array creation at import time
+
+
+# ---------------------------------------------------------------------------
+# term matching
+# ---------------------------------------------------------------------------
+
+def match_terms(terms: Arrays, labels: jnp.ndarray, ns: jnp.ndarray = None) -> jnp.ndarray:
+    """[TT, X]: does term t's (namespace-set, label-selector) match subject x?
+
+    labels: [X, K] value-id rows; ns: [X] namespace ids or None to skip the
+    namespace check. Selector semantics = metav1.LabelSelectorAsSelector
+    (nil matches nothing; empty matches everything; matchLabels AND
+    matchExpressions)."""
+    K = labels.shape[1]
+    # matchLabels pairs
+    ml_slot = jnp.clip(terms["ml_slot"], 0, K - 1)  # [TT, ML]
+    vals_at = labels.T[ml_slot]  # [TT, ML, X]
+    ml_ok = (terms["ml_slot"][..., None] < 0) | (vals_at == terms["ml_val"][..., None])
+    sel_ok = jnp.all(ml_ok, axis=1)  # [TT, X]
+    # matchExpressions
+    ex_slot = jnp.clip(terms["ex_slot"], 0, K - 1)
+    ex_vals_at = labels.T[ex_slot]  # [TT, EX, X]
+    present = ex_vals_at != 0
+    in_set = jnp.any(ex_vals_at[..., None, :] == terms["ex_vals"][..., :, None], axis=-2)
+    op = terms["ex_op"][..., None]
+    ex_ok = jnp.ones_like(present)
+    ex_ok = jnp.where(op == OP_IN, present & in_set, ex_ok)
+    ex_ok = jnp.where(op == OP_NOT_IN, ~present | ~in_set, ex_ok)
+    ex_ok = jnp.where(op == OP_EXISTS, present, ex_ok)
+    ex_ok = jnp.where(op == OP_DOES_NOT_EXIST, ~present, ex_ok)
+    ex_ok = jnp.where(op == OP_NEVER, jnp.zeros_like(present), ex_ok)
+    sel_ok = sel_ok & jnp.all(ex_ok, axis=1)
+    sel_ok = sel_ok & terms["has_selector"][:, None]
+    if ns is not None:
+        ns_in = jnp.any(ns[None, None, :] == terms["ns_ids"][..., None], axis=1)  # [TT, X]
+        sel_ok = sel_ok & (terms["ns_any"][:, None] | ns_in)
+    return sel_ok & terms["valid"][:, None]
+
+
+def _bucket_of(nodes: Arrays, slot: jnp.ndarray, idx: jnp.ndarray = None):
+    """Dense topology bucket at per-term key slots. slot: [TT]; idx: [X] node
+    rows shared by all terms (or None = all nodes).
+    Returns (bucket [TT, X] clipped ≥0, has_key [TT, X])."""
+    dense = nodes["label_dense"]  # [N, K]
+    if idx is not None:
+        dense = dense[idx]  # [X, K]
+    slot_c = jnp.clip(slot, 0, dense.shape[1] - 1)
+    b = dense.T[slot_c]  # [TT, X]
+    has = (b >= 0) & (slot[:, None] >= 0)
+    return jnp.maximum(b, 0), has
+
+
+def _bucket_of_owner(nodes: Arrays, slot: jnp.ndarray, owner: jnp.ndarray):
+    """Dense bucket of each term's OWN node at its own slot → [TT, 1]."""
+    dense = nodes["label_dense"][owner]  # [TT, K]
+    slot_c = jnp.clip(slot, 0, dense.shape[1] - 1)
+    b = jnp.take_along_axis(dense, slot_c[:, None], axis=1)  # [TT, 1]
+    has = (b >= 0) & (slot[:, None] >= 0)
+    return jnp.maximum(b, 0), has
+
+
+def _seg_sum(values: jnp.ndarray, buckets: jnp.ndarray, num: int) -> jnp.ndarray:
+    """vmapped segment_sum over the leading term axis."""
+    return jax.vmap(lambda v, s: jax.ops.segment_sum(v, s, num_segments=num))(values, buckets)
+
+
+def _gather_rows(table: jnp.ndarray, buckets: jnp.ndarray) -> jnp.ndarray:
+    """table: [TT, V]; buckets: [TT, X] → [TT, X] (per-row gather)."""
+    return jax.vmap(lambda t, b: t[b])(table, buckets)
+
+
+def _merge_same_key(terms: Arrays, mask: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
+    """Sum rows of `table` over terms sharing (owner, topo_slot) — replicates
+    the reference's per-(key,value) pair maps being shared across constraints
+    with the same topology key (metadata.go tpPairToMatchNum)."""
+    same = (
+        mask[:, None]
+        & mask[None, :]
+        & (terms["owner"][:, None] == terms["owner"][None, :])
+        & (terms["topo_slot"][:, None] == terms["topo_slot"][None, :])
+    )
+    return jnp.matmul(same.astype(table.dtype), table)
+
+
+def _scatter_and(ok_t: jnp.ndarray, owner: jnp.ndarray, mask_t: jnp.ndarray, B: int) -> jnp.ndarray:
+    """AND of ok_t rows per owner → [B, N] (terms not in mask contribute 1)."""
+    contrib = jnp.where(mask_t[:, None], ok_t, True).astype(jnp.int32)
+    out = jnp.ones((B, ok_t.shape[1]), jnp.int32)
+    out = out.at[jnp.where(mask_t, owner, B)].min(contrib, mode="drop")
+    return out.astype(bool)
+
+
+def _scatter_or(bad_t: jnp.ndarray, owner: jnp.ndarray, mask_t: jnp.ndarray, B: int) -> jnp.ndarray:
+    contrib = jnp.where(mask_t[:, None], bad_t, False).astype(jnp.int32)
+    out = jnp.zeros((B, bad_t.shape[1]), jnp.int32)
+    out = out.at[jnp.where(mask_t, owner, B)].max(contrib, mode="drop")
+    return out.astype(bool)
+
+
+def _scatter_add(val_t: jnp.ndarray, owner: jnp.ndarray, mask_t: jnp.ndarray, B: int) -> jnp.ndarray:
+    contrib = jnp.where(mask_t[:, None], val_t, 0)
+    out = jnp.zeros((B, val_t.shape[1]), val_t.dtype)
+    out = out.at[jnp.where(mask_t, owner, B)].add(contrib, mode="drop")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# EvenPodsSpread
+# ---------------------------------------------------------------------------
+
+def spread_filter(
+    nodes: Arrays, eps: Arrays, terms: Arrays, selector_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """EvenPodsSpreadPredicate (predicates.go:1778) with metadata computed on
+    device (metadata.go:399 getEvenPodsSpreadMetadata). selector_mask is the
+    PodMatchNodeSelector matrix [B, N] (candidate nodes must pass the
+    incoming pod's node selector/affinity)."""
+    B, N = selector_mask.shape
+    hard = terms["valid"] & (terms["kind"] == SPREAD_HARD)
+    owner = terms["owner"]
+
+    bucket_n, haskey_n = _bucket_of(nodes, terms["topo_slot"])  # [TT, N]
+    # candidate nodes per pod: selector ∧ ALL hard topo keys present ∧ valid
+    all_keys = _scatter_and(haskey_n, owner, hard, B)
+    cand = selector_mask & all_keys & nodes["valid"][None, :]
+
+    # existing-pod match per term (same namespace as the incoming pod —
+    # ns_ids were compiled to [pod.namespace] for hard constraints)
+    m_ep = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & hard[:, None]
+    cnt_node = _seg_sum(m_ep.astype(jnp.int32), jnp.broadcast_to(eps["node_idx"][None, :], m_ep.shape), N)  # [TT, N]
+    cand_t = cand[owner]  # [TT, N]
+    pair_cnt = _seg_sum(jnp.where(cand_t, cnt_node, 0), bucket_n, N)  # [TT, V]
+    pair_present = _seg_sum((cand_t & haskey_n).astype(jnp.int32), bucket_n, N) > 0
+
+    merged_cnt = _merge_same_key(terms, hard, pair_cnt)
+    merged_present = _merge_same_key(terms, hard, pair_present.astype(jnp.int32)) > 0
+
+    min_match = jnp.min(jnp.where(merged_present, merged_cnt, jnp.asarray(_BIG, merged_cnt.dtype)), axis=1)  # [TT]
+    match_num_n = jnp.where(
+        _gather_rows(merged_present, bucket_n), _gather_rows(merged_cnt, bucket_n), 0
+    )  # [TT, N]
+    self_m = terms["self_match"].astype(jnp.int32)[:, None]
+    skew_ok = match_num_n + self_m - min_match[:, None] <= terms["weight"][:, None]
+    ok_t = haskey_n & skew_ok
+    ok = _scatter_and(ok_t, owner, hard, B)
+
+    # empty pair map → predicate passes (predicates.go:1800)
+    any_pair_t = jnp.any(merged_present, axis=1)  # [TT]
+    any_pair = jnp.zeros(B + 1, bool).at[jnp.where(hard, owner, B)].max(any_pair_t & hard)[:B]
+    return ok | ~any_pair[:, None]
+
+
+def spread_score(
+    nodes: Arrays, eps: Arrays, terms: Arrays, aux: Arrays, selector_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """CalculateEvenPodsSpreadPriority (even_pods_spread.go:85): member nodes
+    carry all soft topo keys; counts accumulate over nodes ALSO passing the
+    pod's node selector; score = 10*(total-count)/(total-min); counts span
+    all namespaces (reference quirk)."""
+    B, N = selector_mask.shape
+    soft = terms["valid"] & (terms["kind"] == SPREAD_SOFT)
+    owner = terms["owner"]
+    has_soft = jnp.zeros(B + 1, bool).at[jnp.where(soft, owner, B)].max(soft)[:B]
+
+    bucket_n, haskey_n = _bucket_of(nodes, terms["topo_slot"])
+    member = _scatter_and(haskey_n, owner, soft, B) & nodes["valid"][None, :]  # [B, N]
+    counting = member & selector_mask
+
+    m_ep = match_terms(terms, eps["label_vals"], None) & eps["valid"][None, :] & soft[:, None]
+    cnt_node = _seg_sum(m_ep.astype(jnp.int32), jnp.broadcast_to(eps["node_idx"][None, :], m_ep.shape), N)
+    counting_t = counting[owner]
+    member_t = member[owner]
+    pair_cnt = _seg_sum(jnp.where(counting_t, cnt_node, 0), bucket_n, N)
+    pair_present = _seg_sum((member_t & haskey_n).astype(jnp.int32), bucket_n, N) > 0
+
+    merged_cnt = _merge_same_key(terms, soft, pair_cnt)
+    merged_present = _merge_same_key(terms, soft, pair_present.astype(jnp.int32)) > 0
+
+    # per-node count: Σ over the pod's soft terms of its pair count (only
+    # where the pair was initialized by a member node)
+    node_cnt_t = jnp.where(
+        haskey_n & _gather_rows(merged_present, bucket_n), _gather_rows(merged_cnt, bucket_n), 0
+    )
+    node_cnt = _scatter_add(node_cnt_t, owner, soft, B)  # [B, N]
+
+    total = jnp.sum(jnp.where(member, node_cnt, 0), axis=1)  # [B]
+    min_cnt = jnp.min(jnp.where(member, node_cnt, jnp.asarray(_BIG, node_cnt.dtype)), axis=1)
+    has_member = jnp.any(member, axis=1)
+    min_cnt = jnp.where(has_member, min_cnt, 0)
+    diff = total - min_cnt
+    # int(f64(10*(total-cnt))/diff) == exact integer division here: all values
+    # are non-negative ints < 2^35, exactly representable in float64
+    f = jnp.where(
+        diff[:, None] > 0,
+        MAX_NODE_SCORE * (total[:, None] - node_cnt) // jnp.maximum(diff, 1)[:, None],
+        MAX_NODE_SCORE,
+    )
+    return jnp.where(member & has_soft[:, None], f, 0)
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity
+# ---------------------------------------------------------------------------
+
+def interpod_filter(
+    nodes: Arrays,
+    eps: Arrays,
+    terms: Arrays,
+    aux: Arrays,
+    ex_terms: Arrays,
+    pods: Arrays,
+) -> jnp.ndarray:
+    """InterPodAffinityMatches (predicates.go:1269), metadata path:
+      1. existing pods' required anti-affinity blocks same-topology nodes
+      2. incoming required affinity: node must match topology of ALL terms
+         (with the first-pod-in-series escape)
+      3. incoming required anti-affinity: node matching ANY term fails."""
+    B = pods["valid"].shape[0]
+    N = nodes["valid"].shape[0]
+
+    # --- 1. existing-pods anti-affinity (ex_terms, owner = node row) -------
+    ex_anti = ex_terms["valid"] & (ex_terms["kind"] == ANTI_REQ)
+    m_et = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_anti[:, None]  # [ET, B]
+    owner_bucket, owner_has = _bucket_of_owner(nodes, ex_terms["topo_slot"], ex_terms["owner"])
+    bucket_n, haskey_n = _bucket_of(nodes, ex_terms["topo_slot"])  # [ET, N]
+    pair_match = owner_has & haskey_n & (bucket_n == owner_bucket)  # [ET, N]
+    fail_existing = jnp.matmul(m_et.astype(jnp.float32).T, pair_match.astype(jnp.float32)) > 0.5  # [B, N]
+
+    # --- 2./3. incoming terms ---------------------------------------------
+    aff = terms["valid"] & (terms["kind"] == AFF_REQ)
+    anti = terms["valid"] & (terms["kind"] == ANTI_REQ)
+    owner = terms["owner"]
+    # per-term property match of existing pods
+    m_ep = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :]  # [TT, M]
+    # affinity: existing pod must match ALL of the owner's aff terms
+    matchall = (
+        jnp.ones((B + 1, m_ep.shape[1]), jnp.int32)
+        .at[jnp.where(aff, owner, B)]
+        .min(jnp.where(aff[:, None], m_ep, True).astype(jnp.int32), mode="drop")[:B]
+        .astype(bool)
+    )  # [B, M]
+
+    ep_bucket, ep_has = _bucket_of(nodes, terms["topo_slot"], eps["node_idx"])  # [TT, M]
+    bucket_n2, haskey_n2 = _bucket_of(nodes, terms["topo_slot"])  # [TT, N]
+
+    contrib_aff = matchall[owner] & ep_has & aff[:, None]  # [TT, M]
+    agg_aff = _seg_sum(contrib_aff.astype(jnp.int32), ep_bucket, N) > 0  # [TT, V]
+    ok_aff_t = haskey_n2 & _gather_rows(agg_aff, bucket_n2)
+    aff_ok = _scatter_and(ok_aff_t, owner, aff, B)
+    any_pair = jnp.zeros(B + 1, bool).at[jnp.where(aff, owner, B)].max(jnp.any(agg_aff, axis=1) & aff)[:B]
+    escape = ~any_pair & aux["self_aff_match"]
+    aff_result = aff_ok | escape[:, None] | ~aux["has_aff"][:, None]
+
+    contrib_anti = m_ep & ep_has & anti[:, None]
+    agg_anti = _seg_sum(contrib_anti.astype(jnp.int32), ep_bucket, N) > 0
+    bad_anti_t = haskey_n2 & _gather_rows(agg_anti, bucket_n2)
+    anti_bad = _scatter_or(bad_anti_t, owner, anti, B)
+
+    return ~fail_existing & aff_result & ~anti_bad
+
+
+def interpod_score(
+    nodes: Arrays, eps: Arrays, terms: Arrays, ex_terms: Arrays, pods: Arrays
+) -> jnp.ndarray:
+    """CalculateInterPodAffinityPriority (interpod_affinity.go:99): weighted
+    same-topology counts from (a) the incoming pod's preferred terms matched
+    against existing pods, (b) existing pods' required-affinity (x hard
+    weight) and preferred terms matched against the incoming pod; min-max
+    normalized to [0, 10]."""
+    B = pods["valid"].shape[0]
+    N = nodes["valid"].shape[0]
+
+    # (a) incoming preferred terms vs existing pods
+    pref = terms["valid"] & ((terms["kind"] == AFF_PREF) | (terms["kind"] == ANTI_PREF))
+    owner = terms["owner"]
+    m_ep = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & pref[:, None]
+    ep_bucket, ep_has = _bucket_of(nodes, terms["topo_slot"], eps["node_idx"])
+    cnt = _seg_sum((m_ep & ep_has).astype(jnp.int32), ep_bucket, N)  # [TT, V]
+    bucket_n, haskey_n = _bucket_of(nodes, terms["topo_slot"])
+    contrib_t = jnp.where(haskey_n, _gather_rows(cnt, bucket_n), 0) * terms["weight"][:, None]
+    counts = _scatter_add(contrib_t.astype(jnp.int64), owner, pref, B)  # [B, N]
+
+    # (b) existing pods' terms vs the incoming pod (MXU matmul)
+    ex_score = ex_terms["valid"] & (
+        (ex_terms["kind"] == AFF_REQ) | (ex_terms["kind"] == AFF_PREF) | (ex_terms["kind"] == ANTI_PREF)
+    )
+    m_et = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_score[:, None]  # [ET, B]
+    owner_bucket, owner_has = _bucket_of_owner(nodes, ex_terms["topo_slot"], ex_terms["owner"])
+    bucket_ne, haskey_ne = _bucket_of(nodes, ex_terms["topo_slot"])
+    pair_match = owner_has & haskey_ne & (bucket_ne == owner_bucket)  # [ET, N]
+    weighted = m_et.astype(jnp.float32) * ex_terms["weight"][:, None].astype(jnp.float32)  # [ET, B]
+    counts = counts + jnp.matmul(weighted.T, pair_match.astype(jnp.float32)).astype(jnp.int64)
+
+    valid = nodes["valid"][None, :] & pods["valid"][:, None]
+    masked = jnp.where(valid, counts, 0)
+    max_c = jnp.maximum(jnp.max(masked, axis=1), 0)  # [B]
+    min_c = jnp.minimum(jnp.min(masked, axis=1), 0)
+    diff = max_c - min_c
+    # exact: non-negative int64 operands, f64 division would be exact anyway
+    f = jnp.where(
+        diff[:, None] > 0,
+        MAX_NODE_SCORE * (counts - min_c[:, None]) // jnp.maximum(diff, 1)[:, None],
+        0,
+    )
+    return jnp.where(valid, f, 0)
+
+
+# ---------------------------------------------------------------------------
+# SelectorSpread
+# ---------------------------------------------------------------------------
+
+def selector_spread_score(
+    nodes: Arrays, eps: Arrays, terms: Arrays, aux: Arrays
+) -> jnp.ndarray:
+    """CalculateSpreadPriorityMap/Reduce (selector_spreading.go): count
+    same-namespace non-deleting pods matching ALL controller selectors;
+    blend 1/3 node-level + 2/3 zone-level, fewer is better."""
+    B = aux["n_sel_spread"].shape[0]
+    N = nodes["valid"].shape[0]
+    ss = terms["valid"] & (terms["kind"] == SEL_SPREAD)
+    owner = terms["owner"]
+    m_ep = match_terms(terms, eps["label_vals"], eps["ns_id"])  # ns compiled = pod ns
+    # AND across the pod's selectors
+    matchall = (
+        jnp.ones((B + 1, m_ep.shape[1]), jnp.int32)
+        .at[jnp.where(ss, owner, B)]
+        .min(jnp.where(ss[:, None], m_ep, True).astype(jnp.int32), mode="drop")[:B]
+        .astype(bool)
+    )
+    matchall = matchall & eps["valid"][None, :] & ~eps["deleting"][None, :]
+    matchall = matchall & (aux["n_sel_spread"] > 0)[:, None]
+    counts = jax.vmap(
+        lambda m: jax.ops.segment_sum(m.astype(jnp.int64), eps["node_idx"], num_segments=N)
+    )(matchall)  # [B, N]
+    counts = jnp.where(nodes["valid"][None, :], counts, 0)
+
+    max_node = jnp.max(counts, axis=1)  # [B]
+    zone_ok = (nodes["zone_dense"] >= 0) & nodes["valid"]
+    zbucket = jnp.clip(nodes["zone_dense"], 0)
+    zcounts = jax.vmap(
+        lambda c: jax.ops.segment_sum(jnp.where(zone_ok, c, 0), zbucket, num_segments=N)
+    )(counts)  # [B, Z]
+    max_zone = jnp.max(zcounts, axis=1)
+    have_zones = jnp.any(zone_ok)
+
+    fscore = jnp.where(
+        max_node[:, None] > 0,
+        MAX_NODE_SCORE * (max_node[:, None] - counts).astype(jnp.float64) / jnp.maximum(max_node, 1)[:, None],
+        jnp.float64(MAX_NODE_SCORE),
+    )
+    zscore = jnp.where(
+        max_zone[:, None] > 0,
+        MAX_NODE_SCORE * (max_zone[:, None] - zcounts).astype(jnp.float64) / jnp.maximum(max_zone, 1)[:, None],
+        jnp.float64(MAX_NODE_SCORE),
+    )
+    node_z = jnp.take_along_axis(
+        zscore, jnp.broadcast_to(zbucket[None, :], counts.shape), axis=1
+    )
+    blended = jnp.where(
+        have_zones & zone_ok[None, :],
+        fscore * (1.0 / 3.0) + (2.0 / 3.0) * node_z,
+        fscore,
+    )
+    return blended.astype(jnp.int64)

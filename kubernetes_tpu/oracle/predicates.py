@@ -23,6 +23,9 @@ from ..api.selectors import (
 from ..api.types import (
     Affinity,
     Pod,
+    RESOURCE_CPU,
+    RESOURCE_EPHEMERAL_STORAGE,
+    RESOURCE_MEMORY,
     PodAffinityTerm,
     TAINT_NO_EXECUTE,
     TAINT_NO_SCHEDULE,
@@ -89,21 +92,27 @@ def pod_match_node_selector(pod: Pod, node_info: NodeInfo) -> bool:
 
 
 def pod_fits_resources(pod: Pod, node_info: NodeInfo) -> bool:
-    """PodFitsResources (predicates.go:854): pod count always checked; cpu,
-    memory, ephemeral-storage and scalar resources checked only if the pod
-    requests anything at all."""
+    """PodFitsResources (predicates.go:854): pod count always checked. When
+    the pod requests anything at all, cpu/memory/ephemeral-storage are ALWAYS
+    checked (so a zero-cpu pod still fails on a cpu-overcommitted node, per
+    the reference's unconditional compares at predicates.go:886-895) while
+    scalar resources are checked only when requested non-zero (explicit-zero
+    scalar requests are treated as unset — indistinguishable in the tensor
+    encoding; deviation only matters on overcommitted nodes)."""
     if len(node_info.pods) + 1 > node_info.allowed_pod_number():
         return False
     req = pod.resource_request()
-    interesting = {k: v for k, v in req.items() if v != 0}
-    if not interesting:
+    if all(v == 0 for k, v in req.items() if k != "pods"):
         return True
     alloc = node_info.node.allocatable_int()
     used = node_info.requested()
-    for name, r in interesting.items():
-        if name == "pods":
+    for name in (RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_EPHEMERAL_STORAGE):
+        if alloc.get(name, 0) < req.get(name, 0) + used.get(name, 0):
+            return False
+    for name, r in req.items():
+        if name in (RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_EPHEMERAL_STORAGE, "pods"):
             continue
-        if alloc.get(name, 0) < r + used.get(name, 0):
+        if r != 0 and alloc.get(name, 0) < r + used.get(name, 0):
             return False
     return True
 

@@ -1,0 +1,329 @@
+"""Flat term tables: the sparse encoding of (anti-)affinity terms, topology
+spread constraints, and spreading selectors.
+
+The reference precomputes per-pod topology-pair maps (predicates/metadata.go
+topologyPairsMaps, evenPodsSpreadMetadata) with nested hash maps. Here every
+term — an (owner, topology-key-slot, namespace-set, label-selector) tuple —
+becomes one ROW of a padded table; matching a term against all existing pods
+or the whole incoming batch is then a single broadcasted integer-compare, and
+per-topology-value aggregation is a segment_sum keyed by the dense value
+index (NodeBank.label_dense). Affinity terms are rare relative to pods, so
+the tables stay small (sparse encoding of a quadratic problem).
+
+Term kinds:
+  incoming batch:  AFF_REQ, ANTI_REQ (Filter), AFF_PREF, ANTI_PREF (Score),
+                   SPREAD_HARD (Filter), SPREAD_SOFT (Score), SEL_SPREAD
+  existing pods:   same AFF_*/ANTI_* kinds with owner = ExistingPodsBank row
+                   (the symmetric side: existing pods' terms matched against
+                   the incoming pod)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.types import (
+    LabelSelector,
+    Pod,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from ..api.selectors import match_label_selector
+from ..oracle.nodeinfo import Snapshot
+from ..oracle.predicates import (
+    get_hard_spread_constraints,
+    get_pod_affinity_terms,
+    get_pod_anti_affinity_terms,
+    get_soft_spread_constraints,
+    pod_matches_all_term_properties,
+)
+from .tensors import (
+    KeySlotOverflow,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NEVER,
+    OP_NOT_IN,
+    OP_PAD,
+    Vocab,
+    _bucket,
+)
+
+# term kinds
+AFF_REQ = 1
+ANTI_REQ = 2
+AFF_PREF = 3
+ANTI_PREF = 4
+SPREAD_HARD = 5
+SPREAD_SOFT = 6
+SEL_SPREAD = 7
+
+
+@dataclass
+class TermBank:
+    """Padded term rows + compiled label selectors."""
+
+    vocab: Vocab
+    capacity: int
+    ns_cap: int = 4  # namespaces per term
+    ml_cap: int = 4  # matchLabels pairs per selector
+    ex_cap: int = 4  # matchExpressions per selector
+    val_cap: int = 6  # values per expression
+
+    def __post_init__(self):
+        t = self.capacity
+        self.key_capacity = self.vocab.config.key_slots
+        self.valid = np.zeros(t, bool)
+        self.kind = np.zeros(t, np.int32)
+        self.owner = np.zeros(t, np.int32)
+        self.weight = np.zeros(t, np.int32)  # pref weight / maxSkew
+        self.topo_slot = np.full(t, -1, np.int32)
+        self.self_match = np.zeros(t, bool)  # spread: selector matches owner pod
+        self.ns_any = np.zeros(t, bool)
+        self.ns_ids = np.zeros((t, self.ns_cap), np.int32)
+        self.has_selector = np.zeros(t, bool)  # nil selector matches nothing
+        self.ml_slot = np.full((t, self.ml_cap), -1, np.int32)
+        self.ml_val = np.zeros((t, self.ml_cap), np.int32)
+        self.ex_op = np.zeros((t, self.ex_cap), np.int32)
+        self.ex_slot = np.full((t, self.ex_cap), -1, np.int32)
+        self.ex_vals = np.full((t, self.ex_cap, self.val_cap), -1, np.int32)
+        self.count = 0
+        self.overflow_owners: set = set()
+
+    def _compile_selector(self, row: int, sel: Optional[LabelSelector]) -> None:
+        v = self.vocab
+        if sel is None:
+            self.has_selector[row] = False
+            return
+        self.has_selector[row] = True
+        ml = list(sel.match_labels.items())
+        if len(ml) > self.ml_cap:
+            self.overflow_owners.add(int(self.owner[row]))
+        for j, (k, val) in enumerate(ml[: self.ml_cap]):
+            s = v.slot_of_key(k)
+            if s >= self.key_capacity:
+                raise KeySlotOverflow()
+            self.ml_slot[row, j] = s
+            self.ml_val[row, j] = v.id(val)
+        exprs = sel.match_expressions
+        if len(exprs) > self.ex_cap:
+            self.overflow_owners.add(int(self.owner[row]))
+        op_map = {"In": OP_IN, "NotIn": OP_NOT_IN, "Exists": OP_EXISTS, "DoesNotExist": OP_DOES_NOT_EXIST}
+        for j, e in enumerate(exprs[: self.ex_cap]):
+            op = op_map.get(e.operator, OP_NEVER)
+            # In/NotIn with no values is invalid (selector parse error →
+            # matches nothing, LabelSelectorAsSelector error path)
+            if op in (OP_IN, OP_NOT_IN) and not e.values:
+                op = OP_NEVER
+            self.ex_op[row, j] = op
+            s = v.slot_of_key(e.key)
+            if s >= self.key_capacity:
+                raise KeySlotOverflow()
+            self.ex_slot[row, j] = s
+            if len(e.values) > self.val_cap:
+                self.overflow_owners.add(int(self.owner[row]))
+            for k_idx, val in enumerate(e.values[: self.val_cap]):
+                self.ex_vals[row, j, k_idx] = v.id(val)
+
+    def add(
+        self,
+        kind: int,
+        owner: int,
+        topo_key: str,
+        selector: Optional[LabelSelector],
+        namespaces: Sequence[str] = (),
+        ns_any: bool = False,
+        weight: int = 0,
+        self_match: bool = False,
+    ) -> int:
+        v = self.vocab
+        row = self.count
+        if row >= self.capacity:
+            self.overflow_owners.add(owner)
+            return -1
+        self.count += 1
+        self.valid[row] = True
+        self.kind[row] = kind
+        self.owner[row] = owner
+        self.weight[row] = weight
+        self.self_match[row] = self_match
+        if topo_key:
+            s = v.slot_of_key(topo_key)
+            if s >= self.key_capacity:
+                raise KeySlotOverflow()
+            self.topo_slot[row] = s
+        self.ns_any[row] = ns_any
+        if not ns_any:
+            nss = list(namespaces)
+            if len(nss) > self.ns_cap:
+                self.overflow_owners.add(owner)
+            for j, ns in enumerate(nss[: self.ns_cap]):
+                self.ns_ids[row, j] = v.id(ns)
+        self._compile_selector(row, selector)
+        return row
+
+    def arrays(self) -> Dict[str, np.ndarray]:
+        return {
+            "valid": self.valid,
+            "kind": self.kind,
+            "owner": self.owner,
+            "weight": self.weight,
+            "topo_slot": self.topo_slot,
+            "self_match": self.self_match,
+            "ns_any": self.ns_any,
+            "ns_ids": self.ns_ids,
+            "has_selector": self.has_selector,
+            "ml_slot": self.ml_slot,
+            "ml_val": self.ml_val,
+            "ex_op": self.ex_op,
+            "ex_slot": self.ex_slot,
+            "ex_vals": self.ex_vals,
+        }
+
+
+def _term_namespaces(owner_pod: Pod, term: PodAffinityTerm) -> List[str]:
+    return list(term.namespaces) if term.namespaces else [owner_pod.namespace]
+
+
+def compile_batch_terms(
+    vocab: Vocab,
+    pods: Sequence[Pod],
+    spread_selectors: Optional[Dict[int, List[LabelSelector]]] = None,
+    capacity: Optional[int] = None,
+    b_capacity: Optional[int] = None,
+) -> Tuple[TermBank, Dict[str, np.ndarray]]:
+    """Compile all topology-coupled structure of a pending-pod batch into one
+    TermBank + per-pod aux arrays:
+      self_aff_match[b]: pod matches its own required affinity terms' props
+                         (the first-pod-in-series escape hatch)
+      has_aff[b] / has_anti[b]: pod has required (anti-)affinity terms
+      n_sel_spread[b]: number of spreading selectors (0 → score 0 rule)
+    """
+    n_terms = 0
+    for p in pods:
+        n_terms += len(get_hard_spread_constraints(p)) + len(get_soft_spread_constraints(p))
+        n_terms += len(get_pod_affinity_terms(p.affinity)) + len(get_pod_anti_affinity_terms(p.affinity))
+        if p.affinity is not None and p.affinity.pod_affinity is not None:
+            n_terms += len(p.affinity.pod_affinity.preferred)
+        if p.affinity is not None and p.affinity.pod_anti_affinity is not None:
+            n_terms += len(p.affinity.pod_anti_affinity.preferred)
+        if spread_selectors:
+            n_terms += len(spread_selectors.get(id(p), []) or [])
+    bank = TermBank(vocab, capacity or _bucket(max(n_terms, 1)))
+    b_count = b_capacity or _bucket(len(pods))
+    self_aff_match = np.zeros(b_count, bool)
+    has_aff = np.zeros(b_count, bool)
+    has_anti = np.zeros(b_count, bool)
+    n_sel_spread = np.zeros(b_count, np.int32)
+
+    for b, p in enumerate(pods):
+        for c in get_hard_spread_constraints(p):
+            bank.add(
+                SPREAD_HARD,
+                b,
+                c.topology_key,
+                c.label_selector,
+                namespaces=[p.namespace],
+                weight=c.max_skew,
+                self_match=match_label_selector(c.label_selector, p.labels),
+            )
+        for c in get_soft_spread_constraints(p):
+            # the soft-spread priority counts matching pods in ALL namespaces
+            # (even_pods_spread.go quirk, see oracle.priorities)
+            bank.add(
+                SPREAD_SOFT,
+                b,
+                c.topology_key,
+                c.label_selector,
+                ns_any=True,
+                weight=c.max_skew,
+                self_match=match_label_selector(c.label_selector, p.labels),
+            )
+        aff_terms = get_pod_affinity_terms(p.affinity)
+        if aff_terms:
+            has_aff[b] = True
+            self_aff_match[b] = pod_matches_all_term_properties(p, p, aff_terms)
+        for t in aff_terms:
+            bank.add(AFF_REQ, b, t.topology_key, t.label_selector, _term_namespaces(p, t))
+        anti_terms = get_pod_anti_affinity_terms(p.affinity)
+        if anti_terms:
+            has_anti[b] = True
+        for t in anti_terms:
+            bank.add(ANTI_REQ, b, t.topology_key, t.label_selector, _term_namespaces(p, t))
+        if p.affinity is not None and p.affinity.pod_affinity is not None:
+            for w in p.affinity.pod_affinity.preferred:
+                if w.weight and w.pod_affinity_term.topology_key:
+                    t = w.pod_affinity_term
+                    bank.add(AFF_PREF, b, t.topology_key, t.label_selector, _term_namespaces(p, t), weight=w.weight)
+        if p.affinity is not None and p.affinity.pod_anti_affinity is not None:
+            for w in p.affinity.pod_anti_affinity.preferred:
+                if w.weight and w.pod_affinity_term.topology_key:
+                    t = w.pod_affinity_term
+                    bank.add(ANTI_PREF, b, t.topology_key, t.label_selector, _term_namespaces(p, t), weight=-w.weight)
+        for sel in (spread_selectors or {}).get(id(p), []) or []:
+            bank.add(SEL_SPREAD, b, "", sel, namespaces=[p.namespace])
+            n_sel_spread[b] += 1
+    aux = {
+        "self_aff_match": self_aff_match,
+        "has_aff": has_aff,
+        "has_anti": has_anti,
+        "n_sel_spread": n_sel_spread,
+    }
+    return bank, aux
+
+
+def compile_existing_terms(
+    vocab: Vocab,
+    snapshot: Snapshot,
+    row_of: Dict[str, int],
+    hard_pod_affinity_weight: int = 1,
+    capacity: Optional[int] = None,
+) -> Tuple[TermBank, Dict[int, int]]:
+    """Compile every existing pod's (anti-)affinity terms. Owner = the row of
+    the pod's NODE in the NodeBank (all the kernels need is the fixed node).
+
+    Returns (bank, {}). Kind semantics on this bank:
+      ANTI_REQ — existing pod's required anti-affinity (Filter: blocks the
+                 incoming pod on same-topology nodes)
+      AFF_REQ  — existing pod's required affinity (Score: symmetric weight =
+                 hardPodAffinityWeight, interpod_affinity.go:131)
+      AFF_PREF/ANTI_PREF — existing pod's preferred terms (Score, ±weight)
+    """
+    pods_with_terms = []
+    n_terms = 0
+    for ni in snapshot.node_infos.values():
+        for p in ni.pods_with_affinity():
+            aff = p.affinity
+            cnt = len(get_pod_affinity_terms(aff)) + len(get_pod_anti_affinity_terms(aff))
+            if aff.pod_affinity is not None:
+                cnt += len(aff.pod_affinity.preferred)
+            if aff.pod_anti_affinity is not None:
+                cnt += len(aff.pod_anti_affinity.preferred)
+            if cnt:
+                pods_with_terms.append((p, row_of[ni.node.name]))
+                n_terms += cnt
+    bank = TermBank(vocab, capacity or _bucket(max(n_terms, 1)))
+    for p, node_row in pods_with_terms:
+        aff = p.affinity
+        for t in get_pod_anti_affinity_terms(aff):
+            bank.add(ANTI_REQ, node_row, t.topology_key, t.label_selector, _term_namespaces(p, t))
+        for t in get_pod_affinity_terms(aff):
+            if hard_pod_affinity_weight > 0 and t.topology_key:
+                bank.add(
+                    AFF_REQ, node_row, t.topology_key, t.label_selector,
+                    _term_namespaces(p, t), weight=hard_pod_affinity_weight,
+                )
+        if aff.pod_affinity is not None:
+            for w in aff.pod_affinity.preferred:
+                if w.weight and w.pod_affinity_term.topology_key:
+                    t = w.pod_affinity_term
+                    bank.add(AFF_PREF, node_row, t.topology_key, t.label_selector, _term_namespaces(p, t), weight=w.weight)
+        if aff.pod_anti_affinity is not None:
+            for w in aff.pod_anti_affinity.preferred:
+                if w.weight and w.pod_affinity_term.topology_key:
+                    t = w.pod_affinity_term
+                    bank.add(ANTI_PREF, node_row, t.topology_key, t.label_selector, _term_namespaces(p, t), weight=-w.weight)
+    return bank, {}

@@ -1,0 +1,75 @@
+"""Bit-for-bit parity: device score kernels vs the scalar oracle."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from kubernetes_tpu.models.generators import ClusterGen
+from kubernetes_tpu.ops import scores as S
+from kubernetes_tpu.oracle import Snapshot
+from kubernetes_tpu.oracle import priorities as opri
+from kubernetes_tpu.state.tensors import PodBatch, _bucket, encode_snapshot
+
+ORACLE_FNS = {
+    "least_requested": opri.least_requested_priority,
+    "most_requested": opri.most_requested_priority,
+    "balanced_allocation": opri.balanced_resource_allocation,
+    "node_affinity": opri.node_affinity_priority,
+    "taint_toleration": opri.taint_toleration_priority,
+    "prefer_avoid_pods": opri.node_prefer_avoid_pods_priority,
+    "image_locality": opri.image_locality_priority,
+}
+
+
+def _encode(snap, pods):
+    bank, eps, rows = encode_snapshot(snap)
+    batch = PodBatch(bank.vocab, _bucket(len(pods)))
+    for i, p in enumerate(pods):
+        batch.set_pod(i, p)
+    na = {k: jnp.asarray(v) for k, v in bank.arrays().items()}
+    pa = {k: jnp.asarray(v) for k, v in batch.arrays().items()}
+    return na, pa
+
+
+@pytest.mark.parametrize("seed", [10, 11])
+def test_score_parity_random_clusters(seed):
+    g = ClusterGen(seed)
+    nodes, existing = g.cluster(20, 70, feature_rate=0.5)
+    snap = Snapshot(nodes, existing)
+    pods = [g.pod(70_000 + i, feature_rate=0.5) for i in range(12)]
+    na, pa = _encode(snap, pods)
+    device = {k: np.asarray(v) for k, v in S.score_components(na, pa).items()}
+    node_names = list(snap.node_infos.keys())
+    for name, fn in ORACLE_FNS.items():
+        for b, p in enumerate(pods):
+            expect = fn(p, snap)
+            for n, node_name in enumerate(node_names):
+                assert int(device[name][b, n]) == expect[node_name], (
+                    f"seed={seed} priority={name} pod={p.name} node={node_name} "
+                    f"oracle={expect[node_name]} device={int(device[name][b, n])}"
+                )
+
+
+def test_prefer_avoid_pods_signature():
+    import json
+
+    from kubernetes_tpu.models.generators import make_node, make_pod
+
+    node_bad = make_node("n-avoid")
+    node_bad.annotations[opri.PREFER_AVOID_PODS_ANNOTATION] = json.dumps(
+        {
+            "preferAvoidPods": [
+                {"podSignature": {"podController": {"kind": "ReplicaSet", "uid": "rs-1"}}}
+            ]
+        }
+    )
+    node_ok = make_node("n-ok")
+    snap = Snapshot([node_bad, node_ok], [])
+    pod = make_pod("p")
+    pod.owner_references = [{"kind": "ReplicaSet", "uid": "rs-1", "controller": True}]
+    na, pa = _encode(snap, [pod])
+    got = np.asarray(S.prefer_avoid_pods(na, pa))
+    assert got[0, 0] == 0 and got[0, 1] == S.MAX_NODE_SCORE
+    expect = opri.node_prefer_avoid_pods_priority(pod, snap)
+    assert expect["n-avoid"] == 0 and expect["n-ok"] == 10

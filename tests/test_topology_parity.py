@@ -1,0 +1,118 @@
+"""Bit-for-bit parity: topology kernels (spread / inter-pod affinity /
+selector spread) vs the scalar oracle."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from kubernetes_tpu.api.types import LabelSelector
+from kubernetes_tpu.models.generators import ClusterGen
+from kubernetes_tpu.ops import filters as F
+from kubernetes_tpu.ops import topology as T
+from kubernetes_tpu.oracle import Snapshot
+from kubernetes_tpu.oracle import predicates as opred
+from kubernetes_tpu.oracle import priorities as opri
+from kubernetes_tpu.state.tensors import PodBatch, _bucket, encode_snapshot
+from kubernetes_tpu.state.terms import compile_batch_terms, compile_existing_terms
+
+
+def _setup(seed, n_nodes=20, n_existing=80, n_pending=12, feature_rate=0.6, selectors=None):
+    g = ClusterGen(seed)
+    nodes, existing = g.cluster(n_nodes, n_existing, feature_rate)
+    snap = Snapshot(nodes, existing)
+    pods = [g.pod(80_000 + i, feature_rate) for i in range(n_pending)]
+    bank, epsb, row_of = encode_snapshot(snap)
+    vocab = bank.vocab
+    batch = PodBatch(vocab, _bucket(len(pods)))
+    for i, p in enumerate(pods):
+        batch.set_pod(i, p)
+    tb, aux = compile_batch_terms(vocab, pods, spread_selectors=selectors)
+    etb, _ = compile_existing_terms(vocab, snap, row_of)
+    na = {k: jnp.asarray(v) for k, v in bank.arrays().items()}
+    pa = {k: jnp.asarray(v) for k, v in batch.arrays().items()}
+    ea = {k: jnp.asarray(v) for k, v in epsb.arrays().items()}
+    ta = {k: jnp.asarray(v) for k, v in tb.arrays().items()}
+    xa = {k: jnp.asarray(v) for k, v in etb.arrays().items()}
+    auxa = {k: jnp.asarray(v) for k, v in aux.items()}
+    sel_mask = F.pod_match_node_selector(na, pa)
+    return snap, pods, na, pa, ea, ta, xa, auxa, sel_mask
+
+
+@pytest.mark.parametrize("seed", [20, 21, 22])
+def test_spread_filter_parity(seed):
+    snap, pods, na, pa, ea, ta, xa, aux, sel_mask = _setup(seed)
+    got = np.asarray(T.spread_filter(na, ea, ta, sel_mask))
+    node_list = list(snap.node_infos.values())
+    for b, p in enumerate(pods):
+        meta = opred.compute_even_pods_spread_metadata(p, snap)
+        for n, ni in enumerate(node_list):
+            expect = opred.even_pods_spread_predicate(p, ni, meta)
+            assert bool(got[b, n]) == expect, f"seed={seed} pod={p.name} node={ni.node.name}"
+
+
+@pytest.mark.parametrize("seed", [23, 24, 25])
+def test_interpod_filter_parity(seed):
+    snap, pods, na, pa, ea, ta, xa, aux, sel_mask = _setup(seed)
+    got = np.asarray(T.interpod_filter(na, ea, ta, aux, xa, pa))
+    node_list = list(snap.node_infos.values())
+    for b, p in enumerate(pods):
+        meta = opred.compute_pod_affinity_metadata(p, snap)
+        for n, ni in enumerate(node_list):
+            expect = opred.inter_pod_affinity_matches(p, ni, meta)
+            assert bool(got[b, n]) == expect, f"seed={seed} pod={p.name} node={ni.node.name}"
+
+
+@pytest.mark.parametrize("seed", [26, 27])
+def test_spread_score_parity(seed):
+    snap, pods, na, pa, ea, ta, xa, aux, sel_mask = _setup(seed)
+    got = np.asarray(T.spread_score(na, ea, ta, aux, sel_mask))
+    node_names = list(snap.node_infos.keys())
+    for b, p in enumerate(pods):
+        expect = opri.even_pods_spread_priority(p, snap)
+        for n, name in enumerate(node_names):
+            assert int(got[b, n]) == expect[name], (
+                f"seed={seed} pod={p.name} node={name} oracle={expect[name]} got={int(got[b, n])}"
+            )
+
+
+@pytest.mark.parametrize("seed", [28, 29])
+def test_interpod_score_parity(seed):
+    snap, pods, na, pa, ea, ta, xa, aux, sel_mask = _setup(seed)
+    got = np.asarray(T.interpod_score(na, ea, ta, xa, pa))
+    node_names = list(snap.node_infos.keys())
+    for b, p in enumerate(pods):
+        expect = opri.inter_pod_affinity_priority(p, snap)
+        for n, name in enumerate(node_names):
+            assert int(got[b, n]) == expect[name], (
+                f"seed={seed} pod={p.name} node={name} oracle={expect[name]} got={int(got[b, n])}"
+            )
+
+
+def test_selector_spread_parity():
+    g = ClusterGen(33)
+    nodes, existing = g.cluster(16, 60, 0.5)
+    snap = Snapshot(nodes, existing)
+    pods = [g.pod(90_000 + i, 0.5) for i in range(8)]
+    sels = {
+        id(p): [LabelSelector(match_labels={"app": p.labels.get("app", "web")})]
+        for p in pods[:6]  # last two pods: no controller selectors
+    }
+    bank, epsb, row_of = encode_snapshot(snap)
+    vocab = bank.vocab
+    batch = PodBatch(vocab, _bucket(len(pods)))
+    for i, p in enumerate(pods):
+        batch.set_pod(i, p)
+    tb, aux = compile_batch_terms(vocab, pods, spread_selectors=sels)
+    na = {k: jnp.asarray(v) for k, v in bank.arrays().items()}
+    ea = {k: jnp.asarray(v) for k, v in epsb.arrays().items()}
+    ta = {k: jnp.asarray(v) for k, v in tb.arrays().items()}
+    auxa = {k: jnp.asarray(v) for k, v in aux.items()}
+    got = np.asarray(T.selector_spread_score(na, ea, ta, auxa))
+    node_names = list(snap.node_infos.keys())
+    for b, p in enumerate(pods):
+        expect = opri.selector_spread_priority(p, snap, sels.get(id(p)))
+        for n, name in enumerate(node_names):
+            assert int(got[b, n]) == expect[name], (
+                f"pod={p.name} node={name} oracle={expect[name]} got={int(got[b, n])}"
+            )
