@@ -1,0 +1,135 @@
+"""Batch assignment solver: the departure from the reference's one-pod loop.
+
+The reference schedules strictly one pod per cycle (scheduleOne,
+scheduler.go:579): filter -> score -> selectHost -> assume, with the cache
+mutated between pods. Here a whole BATCH of pending pods is solved in one
+compiled XLA program: a lax.scan walks the pods in the same order the
+reference's queue would pop them (priority desc, then enqueue time asc —
+internal/queue/scheduling_queue.go activeQ comparator), committing each pod
+to its best feasible node and updating the resource residuals in the scan
+carry. One device dispatch replaces B scheduling cycles.
+
+Intra-batch semantics contract:
+* Resources and pod counts are EXACT within the batch (the carry).
+* Topology masks/scores (spread, inter-pod affinity) are computed against
+  the pre-batch snapshot; pods earlier in the batch do not update them for
+  later pods. Pods carrying topology constraints (or matched by existing
+  anti-affinity terms) should be committed through the host-side oracle
+  re-check (scheduler/driver.py) — the same optimistic-assume + re-queue
+  discipline the reference applies across its async bind boundary
+  (scheduler.go:631-673, MakeDefaultErrorFunc re-queue on conflict).
+* selectHost tie-break: uniform among max-score nodes via the PRNG key
+  (core/generic_scheduler.go:278 reservoir sampling).
+
+Gang/all-or-nothing (absent upstream, natural here): pods may carry a group
+id; a second scan pass drops groups that did not fully fit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Arrays = Dict[str, jnp.ndarray]
+
+
+def pop_order(priority: jnp.ndarray, enqueue_seq: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Queue pop order: priority desc, then enqueue sequence asc (activeQ
+    comparator podsCompareBackoffCompleted / higher-priority-first); invalid
+    rows last. Returns the permutation [B]."""
+    return jnp.lexsort((enqueue_seq, -priority.astype(jnp.int64), ~valid))
+
+
+def _select_host(score: jnp.ndarray, feasible: jnp.ndarray, key) -> jnp.ndarray:
+    """selectHost semantics: uniform among the max-score feasible nodes."""
+    neg = jnp.iinfo(score.dtype).min
+    masked = jnp.where(feasible, score, neg)
+    best = jnp.max(masked)
+    ties = feasible & (masked == best)
+    # random tie-break: pick max over uniform noise restricted to ties
+    noise = jax.random.uniform(key, score.shape)
+    pick = jnp.argmax(jnp.where(ties, noise, -1.0))
+    return jnp.where(jnp.any(feasible), pick, -1)
+
+
+@partial(jax.jit, static_argnames=("deterministic",))
+def solve_greedy(
+    mask: jnp.ndarray,  # [B, N] feasibility from filter kernels
+    score: jnp.ndarray,  # [B, N] weighted priority sums
+    req: jnp.ndarray,  # [B, R] pod requests (GetResourceRequest)
+    free0: jnp.ndarray,  # [N, R] alloc - requested at batch start
+    count0: jnp.ndarray,  # [N] pod counts at batch start
+    allowed: jnp.ndarray,  # [N] allowed pod numbers
+    order: jnp.ndarray,  # [B] scan order (pop_order)
+    rng_key,  # PRNG key for tie-breaks
+    deterministic: bool = False,
+) -> jnp.ndarray:
+    """Greedy-by-priority batch assignment → node row per pod, -1 = no fit.
+
+    Each scan step re-checks resource fit against the carry residuals, so an
+    earlier pod consuming a node's last CPU makes it infeasible for later
+    pods — exactly as if the reference had scheduled them sequentially."""
+    B, N = mask.shape
+
+    def step(carry, inp):
+        free, count = carry
+        i, key = inp
+        m = mask[i]
+        fits = jnp.all(req[i][None, :] <= free, axis=-1) & (count + 1 <= allowed)
+        feasible = m & fits
+        if deterministic:
+            neg = jnp.iinfo(score.dtype).min
+            masked = jnp.where(feasible, score[i], neg)
+            choice = jnp.where(jnp.any(feasible), jnp.argmax(masked), -1)
+        else:
+            choice = _select_host(score[i], feasible, key)
+        committed = choice >= 0
+        sel = jnp.where(committed, choice, 0)
+        free = jnp.where(
+            committed,
+            free.at[sel].add(-req[i]),
+            free,
+        )
+        count = jnp.where(committed, count.at[sel].add(1), count)
+        return (free, count), choice
+
+    keys = jax.random.split(rng_key, B)
+    (_, _), choices = jax.lax.scan(step, (free0, count0), (order, keys))
+    # scatter back to original pod positions
+    out = jnp.full((B,), -1, jnp.int32)
+    return out.at[order].set(choices.astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("deterministic",))
+def solve_gang(
+    mask: jnp.ndarray,
+    score: jnp.ndarray,
+    req: jnp.ndarray,
+    free0: jnp.ndarray,
+    count0: jnp.ndarray,
+    allowed: jnp.ndarray,
+    order: jnp.ndarray,
+    group: jnp.ndarray,  # [B] group id, -1 = ungrouped
+    rng_key,
+    deterministic: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """All-or-nothing gang assignment: two-pass greedy. Pass 1 places
+    everything; groups with any unplaced member are dropped and pass 2
+    re-solves without them (their capacity is released for other pods).
+    Returns (assignment [B], gang_ok [B])."""
+    B = mask.shape[0]
+    k1, k2 = jax.random.split(rng_key)
+    first = solve_greedy(mask, score, req, free0, count0, allowed, order, k1, deterministic=deterministic)
+    grouped = group >= 0
+    failed_member = grouped & (first < 0)
+    # group failed if ANY member failed (segment max over group ids)
+    ngroups = B  # group ids are < B by construction
+    fail_by_group = jnp.zeros(ngroups, bool).at[jnp.where(grouped, group, 0)].max(failed_member)
+    dropped = grouped & fail_by_group[jnp.where(grouped, group, 0)]
+    mask2 = mask & ~dropped[:, None]
+    second = solve_greedy(mask2, score, req, free0, count0, allowed, order, k2, deterministic=deterministic)
+    gang_ok = ~dropped
+    return jnp.where(dropped, -1, second), gang_ok

@@ -1,0 +1,346 @@
+"""Scheduler driver: the batch scheduling loop.
+
+The reference's scheduleOne (scheduler.go:579) does, per pod: pop →
+snapshot → filter → score → selectHost → reserve → assume → async(permit →
+prebind → bind → postbind). This driver keeps exactly that lifecycle and
+extension-hook order but amortizes the expensive middle across a BATCH:
+
+    pop_batch → TensorMirror.sync (dirty-row patch) → device kernels
+    (filter+score+topology matrices) → lax.scan greedy solve →
+    per-pod commit: [oracle re-check if topology-coupled] → reserve →
+    assume → async bind pipeline
+
+Failure handling mirrors MakeDefaultErrorFunc (factory.go:646): failed /
+unfitting pods go back through AddUnschedulableIfNotPresent with the cycle
+counter, and preemption (preemption.py) nominates a node when enabled.
+
+The pipeline parallelism of assume-then-async-bind (scheduler.go:631-673) is
+kept: binds run on a thread pool while the next batch solves on device.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api.types import Pod
+from ..framework.interface import CycleState, Framework, Status
+from ..oracle.predicates import compute_predicate_metadata, pod_fits_on_node
+from ..state.cache import SchedulerCache, TensorMirror
+from ..state.queue import PodInfo, PriorityQueue
+from ..state.tensors import KeySlotOverflow, PodBatch, _bucket
+from ..state.terms import compile_batch_terms, compile_existing_terms
+from . import preemption as preemption_mod
+
+
+@dataclass
+class ScheduleResult:
+    scheduled: int = 0
+    unschedulable: int = 0
+    errors: int = 0
+    preempted: int = 0
+    assignments: Dict[str, str] = field(default_factory=dict)
+
+
+class Binder:
+    """Default binder: callable hook (pod, node_name) -> None, raising on
+    failure — the equivalent of POST pods/<p>/binding (factory.go:713)."""
+
+    def __init__(self, bind_fn: Optional[Callable[[Pod, str], None]] = None):
+        self._fn = bind_fn
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        if self._fn is not None:
+            self._fn(pod, node_name)
+
+
+def _needs_oracle_recheck(pod: Pod) -> bool:
+    """Pods whose feasibility can be perturbed by earlier pods in the same
+    batch (the solver's carry only tracks resources): topology-spread or
+    required (anti-)affinity terms. See ops/solver.py contract."""
+    if pod.topology_spread_constraints:
+        return True
+    a = pod.affinity
+    if a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None):
+        return True
+    return False
+
+
+class Scheduler:
+    """The driver. One instance per scheduler process (leader)."""
+
+    def __init__(
+        self,
+        cache: Optional[SchedulerCache] = None,
+        queue: Optional[PriorityQueue] = None,
+        binder: Optional[Binder] = None,
+        framework: Optional[Framework] = None,
+        batch_size: int = 256,
+        enable_preemption: bool = True,
+        deterministic: bool = False,
+        seed: int = 0,
+        error_fn: Optional[Callable[[Pod, Exception], None]] = None,
+        bind_workers: int = 8,
+        event_fn: Optional[Callable[[Pod, str, str], None]] = None,
+    ):
+        self.cache = cache or SchedulerCache()
+        self.queue = queue or PriorityQueue()
+        self.binder = binder or Binder()
+        self.framework = framework or Framework()
+        self.mirror = TensorMirror(self.cache)
+        self.batch_size = batch_size
+        self.enable_preemption = enable_preemption
+        self.deterministic = deterministic
+        self.error_fn = error_fn
+        self.event_fn = event_fn or (lambda pod, reason, msg: None)
+        self._bind_pool = ThreadPoolExecutor(max_workers=bind_workers, thread_name_prefix="bind")
+        self._rng_seed = seed
+        self._cycle = 0
+        self._spread_selectors_fn: Optional[Callable[[Pod], list]] = None
+        self._jax = None  # lazily imported so pure-host tests stay light
+
+    def set_spread_selectors_fn(self, fn: Callable[[Pod], list]) -> None:
+        """Install the getSelectors equivalent (services/RC/RS/SS listers,
+        selector_spreading.go getSelectors) used for SelectorSpread scoring."""
+        self._spread_selectors_fn = fn
+
+    # -- device solve --------------------------------------------------------
+
+    def _device_solve(self, infos: List[PodInfo]) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import filters as F
+        from ..ops import scores as S
+        from ..ops import topology as T
+        from ..ops.solver import pop_order, solve_greedy
+
+        pods = [pi.pod for pi in infos]
+        vocab = self.mirror.vocab
+        while True:
+            try:
+                batch = PodBatch(vocab, _bucket(len(pods)))
+                for i, p in enumerate(pods):
+                    batch.set_pod(i, p)
+                selectors = None
+                if self._spread_selectors_fn is not None:
+                    selectors = {id(p): self._spread_selectors_fn(p) for p in pods}
+                tb, aux = compile_batch_terms(
+                    vocab, pods, spread_selectors=selectors, b_capacity=batch.capacity
+                )
+                etb, _ = compile_existing_terms(vocab, self.cache.snapshot, self.mirror.row_of)
+                break
+            except KeySlotOverflow:
+                self.mirror._rebuild()
+
+        J = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
+        na = J(self.mirror.nodes.arrays())
+        pa = J(batch.arrays())
+        ea = J(self.mirror.eps.arrays())
+        ta = J(tb.arrays())
+        xa = J(etb.arrays())
+        au = J(aux)
+        ids = F.make_ids(vocab)
+
+        base = F.combined_mask(na, pa, ids)
+        sel = F.pod_match_node_selector(na, pa)
+        mask = (
+            base
+            & T.spread_filter(na, ea, ta, sel)
+            & T.interpod_filter(na, ea, ta, au, xa, pa)
+        )
+        score = (
+            S.score_matrix(na, pa)
+            + T.interpod_score(na, ea, ta, xa, pa)
+            + T.spread_score(na, ea, ta, au, sel)
+            + T.selector_spread_score(na, ea, ta, au)
+        )
+        free0 = na["alloc"] - na["requested"]
+        order = pop_order(
+            pa["priority"],
+            jnp.asarray(np.arange(batch.capacity, dtype=np.int32)),
+            pa["valid"],
+        )
+        self._cycle += 1
+        key = jax.random.PRNGKey(self._rng_seed + self._cycle)
+        assign = solve_greedy(
+            mask,
+            score,
+            pa["req"],
+            free0,
+            na["pod_count"].astype(free0.dtype),
+            na["allowed_pods"].astype(free0.dtype),
+            order,
+            key,
+            deterministic=self.deterministic,
+        )
+        return (
+            np.asarray(assign)[: len(pods)],
+            np.asarray(pa["fallback"])[: len(pods)],
+            np.asarray(score)[: len(pods)],
+        )
+
+    def _oracle_place(self, pod: Pod, score_row: np.ndarray, meta) -> Optional[str]:
+        """Scalar fallback placement: oracle-feasible nodes against the live
+        snapshot (including this batch's assumed pods), best device score
+        first."""
+        best = None
+        best_score = None
+        for cand, ni in self.cache.snapshot.node_infos.items():
+            if not pod_fits_on_node(pod, ni, meta=meta)[0]:
+                continue
+            row = self.mirror.row_of.get(cand)
+            s = int(score_row[row]) if row is not None and row < len(score_row) else 0
+            if best_score is None or s > best_score:
+                best, best_score = cand, s
+        return best
+
+    # -- commit path ---------------------------------------------------------
+
+    def _commit(self, info: PodInfo, node_name: str, cycle: int) -> bool:
+        """reserve → assume → async(permit → prebind → bind → postbind)."""
+        pod = info.pod
+        state = CycleState()
+        st = self.framework.run_reserve(state, pod, node_name)
+        if not st.is_success():
+            self._fail(info, cycle, f"reserve: {st.message}")
+            return False
+        import dataclasses
+
+        assumed = dataclasses.replace(pod, node_name=node_name)
+        try:
+            self.cache.assume_pod(assumed)
+        except ValueError:
+            self._fail(info, cycle, "already assumed")
+            return False
+
+        def bind_async():
+            st = self.framework.run_permit(state, pod, node_name)
+            if not st.is_success():
+                self._unbind(info, assumed, node_name, state, cycle, f"permit: {st.message}")
+                return
+            st = self.framework.run_pre_bind(state, pod, node_name)
+            if not st.is_success():
+                self._unbind(info, assumed, node_name, state, cycle, f"prebind: {st.message}")
+                return
+            try:
+                st = self.framework.run_bind(state, pod, node_name)
+                if st.code != 0 and st.code != 4:  # not SUCCESS, not SKIP
+                    raise RuntimeError(st.message)
+                self.binder.bind(pod, node_name)
+            except Exception as e:  # bind RPC failed → forget + requeue
+                self._unbind(info, assumed, node_name, state, cycle, f"bind: {e}")
+                return
+            self.cache.finish_binding(assumed)
+            self.framework.run_post_bind(state, pod, node_name)
+            self.event_fn(pod, "Scheduled", f"bound to {node_name}")
+
+        self._bind_pool.submit(bind_async)
+        return True
+
+    def _unbind(self, info: PodInfo, assumed: Pod, node_name: str, state, cycle: int, msg: str) -> None:
+        self.cache.forget_pod(assumed)
+        self.framework.run_unreserve(state, info.pod, node_name)
+        self._fail(info, cycle, msg)
+
+    def _fail(self, info: PodInfo, cycle: int, msg: str) -> None:
+        self.event_fn(info.pod, "FailedScheduling", msg)
+        self.queue.add_unschedulable(info, cycle)
+
+    def _try_preempt(self, info: PodInfo) -> bool:
+        """scheduler.go:612 preempt: nominate a node, delete victims."""
+        pod = info.pod
+        node, victims, clear = preemption_mod.preempt(pod, self.cache.snapshot)
+        if node is None:
+            return False
+        for v in victims:
+            self.cache.remove_pod(v)
+            self.event_fn(v, "Preempted", f"by {pod.key()}")
+        pod.nominated_node_name = node
+        self.event_fn(pod, "Nominated", node)
+        return True
+
+    # -- main loop -----------------------------------------------------------
+
+    def schedule_batch(self, max_pods: Optional[int] = None) -> ScheduleResult:
+        res = ScheduleResult()
+        infos = self.queue.pop_batch(max_pods or self.batch_size)
+        if not infos:
+            return res
+        cycle = self.queue.scheduling_cycle()
+        self.mirror.sync()
+        try:
+            assign, fallback, score = self._device_solve(infos)
+        except Exception as e:
+            for info in infos:
+                res.errors += 1
+                if self.error_fn:
+                    self.error_fn(info.pod, e)
+                self._fail(info, cycle, f"solve error: {e}")
+            return res
+
+        # commit in pop order (priority desc) so oracle re-checks see earlier
+        # assumes, reproducing sequential semantics for topology pods
+        order = sorted(
+            range(len(infos)),
+            key=lambda i: (-infos[i].pod.get_priority(), infos[i].seq),
+        )
+        for i in order:
+            info = infos[i]
+            pod = info.pod
+            row = int(assign[i])
+            node_name = self.mirror.node_name_of_row(row) if row >= 0 else None
+            if node_name is not None and (fallback[i] or _needs_oracle_recheck(pod)):
+                ni = self.cache.snapshot.get(node_name)
+                meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                ok = ni is not None and pod_fits_on_node(pod, ni, meta=meta)[0]
+                if not ok:
+                    # invalidated by an earlier commit in this batch (the
+                    # solver carry tracks only resources) — re-place via the
+                    # oracle against the CURRENT snapshot, ranking candidates
+                    # by the device score row (sequential-equivalent filter,
+                    # batch-stale scores)
+                    node_name = self._oracle_place(pod, score[i], meta)
+            if fallback[i] and node_name is None:
+                # encoding overflowed — full scalar fallback over all nodes
+                meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                node_name = self._oracle_place(pod, score[i], meta)
+            if node_name is None:
+                res.unschedulable += 1
+                self._fail(info, cycle, "no fit")
+                if self.enable_preemption and self._try_preempt(info):
+                    res.preempted += 1
+                    # victim deletions are cluster events: wake the queue
+                    # (eventhandlers.go:127 → MoveAllToActiveQueue); the pod
+                    # retries after its backoff expires
+                    self.queue.move_all_to_active()
+                continue
+            if self._commit(info, node_name, cycle):
+                res.scheduled += 1
+                res.assignments[pod.key()] = node_name
+            else:
+                res.unschedulable += 1
+        return res
+
+    def run_until_empty(self, max_cycles: int = 1000) -> ScheduleResult:
+        total = ScheduleResult()
+        for _ in range(max_cycles):
+            r = self.schedule_batch()
+            total.scheduled += r.scheduled
+            total.unschedulable += r.unschedulable
+            total.errors += r.errors
+            total.preempted += r.preempted
+            total.assignments.update(r.assignments)
+            if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+                break
+        return total
+
+    def wait_for_binds(self) -> None:
+        """Drain the bind pipeline (tests/benchmarks)."""
+        self._bind_pool.shutdown(wait=True)
+        self._bind_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="bind")

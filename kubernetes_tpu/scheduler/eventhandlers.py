@@ -1,0 +1,91 @@
+"""Event handlers: informer callbacks → cache + queue.
+
+Reference: pkg/scheduler/eventhandlers.go AddAllEventHandlers (:380):
+  assigned pods   → cache add/update/remove (confirming assumed pods, :255)
+  pending pods    → scheduling queue (:214), filtered by scheduler name
+  nodes           → cache + MoveAllToActiveQueue wake-up (:92-130)
+  PV/PVC/Service  → MoveAllToActiveQueue (cluster events can unblock pods)
+plus skipPodUpdate (:336): resource-version-only updates don't requeue.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.types import Node, Pod
+from ..state.cache import SchedulerCache
+from ..state.queue import PodInfo, PriorityQueue
+
+
+def _assigned(pod: Pod) -> bool:
+    return bool(pod.node_name)
+
+
+def _responsible(pod: Pod, scheduler_name: str) -> bool:
+    return pod.scheduler_name == scheduler_name
+
+
+class EventHandlers:
+    """Wire an informer-like event source into the scheduler state."""
+
+    def __init__(
+        self,
+        cache: SchedulerCache,
+        queue: PriorityQueue,
+        scheduler_name: str = "default-scheduler",
+    ):
+        self.cache = cache
+        self.queue = queue
+        self.scheduler_name = scheduler_name
+
+    # -- pods ---------------------------------------------------------------
+
+    def on_pod_add(self, pod: Pod) -> None:
+        if _assigned(pod):
+            self.cache.add_pod(pod)
+            self.queue.move_all_to_active()  # assignedPodAdded (:451 via queue)
+        elif _responsible(pod, self.scheduler_name):
+            self.queue.add(pod)
+
+    def on_pod_update(self, old: Pod, new: Pod) -> None:
+        if self._skip_pod_update(old, new):
+            return
+        if _assigned(new):
+            self.cache.update_pod(old, new)
+            self.queue.move_all_to_active()
+        elif _responsible(new, self.scheduler_name):
+            self.queue.update(old, new)
+
+    def on_pod_delete(self, pod: Pod) -> None:
+        if _assigned(pod):
+            self.cache.remove_pod(pod)
+            self.queue.move_all_to_active()
+        else:
+            self.queue.delete(pod)
+
+    def _skip_pod_update(self, old: Pod, new: Pod) -> bool:
+        """skipPodUpdate (:336): ignore updates that only touch
+        resourceVersion/status the scheduler itself wrote."""
+        return (
+            old.node_name == new.node_name
+            and old.labels == new.labels
+            and old.resource_version == new.resource_version
+        )
+
+    # -- nodes --------------------------------------------------------------
+
+    def on_node_add(self, node: Node) -> None:
+        self.cache.add_node(node)
+        self.queue.move_all_to_active()
+
+    def on_node_update(self, old: Optional[Node], new: Node) -> None:
+        self.cache.update_node(new)
+        self.queue.move_all_to_active()
+
+    def on_node_delete(self, node: Node) -> None:
+        self.cache.remove_node(node.name)
+
+    # -- other cluster events (PV/PVC/Service/StorageClass) ------------------
+
+    def on_cluster_event(self) -> None:
+        self.queue.move_all_to_active()

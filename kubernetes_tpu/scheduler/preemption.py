@@ -1,0 +1,166 @@
+"""Preemption: victim selection when no node fits.
+
+Reference: core/generic_scheduler.go Preempt (:313),
+selectNodesForPreemption (:1007), selectVictimsOnNode (:1104),
+pickOneNodeForPreemption (:878), nodesWherePreemptionMightHelp (:1218).
+
+Host-side implementation over the oracle (preemption runs only for pods
+that already failed the fast path — inherently rare, so scalar cost is
+acceptable; vectorized victim search is a planned optimization).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..api.types import Pod
+from ..oracle.nodeinfo import NodeInfo, Snapshot
+from ..oracle.predicates import (
+    check_node_unschedulable,
+    compute_predicate_metadata,
+    pod_fits_host,
+    pod_fits_on_node,
+    pod_match_node_selector,
+    pod_tolerates_node_taints,
+)
+
+
+@dataclass
+class Victims:
+    pods: List[Pod]
+    num_pdb_violations: int = 0
+
+
+def pod_eligible_to_preempt_others(pod: Pod, snapshot: Snapshot) -> bool:
+    """podEligibleToPreemptOthers (:847): a pod that already nominated a node
+    where a lower-priority pod is terminating must wait."""
+    if pod.nominated_node_name:
+        ni = snapshot.get(pod.nominated_node_name)
+        if ni is not None:
+            for p in ni.pods:
+                if p.deletion_timestamp is not None and p.get_priority() < pod.get_priority():
+                    return False
+    return True
+
+
+def nodes_where_preemption_might_help(pod: Pod, snapshot: Snapshot) -> List[str]:
+    """:1218 — skip nodes whose failure cannot be resolved by removing pods
+    (node selector, taints, unschedulable, name pinning are unresolvable)."""
+    out = []
+    for name, ni in snapshot.node_infos.items():
+        if not check_node_unschedulable(pod, ni):
+            continue
+        if not pod_fits_host(pod, ni):
+            continue
+        if not pod_match_node_selector(pod, ni):
+            continue
+        if not pod_tolerates_node_taints(pod, ni):
+            continue
+        out.append(name)
+    return out
+
+
+def select_victims_on_node(pod: Pod, node_name: str, snapshot: Snapshot) -> Optional[Victims]:
+    """selectVictimsOnNode (:1104): remove ALL lower-priority pods; if the
+    pod then fits, reprieve victims (highest priority first) keeping every
+    one whose re-addition still lets the pod fit."""
+    ni = snapshot.get(node_name)
+    if ni is None:
+        return None
+    prio = pod.get_priority()
+    potential = [p for p in ni.pods if p.get_priority() < prio]
+    if not potential:
+        return None
+
+    # shadow snapshot: same objects, shallow per-node pod lists
+    shadow = Snapshot()
+    for n, info in snapshot.node_infos.items():
+        si = shadow.add_node(info.node)
+        si.pods = list(info.pods)
+    sni = shadow.get(node_name)
+    sni.pods = [p for p in sni.pods if p.get_priority() >= prio]
+
+    meta = compute_predicate_metadata(pod, shadow)
+    fits, _ = pod_fits_on_node(pod, sni, meta=meta)
+    if not fits:
+        return None
+
+    victims: List[Pod] = []
+    # reprieve in descending priority (then earlier start first — approximated
+    # by creation timestamp, util.MoreImportantPod)
+    for p in sorted(potential, key=lambda x: (-x.get_priority(), x.creation_timestamp)):
+        sni.pods.append(p)
+        meta = compute_predicate_metadata(pod, shadow)
+        still_fits, _ = pod_fits_on_node(pod, sni, meta=meta)
+        if not still_fits:
+            sni.pods.remove(p)
+            victims.append(p)
+    if not victims:
+        return None
+    return Victims(pods=victims)
+
+
+def pick_one_node_for_preemption(candidates: Dict[str, Victims]) -> Optional[str]:
+    """pickOneNodeForPreemption (:878) tie-break chain:
+    1. fewest PDB violations  2. lowest highest-victim-priority
+    3. smallest priority sum  4. fewest victims
+    5. latest start time of the highest-priority victim  6. first."""
+    if not candidates:
+        return None
+    names = list(candidates)
+
+    def keep_min(names: List[str], keyfn) -> List[str]:
+        vals = {n: keyfn(candidates[n]) for n in names}
+        m = min(vals.values())
+        return [n for n in names if vals[n] == m]
+
+    names = keep_min(names, lambda v: v.num_pdb_violations)
+    if len(names) == 1:
+        return names[0]
+    names = keep_min(names, lambda v: max(p.get_priority() for p in v.pods))
+    if len(names) == 1:
+        return names[0]
+    names = keep_min(names, lambda v: sum(p.get_priority() for p in v.pods))
+    if len(names) == 1:
+        return names[0]
+    names = keep_min(names, lambda v: len(v.pods))
+    if len(names) == 1:
+        return names[0]
+    # latest (max) start time among each node's highest-priority victim
+    names = keep_min(
+        names,
+        lambda v: -max(
+            p.creation_timestamp
+            for p in v.pods
+            if p.get_priority() == max(q.get_priority() for q in v.pods)
+        ),
+    )
+    return names[0]
+
+
+def preempt(pod: Pod, snapshot: Snapshot) -> Tuple[Optional[str], List[Pod], List[str]]:
+    """Preempt (:313): returns (node, victims, nominated pod keys to clear).
+    The third element lists LOWER-priority pods nominated to the chosen node
+    whose nomination should be cleared (:346-360)."""
+    if not pod_eligible_to_preempt_others(pod, snapshot):
+        return None, [], []
+    potential = nodes_where_preemption_might_help(pod, snapshot)
+    candidates: Dict[str, Victims] = {}
+    for name in potential:
+        v = select_victims_on_node(pod, name, snapshot)
+        if v is not None:
+            candidates[name] = v
+    chosen = pick_one_node_for_preemption(candidates)
+    if chosen is None:
+        return None, [], []
+    # lower-priority nominated pods on the chosen node lose their nomination
+    clear: List[str] = []
+    ni = snapshot.get(chosen)
+    prio = pod.get_priority()
+    if ni is not None:
+        for p in ni.pods:
+            if p.nominated_node_name == chosen and p.get_priority() < prio:
+                clear.append(p.key())
+    return chosen, candidates[chosen].pods, clear

@@ -1,0 +1,311 @@
+"""Scheduler cache: authoritative in-memory cluster state with the
+assumed-pod state machine and generation-tracked incremental tensor sync.
+
+Reference: pkg/scheduler/internal/cache/cache.go. State machine for a pod
+(interface.go:33-47):
+
+    Initial → Assume → [bind succeeds] → Added (expires after TTL unless
+    confirmed by the informer) → Update/Remove via informer events
+    Assume → Forget (bind failed) → Initial
+
+The cache is never authoritative storage — etcd is (SURVEY.md §5
+checkpoint/resume): on restart everything is rebuilt from a fresh list+watch.
+Device tensors are a further derived layer: `TensorMirror` keeps NodeBank /
+ExistingPodsBank rows in sync with this cache, patching only DIRTY rows per
+cycle the way UpdateNodeInfoSnapshot walks its generation-ordered dirty list
+(cache.go:206-242).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..api.types import Node, Pod
+from ..oracle.nodeinfo import NodeInfo, Snapshot
+from .tensors import (
+    EncodingConfig,
+    ExistingPodsBank,
+    KeySlotOverflow,
+    NodeBank,
+    Vocab,
+    _bucket,
+)
+
+DEFAULT_ASSUME_TTL = 30.0  # cache.go durationToExpireAssumedPod (30s default)
+
+
+@dataclass
+class _PodState:
+    pod: Pod
+    assumed: bool = False
+    binding_finished: bool = False
+    deadline: Optional[float] = None  # TTL expiry for assumed pods
+
+
+class SchedulerCache:
+    """cache.go schedulerCache: node name → NodeInfo, pod key → state."""
+
+    def __init__(self, ttl: float = DEFAULT_ASSUME_TTL, now: Callable[[], float] = time.monotonic):
+        self._lock = threading.RLock()
+        self._ttl = ttl
+        self._now = now
+        self.snapshot = Snapshot()
+        self._pod_states: Dict[str, _PodState] = {}
+        self._assumed: Set[str] = set()
+        self.dirty_nodes: Set[str] = set()  # generation-equivalent dirty set
+        self.removed_nodes: Set[str] = set()
+
+    # -- helpers -------------------------------------------------------------
+
+    def _node_info(self, name: str) -> Optional[NodeInfo]:
+        return self.snapshot.get(name)
+
+    def _add_pod_to_node(self, pod: Pod) -> None:
+        ni = self.snapshot.get(pod.node_name)
+        if ni is None:
+            # pod on an unknown node: track headlessly (reference keeps an
+            # imaginary NodeInfo; it becomes real when the node arrives)
+            ni = self.snapshot.add_node(Node(name=pod.node_name))
+            ni.node.labels = {}
+        ni.pods.append(pod)
+        self.dirty_nodes.add(pod.node_name)
+
+    def _remove_pod_from_node(self, pod: Pod) -> None:
+        ni = self.snapshot.get(pod.node_name)
+        if ni is None:
+            return
+        ni.pods = [p for p in ni.pods if p.key() != pod.key()]
+        self.dirty_nodes.add(pod.node_name)
+
+    # -- assumed pod state machine (cache.go:270-388) ------------------------
+
+    def assume_pod(self, pod: Pod) -> None:
+        """AssumePod: optimistically add to the target node before bind."""
+        with self._lock:
+            key = pod.key()
+            if key in self._pod_states:
+                raise ValueError(f"pod {key} already in cache")
+            self._pod_states[key] = _PodState(pod=pod, assumed=True)
+            self._assumed.add(key)
+            self._add_pod_to_node(pod)
+
+    def finish_binding(self, pod: Pod) -> None:
+        """FinishBinding: start the TTL clock (cache.go:300)."""
+        with self._lock:
+            st = self._pod_states.get(pod.key())
+            if st is None or not st.assumed:
+                return
+            st.binding_finished = True
+            st.deadline = self._now() + self._ttl
+
+    def forget_pod(self, pod: Pod) -> None:
+        """ForgetPod: bind failed; undo the assume (cache.go:334)."""
+        with self._lock:
+            key = pod.key()
+            st = self._pod_states.get(key)
+            if st is None or not st.assumed:
+                return
+            self._remove_pod_from_node(st.pod)
+            del self._pod_states[key]
+            self._assumed.discard(key)
+
+    # -- informer-confirmed pod events (cache.go:389-520) --------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        """AddPod: informer says the pod is bound. Confirms an assumed pod or
+        adds a foreign one."""
+        with self._lock:
+            key = pod.key()
+            st = self._pod_states.get(key)
+            if st is not None and st.assumed:
+                # confirmation: replace the assumed object with the real one
+                if st.pod.node_name != pod.node_name:
+                    self._remove_pod_from_node(st.pod)
+                    self._add_pod_to_node(pod)
+                else:
+                    self._remove_pod_from_node(st.pod)
+                    self._add_pod_to_node(pod)
+                self._pod_states[key] = _PodState(pod=pod)
+                self._assumed.discard(key)
+                return
+            if st is not None:
+                self.update_pod(st.pod, pod)
+                return
+            self._pod_states[key] = _PodState(pod=pod)
+            self._add_pod_to_node(pod)
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        with self._lock:
+            self._remove_pod_from_node(old)
+            self._add_pod_to_node(new)
+            self._pod_states[new.key()] = _PodState(pod=new)
+
+    def remove_pod(self, pod: Pod) -> None:
+        with self._lock:
+            key = pod.key()
+            st = self._pod_states.pop(key, None)
+            self._assumed.discard(key)
+            if st is not None:
+                self._remove_pod_from_node(st.pod)
+
+    def is_assumed(self, key: str) -> bool:
+        with self._lock:
+            return key in self._assumed
+
+    def cleanup_expired(self) -> List[Pod]:
+        """cleanupAssumedPods (cache.go:658): drop assumed pods whose bind
+        confirmation never arrived within TTL (self-healing after lost
+        binds). Returns the expired pods so the driver can re-queue them."""
+        with self._lock:
+            now = self._now()
+            expired = []
+            for key in list(self._assumed):
+                st = self._pod_states[key]
+                if st.binding_finished and st.deadline is not None and now > st.deadline:
+                    expired.append(st.pod)
+                    self._remove_pod_from_node(st.pod)
+                    del self._pod_states[key]
+                    self._assumed.discard(key)
+            return expired
+
+    # -- node events (cache.go:522-600) --------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            ni = self.snapshot.get(node.name)
+            if ni is None:
+                self.snapshot.add_node(node)
+            else:
+                ni.node = node  # was a headless placeholder
+            self.dirty_nodes.add(node.name)
+            self.removed_nodes.discard(node.name)
+
+    def update_node(self, node: Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            ni = self.snapshot.node_infos.pop(name, None)
+            if ni is not None:
+                for p in ni.pods:
+                    self._pod_states.pop(p.key(), None)
+                    self._assumed.discard(p.key())
+            self.dirty_nodes.discard(name)
+            self.removed_nodes.add(name)
+
+    # -- counters ------------------------------------------------------------
+
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self.snapshot.node_infos)
+
+    def pod_count(self) -> int:
+        with self._lock:
+            return len(self._pod_states)
+
+
+class TensorMirror:
+    """Keeps device-facing banks (NodeBank + ExistingPodsBank) patched from a
+    SchedulerCache — the TPU replacement for UpdateNodeInfoSnapshot's
+    generation walk. Rows are allocated per node from a free list; pods
+    re-encode with their node's row (pods move rarely; node rows are stable).
+
+    sync() applies only dirty nodes. Capacity overflow (more nodes than the
+    bank, label-key growth) triggers a full rebuild at the next bucket size —
+    bounded recompilation by construction.
+    """
+
+    def __init__(self, cache: SchedulerCache, vocab: Optional[Vocab] = None):
+        self.cache = cache
+        self.vocab = vocab or Vocab()
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        snap = self.cache.snapshot
+        while True:
+            try:
+                n_nodes = max(len(snap.node_infos), 1)
+                self.nodes = NodeBank(self.vocab, _bucket(n_nodes))
+                self.row_of: Dict[str, int] = {}
+                self.name_of_row: List[Optional[str]] = [None] * self.nodes.capacity
+                self._free_rows = list(range(self.nodes.capacity - 1, -1, -1))
+                for ni in snap.node_infos.values():
+                    row = self._free_rows.pop()
+                    self.row_of[ni.node.name] = row
+                    self.name_of_row[row] = ni.node.name
+                    self.nodes.set_node(row, ni)
+                n_pods = max(sum(len(ni.pods) for ni in snap.node_infos.values()), 1)
+                self.eps = ExistingPodsBank(self.vocab, _bucket(n_pods))
+                self._encode_all_pods()
+                break
+            except KeySlotOverflow:
+                continue
+        self.cache.dirty_nodes.clear()
+        self.cache.removed_nodes.clear()
+        self.generation = 0
+
+    def _encode_all_pods(self) -> None:
+        """Existing pods are re-packed densely; row churn is fine because no
+        state outside the bank references pod rows."""
+        self.eps.valid[:] = False
+        j = 0
+        for name, ni in self.cache.snapshot.node_infos.items():
+            row = self.row_of[name]
+            for pod in ni.pods:
+                if j >= self.eps.capacity:
+                    raise KeySlotOverflow()  # grow pods bank via rebuild
+                self.eps.set_pod(j, pod, row)
+                j += 1
+        self._pods_used = j
+
+    def sync(self) -> bool:
+        """Apply dirty nodes. Returns True if a full rebuild happened (device
+        arrays change shape → recompile)."""
+        cache = self.cache
+        with cache._lock:
+            dirty = set(cache.dirty_nodes)
+            removed = set(cache.removed_nodes)
+            cache.dirty_nodes.clear()
+            cache.removed_nodes.clear()
+            new_nodes = [n for n in cache.snapshot.node_infos if n not in self.row_of]
+            if len(self.row_of) - len(removed) + len(new_nodes) > self.nodes.capacity or (
+                new_nodes and not self._free_rows
+            ):
+                self._rebuild()
+                return True
+            try:
+                for name in removed:
+                    row = self.row_of.pop(name, None)
+                    if row is not None:
+                        self.nodes.clear_node(row)
+                        self.name_of_row[row] = None
+                        self._free_rows.append(row)
+                for name in new_nodes:
+                    row = self._free_rows.pop()
+                    self.row_of[name] = row
+                    self.name_of_row[row] = name
+                for name in dirty | set(new_nodes):
+                    ni = cache.snapshot.get(name)
+                    if ni is not None and name in self.row_of:
+                        self.nodes.set_node(self.row_of[name], ni)
+                # pods: repack when anything changed (cheap row writes; the
+                # expensive part — device upload — is once per cycle anyway)
+                if dirty or removed or new_nodes:
+                    n_pods = sum(len(ni.pods) for ni in cache.snapshot.node_infos.values())
+                    if n_pods > self.eps.capacity:
+                        self._rebuild()
+                        return True
+                    self._encode_all_pods()
+            except KeySlotOverflow:
+                self._rebuild()
+                return True
+            self.generation += 1
+            return False
+
+    def node_name_of_row(self, row: int) -> Optional[str]:
+        if 0 <= row < len(self.name_of_row):
+            return self.name_of_row[row]
+        return None

@@ -1,0 +1,245 @@
+"""Scheduling queue: the 3-queue design of the reference.
+
+Reference: pkg/scheduler/internal/queue/scheduling_queue.go PriorityQueue
+(:120-152):
+  activeQ         — heap ordered by (priority desc, enqueue time asc); pods
+                    ready to schedule (Pop blocks on it, :444)
+  podBackoffQ     — heap ordered by backoff expiry; pods that failed and are
+                    waiting out their backoff (flushed to activeQ, :389)
+  unschedulableQ  — map of pods that found no node; moved back to activeQ on
+                    cluster events (MoveAllToActiveQueue :569) or after the
+                    unschedulable timeout (:423, 60s)
+plus the nominated-pods index (preemption nominees per node) and the
+move-request cycle counter that closes the race between "pod determined
+unschedulable" and "cluster changed meanwhile" (:353-386).
+
+Backoff: PodBackoffMap (pod_backoff.go): initial 1s, doubled per attempt,
+capped at 10s.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..api.types import Pod
+
+INITIAL_BACKOFF = 1.0  # pod_backoff.go initialDuration
+MAX_BACKOFF = 10.0  # pod_backoff.go maxDuration
+UNSCHEDULABLE_TIMEOUT = 60.0  # scheduling_queue.go unschedulableQTimeInterval
+
+
+@dataclass
+class PodInfo:
+    """framework.PodInfo: pod + queue timestamps."""
+
+    pod: Pod
+    timestamp: float = 0.0  # time added to the queue
+    attempts: int = 0
+    seq: int = 0  # monotonic enqueue sequence (tie-break within priority)
+
+
+class PriorityQueue:
+    def __init__(self, now: Callable[[], float] = time.monotonic):
+        self._lock = threading.Condition()
+        self._now = now
+        self._seq = itertools.count()
+        self._active: List[Tuple[int, int, str]] = []  # (-prio, seq, key)
+        self._backoff: List[Tuple[float, int, str]] = []  # (expiry, seq, key)
+        self._unschedulable: Dict[str, PodInfo] = {}
+        self._infos: Dict[str, PodInfo] = {}
+        self._in_active: Set[str] = set()
+        self._attempts: Dict[str, int] = {}  # backoff attempt counts
+        self._last_failure: Dict[str, float] = {}
+        self._last_move_request_cycle = -1
+        self._scheduling_cycle = 0
+        self.nominated: Dict[str, str] = {}  # pod key → nominated node
+        self._nominated_by_node: Dict[str, Set[str]] = {}
+        self.closed = False
+
+    # -- internals -----------------------------------------------------------
+
+    def _push_active(self, info: PodInfo) -> None:
+        key = info.pod.key()
+        self._infos[key] = info
+        if key in self._in_active:
+            return
+        heapq.heappush(self._active, (-info.pod.get_priority(), info.seq, key))
+        self._in_active.add(key)
+        self._lock.notify()
+
+    def _backoff_duration(self, key: str) -> float:
+        attempts = self._attempts.get(key, 0)
+        d = INITIAL_BACKOFF * (2 ** max(attempts - 1, 0))
+        return min(d, MAX_BACKOFF)
+
+    # -- public API (scheduling_queue.go) -----------------------------------
+
+    def add(self, pod: Pod) -> None:
+        """Add: new pending pod → activeQ."""
+        with self._lock:
+            info = PodInfo(pod=pod, timestamp=self._now(), seq=next(self._seq))
+            self._unschedulable.pop(pod.key(), None)
+            self._push_active(info)
+            self._update_nominated(pod)
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[PodInfo]:
+        """Pop: blocks until a pod is available (queue.Pop :444)."""
+        with self._lock:
+            deadline = None if timeout is None else self._now() + timeout
+            while not self._active and not self.closed:
+                self._flush_locked()
+                wait = 0.1
+                if deadline is not None:
+                    remaining = deadline - self._now()
+                    if remaining <= 0:
+                        return None
+                    wait = min(wait, remaining)
+                self._lock.wait(wait)
+            if self.closed and not self._active:
+                return None
+            _, _, key = heapq.heappop(self._active)
+            self._in_active.discard(key)
+            info = self._infos[key]
+            info.attempts += 1
+            self._scheduling_cycle += 1
+            return info
+
+    def pop_batch(self, max_pods: int) -> List[PodInfo]:
+        """Drain up to max_pods from activeQ without blocking — the batch
+        entry point for the vectorized solver. Preserves pop order."""
+        with self._lock:
+            self._flush_locked()
+            out = []
+            while self._active and len(out) < max_pods:
+                _, _, key = heapq.heappop(self._active)
+                self._in_active.discard(key)
+                info = self._infos[key]
+                info.attempts += 1
+                out.append(info)
+            if out:
+                self._scheduling_cycle += 1
+            return out
+
+    def add_unschedulable(self, info: PodInfo, pod_scheduling_cycle: Optional[int] = None) -> None:
+        """AddUnschedulableIfNotPresent (:353): if a move request arrived
+        since this pod's cycle started, go to backoffQ (retry soon) instead
+        of unschedulableQ (wait for an event)."""
+        with self._lock:
+            key = info.pod.key()
+            self._attempts[key] = self._attempts.get(key, 0) + 1
+            self._last_failure[key] = self._now()
+            cycle = pod_scheduling_cycle if pod_scheduling_cycle is not None else self._scheduling_cycle
+            if self._last_move_request_cycle >= cycle:
+                expiry = self._now() + self._backoff_duration(key)
+                self._infos[key] = info
+                heapq.heappush(self._backoff, (expiry, info.seq, key))
+            else:
+                info.timestamp = self._now()
+                self._infos[key] = info
+                self._unschedulable[key] = info
+            self._update_nominated(info.pod)
+
+    def scheduling_cycle(self) -> int:
+        with self._lock:
+            return self._scheduling_cycle
+
+    def move_all_to_active(self) -> None:
+        """MoveAllToActiveQueue (:569): a cluster event may have made
+        unschedulable pods feasible."""
+        with self._lock:
+            now = self._now()
+            for key, info in list(self._unschedulable.items()):
+                # still backing off → backoffQ; else straight to activeQ
+                expiry = self._last_failure.get(key, 0.0) + self._backoff_duration(key)
+                if expiry <= now:
+                    self._push_active(info)
+                else:
+                    heapq.heappush(self._backoff, (expiry, info.seq, key))
+            self._unschedulable.clear()
+            self._last_move_request_cycle = self._scheduling_cycle
+            self._lock.notify_all()
+
+    def _flush_locked(self) -> None:
+        """flushBackoffQCompleted (:389) + flushUnschedulableQLeftover
+        (:423)."""
+        now = self._now()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, key = heapq.heappop(self._backoff)
+            info = self._infos.get(key)
+            if info is not None:
+                self._push_active(info)
+        for key, info in list(self._unschedulable.items()):
+            if now - info.timestamp > UNSCHEDULABLE_TIMEOUT:
+                del self._unschedulable[key]
+                self._push_active(info)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def delete(self, pod: Pod) -> None:
+        with self._lock:
+            key = pod.key()
+            self._infos.pop(key, None)
+            self._unschedulable.pop(key, None)
+            self._in_active.discard(key)  # lazily skipped on pop
+            self._attempts.pop(key, None)
+            self._last_failure.pop(key, None)
+            self._remove_nominated(key)
+            self._active = [(p, s, k) for (p, s, k) in self._active if k != key]
+            heapq.heapify(self._active)
+
+    def update(self, old: Pod, new: Pod) -> None:
+        with self._lock:
+            key = new.key()
+            if key in self._unschedulable:
+                info = self._unschedulable.pop(key)
+                info.pod = new
+                self._push_active(info)
+            elif key in self._infos:
+                self._infos[key].pod = new
+            else:
+                self.add(new)
+            self._update_nominated(new)
+
+    def clear_backoff(self, pod: Pod) -> None:
+        with self._lock:
+            self._attempts.pop(pod.key(), None)
+            self._last_failure.pop(pod.key(), None)
+
+    # -- nominated pods (preemption nominees) --------------------------------
+
+    def _update_nominated(self, pod: Pod) -> None:
+        key = pod.key()
+        self._remove_nominated(key)
+        node = pod.nominated_node_name
+        if node:
+            self.nominated[key] = node
+            self._nominated_by_node.setdefault(node, set()).add(key)
+
+    def _remove_nominated(self, key: str) -> None:
+        node = self.nominated.pop(key, None)
+        if node:
+            self._nominated_by_node.get(node, set()).discard(key)
+
+    def nominated_pods_for_node(self, node: str) -> List[Pod]:
+        with self._lock:
+            return [
+                self._infos[k].pod
+                for k in self._nominated_by_node.get(node, set())
+                if k in self._infos
+            ]
+
+    def close(self) -> None:
+        with self._lock:
+            self.closed = True
+            self._lock.notify_all()
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._active) + len(self._backoff) + len(self._unschedulable)
