@@ -281,6 +281,18 @@ class Node:
         return out
 
 
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1beta1 PodDisruptionBudget — the scheduling-visible subset:
+    selector + status.disruptionsAllowed, which preemption consults via
+    filterPodsWithPDBViolation (core/generic_scheduler.go:1055)."""
+
+    name: str = ""
+    namespace: str = "default"
+    selector: Optional[LabelSelector] = None
+    disruptions_allowed: int = 0
+
+
 def _request_value(resource_name: str, q: Quantity) -> int:
     if resource_name == RESOURCE_CPU:
         return q.milli_value()

@@ -141,7 +141,10 @@ def image_locality(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
     """ImageLocalityPriorityMap: gather spread-scaled image sizes per
     (pod image, node), clamp to [23MB, 1000MB], map to [0, 10]."""
     table = nodes["image_scaled"]  # [N, V_img]
-    img = jnp.clip(pods["image_ids"], 0, table.shape[1] - 1)  # [B, CI]
+    # ids beyond the table width are images no node has (interned after the
+    # table was built) — they contribute 0, not an aliased column
+    in_vocab = (pods["image_ids"] > 0) & (pods["image_ids"] < table.shape[1])
+    img = jnp.where(in_vocab, pods["image_ids"], 0)  # [B, CI]; col 0 is zeros
     sums = jnp.sum(table[:, img], axis=-1)  # [N, B] (gather then sum CI)
     total = sums.T  # [B, N]
     clamped = jnp.clip(total, IMAGE_MIN, IMAGE_MAX)

@@ -66,6 +66,7 @@ def solve_greedy(
     order: jnp.ndarray,  # [B] scan order (pop_order)
     rng_key,  # PRNG key for tie-breaks
     deterministic: bool = False,
+    req_any: Optional[jnp.ndarray] = None,  # [B] pod requests anything at all
 ) -> jnp.ndarray:
     """Greedy-by-priority batch assignment → node row per pod, -1 = no fit.
 
@@ -73,12 +74,18 @@ def solve_greedy(
     earlier pod consuming a node's last CPU makes it infeasible for later
     pods — exactly as if the reference had scheduled them sequentially."""
     B, N = mask.shape
+    if req_any is None:
+        req_any = jnp.any(req > 0, axis=-1)
 
     def step(carry, inp):
         free, count = carry
         i, key = inp
         m = mask[i]
-        fits = jnp.all(req[i][None, :] <= free, axis=-1) & (count + 1 <= allowed)
+        # PodFitsResources (predicates.go:854): the pod-count check always
+        # applies; the resource rows only when the pod requests anything, so
+        # empty-request pods pass even on overcommitted (free < 0) nodes.
+        res_ok = ~req_any[i] | jnp.all(req[i][None, :] <= free, axis=-1)
+        fits = res_ok & (count + 1 <= allowed)
         feasible = m & fits
         if deterministic:
             neg = jnp.iinfo(score.dtype).min
@@ -115,6 +122,7 @@ def solve_gang(
     group: jnp.ndarray,  # [B] group id, -1 = ungrouped
     rng_key,
     deterministic: bool = False,
+    req_any: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """All-or-nothing gang assignment: two-pass greedy. Pass 1 places
     everything; groups with any unplaced member are dropped and pass 2
@@ -122,7 +130,7 @@ def solve_gang(
     Returns (assignment [B], gang_ok [B])."""
     B = mask.shape[0]
     k1, k2 = jax.random.split(rng_key)
-    first = solve_greedy(mask, score, req, free0, count0, allowed, order, k1, deterministic=deterministic)
+    first = solve_greedy(mask, score, req, free0, count0, allowed, order, k1, deterministic=deterministic, req_any=req_any)
     grouped = group >= 0
     failed_member = grouped & (first < 0)
     # group failed if ANY member failed (segment max over group ids)
@@ -130,6 +138,6 @@ def solve_gang(
     fail_by_group = jnp.zeros(ngroups, bool).at[jnp.where(grouped, group, 0)].max(failed_member)
     dropped = grouped & fail_by_group[jnp.where(grouped, group, 0)]
     mask2 = mask & ~dropped[:, None]
-    second = solve_greedy(mask2, score, req, free0, count0, allowed, order, k2, deterministic=deterministic)
+    second = solve_greedy(mask2, score, req, free0, count0, allowed, order, k2, deterministic=deterministic, req_any=req_any)
     gang_ok = ~dropped
     return jnp.where(dropped, -1, second), gang_ok

@@ -28,14 +28,19 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..api.types import Pod
+from ..api.types import Pod, PodDisruptionBudget
 from ..framework.interface import CycleState, Framework, Status
-from ..oracle.predicates import compute_predicate_metadata, pod_fits_on_node
+from ..oracle.predicates import (
+    compute_predicate_metadata,
+    pod_fits_on_node,
+    pod_fits_resources,
+)
 from ..state.cache import SchedulerCache, TensorMirror
 from ..state.queue import PodInfo, PriorityQueue
 from ..state.tensors import KeySlotOverflow, PodBatch, _bucket
-from ..state.terms import compile_batch_terms, compile_existing_terms
+from ..state.terms import compile_batch_terms
 from . import preemption as preemption_mod
+from .preemption import fits_considering_nominated, fits_with_nominees
 
 
 @dataclass
@@ -45,6 +50,19 @@ class ScheduleResult:
     errors: int = 0
     preempted: int = 0
     assignments: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SolveOutput:
+    """Device-solve result + the host-side caveats the commit loop must
+    honor (overflowed encodings force the scalar oracle path)."""
+
+    assign: np.ndarray  # [len(pods)] node row or -1
+    fallback: np.ndarray  # [len(pods)] bool: encoding/term overflow → oracle
+    score: np.ndarray  # [len(pods), N] device score rows (for oracle ranking)
+    has_anti: np.ndarray  # [len(pods)] bool: pod carries required anti-affinity
+    existing_overflow: bool  # existing pods' terms truncated → recheck all
+    node_fallback_any: bool  # some node rows excluded from the fast path
 
 
 class Binder:
@@ -61,12 +79,16 @@ class Binder:
 
 def _needs_oracle_recheck(pod: Pod) -> bool:
     """Pods whose feasibility can be perturbed by earlier pods in the same
-    batch (the solver's carry only tracks resources): topology-spread or
-    required (anti-)affinity terms. See ops/solver.py contract."""
+    batch (the solver's carry only tracks resources and pod counts):
+    topology-spread, required (anti-)affinity terms, or host ports (two
+    ported pods can collide on the node the pre-batch mask cleared for
+    both). See ops/solver.py contract."""
     if pod.topology_spread_constraints:
         return True
     a = pod.affinity
     if a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None):
+        return True
+    if pod.host_ports():
         return True
     return False
 
@@ -87,6 +109,8 @@ class Scheduler:
         error_fn: Optional[Callable[[Pod, Exception], None]] = None,
         bind_workers: int = 8,
         event_fn: Optional[Callable[[Pod, str, str], None]] = None,
+        pdb_lister: Optional[Callable[[], List[PodDisruptionBudget]]] = None,
+        delete_fn: Optional[Callable[[Pod], None]] = None,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -98,6 +122,12 @@ class Scheduler:
         self.deterministic = deterministic
         self.error_fn = error_fn
         self.event_fn = event_fn or (lambda pod, reason, msg: None)
+        # PDB lister (preemption tie-break) and the victim-delete hook: the
+        # reference issues an API delete (scheduler.go:436-470) and lets the
+        # informer remove the pod; with no API, fall back to direct removal.
+        self.pdb_lister = pdb_lister or (lambda: [])
+        self.delete_fn = delete_fn
+        self._bind_workers = bind_workers
         self._bind_pool = ThreadPoolExecutor(max_workers=bind_workers, thread_name_prefix="bind")
         self._rng_seed = seed
         self._cycle = 0
@@ -111,7 +141,7 @@ class Scheduler:
 
     # -- device solve --------------------------------------------------------
 
-    def _device_solve(self, infos: List[PodInfo]) -> np.ndarray:
+    def _device_solve(self, infos: List[PodInfo]) -> SolveOutput:
         import jax
         import jax.numpy as jnp
 
@@ -133,10 +163,18 @@ class Scheduler:
                 tb, aux = compile_batch_terms(
                     vocab, pods, spread_selectors=selectors, b_capacity=batch.capacity
                 )
-                etb, _ = compile_existing_terms(vocab, self.cache.snapshot, self.mirror.row_of)
+                etb = self.mirror.existing_terms()
                 break
             except KeySlotOverflow:
                 self.mirror._rebuild()
+
+        # term-table overflow: truncated/dropped terms under- or over-match on
+        # device — route the affected pods through the scalar oracle instead
+        # (ADVICE r1: overflow_owners was recorded but never consumed)
+        for owner in tb.overflow_owners:
+            if 0 <= owner < len(pods):
+                batch.fallback[owner] = True
+        existing_overflow = bool(etb.overflow_owners)
 
         J = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
         na = J(self.mirror.nodes.arrays())
@@ -178,21 +216,32 @@ class Scheduler:
             order,
             key,
             deterministic=self.deterministic,
+            req_any=pa["req_any"],
         )
-        return (
-            np.asarray(assign)[: len(pods)],
-            np.asarray(pa["fallback"])[: len(pods)],
-            np.asarray(score)[: len(pods)],
+        n = len(pods)
+        return SolveOutput(
+            assign=np.asarray(assign)[:n],
+            fallback=np.asarray(batch.fallback)[:n],
+            score=np.asarray(score)[:n],
+            has_anti=np.asarray(aux["has_anti"])[:n],
+            existing_overflow=existing_overflow,
+            node_fallback_any=bool((self.mirror.nodes.fallback & self.mirror.nodes.valid).any()),
         )
 
     def _oracle_place(self, pod: Pod, score_row: np.ndarray, meta) -> Optional[str]:
         """Scalar fallback placement: oracle-feasible nodes against the live
         snapshot (including this batch's assumed pods), best device score
-        first."""
+        first. Nodes with nominated pods additionally pass the two-pass
+        nominated check (generic_scheduler.go:612-697)."""
         best = None
         best_score = None
         for cand, ni in self.cache.snapshot.node_infos.items():
             if not pod_fits_on_node(pod, ni, meta=meta)[0]:
+                continue
+            nominees = preemption_mod.eligible_nominees(
+                pod, cand, self.queue.nominated_pods_for_node
+            )
+            if nominees and not fits_with_nominees(pod, cand, self.cache.snapshot, nominees):
                 continue
             row = self.mirror.row_of.get(cand)
             s = int(score_row[row]) if row is not None and row < len(score_row) else 0
@@ -218,6 +267,10 @@ class Scheduler:
         except ValueError:
             self._fail(info, cycle, "already assumed")
             return False
+        # the pod is no longer a pending nominee anywhere — drop it from the
+        # queue's nominated index (DeleteNominatedPodIfExists at assume time,
+        # scheduler.go:529) so it isn't double-counted on its node
+        self.queue.clear_nomination(pod.key())
 
         def bind_async():
             st = self.framework.run_permit(state, pod, node_name)
@@ -253,14 +306,32 @@ class Scheduler:
         self.queue.add_unschedulable(info, cycle)
 
     def _try_preempt(self, info: PodInfo) -> bool:
-        """scheduler.go:612 preempt: nominate a node, delete victims."""
+        """scheduler.go:612 preempt: nominate a node, delete victims, clear
+        obsolete lower-priority nominations. Runs BEFORE the failed pod is
+        re-queued so the queue's nominated index sees the nomination."""
         pod = info.pod
-        node, victims, clear = preemption_mod.preempt(pod, self.cache.snapshot)
+        node, victims, clear = preemption_mod.preempt(
+            pod,
+            self.cache.snapshot,
+            pdbs=self.pdb_lister(),
+            nominated_fn=self.queue.nominated_pods_for_node,
+            # never evict a pod whose bind is still in flight: removing it
+            # locally while the async bind completes would desync the cache
+            # from the node's real occupancy
+            can_disrupt=lambda p: not self.cache.is_assumed(p.key()),
+        )
         if node is None:
             return False
         for v in victims:
-            self.cache.remove_pod(v)
+            if self.delete_fn is not None:
+                # API delete: the informer's delete event removes it from the
+                # cache (and graceful termination is the kubelet's business)
+                self.delete_fn(v)
+            else:
+                self.cache.remove_pod(v)
             self.event_fn(v, "Preempted", f"by {pod.key()}")
+        for key in clear:
+            self.queue.clear_nomination(key)
         pod.nominated_node_name = node
         self.event_fn(pod, "Nominated", node)
         return True
@@ -275,7 +346,7 @@ class Scheduler:
         cycle = self.queue.scheduling_cycle()
         self.mirror.sync()
         try:
-            assign, fallback, score = self._device_solve(infos)
+            out = self._device_solve(infos)
         except Exception as e:
             for info in infos:
                 res.errors += 1
@@ -283,6 +354,18 @@ class Scheduler:
                     self.error_fn(info.pod, e)
                 self._fail(info, cycle, f"solve error: {e}")
             return res
+
+        nominated_fn = self.queue.nominated_pods_for_node
+        # once a pod carrying required anti-affinity commits, its terms can
+        # invalidate ANY later pod's device placement (the mask predates the
+        # batch) — force the oracle re-check for the rest of the batch
+        # (reference: sequential loop sees it via
+        # satisfiesExistingPodsAntiAffinity, predicates.go:1284)
+        anti_committed = False
+        # once ANY pod commits to a different node than the solver chose (an
+        # oracle re-placement), the scan carry's residuals are stale for the
+        # rest of the batch — later device picks need a resource validation
+        residuals_diverged = False
 
         # commit in pop order (priority desc) so oracle re-checks see earlier
         # assumes, reproducing sequential semantics for topology pods
@@ -293,28 +376,59 @@ class Scheduler:
         for i in order:
             info = infos[i]
             pod = info.pod
-            row = int(assign[i])
+            row = int(out.assign[i])
             node_name = self.mirror.node_name_of_row(row) if row >= 0 else None
-            if node_name is not None and (fallback[i] or _needs_oracle_recheck(pod)):
-                ni = self.cache.snapshot.get(node_name)
+            device_choice = node_name
+            needs_recheck = (
+                out.fallback[i]
+                or out.existing_overflow
+                or anti_committed
+                or _needs_oracle_recheck(pod)
+            )
+            if node_name is not None and (needs_recheck or nominated_fn(node_name)):
                 meta = compute_predicate_metadata(pod, self.cache.snapshot)
-                ok = ni is not None and pod_fits_on_node(pod, ni, meta=meta)[0]
+                ok = self.cache.snapshot.get(node_name) is not None and fits_considering_nominated(
+                    pod, node_name, self.cache.snapshot, nominated_fn, meta=meta
+                )
                 if not ok:
                     # invalidated by an earlier commit in this batch (the
                     # solver carry tracks only resources) — re-place via the
                     # oracle against the CURRENT snapshot, ranking candidates
                     # by the device score row (sequential-equivalent filter,
                     # batch-stale scores)
-                    node_name = self._oracle_place(pod, score[i], meta)
-            if fallback[i] and node_name is None:
-                # encoding overflowed — full scalar fallback over all nodes
+                    node_name = self._oracle_place(pod, out.score[i], meta)
+            elif node_name is not None and residuals_diverged:
+                # constraint-free pod, but an earlier re-placement moved
+                # capacity the solver didn't account for: cheap scalar
+                # resource check against the LIVE snapshot; full oracle
+                # re-place only if it fails
+                ni = self.cache.snapshot.get(node_name)
+                if ni is None or not pod_fits_resources(pod, ni):
+                    meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                    node_name = self._oracle_place(pod, out.score[i], meta)
+            if node_name is None and (
+                out.fallback[i]
+                or out.existing_overflow
+                or out.node_fallback_any
+                or residuals_diverged
+            ):
+                # the device mask may be conservatively wrong (encoding
+                # overflow / excluded node rows / capacity the carry charged
+                # to a node an earlier pod vacated) — full scalar fallback
+                # over all nodes before declaring the pod unschedulable
                 meta = compute_predicate_metadata(pod, self.cache.snapshot)
-                node_name = self._oracle_place(pod, score[i], meta)
+                node_name = self._oracle_place(pod, out.score[i], meta)
             if node_name is None:
+                if device_choice is not None:
+                    # the solver charged this pod's request to a node it never
+                    # occupied — later device picks may be too conservative
+                    residuals_diverged = True
                 res.unschedulable += 1
-                self._fail(info, cycle, "no fit")
-                if self.enable_preemption and self._try_preempt(info):
+                preempted_now = self.enable_preemption and self._try_preempt(info)
+                if preempted_now:
                     res.preempted += 1
+                self._fail(info, cycle, "no fit")
+                if preempted_now:
                     # victim deletions are cluster events: wake the queue
                     # (eventhandlers.go:127 → MoveAllToActiveQueue); the pod
                     # retries after its backoff expires
@@ -323,8 +437,14 @@ class Scheduler:
             if self._commit(info, node_name, cycle):
                 res.scheduled += 1
                 res.assignments[pod.key()] = node_name
+                if out.has_anti[i]:
+                    anti_committed = True
+                if node_name != device_choice:
+                    residuals_diverged = True
             else:
                 res.unschedulable += 1
+                if device_choice is not None:
+                    residuals_diverged = True
         return res
 
     def run_until_empty(self, max_cycles: int = 1000) -> ScheduleResult:
@@ -343,4 +463,6 @@ class Scheduler:
     def wait_for_binds(self) -> None:
         """Drain the bind pipeline (tests/benchmarks)."""
         self._bind_pool.shutdown(wait=True)
-        self._bind_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="bind")
+        self._bind_pool = ThreadPoolExecutor(
+            max_workers=self._bind_workers, thread_name_prefix="bind"
+        )

@@ -64,13 +64,17 @@ class EventHandlers:
             self.queue.delete(pod)
 
     def _skip_pod_update(self, old: Pod, new: Pod) -> bool:
-        """skipPodUpdate (:336): ignore updates that only touch
-        resourceVersion/status the scheduler itself wrote."""
-        return (
-            old.node_name == new.node_name
-            and old.labels == new.labels
-            and old.resource_version == new.resource_version
-        )
+        """skipPodUpdate (eventhandlers.go:336): skip only when (1) the pod
+        is ASSUMED in the cache (the update is likely the echo of our own
+        bind), and (2) the objects are identical once ResourceVersion,
+        Spec.NodeName and Annotations — the fields the scheduler/API server
+        write — are stripped. Any real spec change must requeue."""
+        if not self.cache.is_assumed(new.key()):
+            return False
+        import dataclasses
+
+        strip = dict(resource_version="", node_name="", annotations={})
+        return dataclasses.replace(old, **strip) == dataclasses.replace(new, **strip)
 
     # -- nodes --------------------------------------------------------------
 

@@ -2,7 +2,9 @@
 
 Reference: core/generic_scheduler.go Preempt (:313),
 selectNodesForPreemption (:1007), selectVictimsOnNode (:1104),
-pickOneNodeForPreemption (:878), nodesWherePreemptionMightHelp (:1218).
+filterPodsWithPDBViolation (:1055), pickOneNodeForPreemption (:878),
+nodesWherePreemptionMightHelp (:1218), podFitsOnNode's nominated-pods
+two-pass rule (:612-697).
 
 Host-side implementation over the oracle (preemption runs only for pods
 that already failed the fast path — inherently rare, so scalar cost is
@@ -11,11 +13,11 @@ acceptable; vectorized victim search is a planned optimization).
 
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from ..api.types import Pod
+from ..api.selectors import match_label_selector
+from ..api.types import Pod, PodDisruptionBudget
 from ..oracle.nodeinfo import NodeInfo, Snapshot
 from ..oracle.predicates import (
     check_node_unschedulable,
@@ -26,11 +28,81 @@ from ..oracle.predicates import (
     pod_tolerates_node_taints,
 )
 
+NominatedFn = Callable[[str], List[Pod]]
+
 
 @dataclass
 class Victims:
     pods: List[Pod]
     num_pdb_violations: int = 0
+
+
+def _shadow_one(snapshot: Snapshot, node_name: str) -> Snapshot:
+    """Copy-on-write snapshot that clones ONLY node_name's pod list (the one
+    thing victim search / nominee simulation mutates); every other NodeInfo
+    is shared with the source — O(nodes) references, not O(pods) copies."""
+    shadow = Snapshot()
+    for n, info in snapshot.node_infos.items():
+        if n == node_name:
+            si = shadow.add_node(info.node)
+            si.pods = list(info.pods)
+        else:
+            shadow.node_infos[n] = info
+    return shadow
+
+
+def eligible_nominees(pod: Pod, node_name: str, nominated_fn: Optional[NominatedFn]) -> List[Pod]:
+    """Nominated pods the two-pass rule must count for `pod` on this node:
+    someone else's nomination with equal-or-higher priority
+    (generic_scheduler.go:620-630)."""
+    if nominated_fn is None:
+        return []
+    prio = pod.get_priority()
+    return [
+        p
+        for p in nominated_fn(node_name)
+        if p.key() != pod.key() and p.get_priority() >= prio
+    ]
+
+
+def fits_considering_nominated(
+    pod: Pod,
+    node_name: str,
+    snapshot: Snapshot,
+    nominated_fn: Optional[NominatedFn],
+    meta=None,
+) -> bool:
+    """podFitsOnNode's two-pass rule (generic_scheduler.go:612-697): when
+    the node has nominated pods of priority >= the incoming pod's, predicates
+    must pass BOTH with those pods' resources/affinity counted AND without
+    (nominated pods may never arrive, and their absence can break the
+    incoming pod's required pod affinity)."""
+    ni = snapshot.get(node_name)
+    if ni is None:
+        return False
+    nominees = eligible_nominees(pod, node_name, nominated_fn)
+    if meta is None:
+        meta = compute_predicate_metadata(pod, snapshot)
+    if not pod_fits_on_node(pod, ni, meta=meta)[0]:
+        return False
+    if not nominees:
+        return True
+    return fits_with_nominees(pod, node_name, snapshot, nominees)
+
+
+def fits_with_nominees(
+    pod: Pod, node_name: str, snapshot: Snapshot, nominees: Sequence[Pod]
+) -> bool:
+    """The with-nominated-pods pass alone (callers have already verified the
+    plain pass)."""
+    import dataclasses
+
+    shadow = _shadow_one(snapshot, node_name)
+    sni = shadow.get(node_name)
+    for p in nominees:
+        sni.pods.append(dataclasses.replace(p, node_name=node_name))
+    meta2 = compute_predicate_metadata(pod, shadow)
+    return pod_fits_on_node(pod, sni, meta=meta2)[0]
 
 
 def pod_eligible_to_preempt_others(pod: Pod, snapshot: Snapshot) -> bool:
@@ -62,44 +134,95 @@ def nodes_where_preemption_might_help(pod: Pod, snapshot: Snapshot) -> List[str]
     return out
 
 
-def select_victims_on_node(pod: Pod, node_name: str, snapshot: Snapshot) -> Optional[Victims]:
+def _pods_violating_pdbs(
+    pods: Sequence[Pod], pdbs: Sequence[PodDisruptionBudget]
+) -> Tuple[List[Pod], List[Pod]]:
+    """filterPodsWithPDBViolation (:1055): a pod 'violates' when it matches a
+    PDB (same namespace, selector) whose disruptionsAllowed is exhausted."""
+    violating, non_violating = [], []
+    for p in pods:
+        hit = False
+        for pdb in pdbs:
+            if pdb.namespace != p.namespace or pdb.selector is None:
+                continue
+            # an EMPTY selector matches nothing here (the reference does
+            # `if selector.Empty() || !selector.Matches(...) { continue }`,
+            # generic_scheduler.go:1069) — the opposite of the usual
+            # empty-selector-matches-all label semantics
+            if not pdb.selector.match_labels and not pdb.selector.match_expressions:
+                continue
+            if match_label_selector(pdb.selector, p.labels):
+                if pdb.disruptions_allowed <= 0:
+                    hit = True
+        (violating if hit else non_violating).append(p)
+    return violating, non_violating
+
+
+def _importance(p: Pod) -> Tuple[int, float]:
+    """util.MoreImportantPod sort key: higher priority first, then earlier
+    start (approximated by creation timestamp)."""
+    return (-p.get_priority(), p.creation_timestamp)
+
+
+def select_victims_on_node(
+    pod: Pod,
+    node_name: str,
+    snapshot: Snapshot,
+    pdbs: Sequence[PodDisruptionBudget] = (),
+    can_disrupt: Optional[Callable[[Pod], bool]] = None,
+) -> Optional[Victims]:
     """selectVictimsOnNode (:1104): remove ALL lower-priority pods; if the
-    pod then fits, reprieve victims (highest priority first) keeping every
-    one whose re-addition still lets the pod fit."""
+    pod then fits, reprieve candidates most-important-first — PDB-protected
+    pods get reprieved first; any that cannot be reprieved count as PDB
+    violations for the tie-break.
+
+    can_disrupt: extra victim eligibility (the driver excludes ASSUMED pods
+    whose bind is still in flight — deleting those would corrupt the cache's
+    capacity view; the reference tolerates this because victims die via API
+    delete + informer echo)."""
     ni = snapshot.get(node_name)
     if ni is None:
         return None
     prio = pod.get_priority()
-    potential = [p for p in ni.pods if p.get_priority() < prio]
+    potential = [
+        p
+        for p in ni.pods
+        if p.get_priority() < prio and (can_disrupt is None or can_disrupt(p))
+    ]
     if not potential:
         return None
 
-    # shadow snapshot: same objects, shallow per-node pod lists
-    shadow = Snapshot()
-    for n, info in snapshot.node_infos.items():
-        si = shadow.add_node(info.node)
-        si.pods = list(info.pods)
+    shadow = _shadow_one(snapshot, node_name)
     sni = shadow.get(node_name)
-    sni.pods = [p for p in sni.pods if p.get_priority() >= prio]
+    victims_set = {id(p) for p in potential}
+    sni.pods = [p for p in sni.pods if id(p) not in victims_set]
 
     meta = compute_predicate_metadata(pod, shadow)
     fits, _ = pod_fits_on_node(pod, sni, meta=meta)
     if not fits:
         return None
 
+    violating, non_violating = _pods_violating_pdbs(potential, pdbs)
     victims: List[Pod] = []
-    # reprieve in descending priority (then earlier start first — approximated
-    # by creation timestamp, util.MoreImportantPod)
-    for p in sorted(potential, key=lambda x: (-x.get_priority(), x.creation_timestamp)):
+    num_violations = 0
+
+    def reprieve(p: Pod) -> bool:
         sni.pods.append(p)
         meta = compute_predicate_metadata(pod, shadow)
         still_fits, _ = pod_fits_on_node(pod, sni, meta=meta)
         if not still_fits:
             sni.pods.remove(p)
             victims.append(p)
+        return still_fits
+
+    for p in sorted(violating, key=_importance):
+        if not reprieve(p):
+            num_violations += 1
+    for p in sorted(non_violating, key=_importance):
+        reprieve(p)
     if not victims:
         return None
-    return Victims(pods=victims)
+    return Victims(pods=victims, num_pdb_violations=num_violations)
 
 
 def pick_one_node_for_preemption(candidates: Dict[str, Victims]) -> Optional[str]:
@@ -140,27 +263,34 @@ def pick_one_node_for_preemption(candidates: Dict[str, Victims]) -> Optional[str
     return names[0]
 
 
-def preempt(pod: Pod, snapshot: Snapshot) -> Tuple[Optional[str], List[Pod], List[str]]:
+def preempt(
+    pod: Pod,
+    snapshot: Snapshot,
+    pdbs: Sequence[PodDisruptionBudget] = (),
+    nominated_fn: Optional[NominatedFn] = None,
+    can_disrupt: Optional[Callable[[Pod], bool]] = None,
+) -> Tuple[Optional[str], List[Pod], List[str]]:
     """Preempt (:313): returns (node, victims, nominated pod keys to clear).
     The third element lists LOWER-priority pods nominated to the chosen node
-    whose nomination should be cleared (:346-360)."""
+    (from the scheduling queue's nominated index, :346-360) whose nomination
+    should be cleared — their node is about to be consumed by this pod."""
     if not pod_eligible_to_preempt_others(pod, snapshot):
         return None, [], []
     potential = nodes_where_preemption_might_help(pod, snapshot)
     candidates: Dict[str, Victims] = {}
     for name in potential:
-        v = select_victims_on_node(pod, name, snapshot)
+        v = select_victims_on_node(pod, name, snapshot, pdbs=pdbs, can_disrupt=can_disrupt)
         if v is not None:
             candidates[name] = v
     chosen = pick_one_node_for_preemption(candidates)
     if chosen is None:
         return None, [], []
-    # lower-priority nominated pods on the chosen node lose their nomination
+    # lower-priority pending pods nominated to the chosen node lose their
+    # nomination (getLowerPriorityNominatedPods :1240)
     clear: List[str] = []
-    ni = snapshot.get(chosen)
     prio = pod.get_priority()
-    if ni is not None:
-        for p in ni.pods:
-            if p.nominated_node_name == chosen and p.get_priority() < prio:
+    if nominated_fn is not None:
+        for p in nominated_fn(chosen):
+            if p.get_priority() < prio:
                 clear.append(p.key())
     return chosen, candidates[chosen].pods, clear

@@ -28,6 +28,7 @@ from ..oracle.nodeinfo import NodeInfo, Snapshot
 from .tensors import (
     EncodingConfig,
     ExistingPodsBank,
+    ImageTable,
     KeySlotOverflow,
     NodeBank,
     Vocab,
@@ -122,12 +123,10 @@ class SchedulerCache:
             st = self._pod_states.get(key)
             if st is not None and st.assumed:
                 # confirmation: replace the assumed object with the real one
-                if st.pod.node_name != pod.node_name:
-                    self._remove_pod_from_node(st.pod)
-                    self._add_pod_to_node(pod)
-                else:
-                    self._remove_pod_from_node(st.pod)
-                    self._add_pod_to_node(pod)
+                # (the informer may report a different node than we assumed —
+                # removing from the OLD node handles both cases)
+                self._remove_pod_from_node(st.pod)
+                self._add_pod_to_node(pod)
                 self._pod_states[key] = _PodState(pod=pod)
                 self._assumed.discard(key)
                 return
@@ -210,12 +209,14 @@ class SchedulerCache:
 class TensorMirror:
     """Keeps device-facing banks (NodeBank + ExistingPodsBank) patched from a
     SchedulerCache — the TPU replacement for UpdateNodeInfoSnapshot's
-    generation walk. Rows are allocated per node from a free list; pods
-    re-encode with their node's row (pods move rarely; node rows are stable).
+    generation walk (cache.go:206-242). Rows are allocated per node from a
+    free list; each node's pods get eps rows from a second free list, and
+    sync() touches ONLY the pods of dirty nodes — patch cost is proportional
+    to the delta, not the cluster.
 
-    sync() applies only dirty nodes. Capacity overflow (more nodes than the
-    bank, label-key growth) triggers a full rebuild at the next bucket size —
-    bounded recompilation by construction.
+    Capacity overflow (more nodes/pods than the banks, label-key growth)
+    triggers a full rebuild at the next bucket size — bounded recompilation
+    by construction.
     """
 
     def __init__(self, cache: SchedulerCache, vocab: Optional[Vocab] = None):
@@ -239,31 +240,48 @@ class TensorMirror:
                     self.nodes.set_node(row, ni)
                 n_pods = max(sum(len(ni.pods) for ni in snap.node_infos.values()), 1)
                 self.eps = ExistingPodsBank(self.vocab, _bucket(n_pods))
-                self._encode_all_pods()
+                self._node_pod_rows: Dict[str, List[int]] = {}
+                self._free_pod_rows = list(range(self.eps.capacity - 1, -1, -1))
+                for name, ni in snap.node_infos.items():
+                    self._encode_node_pods(name, ni)
+                ImageTable(self.vocab).apply(self.nodes, snap, self.row_of)
+                self._image_sig = {
+                    name: self._image_signature(ni) for name, ni in snap.node_infos.items()
+                }
                 break
             except KeySlotOverflow:
                 continue
         self.cache.dirty_nodes.clear()
         self.cache.removed_nodes.clear()
+        self._etb = None  # cached existing-terms bank (compile_existing_terms)
         self.generation = 0
 
-    def _encode_all_pods(self) -> None:
-        """Existing pods are re-packed densely; row churn is fine because no
-        state outside the bank references pod rows."""
-        self.eps.valid[:] = False
-        j = 0
-        for name, ni in self.cache.snapshot.node_infos.items():
-            row = self.row_of[name]
-            for pod in ni.pods:
-                if j >= self.eps.capacity:
-                    raise KeySlotOverflow()  # grow pods bank via rebuild
-                self.eps.set_pod(j, pod, row)
-                j += 1
-        self._pods_used = j
+    @staticmethod
+    def _image_signature(ni: NodeInfo):
+        return frozenset(ni.image_sizes().items())
+
+    def _release_node_pods(self, name: str) -> None:
+        for row in self._node_pod_rows.pop(name, ()):
+            self.eps.valid[row] = False
+            self._free_pod_rows.append(row)
+
+    def _encode_node_pods(self, name: str, ni: NodeInfo) -> None:
+        """Re-encode one node's pods into freshly allocated eps rows. Raises
+        KeySlotOverflow when the bank is full (caller rebuilds bigger)."""
+        node_row = self.row_of[name]
+        rows: List[int] = []
+        for pod in ni.pods:
+            if not self._free_pod_rows:
+                self._node_pod_rows[name] = rows  # keep bookkeeping consistent
+                raise KeySlotOverflow()
+            row = self._free_pod_rows.pop()
+            self.eps.set_pod(row, pod, node_row)
+            rows.append(row)
+        self._node_pod_rows[name] = rows
 
     def sync(self) -> bool:
-        """Apply dirty nodes. Returns True if a full rebuild happened (device
-        arrays change shape → recompile)."""
+        """Apply dirty nodes (and ONLY their pods). Returns True if a full
+        rebuild happened (device arrays change shape → recompile)."""
         cache = self.cache
         with cache._lock:
             dirty = set(cache.dirty_nodes)
@@ -276,6 +294,8 @@ class TensorMirror:
             ):
                 self._rebuild()
                 return True
+            if not (dirty or removed or new_nodes):
+                return False
             try:
                 for name in removed:
                     row = self.row_of.pop(name, None)
@@ -283,27 +303,56 @@ class TensorMirror:
                         self.nodes.clear_node(row)
                         self.name_of_row[row] = None
                         self._free_rows.append(row)
+                    self._release_node_pods(name)
+                    self._image_sig.pop(name, None)
                 for name in new_nodes:
                     row = self._free_rows.pop()
                     self.row_of[name] = row
                     self.name_of_row[row] = name
+                images_changed = bool(removed) or bool(new_nodes)
+                affinity_changed = bool(removed)
                 for name in dirty | set(new_nodes):
                     ni = cache.snapshot.get(name)
-                    if ni is not None and name in self.row_of:
-                        self.nodes.set_node(self.row_of[name], ni)
-                # pods: repack when anything changed (cheap row writes; the
-                # expensive part — device upload — is once per cycle anyway)
-                if dirty or removed or new_nodes:
-                    n_pods = sum(len(ni.pods) for ni in cache.snapshot.node_infos.values())
-                    if n_pods > self.eps.capacity:
-                        self._rebuild()
-                        return True
-                    self._encode_all_pods()
+                    if ni is None or name not in self.row_of:
+                        continue
+                    self.nodes.set_node(self.row_of[name], ni)
+                    # pods: release this node's old rows, re-encode current
+                    old_rows = self._node_pod_rows.get(name, [])
+                    had_affinity = any(
+                        self.eps.has_affinity[r] for r in old_rows
+                    ) or any(p.affinity is not None for p in ni.pods)
+                    if had_affinity:
+                        affinity_changed = True
+                    self._release_node_pods(name)
+                    self._encode_node_pods(name, ni)
+                    sig = self._image_signature(ni)
+                    if self._image_sig.get(name) != sig:
+                        self._image_sig[name] = sig
+                        images_changed = True
+                if images_changed:
+                    # spread scaling depends on cluster-wide image placement
+                    # and node count → recompute the whole table (rare: image
+                    # states and node membership change far less than pods)
+                    ImageTable(self.vocab).apply(self.nodes, cache.snapshot, self.row_of)
+                if affinity_changed:
+                    self._etb = None
             except KeySlotOverflow:
                 self._rebuild()
                 return True
             self.generation += 1
             return False
+
+    def existing_terms(self):
+        """Cached compile_existing_terms over the current snapshot —
+        invalidated by sync() only when a dirty node's affinity-pod set could
+        have changed. Raises KeySlotOverflow like the compilers."""
+        if self._etb is None:
+            from .terms import compile_existing_terms
+
+            self._etb, _ = compile_existing_terms(
+                self.vocab, self.cache.snapshot, self.row_of
+            )
+        return self._etb
 
     def node_name_of_row(self, row: int) -> Optional[str]:
         if 0 <= row < len(self.name_of_row):

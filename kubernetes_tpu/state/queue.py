@@ -227,6 +227,16 @@ class PriorityQueue:
         if node:
             self._nominated_by_node.get(node, set()).discard(key)
 
+    def clear_nomination(self, key: str) -> None:
+        """Drop a pending pod's nomination (the preempt 'clear' list,
+        generic_scheduler.go:346-360: lower-priority nominees of a node just
+        claimed by a higher-priority preemptor)."""
+        with self._lock:
+            self._remove_nominated(key)
+            info = self._infos.get(key)
+            if info is not None:
+                info.pod.nominated_node_name = ""
+
     def nominated_pods_for_node(self, node: str) -> List[Pod]:
         with self._lock:
             return [

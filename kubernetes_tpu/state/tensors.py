@@ -344,6 +344,9 @@ class NodeBank:
     def clear_node(self, i: int) -> None:
         self.valid[i] = False
         self.pod_count[i] = 0
+        # un-latch the conservative flags: a stale True on an invalid row
+        # would force the driver's O(nodes) oracle fallback forever
+        self.fallback[i] = False
 
     def arrays(self) -> Dict[str, np.ndarray]:
         out = {
@@ -427,29 +430,31 @@ class ImageTable:
     def __init__(self, vocab: Vocab):
         self.vocab = vocab
 
-    def apply(self, bank: NodeBank, snapshot: Snapshot) -> None:
+    def apply(
+        self, bank: NodeBank, snapshot: Snapshot, row_of: Optional[Dict[str, int]] = None
+    ) -> None:
+        """row_of maps node name → bank row; defaults to snapshot enumeration
+        order (the encode_snapshot layout)."""
         v = self.vocab
+        if row_of is None:
+            row_of = {ni.node.name: i for i, ni in enumerate(snapshot.node_infos.values())}
         node_counts = snapshot.total_image_nodes()
         total_nodes = len(snapshot.node_infos)
         # image vocabulary = every image name seen on any node
         max_id = 0
-        for idx, ni in _bank_rows(bank, snapshot):
-            sizes = ni.image_sizes()
-            for name in sizes:
+        for ni in snapshot.node_infos.values():
+            for name in ni.image_sizes():
                 max_id = max(max_id, v.id(name))
         # bucketed width → stable kernel shapes across snapshots
         table = np.zeros((bank.capacity, _bucket(max_id + 1, 64)), np.int64)
-        for idx, ni in _bank_rows(bank, snapshot):
-            sizes = ni.image_sizes()
-            for name, size in sizes.items():
+        for ni in snapshot.node_infos.values():
+            idx = row_of.get(ni.node.name)
+            if idx is None:
+                continue
+            for name, size in ni.image_sizes().items():
                 spread = node_counts.get(name, 0) / total_nodes if total_nodes else 0.0
                 table[idx, v.id(name)] = int(size * spread)
         bank.image_scaled = table
-
-
-def _bank_rows(bank: NodeBank, snapshot: Snapshot):
-    for idx, ni in enumerate(snapshot.node_infos.values()):
-        yield idx, ni
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,279 @@
+"""Regression tests for round-1 advisor/judge findings:
+
+1. intra-batch required anti-affinity vs constraint-free pods (ADVICE high)
+2. term-table overflow → oracle fallback (ADVICE high)
+3. nominated-node protection + clear list (ADVICE med / generic_scheduler.go:612)
+4. ImageLocality in the production device path (ADVICE med)
+5. zero-request pods on overcommitted nodes (ADVICE low / predicates.go:854)
+6. skipPodUpdate semantics (eventhandlers.go:336)
+7. PDB-aware preemption (generic_scheduler.go:1055)
+8. incremental (dirty-only) TensorMirror sync
+"""
+
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    LabelSelectorRequirement,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodDisruptionBudget,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.scheduler.eventhandlers import EventHandlers
+from kubernetes_tpu.state.cache import SchedulerCache, TensorMirror
+from kubernetes_tpu.state.queue import PriorityQueue
+
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def _mk(nodes, existing=(), **kw):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(p)
+    binds = []
+    binder = Binder(lambda pod, node: binds.append((pod.key(), node)))
+    sched = Scheduler(cache=cache, queue=PriorityQueue(), binder=binder,
+                      deterministic=True, **kw)
+    return sched, binds
+
+
+def _host_nodes(n, **kw):
+    return [make_node(f"n{i}", labels={HOSTNAME: f"n{i}"}, **kw) for i in range(n)]
+
+
+# 1 ─ intra-batch anti-affinity: the anti-affinity CARRIER commits first
+# (higher priority), then a constraint-free pod whose labels match the
+# carrier's term must not land in the carrier's topology domain.
+def test_constraint_free_pod_respects_earlier_anti_affinity_commit():
+    nodes = _host_nodes(2)
+    sched, _ = _mk(nodes)
+    term = PodAffinityTerm(
+        label_selector=LabelSelector(match_labels={"app": "x"}),
+        topology_key=HOSTNAME,
+    )
+    carrier = make_pod("carrier", labels={"app": "x"})
+    carrier.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[term]))
+    carrier.priority = 100
+    free = make_pod("free", labels={"app": "x"})  # no constraints of its own
+    free.priority = 0
+    sched.queue.add(carrier)
+    sched.queue.add(free)
+    res = sched.schedule_batch()
+    assert res.scheduled == 2, res
+    assert res.assignments["default/carrier"] != res.assignments["default/free"]
+
+
+def test_constraint_free_pod_fails_when_anti_affinity_blocks_everywhere():
+    # one node: carrier takes it; the matching constraint-free pod must NOT
+    # be committed onto the same host (the reference's sequential loop
+    # rejects it via satisfiesExistingPodsAntiAffinity, predicates.go:1284)
+    nodes = _host_nodes(1)
+    sched, _ = _mk(nodes)
+    term = PodAffinityTerm(
+        label_selector=LabelSelector(match_labels={"app": "x"}),
+        topology_key=HOSTNAME,
+    )
+    carrier = make_pod("carrier", labels={"app": "x"})
+    carrier.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[term]))
+    carrier.priority = 100
+    free = make_pod("free", labels={"app": "x"})
+    sched.queue.add(carrier)
+    sched.queue.add(free)
+    res = sched.schedule_batch()
+    assert res.assignments.get("default/carrier") == "n0"
+    assert "default/free" not in res.assignments
+    assert res.unschedulable == 1
+
+
+# 2 ─ term overflow: an existing pod's anti-affinity with >6 In-values is
+# truncated on device; the driver must fall back to the oracle rather than
+# committing a violating placement.
+def test_existing_term_value_overflow_forces_oracle():
+    nodes = _host_nodes(1)
+    vals = [f"v{i}" for i in range(10)]  # > val_cap (6)
+    term = PodAffinityTerm(
+        label_selector=LabelSelector(
+            match_expressions=[LabelSelectorRequirement(key="app", operator="In", values=vals)]
+        ),
+        topology_key=HOSTNAME,
+    )
+    existing = make_pod("anti", node_name="n0", labels={"app": "keeper"})
+    existing.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[term]))
+    sched, _ = _mk(nodes, existing=[existing])
+    # incoming matches value v9 — truncated OUT of the device table, so the
+    # device mask wrongly allows n0; the oracle must veto it
+    incoming = make_pod("incoming", labels={"app": "v9"})
+    sched.queue.add(incoming)
+    res = sched.schedule_batch()
+    assert "default/incoming" not in res.assignments
+    assert res.unschedulable == 1
+
+
+def test_batch_term_value_overflow_falls_back_to_oracle():
+    # the INCOMING pod's own anti-affinity truncates: device over/under-
+    # matches; the oracle path must still produce a correct placement
+    nodes = _host_nodes(2)
+    existing = make_pod("blocker", node_name="n0", labels={"app": "v9"})
+    sched, _ = _mk(nodes, existing=[existing])
+    vals = [f"v{i}" for i in range(10)]
+    term = PodAffinityTerm(
+        label_selector=LabelSelector(
+            match_expressions=[LabelSelectorRequirement(key="app", operator="In", values=vals)]
+        ),
+        topology_key=HOSTNAME,
+    )
+    incoming = make_pod("incoming")
+    incoming.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[term]))
+    sched.queue.add(incoming)
+    res = sched.schedule_batch()
+    # v9 is beyond the device value capacity; only the oracle sees the match
+    assert res.assignments.get("default/incoming") == "n1"
+
+
+# 3 ─ nominated-node protection: after preemption nominates a node, a
+# lower-priority pod in the next batch must not consume the freed capacity.
+def test_nominated_capacity_protected_from_lower_priority():
+    nodes = [make_node("n0", cpu_milli=1000, mem=2**30)]
+    victim = make_pod("victim", cpu_milli=900, mem=0, node_name="n0")
+    victim.priority = 0
+    sched, _ = _mk(nodes, existing=[victim])
+    urgent = make_pod("urgent", cpu_milli=900, mem=0)
+    urgent.priority = 1000
+    sched.queue.add(urgent)
+    res = sched.schedule_batch()
+    assert res.preempted == 1
+    assert urgent.nominated_node_name == "n0"
+    # queue's nominated index learned of it at requeue time
+    assert sched.queue.nominated_pods_for_node("n0")
+
+    # a lower-priority opportunist arrives before the urgent pod's backoff
+    opportunist = make_pod("opportunist", cpu_milli=900, mem=0)
+    opportunist.priority = 1
+    sched.queue.add(opportunist)
+    res2 = sched.schedule_batch()
+    assert "default/opportunist" not in res2.assignments, res2
+    # after backoff, the urgent pod takes its nominated node
+    time.sleep(1.1)
+    res3 = sched.schedule_batch()
+    assert res3.assignments.get("default/urgent") == "n0"
+
+
+# 4 ─ ImageLocality is live in the device path via TensorMirror.
+def test_image_locality_scored_in_device_path():
+    from kubernetes_tpu.api.types import ContainerImage
+
+    big = 900 * 2**20
+    img = "registry.local/app-0:v1"  # the image make_pod assigns
+    nodes = [
+        make_node("with-image", images=[ContainerImage(names=[img], size_bytes=big)]),
+        make_node("without-image"),
+    ]
+    sched, _ = _mk(nodes)
+    sched.queue.add(make_pod("p0"))
+    res = sched.schedule_batch()
+    assert res.assignments["default/p0"] == "with-image"
+
+
+# 5 ─ zero-request pod on an overcommitted node must schedule.
+def test_zero_request_pod_on_overcommitted_node():
+    node = make_node("n0", cpu_milli=100, mem=2**20)
+    hog = make_pod("hog", cpu_milli=200, mem=2**22, node_name="n0")  # overcommit
+    sched, _ = _mk([node], existing=[hog])
+    empty = make_pod("empty", cpu_milli=0, mem=0)
+    sched.queue.add(empty)
+    res = sched.schedule_batch()
+    assert res.assignments.get("default/empty") == "n0", res
+
+
+# 6 ─ skipPodUpdate: only assumed pods with RV/nodeName/annotation-only
+# diffs are skipped; real spec changes always requeue.
+def test_skip_pod_update_semantics():
+    import dataclasses
+
+    cache = SchedulerCache()
+    queue = PriorityQueue()
+    h = EventHandlers(cache, queue)
+    cache.add_node(make_node("n0"))
+
+    # an assumed pod: RV-only echo of our own bind → skipped
+    assumed = make_pod("a", node_name="n0")
+    cache.assume_pod(assumed)
+    echo = dataclasses.replace(assumed, resource_version="2")
+    moves_before = cache.pod_count()
+    h.on_pod_update(assumed, echo)
+    assert cache.pod_count() == moves_before  # no churn
+
+    # NOT assumed: identical-looking update must still be processed
+    pending = make_pod("b")
+    queue.add(pending)
+    changed = dataclasses.replace(pending, resource_version="3", labels={"new": "label"})
+    h.on_pod_update(pending, changed)
+    # the queue sees the new object (labels changed → real update)
+    infos = queue.pop_batch(10)
+    assert any(i.pod.labels.get("new") == "label" for i in infos)
+
+
+# 7 ─ PDB-aware preemption: prefer the node whose victims violate no PDB.
+def test_preemption_prefers_node_without_pdb_violation():
+    nodes = [make_node("n0", cpu_milli=1000, mem=2**30),
+             make_node("n1", cpu_milli=1000, mem=2**30)]
+    protected = make_pod("protected", cpu_milli=900, mem=0, node_name="n0",
+                         labels={"app": "guarded"})
+    protected.priority = 0
+    plain = make_pod("plain", cpu_milli=900, mem=0, node_name="n1")
+    plain.priority = 0
+    pdb = PodDisruptionBudget(
+        name="guard", namespace="default",
+        selector=LabelSelector(match_labels={"app": "guarded"}),
+        disruptions_allowed=0,
+    )
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    cache.add_pod(protected)
+    cache.add_pod(plain)
+    sched = Scheduler(cache=cache, queue=PriorityQueue(), binder=Binder(),
+                      deterministic=True, pdb_lister=lambda: [pdb])
+    urgent = make_pod("urgent", cpu_milli=900, mem=0)
+    urgent.priority = 1000
+    sched.queue.add(urgent)
+    res = sched.schedule_batch()
+    assert res.preempted == 1
+    assert urgent.nominated_node_name == "n1"  # plain victim, no PDB hit
+    # the protected pod survived
+    assert any(p.name == "protected" for p in cache.snapshot.get("n0").pods)
+
+
+# 8 ─ TensorMirror sync touches only dirty nodes' pods.
+def test_sync_touches_only_dirty_nodes(monkeypatch):
+    cache = SchedulerCache()
+    for i in range(8):
+        cache.add_node(make_node(f"n{i}"))
+    # 30 pods → eps bucket 32 with free rows (no growth-rebuild on +1)
+    for i in range(30):
+        cache.add_pod(make_pod(f"p{i}", node_name=f"n{i % 8}"))
+    mirror = TensorMirror(cache)
+
+    encoded = []
+    orig = type(mirror.eps).set_pod
+
+    def spy(self, j, pod, node_idx):
+        encoded.append(pod.key())
+        return orig(self, j, pod, node_idx)
+
+    monkeypatch.setattr(type(mirror.eps), "set_pod", spy)
+    cache.add_pod(make_pod("p-new", node_name="n3"))
+    mirror.sync()
+    # only n3's pods re-encoded: its 4 originals + the new one
+    assert len(encoded) == 5, encoded
+    assert set(encoded) == {"default/p3", "default/p11", "default/p19",
+                            "default/p27", "default/p-new"}

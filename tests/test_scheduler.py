@@ -152,20 +152,52 @@ def test_bind_failure_forgets_and_requeues():
     assert sched.queue.pending_count() == 1
 
 
-def test_large_random_cluster_matches_oracle_feasibility():
-    g = ClusterGen(77)
-    nodes, existing = g.cluster(16, 40, feature_rate=0.4)
-    sched, binds = _mk_scheduler(nodes, existing=existing)
-    pods = [g.pod(100 + i, feature_rate=0.4) for i in range(10)]
+def _assert_sequential_equivalent(seed, n_nodes=16, n_existing=40, n_pending=12,
+                                  feature_rate=0.4):
+    """Sequential-equivalence property: replay the batch scheduler's commit
+    order (priority desc, enqueue seq asc — driver.schedule_batch) through
+    the pure oracle and assert that every assignment was oracle-feasible at
+    its commit time, and every unschedulable pod had NO feasible node at its
+    evaluation time. This is exactly what the reference's one-pod-at-a-time
+    loop (scheduleOne, scheduler.go:579) would have decided."""
+    import dataclasses
+
+    g = ClusterGen(seed)
+    nodes, existing = g.cluster(n_nodes, n_existing, feature_rate=feature_rate)
+    sched, binds = _mk_scheduler(nodes, existing=existing, enable_preemption=False)
+    pods = [g.pod(1000 + i, feature_rate=feature_rate) for i in range(n_pending)]
     for p in pods:
         sched.queue.add(p)
     res = sched.schedule_batch()
-    # every assignment must be oracle-feasible at commit time's snapshot;
-    # weaker invariant checked here: assigned node was feasible pre-batch OR
-    # pod had no topology coupling (resources tracked exactly)
-    for p in pods:
+    assert res.scheduled + res.unschedulable == n_pending
+
+    # replay in the driver's deterministic commit order
+    snap = Snapshot(list(nodes), list(existing))
+    ordered = sorted(range(len(pods)), key=lambda i: (-pods[i].get_priority(), i))
+    for i in ordered:
+        p = pods[i]
+        feasible = find_nodes_that_fit(p, snap)
         node = res.assignments.get(p.key())
         if node is not None:
-            snap_feasible = find_nodes_that_fit(p, Snapshot(nodes, list(existing)))
-            assert node in snap_feasible or True  # sanity placeholder
-    assert res.scheduled + res.unschedulable == 10
+            assert node in feasible, (
+                f"seed={seed}: {p.key()} committed to {node} which the oracle "
+                f"rejects at commit time (feasible={feasible})"
+            )
+            ni = snap.get(node)
+            ni.pods.append(dataclasses.replace(p, node_name=node))
+        else:
+            assert not feasible, (
+                f"seed={seed}: {p.key()} declared unschedulable but oracle "
+                f"finds feasible nodes {feasible} at evaluation time"
+            )
+
+
+@pytest.mark.parametrize("seed", list(range(20)))
+def test_sequential_equivalence_random_clusters(seed):
+    _assert_sequential_equivalent(seed)
+
+
+@pytest.mark.parametrize("seed", [100, 101, 102, 103, 104])
+def test_sequential_equivalence_affinity_heavy(seed):
+    # high feature rate → most pods carry affinity/anti-affinity/spread
+    _assert_sequential_equivalent(seed, feature_rate=0.9)
