@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""scheduler_perf-equivalent benchmark for the TPU batch scheduler.
+
+Reproduces the BASELINE.json config matrix (the TPU-era analogue of
+test/integration/scheduler_perf/scheduler_bench_test.go:52-283 and the
+density test in scheduler_test.go:72):
+
+  1. 5k pods  /   500 nodes — NodeResourcesFit only
+  2. 50k pods /  5k nodes   — + TaintToleration + NodeAffinity
+  3. 100k pods / 10k nodes  — + PodTopologySpread (scoring)
+  4. 20k pods /  2k nodes   — InterPodAffinity/anti-affinity heavy
+  5. 1k groups x 64 pods    — gang / all-or-nothing (once wired)
+
+Prints exactly ONE JSON line to stdout (the headline metric); the full
+per-config breakdown goes to stderr and BENCH_DETAILS.json. vs_baseline is
+relative to the reference's 100 pods/s warning threshold
+(test/integration/scheduler_perf/scheduler_test.go:41-42) — its single-box
+pass floor is 30 pods/s.
+
+Runs on the default JAX platform (the real TPU chip in CI). Scale down for
+smoke runs with BENCH_SCALE=0.1 or select configs with BENCH_CONFIGS=1,3.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    LabelSelector,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    Quantity,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+SCALE = float(os.environ.get("BENCH_SCALE", "1.0"))
+BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
+ZONES = [f"zone-{i}" for i in range(8)]
+
+
+def _n(x: int) -> int:
+    return max(int(x * SCALE), 8)
+
+
+def mk_node(i: int, zone: str = "", taint: bool = False) -> Node:
+    labels = {"kubernetes.io/hostname": f"node-{i}", "instance-type": ["small", "large"][i % 2]}
+    if zone:
+        labels["failure-domain.beta.kubernetes.io/zone"] = zone
+    alloc = {
+        RESOURCE_CPU: Quantity.parse("32"),
+        RESOURCE_MEMORY: Quantity.parse("256Gi"),
+        RESOURCE_PODS: Quantity.parse(110),
+    }
+    taints = [Taint(key="dedicated", value="batch", effect="NoSchedule")] if taint else []
+    return Node(name=f"node-{i}", labels=labels, allocatable=alloc, capacity=dict(alloc), taints=taints)
+
+
+def mk_pod(i: int, cpu: str = "100m", mem: str = "256Mi", **kw) -> Pod:
+    return Pod(
+        name=f"pod-{i}",
+        namespace="bench",
+        labels=kw.pop("labels", {"app": f"svc-{i % 50}"}),
+        containers=[Container(name="c", requests={
+            RESOURCE_CPU: Quantity.parse(cpu),
+            RESOURCE_MEMORY: Quantity.parse(mem),
+        })],
+        **kw,
+    )
+
+
+# --- config builders: (nodes, pods) ----------------------------------------
+
+def cfg1_resources():
+    nodes = [mk_node(i) for i in range(_n(500))]
+    pods = [mk_pod(i, cpu=["100m", "250m", "500m"][i % 3]) for i in range(_n(5000))]
+    return nodes, pods
+
+
+def cfg2_taint_affinity():
+    n = _n(5000)
+    nodes = [mk_node(i, taint=(i % 4 == 0)) for i in range(n)]
+    pods = []
+    for i in range(_n(50000)):
+        p = mk_pod(i)
+        if i % 2 == 0:
+            p.tolerations = [Toleration(key="dedicated", operator="Equal", value="batch", effect="NoSchedule")]
+        p.affinity = Affinity(node_affinity=NodeAffinity(required=NodeSelector(
+            node_selector_terms=[NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement(key="instance-type", operator="In",
+                                        values=["small", "large"] if i % 3 else ["large"]),
+            ])])))
+        pods.append(p)
+    return nodes, pods
+
+
+def cfg3_spread():
+    n = _n(10000)
+    nodes = [mk_node(i, zone=ZONES[i % len(ZONES)]) for i in range(n)]
+    pods = []
+    for i in range(_n(100000)):
+        p = mk_pod(i, labels={"app": f"svc-{i % 100}"})
+        p.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=1,
+            topology_key="failure-domain.beta.kubernetes.io/zone",
+            when_unsatisfiable="ScheduleAnyway",
+            label_selector=LabelSelector(match_labels={"app": p.labels["app"]}),
+        )]
+        pods.append(p)
+    return nodes, pods
+
+
+def cfg4_interpod():
+    n = _n(2000)
+    nodes = [mk_node(i, zone=ZONES[i % len(ZONES)]) for i in range(n)]
+    pods = []
+    for i in range(_n(20000)):
+        app = f"svc-{i % 20}"
+        p = mk_pod(i, labels={"app": app})
+        term = PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            topology_key="failure-domain.beta.kubernetes.io/zone",
+        )
+        if i % 10 == 0:
+            # sparse REQUIRED anti-affinity (the quadratic pod x pod case)
+            hterm = PodAffinityTerm(
+                label_selector=LabelSelector(match_labels={"exclusive": app}),
+                topology_key="kubernetes.io/hostname",
+            )
+            p.labels["exclusive"] = app
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[hterm]))
+        else:
+            # preferred co-location: scoring-only, stays on the fast path
+            from kubernetes_tpu.api.types import WeightedPodAffinityTerm
+
+            p.affinity = Affinity(pod_affinity=PodAffinity(
+                preferred=[WeightedPodAffinityTerm(weight=10, pod_affinity_term=term)]))
+        pods.append(p)
+    return nodes, pods
+
+
+CONFIGS = {
+    "1": ("5k_pods_500_nodes_resources", cfg1_resources),
+    "2": ("50k_pods_5k_nodes_taint_nodeaffinity", cfg2_taint_affinity),
+    "3": ("100k_pods_10k_nodes_topology_spread", cfg3_spread),
+    "4": ("20k_pods_2k_nodes_interpod_affinity", cfg4_interpod),
+}
+
+
+def run_config(name, build):
+    t_setup = time.perf_counter()
+    nodes, pods = build()
+    cache = SchedulerCache()
+    for node in nodes:
+        cache.add_node(node)
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=Binder(), batch_size=BATCH,
+        enable_preemption=False, deterministic=False, bind_workers=16,
+    )
+    # pre-size the device banks: every capacity growth is an XLA recompile
+    sched.mirror.reserve(len(nodes), len(pods))
+    for p in pods:
+        queue.add(p)
+    setup_s = time.perf_counter() - t_setup
+
+    batch_times = []
+    t0 = time.perf_counter()
+    first_batch_s = None
+    scheduled = unsched = 0
+    while True:
+        tb = time.perf_counter()
+        r = sched.schedule_batch()
+        dt = time.perf_counter() - tb
+        if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+            break
+        if first_batch_s is None:
+            first_batch_s = dt
+        batch_times.append(dt)
+        scheduled += r.scheduled
+        unsched += r.unschedulable
+    sched.wait_for_binds()
+    elapsed = time.perf_counter() - t0
+    steady = sum(batch_times[1:]) or 1e-9
+    bt = np.array(batch_times) if batch_times else np.array([0.0])
+    detail = {
+        "config": name,
+        "nodes": len(nodes),
+        "pods": len(pods),
+        "scheduled": scheduled,
+        "unschedulable": unsched,
+        "elapsed_s": round(elapsed, 3),
+        "pods_per_sec": round(scheduled / elapsed, 1) if elapsed > 0 else 0.0,
+        "pods_per_sec_steady": round(
+            max(scheduled - BATCH, 0) / steady, 1) if len(batch_times) > 1 else None,
+        "first_batch_s": round(first_batch_s or 0.0, 3),
+        "batch_p50_s": round(float(np.percentile(bt, 50)), 4),
+        "batch_p99_s": round(float(np.percentile(bt, 99)), 4),
+        "setup_s": round(setup_s, 3),
+        "phase_split_s": {k: round(v, 3) if isinstance(v, float) else v
+                          for k, v in sched.stats.items()},
+        "mirror_rebuilds": sched.mirror.rebuild_count,
+    }
+    return detail
+
+
+def main():
+    which = os.environ.get("BENCH_CONFIGS", "1,2,3,4").split(",")
+    details = []
+    for key in which:
+        key = key.strip()
+        if key not in CONFIGS:
+            continue
+        name, build = CONFIGS[key]
+        print(f"[bench] running config {key}: {name} ...", file=sys.stderr, flush=True)
+        d = run_config(name, build)
+        details.append(d)
+        print(f"[bench] {json.dumps(d)}", file=sys.stderr, flush=True)
+
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAILS.json"), "w") as f:
+        json.dump(details, f, indent=2)
+
+    # headline: config 3 (the north-star shape) if run, else the largest run
+    headline = None
+    for d in details:
+        if d["config"].startswith("100k"):
+            headline = d
+    if headline is None and details:
+        headline = max(details, key=lambda d: d["pods"])
+    if headline is None:
+        print(json.dumps({"metric": "none", "value": 0, "unit": "pods/s", "vs_baseline": 0}))
+        return
+    value = headline["pods_per_sec"]
+    print(json.dumps({
+        "metric": f"pods_per_sec_{headline['config']}",
+        "value": value,
+        "unit": "pods/s",
+        # reference warn line: 100 pods/s (scheduler_test.go:41-42)
+        "vs_baseline": round(value / 100.0, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

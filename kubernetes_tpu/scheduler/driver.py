@@ -78,15 +78,20 @@ class Binder:
 
 
 def _needs_oracle_recheck(pod: Pod) -> bool:
-    """Pods whose feasibility can be perturbed by earlier pods in the same
+    """Pods whose FEASIBILITY can be perturbed by earlier pods in the same
     batch (the solver's carry only tracks resources and pod counts):
-    topology-spread, required (anti-)affinity terms, or host ports (two
-    ported pods can collide on the node the pre-batch mask cleared for
-    both). See ops/solver.py contract."""
-    if pod.topology_spread_constraints:
+    DoNotSchedule topology-spread, required (anti-)affinity terms, or host
+    ports (two ported pods can collide on the node the pre-batch mask
+    cleared for both). ScheduleAnyway spread and preferred affinity only
+    shift SCORES — batch-stale scores are an accepted part of the batching
+    contract (see ops/solver.py), so those pods stay on the fast path."""
+    if any(c.when_unsatisfiable == "DoNotSchedule" for c in pod.topology_spread_constraints):
         return True
     a = pod.affinity
-    if a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None):
+    if a is not None and (
+        (a.pod_affinity is not None and a.pod_affinity.required)
+        or (a.pod_anti_affinity is not None and a.pod_anti_affinity.required)
+    ):
         return True
     if pod.host_ports():
         return True
@@ -133,6 +138,23 @@ class Scheduler:
         self._cycle = 0
         self._spread_selectors_fn: Optional[Callable[[Pod], list]] = None
         self._jax = None  # lazily imported so pure-host tests stay light
+        # monotonic shape buckets: a smaller tail batch or a term-light batch
+        # must REUSE the largest shapes seen so far — every fresh shape is a
+        # fresh XLA compile (minutes on a remote TPU)
+        self._b_bucket = 16
+        self._t_bucket = 16
+        self._ids = None  # cached device constants (filters.make_ids)
+        # per-phase wall-clock accumulators (the utiltrace/LogIfLong
+        # equivalent; bench.py and metrics read these)
+        self.stats: Dict[str, float] = {
+            "sync_s": 0.0,
+            "encode_s": 0.0,
+            "solve_s": 0.0,
+            "commit_s": 0.0,
+            "oracle_rechecks": 0,
+            "oracle_places": 0,
+            "batches": 0,
+        }
 
     def set_spread_selectors_fn(self, fn: Callable[[Pod], list]) -> None:
         """Install the getSelectors equivalent (services/RC/RS/SS listers,
@@ -143,18 +165,17 @@ class Scheduler:
 
     def _device_solve(self, infos: List[PodInfo]) -> SolveOutput:
         import jax
-        import jax.numpy as jnp
 
         from ..ops import filters as F
-        from ..ops import scores as S
-        from ..ops import topology as T
-        from ..ops.solver import pop_order, solve_greedy
+        from ..ops.pipeline import solve_pipeline
 
+        t0 = time.perf_counter()
         pods = [pi.pod for pi in infos]
         vocab = self.mirror.vocab
+        self._b_bucket = max(self._b_bucket, _bucket(len(pods)))
         while True:
             try:
-                batch = PodBatch(vocab, _bucket(len(pods)))
+                batch = PodBatch(vocab, self._b_bucket)
                 for i, p in enumerate(pods):
                     batch.set_pod(i, p)
                 selectors = None
@@ -163,6 +184,12 @@ class Scheduler:
                 tb, aux = compile_batch_terms(
                     vocab, pods, spread_selectors=selectors, b_capacity=batch.capacity
                 )
+                self._t_bucket = max(self._t_bucket, tb.capacity)
+                if tb.capacity < self._t_bucket:
+                    tb, aux = compile_batch_terms(
+                        vocab, pods, spread_selectors=selectors,
+                        capacity=self._t_bucket, b_capacity=batch.capacity,
+                    )
                 etb = self.mirror.existing_terms()
                 break
             except KeySlotOverflow:
@@ -175,51 +202,27 @@ class Scheduler:
             if 0 <= owner < len(pods):
                 batch.fallback[owner] = True
         existing_overflow = bool(etb.overflow_owners)
+        t1 = time.perf_counter()
+        self.stats["encode_s"] += t1 - t0
 
-        J = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
-        na = J(self.mirror.nodes.arrays())
-        pa = J(batch.arrays())
-        ea = J(self.mirror.eps.arrays())
-        ta = J(tb.arrays())
-        xa = J(etb.arrays())
-        au = J(aux)
-        ids = F.make_ids(vocab)
-
-        base = F.combined_mask(na, pa, ids)
-        sel = F.pod_match_node_selector(na, pa)
-        mask = (
-            base
-            & T.spread_filter(na, ea, ta, sel)
-            & T.interpod_filter(na, ea, ta, au, xa, pa)
-        )
-        score = (
-            S.score_matrix(na, pa)
-            + T.interpod_score(na, ea, ta, xa, pa)
-            + T.spread_score(na, ea, ta, au, sel)
-            + T.selector_spread_score(na, ea, ta, au)
-        )
-        free0 = na["alloc"] - na["requested"]
-        order = pop_order(
-            pa["priority"],
-            jnp.asarray(np.arange(batch.capacity, dtype=np.int32)),
-            pa["valid"],
-        )
+        if self._ids is None:
+            self._ids = F.make_ids(vocab)  # interned constants; stable
+        ids = self._ids
         self._cycle += 1
         key = jax.random.PRNGKey(self._rng_seed + self._cycle)
-        assign = solve_greedy(
-            mask,
-            score,
-            pa["req"],
-            free0,
-            na["pod_count"].astype(free0.dtype),
-            na["allowed_pods"].astype(free0.dtype),
-            order,
+        assign, score = solve_pipeline(
+            self.mirror.nodes.arrays(),
+            batch.arrays(),
+            self.mirror.eps.arrays(),
+            tb.arrays(),
+            etb.arrays(),
+            aux,
+            ids,
             key,
             deterministic=self.deterministic,
-            req_any=pa["req_any"],
         )
         n = len(pods)
-        return SolveOutput(
+        out = SolveOutput(
             assign=np.asarray(assign)[:n],
             fallback=np.asarray(batch.fallback)[:n],
             score=np.asarray(score)[:n],
@@ -227,6 +230,8 @@ class Scheduler:
             existing_overflow=existing_overflow,
             node_fallback_any=bool((self.mirror.nodes.fallback & self.mirror.nodes.valid).any()),
         )
+        self.stats["solve_s"] += time.perf_counter() - t1
+        return out
 
     def _oracle_place(self, pod: Pod, score_row: np.ndarray, meta) -> Optional[str]:
         """Scalar fallback placement: oracle-feasible nodes against the live
@@ -344,7 +349,10 @@ class Scheduler:
         if not infos:
             return res
         cycle = self.queue.scheduling_cycle()
+        self.stats["batches"] += 1
+        t_sync = time.perf_counter()
         self.mirror.sync()
+        self.stats["sync_s"] += time.perf_counter() - t_sync
         try:
             out = self._device_solve(infos)
         except Exception as e:
@@ -366,6 +374,7 @@ class Scheduler:
         # oracle re-placement), the scan carry's residuals are stale for the
         # rest of the batch — later device picks need a resource validation
         residuals_diverged = False
+        t_commit = time.perf_counter()
 
         # commit in pop order (priority desc) so oracle re-checks see earlier
         # assumes, reproducing sequential semantics for topology pods
@@ -386,6 +395,7 @@ class Scheduler:
                 or _needs_oracle_recheck(pod)
             )
             if node_name is not None and (needs_recheck or nominated_fn(node_name)):
+                self.stats["oracle_rechecks"] += 1
                 meta = compute_predicate_metadata(pod, self.cache.snapshot)
                 ok = self.cache.snapshot.get(node_name) is not None and fits_considering_nominated(
                     pod, node_name, self.cache.snapshot, nominated_fn, meta=meta
@@ -411,11 +421,15 @@ class Scheduler:
                 or out.existing_overflow
                 or out.node_fallback_any
                 or residuals_diverged
+                or _needs_oracle_recheck(pod)
             ):
                 # the device mask may be conservatively wrong (encoding
                 # overflow / excluded node rows / capacity the carry charged
-                # to a node an earlier pod vacated) — full scalar fallback
-                # over all nodes before declaring the pod unschedulable
+                # to a node an earlier pod vacated / a topology constraint
+                # SATISFIED by an earlier in-batch commit, e.g. a required
+                # pod-affinity anchor arriving in the same batch) — full
+                # scalar fallback before declaring the pod unschedulable
+                self.stats["oracle_places"] += 1
                 meta = compute_predicate_metadata(pod, self.cache.snapshot)
                 node_name = self._oracle_place(pod, out.score[i], meta)
             if node_name is None:
@@ -445,6 +459,7 @@ class Scheduler:
                 res.unschedulable += 1
                 if device_choice is not None:
                     residuals_diverged = True
+        self.stats["commit_s"] += time.perf_counter() - t_commit
         return res
 
     def run_until_empty(self, max_cycles: int = 1000) -> ScheduleResult:

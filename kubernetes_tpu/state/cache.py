@@ -222,13 +222,30 @@ class TensorMirror:
     def __init__(self, cache: SchedulerCache, vocab: Optional[Vocab] = None):
         self.cache = cache
         self.vocab = vocab or Vocab()
+        self.rebuild_count = -1  # constructor's build doesn't count
+        self._min_nodes = 1
+        self._min_pods = 1
         self._rebuild()
 
+    def reserve(self, n_nodes: int, n_pods: int) -> None:
+        """Pre-size the banks for an expected cluster scale. Every bank
+        growth changes array shapes and costs an XLA recompile (minutes on a
+        remote TPU), so callers that know their scale up front — benchmarks,
+        a scheduler fed a full initial list — should reserve once."""
+        self._min_nodes = max(self._min_nodes, n_nodes)
+        self._min_pods = max(self._min_pods, n_pods)
+        if (
+            _bucket(self._min_nodes) > self.nodes.capacity
+            or _bucket(self._min_pods) > self.eps.capacity
+        ):
+            self._rebuild()
+
     def _rebuild(self) -> None:
+        self.rebuild_count += 1
         snap = self.cache.snapshot
         while True:
             try:
-                n_nodes = max(len(snap.node_infos), 1)
+                n_nodes = max(len(snap.node_infos), self._min_nodes, 1)
                 self.nodes = NodeBank(self.vocab, _bucket(n_nodes))
                 self.row_of: Dict[str, int] = {}
                 self.name_of_row: List[Optional[str]] = [None] * self.nodes.capacity
@@ -238,7 +255,11 @@ class TensorMirror:
                     self.row_of[ni.node.name] = row
                     self.name_of_row[row] = ni.node.name
                     self.nodes.set_node(row, ni)
-                n_pods = max(sum(len(ni.pods) for ni in snap.node_infos.values()), 1)
+                n_pods = max(
+                    sum(len(ni.pods) for ni in snap.node_infos.values()),
+                    self._min_pods,
+                    1,
+                )
                 self.eps = ExistingPodsBank(self.vocab, _bucket(n_pods))
                 self._node_pod_rows: Dict[str, List[int]] = {}
                 self._free_pod_rows = list(range(self.eps.capacity - 1, -1, -1))
@@ -349,9 +370,18 @@ class TensorMirror:
         if self._etb is None:
             from .terms import compile_existing_terms
 
-            self._etb, _ = compile_existing_terms(
+            etb, _ = compile_existing_terms(
                 self.vocab, self.cache.snapshot, self.row_of
             )
+            # monotonic capacity: a shrinking term table would change device
+            # shapes and recompile; reuse the largest bucket seen
+            min_cap = getattr(self, "_etb_min", 16)
+            if etb.capacity < min_cap:
+                etb, _ = compile_existing_terms(
+                    self.vocab, self.cache.snapshot, self.row_of, capacity=min_cap
+                )
+            self._etb_min = max(min_cap, etb.capacity)
+            self._etb = etb
         return self._etb
 
     def node_name_of_row(self, row: int) -> Optional[str]:
