@@ -70,6 +70,40 @@ def solve_pipeline(
     return assign, score
 
 
+def encode_solve_args(snapshot, pods, spread_selectors=None, key=None):
+    """One-shot encode of (snapshot, pending pods) → solve_pipeline args.
+
+    Test/tooling convenience for driving the pipeline outside the
+    Scheduler's incremental TensorMirror path: full snapshot encode
+    (state/tensors.encode_snapshot), batch + term compilation, interned
+    constants, PRNG key. Returns the positional argument tuple for
+    solve_pipeline / make_sharded_pipeline(mesh).
+    """
+    from ..state.tensors import PodBatch, _bucket, encode_snapshot
+    from ..state.terms import compile_batch_terms, compile_existing_terms
+
+    bank, epsb, row_of = encode_snapshot(snapshot)
+    vocab = bank.vocab
+    batch = PodBatch(vocab, _bucket(len(pods)))
+    for i, p in enumerate(pods):
+        batch.set_pod(i, p)
+    tb, aux = compile_batch_terms(
+        vocab, pods, spread_selectors=spread_selectors, b_capacity=batch.capacity
+    )
+    etb, _ = compile_existing_terms(vocab, snapshot, row_of)
+    dev = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
+    return (
+        dev(bank.arrays()),
+        dev(batch.arrays()),
+        dev(epsb.arrays()),
+        dev(tb.arrays()),
+        dev(etb.arrays()),
+        dev(aux),
+        F.make_ids(vocab),
+        key if key is not None else jax.random.PRNGKey(0),
+    )
+
+
 @jax.jit
 def gather_score_rows(score: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Device-side row gather so the host fetches ONLY the score rows it

@@ -1,0 +1,16 @@
+"""Multi-chip parallelism: node-axis sharding over a jax.sharding.Mesh.
+
+See mesh.py for the mesh layout rationale and sharded.py for the two-stage
+(GSPMD mask/score + shard_map greedy commit) design.
+"""
+
+from .mesh import AXIS_NODES, AXIS_PODS, node_mesh, node_shards
+from .sharded import make_sharded_pipeline
+
+__all__ = [
+    "AXIS_NODES",
+    "AXIS_PODS",
+    "node_mesh",
+    "node_shards",
+    "make_sharded_pipeline",
+]

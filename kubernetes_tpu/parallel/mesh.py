@@ -1,0 +1,62 @@
+"""Device-mesh construction for multi-chip scheduling.
+
+The scaling axis of the reference is CLUSTER SIZE (SURVEY §5 "long-context"
+note): the kube-scheduler copes with 10k-node clusters by adaptively
+SAMPLING nodes (numFeasibleNodesToFind, core/generic_scheduler.go:434-453);
+this framework instead evaluates the FULL pods×nodes matrices and shards
+the node axis across TPU chips over ICI. The mesh layout:
+
+  * axis "nodes"  — the node columns of every mask/score matrix and the
+    per-node residual state of the greedy solver live shard-local; the only
+    cross-chip traffic is one tiny (best-score, best-node) argmax collective
+    per committed pod plus XLA-inserted collectives for the handful of
+    global reductions in the topology kernels (min/max normalization,
+    per-topology-value counts).
+  * axis "pods"   — optional data-parallel axis: the [B, N] mask/score
+    COMPUTE is embarrassingly parallel over the pod batch, so B can be
+    split across a second mesh dimension; the sequential greedy commit
+    gathers the matrices to node-sharded form first (the scan is a strict
+    order over pods by construction — reference scheduleOne semantics).
+
+A v5e-8 is mesh (1, 8) or (2, 4); multi-host slices extend the "nodes"
+axis over DCN (node columns never talk to each other except through the
+argmax collective, which is latency- not bandwidth-bound).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_NODES = "nodes"
+AXIS_PODS = "pods"
+
+
+def node_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    pods_parallel: int = 1,
+) -> Mesh:
+    """Build a ("pods", "nodes") mesh over the first n_devices (default all).
+
+    pods_parallel splits the device set into a data-parallel pod axis; the
+    remainder shard the node axis. pods_parallel must divide the device
+    count.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    if len(devs) % pods_parallel != 0:
+        raise ValueError(f"pods_parallel={pods_parallel} does not divide {len(devs)} devices")
+    grid = np.asarray(devs, dtype=object).reshape(pods_parallel, -1)
+    return Mesh(grid, (AXIS_PODS, AXIS_NODES))
+
+
+def node_shards(mesh: Mesh) -> int:
+    return mesh.shape[AXIS_NODES]

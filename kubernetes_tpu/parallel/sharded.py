@@ -1,0 +1,186 @@
+"""Multi-chip solve: the scheduling cycle sharded over the node axis.
+
+Two cooperating pieces (SURVEY §2.4 "TPU-native equivalent"):
+
+1. **Mask/score stage — GSPMD.** The Filter/Score/topology kernels
+   (ops/filters.py, ops/scores.py, ops/topology.py) are column-parallel
+   over nodes: every [B, N] matrix is computed under a
+   `with_sharding_constraint` that pins the node axis to the mesh's
+   "nodes" axis (and optionally the batch axis to "pods"), and XLA's SPMD
+   partitioner inserts the few collectives the topology kernels need
+   (per-topology-value segment sums, min/max normalizations). This is the
+   idiomatic pjit recipe: annotate, let the compiler place psum/all-gather.
+
+2. **Greedy commit stage — explicit shard_map.** The sequential
+   pod-by-pod commit (reference scheduleOne order, one pod's residual
+   update visible to the next) keeps per-node residuals SHARD-LOCAL and
+   pays exactly two tiny collectives per pod: a pmax to find the global
+   best score and a pmin to elect the winning (shard, node) — an argmax
+   over ICI. The winning shard alone updates its residual rows. Bit-for-bit
+   identical to ops/solver.solve_greedy on one device (parity-tested in
+   tests/test_parallel.py), including the selectHost random tie-break
+   (core/generic_scheduler.go:278): the tie-break noise is generated from
+   the same per-step PRNG keys and sliced per shard.
+
+Node capacity is always a power of two (state/tensors._bucket), so any
+power-of-two shard count divides it; no repadding is needed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import filters as F
+from ..ops import scores as S
+from ..ops import topology as T
+from ..ops.solver import pop_order
+from .mesh import AXIS_NODES, AXIS_PODS
+
+Arrays = Dict[str, jnp.ndarray]
+
+_BIG = 2**30
+
+
+def _solver_body(
+    mask: jnp.ndarray,  # [B, Nl] local node columns
+    score: jnp.ndarray,  # [B, Nl]
+    req: jnp.ndarray,  # [B, R] replicated
+    free: jnp.ndarray,  # [Nl, R] shard-local residuals
+    count: jnp.ndarray,  # [Nl]
+    allowed: jnp.ndarray,  # [Nl]
+    order: jnp.ndarray,  # [B] replicated scan order
+    noise: jnp.ndarray,  # [B, Nl] tie-break noise (or [B, 1] dummy)
+    req_any: jnp.ndarray,  # [B] replicated
+    *,
+    deterministic: bool,
+    n_local: int,
+) -> jnp.ndarray:
+    """shard_map body: the greedy scan with cross-shard argmax election."""
+    shard = jax.lax.axis_index(AXIS_NODES)
+    base = (shard * n_local).astype(jnp.int32)
+
+    def step(carry, inp):
+        free, count = carry
+        i, nz = inp
+        m = mask[i]
+        # PodFitsResources against the residual carry (predicates.go:854
+        # semantics: count always, resource rows only when requested)
+        res_ok = ~req_any[i] | jnp.all(req[i][None, :] <= free, axis=-1)
+        feasible = m & res_ok & (count + 1 <= allowed)
+        neg = jnp.iinfo(score.dtype).min
+        masked = jnp.where(feasible, score[i], neg)
+        local_best = jnp.max(masked)
+        global_best = jax.lax.pmax(local_best, AXIS_NODES)
+        any_feasible = jax.lax.pmax(jnp.any(feasible), AXIS_NODES)
+        if deterministic:
+            # first global max == smallest global index among shard maxima
+            gidx = jnp.where(
+                local_best == global_best, base + jnp.argmax(masked).astype(jnp.int32), _BIG
+            )
+        else:
+            # selectHost: uniform among max-score nodes — max noise wins
+            ties = feasible & (masked == global_best)
+            nzm = jnp.where(ties, nz, -1.0)
+            local_nbest = jnp.max(nzm)
+            global_nbest = jax.lax.pmax(local_nbest, AXIS_NODES)
+            gidx = jnp.where(
+                (local_nbest == global_nbest) & jnp.any(ties),
+                base + jnp.argmax(nzm).astype(jnp.int32),
+                _BIG,
+            )
+        choice = jax.lax.pmin(gidx, AXIS_NODES)
+        choice = jnp.where(any_feasible, choice, -1)
+        committed = choice >= 0
+        mine = committed & (choice >= base) & (choice < base + n_local)
+        sel = jnp.where(mine, choice - base, 0)
+        free = jnp.where(mine, free.at[sel].add(-req[i]), free)
+        count = jnp.where(mine, count.at[sel].add(1), count)
+        return (free, count), choice
+
+    (_, _), choices = jax.lax.scan(step, (free, count), (order, noise))
+    return choices.astype(jnp.int32)
+
+
+def make_sharded_pipeline(mesh: Mesh):
+    """Build the jitted multi-chip pipeline bound to `mesh`.
+
+    Same signature/result contract as ops.pipeline.solve_pipeline:
+    (na, pa, ea, ta, xa, au, ids, key, deterministic) → (assign [B],
+    score [B, N]).
+    """
+    n_shards = mesh.shape[AXIS_NODES]
+
+    def _c(x: jnp.ndarray, *spec) -> jnp.ndarray:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+    @partial(jax.jit, static_argnames=("deterministic",))
+    def pipeline(
+        na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays,
+        au: Arrays, ids: Arrays, key, deterministic: bool = False,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        N = na["valid"].shape[0]
+        assert N % n_shards == 0, f"node capacity {N} not divisible by {n_shards} shards"
+        n_local = N // n_shards
+        # pin every per-node bank array's leading axis to the mesh
+        na = {k: _c(v, AXIS_NODES) for k, v in na.items()}
+        # mask/score compute: nodes sharded, batch optionally data-parallel
+        base = F.combined_mask(na, pa, ids)
+        sel = F.pod_match_node_selector(na, pa)
+        mask = _c(
+            base
+            & T.spread_filter(na, ea, ta, sel)
+            & T.interpod_filter(na, ea, ta, au, xa, pa),
+            AXIS_PODS, AXIS_NODES,
+        )
+        score = _c(
+            S.score_matrix(na, pa)
+            + T.interpod_score(na, ea, ta, xa, pa)
+            + T.spread_score(na, ea, ta, au, sel)
+            + T.selector_spread_score(na, ea, ta, au),
+            AXIS_PODS, AXIS_NODES,
+        )
+        # the greedy commit is a strict sequential order over the whole
+        # batch: gather the batch axis, keep nodes sharded
+        mask = _c(mask, None, AXIS_NODES)
+        score = _c(score, None, AXIS_NODES)
+
+        free0 = na["alloc"] - na["requested"]
+        count0 = na["pod_count"].astype(free0.dtype)
+        allowed = na["allowed_pods"].astype(free0.dtype)
+        b = pa["valid"].shape[0]
+        order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
+        if deterministic:
+            noise = jnp.zeros((b, n_shards))
+        else:
+            # bit-identical to the single-device _select_host stream:
+            # per-step keys, full-width uniform rows, sliced per shard
+            keys = jax.random.split(key, b)
+            noise = jax.vmap(lambda k: jax.random.uniform(k, (N,)))(keys)
+        solver = jax.shard_map(
+            partial(_solver_body, deterministic=deterministic, n_local=n_local),
+            mesh=mesh,
+            in_specs=(
+                P(None, AXIS_NODES),  # mask
+                P(None, AXIS_NODES),  # score
+                P(),                  # req
+                P(AXIS_NODES),        # free0
+                P(AXIS_NODES),        # count0
+                P(AXIS_NODES),        # allowed
+                P(),                  # order
+                P(None, AXIS_NODES),  # noise
+                P(),                  # req_any
+            ),
+            out_specs=P(),
+        )
+        choices = solver(
+            mask, score, pa["req"], free0, count0, allowed, order, noise, pa["req_any"]
+        )
+        assign = jnp.full((b,), -1, jnp.int32).at[order].set(choices)
+        return assign, score
+
+    return pipeline

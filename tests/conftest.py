@@ -8,9 +8,19 @@ several devices, so tests force the CPU backend with 8 virtual host devices
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session pins JAX_PLATFORMS to the real chip: the
+# multi-chip parity tests need 8 devices. KTPU_TEST_PLATFORM=axon opts back
+# into running the (single-device) suite on real hardware. The CI image's
+# sitecustomize re-pins the platform at jax-import time, so the env var
+# alone is not enough — the jax.config update below wins.
+_platform = os.environ.get("KTPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (must happen after the env setup above)
+
+jax.config.update("jax_platforms", _platform)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
