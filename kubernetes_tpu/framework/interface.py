@@ -180,6 +180,13 @@ class Framework:
     def _at(self, point: str) -> List[Plugin]:
         return [p for p in self.plugins if _implements(p, point)]
 
+    def has_plugins(self, point: str) -> bool:
+        """Any plugin registered at this extension point? The driver uses
+        this to decide whether a pod can stay on the pure-device fast path
+        (no host plugins) or must route through the host commit path where
+        plugin hooks run (framework.go RunFilterPlugins/RunScorePlugins)."""
+        return bool(self._at(point))
+
     def queue_sort_less(self):
         qs = self._at("less")
         return qs[0].less if qs else None

@@ -1,0 +1,149 @@
+"""Built-in framework plugins.
+
+The reference migrated three plugins to the framework in this version —
+NodeName, TaintToleration, VolumeBinding
+(pkg/scheduler/framework/plugins/{nodename,tainttoleration,volumebinding},
+default_registry.go) — plus `migration/` shims that wrap any legacy
+predicate/priority as a plugin. Same set here. Note the DEFAULT config
+does not register them as framework plugins (the legacy predicate set
+covers the same checks — on this framework, as fused device kernels); they
+exist for Policy/ComponentConfig configurations and as porting targets for
+out-of-tree plugins.
+
+Plugins that need cluster state beyond the NodeInfo handed to Filter take a
+`handle` — the FrameworkHandle equivalent exposing a snapshot accessor
+(framework/v1alpha1/interface.go FrameworkHandle).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+from ...api.types import Pod
+from ...oracle import predicates as opred
+from ...oracle import priorities as opri
+from ..interface import CycleState, Plugin, Status
+
+
+class Handle:
+    """FrameworkHandle: what built-in plugins need from the scheduler."""
+
+    def __init__(self, snapshot_fn: Callable[[], object]):
+        self.snapshot_fn = snapshot_fn
+
+    def snapshot(self):
+        return self.snapshot_fn()
+
+
+class PrioritySort(Plugin):
+    """QueueSort: priority desc, then enqueue order — the default activeQ
+    comparator (scheduling_queue.go activeQComp)."""
+
+    name = "PrioritySort"
+
+    def less(self, a, b) -> bool:
+        pa, pb = a.pod.get_priority(), b.pod.get_priority()
+        if pa != pb:
+            return pa > pb
+        return a.seq < b.seq
+
+
+class NodeName(Plugin):
+    """plugins/nodename: Filter = PodFitsHost (predicates.go:991)."""
+
+    name = "NodeName"
+
+    def filter(self, state: CycleState, pod: Pod, node_info) -> Status:
+        if opred.pod_fits_host(pod, node_info):
+            return Status.success()
+        return Status.unschedulable("node didn't match the requested hostname")
+
+
+class TaintToleration(Plugin):
+    """plugins/tainttoleration: Filter = PodToleratesNodeTaints
+    (predicates.go:1604); Score = preferred-taint count, normalized
+    (taint_toleration.go:55)."""
+
+    name = "TaintToleration"
+    score_weight = 1
+
+    def __init__(self, handle: Optional[Handle] = None):
+        self.handle = handle
+
+    def filter(self, state: CycleState, pod: Pod, node_info) -> Status:
+        if opred.pod_tolerates_node_taints(pod, node_info):
+            return Status.success()
+        return Status.unschedulable("node has taints the pod doesn't tolerate")
+
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+        snap = self.handle.snapshot() if self.handle else None
+        if snap is None:
+            return 0, Status.success()
+        key = f"tt-scores/{pod.key()}"
+        try:
+            scores = state.read(key)
+        except KeyError:
+            scores = opri.taint_toleration_priority(pod, snap)
+            state.write(key, scores)
+        return scores.get(node_name, 0), Status.success()
+
+
+class VolumeBinding(Plugin):
+    """plugins/volumebinding: Filter = CheckVolumeBinding via the volume
+    binder seam (volumebinder/volume_binder.go; plugin shim
+    framework/plugins/volumebinding/volume_binding.go)."""
+
+    name = "VolumeBinding"
+
+    def __init__(self, binder=None):
+        # kubernetes_tpu.volume.VolumeBinder (or anything with
+        # find_pod_volumes(pod, node_info) -> (bool, reasons))
+        self.binder = binder
+
+    def filter(self, state: CycleState, pod: Pod, node_info) -> Status:
+        if self.binder is None:
+            return Status.success()
+        ok, reasons = self.binder.find_pod_volumes(pod, node_info)
+        if ok:
+            return Status.success()
+        return Status.unschedulable("; ".join(reasons) or "volume binding failed")
+
+
+def predicate_plugin(plugin_name: str, fn: Callable[[Pod, object], bool], msg: str = "") -> Plugin:
+    """migration shim: legacy FitPredicate → Filter plugin
+    (framework/plugins/migration/utils.go)."""
+
+    class _Shim(Plugin):
+        name = plugin_name
+
+        def filter(self, state: CycleState, pod: Pod, node_info) -> Status:
+            if fn(pod, node_info):
+                return Status.success()
+            return Status.unschedulable(msg or f"{plugin_name} failed")
+
+    return _Shim()
+
+
+def priority_plugin(
+    plugin_name: str,
+    fn: Callable[[Pod, object], Dict[str, int]],
+    handle: Handle,
+    weight: int = 1,
+) -> Plugin:
+    """migration shim: legacy PriorityFunction → Score plugin. `fn` maps
+    (pod, snapshot) → {node: score}; cached in CycleState per cycle."""
+
+    class _Shim(Plugin):
+        name = plugin_name
+        score_weight = weight
+
+        def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]:
+            key = f"{plugin_name}/{pod.key()}"
+            try:
+                scores = state.read(key)
+            except KeyError:
+                scores = fn(pod, handle.snapshot())
+                state.write(key, scores)
+            return scores.get(node_name, 0), Status.success()
+
+    return _Shim()

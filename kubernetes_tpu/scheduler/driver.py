@@ -121,6 +121,10 @@ class Scheduler:
         self.queue = queue or PriorityQueue()
         self.binder = binder or Binder()
         self.framework = framework or Framework()
+        # QueueSort plugin → activeQ comparator (scheduling_queue.go:120)
+        qs_less = self.framework.queue_sort_less()
+        if qs_less is not None:
+            self.queue.set_queue_sort(qs_less)
         self.mirror = TensorMirror(self.cache)
         self.batch_size = batch_size
         self.enable_preemption = enable_preemption
@@ -173,11 +177,18 @@ class Scheduler:
         pods = [pi.pod for pi in infos]
         vocab = self.mirror.vocab
         self._b_bucket = max(self._b_bucket, _bucket(len(pods)))
+        custom_sort = getattr(self.queue, "_less", None) is not None
         while True:
             try:
                 batch = PodBatch(vocab, self._b_bucket)
                 for i, p in enumerate(pods):
                     batch.set_pod(i, p)
+                if custom_sort:
+                    # a QueueSort plugin's comparator ordered the pop; the
+                    # device scan must consume residuals in that same order,
+                    # so neutralize the priority key and let pop_order fall
+                    # back to the enqueue (= pop) sequence
+                    batch.priority[:] = 0
                 selectors = None
                 if self._spread_selectors_fn is not None:
                     selectors = {id(p): self._spread_selectors_fn(p) for p in pods}
@@ -233,33 +244,61 @@ class Scheduler:
         self.stats["solve_s"] += time.perf_counter() - t1
         return out
 
-    def _oracle_place(self, pod: Pod, score_row: np.ndarray, meta) -> Optional[str]:
+    def _oracle_place(
+        self, pod: Pod, score_row: np.ndarray, meta, state: Optional[CycleState] = None
+    ) -> Optional[str]:
         """Scalar fallback placement: oracle-feasible nodes against the live
         snapshot (including this batch's assumed pods), best device score
         first. Nodes with nominated pods additionally pass the two-pass
-        nominated check (generic_scheduler.go:612-697)."""
-        best = None
-        best_score = None
+        nominated check (generic_scheduler.go:612-697). Host framework
+        plugins run here: Filter as an extra per-node predicate, PostFilter
+        over the feasible set, Score as an addend on the device score row
+        (findNodesThatFit :457 → RunPostFilterPlugins :208 →
+        PrioritizeNodes/RunScorePlugins :794)."""
+        fw = self.framework
+        state = state if state is not None else CycleState()
+        run_filter = fw.run_filter if fw.has_plugins("filter") else None
+        feasible: List[str] = []
         for cand, ni in self.cache.snapshot.node_infos.items():
             if not pod_fits_on_node(pod, ni, meta=meta)[0]:
+                continue
+            if run_filter is not None and not run_filter(state, pod, ni).is_success():
                 continue
             nominees = preemption_mod.eligible_nominees(
                 pod, cand, self.queue.nominated_pods_for_node
             )
             if nominees and not fits_with_nominees(pod, cand, self.cache.snapshot, nominees):
                 continue
+            feasible.append(cand)
+        if not feasible:
+            return None
+        if fw.has_plugins("post_filter"):
+            if not fw.run_post_filter(state, pod, feasible, {}).is_success():
+                return None
+        plugin_scores: Dict[str, int] = {}
+        if fw.has_plugins("score"):
+            plugin_scores = fw.run_scores(state, pod, feasible)
+        best = None
+        best_score = None
+        for cand in feasible:
             row = self.mirror.row_of.get(cand)
             s = int(score_row[row]) if row is not None and row < len(score_row) else 0
+            s += plugin_scores.get(cand, 0)
             if best_score is None or s > best_score:
                 best, best_score = cand, s
         return best
 
     # -- commit path ---------------------------------------------------------
 
-    def _commit(self, info: PodInfo, node_name: str, cycle: int) -> bool:
-        """reserve → assume → async(permit → prebind → bind → postbind)."""
+    def _commit(
+        self, info: PodInfo, node_name: str, cycle: int, state: Optional[CycleState] = None
+    ) -> bool:
+        """reserve → assume → async(permit → prebind → bind → postbind).
+        `state` is the pod's CycleState carried from PreFilter onward, so
+        plugins share per-cycle data across extension points
+        (cycle_state.go)."""
         pod = info.pod
-        state = CycleState()
+        state = state if state is not None else CycleState()
         st = self.framework.run_reserve(state, pod, node_name)
         if not st.is_success():
             self._fail(info, cycle, f"reserve: {st.message}")
@@ -364,6 +403,16 @@ class Scheduler:
             return res
 
         nominated_fn = self.queue.nominated_pods_for_node
+        fw = self.framework
+        # host framework plugins (framework.go): Filter narrows the mask,
+        # PostFilter sees the feasible set, Score adds to the ranking — any
+        # of them forces the host commit path (the device mask/score can't
+        # know what host Python plugins will say)
+        host_filter = fw.has_plugins("filter")
+        host_pre_filter = fw.has_plugins("pre_filter")
+        # Score/PostFilter participate in SELECTION, not just validation —
+        # the device's argmax pick must be re-ranked host-side
+        force_host_rank = fw.has_plugins("score") or fw.has_plugins("post_filter")
         # once a pod carrying required anti-affinity commits, its terms can
         # invalidate ANY later pod's device placement (the mask predates the
         # batch) — force the oracle re-check for the rest of the batch
@@ -376,37 +425,58 @@ class Scheduler:
         residuals_diverged = False
         t_commit = time.perf_counter()
 
-        # commit in pop order (priority desc) so oracle re-checks see earlier
-        # assumes, reproducing sequential semantics for topology pods
-        order = sorted(
-            range(len(infos)),
-            key=lambda i: (-infos[i].pod.get_priority(), infos[i].seq),
-        )
-        for i in order:
+        # commit in pop order so oracle re-checks see earlier assumes,
+        # reproducing sequential semantics. pop_batch pops the activeQ heap,
+        # so `infos` already arrives in comparator order — (priority desc,
+        # seq asc) by default, or the QueueSort plugin's Less — and that
+        # order, not a hardcoded priority sort, is authoritative
+        # (scheduling_queue.go:120 activeQComp).
+        for i in range(len(infos)):
             info = infos[i]
             pod = info.pod
+            state = CycleState()
             row = int(out.assign[i])
             node_name = self.mirror.node_name_of_row(row) if row >= 0 else None
             device_choice = node_name
+            if host_pre_filter:
+                st = fw.run_pre_filter(state, pod)
+                if not st.is_success():
+                    res.unschedulable += 1
+                    if device_choice is not None:
+                        # the solver charged this pod's request to a node it
+                        # will never occupy
+                        residuals_diverged = True
+                    self._fail(info, cycle, f"prefilter: {st.message}")
+                    continue
             needs_recheck = (
                 out.fallback[i]
                 or out.existing_overflow
                 or anti_committed
+                or host_filter
                 or _needs_oracle_recheck(pod)
             )
-            if node_name is not None and (needs_recheck or nominated_fn(node_name)):
+            if node_name is not None and force_host_rank:
+                # Score/PostFilter plugins participate in selection — skip
+                # validating the device pick and re-rank host-side directly
+                self.stats["oracle_places"] += 1
+                meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                node_name = self._oracle_place(pod, out.score[i], meta, state)
+            elif node_name is not None and (needs_recheck or nominated_fn(node_name)):
                 self.stats["oracle_rechecks"] += 1
                 meta = compute_predicate_metadata(pod, self.cache.snapshot)
                 ok = self.cache.snapshot.get(node_name) is not None and fits_considering_nominated(
                     pod, node_name, self.cache.snapshot, nominated_fn, meta=meta
                 )
+                if ok and host_filter:
+                    ni = self.cache.snapshot.get(node_name)
+                    ok = fw.run_filter(state, pod, ni).is_success()
                 if not ok:
                     # invalidated by an earlier commit in this batch (the
                     # solver carry tracks only resources) — re-place via the
                     # oracle against the CURRENT snapshot, ranking candidates
                     # by the device score row (sequential-equivalent filter,
                     # batch-stale scores)
-                    node_name = self._oracle_place(pod, out.score[i], meta)
+                    node_name = self._oracle_place(pod, out.score[i], meta, state)
             elif node_name is not None and residuals_diverged:
                 # constraint-free pod, but an earlier re-placement moved
                 # capacity the solver didn't account for: cheap scalar
@@ -415,7 +485,7 @@ class Scheduler:
                 ni = self.cache.snapshot.get(node_name)
                 if ni is None or not pod_fits_resources(pod, ni):
                     meta = compute_predicate_metadata(pod, self.cache.snapshot)
-                    node_name = self._oracle_place(pod, out.score[i], meta)
+                    node_name = self._oracle_place(pod, out.score[i], meta, state)
             if node_name is None and (
                 out.fallback[i]
                 or out.existing_overflow
@@ -431,7 +501,7 @@ class Scheduler:
                 # scalar fallback before declaring the pod unschedulable
                 self.stats["oracle_places"] += 1
                 meta = compute_predicate_metadata(pod, self.cache.snapshot)
-                node_name = self._oracle_place(pod, out.score[i], meta)
+                node_name = self._oracle_place(pod, out.score[i], meta, state)
             if node_name is None:
                 if device_choice is not None:
                     # the solver charged this pod's request to a node it never
@@ -448,7 +518,7 @@ class Scheduler:
                     # retries after its backoff expires
                     self.queue.move_all_to_active()
                 continue
-            if self._commit(info, node_name, cycle):
+            if self._commit(info, node_name, cycle, state):
                 res.scheduled += 1
                 res.assignments[pod.key()] = node_name
                 if out.has_anti[i]:

@@ -43,12 +43,33 @@ class PodInfo:
     seq: int = 0  # monotonic enqueue sequence (tie-break within priority)
 
 
+class _ActiveEntry:
+    """activeQ heap node. Default order = (priority desc, seq asc) — the
+    activeQ comparator; a QueueSort plugin's Less overrides it
+    (framework.queue_sort_less → SortFn, scheduling_queue.go:120)."""
+
+    __slots__ = ("neg_prio", "seq", "key", "info", "less")
+
+    def __init__(self, info: PodInfo, less):
+        self.neg_prio = -info.pod.get_priority()
+        self.seq = info.seq
+        self.key = info.pod.key()
+        self.info = info
+        self.less = less
+
+    def __lt__(self, other: "_ActiveEntry") -> bool:
+        if self.less is not None:
+            return bool(self.less(self.info, other.info))
+        return (self.neg_prio, self.seq) < (other.neg_prio, other.seq)
+
+
 class PriorityQueue:
-    def __init__(self, now: Callable[[], float] = time.monotonic):
+    def __init__(self, now: Callable[[], float] = time.monotonic, less=None):
         self._lock = threading.Condition()
         self._now = now
         self._seq = itertools.count()
-        self._active: List[Tuple[int, int, str]] = []  # (-prio, seq, key)
+        self._less = less  # QueueSort plugin comparator (PodInfo, PodInfo) -> bool
+        self._active: List[_ActiveEntry] = []
         self._backoff: List[Tuple[float, int, str]] = []  # (expiry, seq, key)
         self._unschedulable: Dict[str, PodInfo] = {}
         self._infos: Dict[str, PodInfo] = {}
@@ -63,12 +84,20 @@ class PriorityQueue:
 
     # -- internals -----------------------------------------------------------
 
+    def set_queue_sort(self, less) -> None:
+        """Install a QueueSort plugin comparator; re-sorts pending entries."""
+        with self._lock:
+            self._less = less
+            for e in self._active:
+                e.less = less
+            heapq.heapify(self._active)
+
     def _push_active(self, info: PodInfo) -> None:
         key = info.pod.key()
         self._infos[key] = info
         if key in self._in_active:
             return
-        heapq.heappush(self._active, (-info.pod.get_priority(), info.seq, key))
+        heapq.heappush(self._active, _ActiveEntry(info, self._less))
         self._in_active.add(key)
         self._lock.notify()
 
@@ -102,7 +131,7 @@ class PriorityQueue:
                 self._lock.wait(wait)
             if self.closed and not self._active:
                 return None
-            _, _, key = heapq.heappop(self._active)
+            key = heapq.heappop(self._active).key
             self._in_active.discard(key)
             info = self._infos[key]
             info.attempts += 1
@@ -116,7 +145,7 @@ class PriorityQueue:
             self._flush_locked()
             out = []
             while self._active and len(out) < max_pods:
-                _, _, key = heapq.heappop(self._active)
+                key = heapq.heappop(self._active).key
                 self._in_active.discard(key)
                 info = self._infos[key]
                 info.attempts += 1
@@ -191,7 +220,7 @@ class PriorityQueue:
             self._attempts.pop(key, None)
             self._last_failure.pop(key, None)
             self._remove_nominated(key)
-            self._active = [(p, s, k) for (p, s, k) in self._active if k != key]
+            self._active = [e for e in self._active if e.key != key]
             heapq.heapify(self._active)
 
     def update(self, old: Pod, new: Pod) -> None:
