@@ -1,0 +1,306 @@
+"""HTTP SchedulerExtender server — the north-star integration seam.
+
+A real kube-scheduler configured with
+
+    {"urlPrefix": "http://host:port", "filterVerb": "filter",
+     "prioritizeVerb": "prioritize", "bindVerb": "bind",
+     "preemptVerb": "preemption", "nodeCacheCapable": true, "weight": 1}
+
+POSTs extender/v1 JSON here per scheduling cycle
+(core/extender.go:43 HTTPExtender.send → :305-331 nodeCacheCapable wire
+modes) and this server answers from the TPU solver's state:
+
+* /filter — feasibility for one pod over the candidate set. In
+  nodeCacheCapable mode only node NAMES cross the wire and candidates
+  resolve against this server's own cluster cache; otherwise full
+  v1.Node objects arrive and are evaluated as a transient snapshot.
+  Large candidate sets route through the device mask kernels (one fused
+  [1, N] filter dispatch on the mirror); small ones use the scalar oracle.
+* /prioritize — 0..10 host priorities (MaxExtenderPriority) from the
+  default weighted score set.
+* /bind — delegated binding (factory.go:713 equivalent) via bind_fn.
+* /preemption — victim-map validation; answers in MetaVictims (UID-only)
+  form when the args came nodeCacheCapable.
+
+The server is the deployment story from BASELINE: front an unmodified
+kube-scheduler with the batch solver without forking it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.types import Node, Pod
+from ..oracle import Snapshot
+from ..oracle.predicates import compute_predicate_metadata, pod_fits_on_node
+from ..oracle.priorities import prioritize_nodes
+from ..state.cache import SchedulerCache, TensorMirror
+from .types import (
+    MAX_EXTENDER_PRIORITY,
+    ExtenderArgs,
+    ExtenderBindingArgs,
+    ExtenderBindingResult,
+    ExtenderFilterResult,
+    ExtenderPreemptionArgs,
+    ExtenderPreemptionResult,
+    HostPriority,
+    MetaVictims,
+)
+
+
+class ExtenderServer:
+    """The solver-backed extender. Feed its cache from an informer (or the
+    fake apiserver); start() serves on a daemon thread."""
+
+    def __init__(
+        self,
+        cache: Optional[SchedulerCache] = None,
+        bind_fn: Optional[Callable[[ExtenderBindingArgs], None]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        device_threshold: int = 256,
+    ):
+        self.cache = cache or SchedulerCache()
+        self.bind_fn = bind_fn
+        self.device_threshold = device_threshold
+        self._mirror: Optional[TensorMirror] = None
+        self._mirror_lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        h, p = self.address
+        return f"http://{h}:{p}"
+
+    def start(self) -> "ExtenderServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- core answers --------------------------------------------------------
+
+    def _device_filter(self, pod: Pod, names: List[str]) -> Optional[Dict[str, bool]]:
+        """One fused [1, N] mask dispatch over the cache mirror; None when
+        the encoding can't represent the pod/nodes (caller falls back to the
+        oracle)."""
+        try:
+            import jax.numpy as jnp
+            import numpy as np
+
+            from ..ops import filters as F
+            from ..ops import topology as T
+            from ..state.tensors import PodBatch, _bucket
+            from ..state.terms import compile_batch_terms
+
+            with self._mirror_lock:
+                if self._mirror is None:
+                    self._mirror = TensorMirror(self.cache)
+                mirror = self._mirror
+                mirror.sync()
+                # any node row in encoding fallback → the device mask can't
+                # answer for the whole set; bail before paying the encode +
+                # dispatch cost
+                if bool((mirror.nodes.fallback & mirror.nodes.valid).any()):
+                    return None
+                batch = PodBatch(mirror.vocab, _bucket(1))
+                batch.set_pod(0, pod)
+                if batch.fallback[0]:
+                    return None
+                tb, aux = compile_batch_terms(mirror.vocab, [pod], b_capacity=batch.capacity)
+                if tb.overflow_owners:
+                    return None
+                etb = mirror.existing_terms()
+                if etb.overflow_owners:
+                    return None
+                dev = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
+                na = dev(mirror.nodes.arrays())
+                pa = dev(batch.arrays())
+                ea = dev(mirror.eps.arrays())
+                ta = dev(tb.arrays())
+                xa = dev(etb.arrays())
+                au = dev(aux)
+                ids = F.make_ids(mirror.vocab)
+                base = F.combined_mask(na, pa, ids)
+                sel = F.pod_match_node_selector(na, pa)
+                mask = base & T.spread_filter(na, ea, ta, sel) & T.interpod_filter(
+                    na, ea, ta, au, xa, pa
+                )
+                row = np.asarray(mask)[0]
+                return {
+                    name: bool(row[mirror.row_of[name]])
+                    for name in names
+                    if name in mirror.row_of
+                }
+        except Exception:
+            return None
+
+    def _resolve(self, args: ExtenderArgs) -> Tuple[Snapshot, List[str], bool]:
+        """(snapshot, candidate names, cache_capable_mode)."""
+        if args.node_names is not None:
+            return self.cache.snapshot, list(args.node_names), True
+        nodes = args.nodes or []
+        return Snapshot(nodes, []), [n.name for n in nodes], False
+
+    def handle_filter(self, args: ExtenderArgs) -> ExtenderFilterResult:
+        pod = args.pod
+        if pod is None:
+            return ExtenderFilterResult(error="no pod in args")
+        snap, names, cache_mode = self._resolve(args)
+        feasible: List[str] = []
+        failed: Dict[str, str] = {}
+        device = (
+            self._device_filter(pod, names)
+            if cache_mode and len(names) >= self.device_threshold
+            else None
+        )
+        if device is not None:
+            for name in names:
+                ok = device.get(name)
+                if ok:
+                    feasible.append(name)
+                else:
+                    failed[name] = "node unknown" if ok is None else "does not fit"
+        else:
+            meta = compute_predicate_metadata(pod, snap)
+            for name in names:
+                ni = snap.get(name)
+                if ni is None:
+                    failed[name] = "node unknown"
+                    continue
+                ok, reasons = pod_fits_on_node(pod, ni, meta=meta)
+                if ok:
+                    feasible.append(name)
+                else:
+                    failed[name] = "; ".join(reasons) if reasons else "does not fit"
+        if cache_mode:
+            return ExtenderFilterResult(node_names=feasible, failed_nodes=failed)
+        keep = set(feasible)
+        return ExtenderFilterResult(
+            nodes=[n for n in (args.nodes or []) if n.name in keep], failed_nodes=failed
+        )
+
+    def handle_prioritize(self, args: ExtenderArgs) -> List[HostPriority]:
+        pod = args.pod
+        if pod is None:
+            return []
+        snap, names, _ = self._resolve(args)
+        scores = prioritize_nodes(pod, snap)
+        # rescale the weighted sum into extender range [0, 10]
+        relevant = {n: scores.get(n, 0) for n in names}
+        hi = max(relevant.values(), default=0)
+        out = []
+        for n in names:
+            s = relevant.get(n, 0)
+            scaled = (s * MAX_EXTENDER_PRIORITY) // hi if hi > 0 else 0
+            out.append(HostPriority(host=n, score=int(scaled)))
+        return out
+
+    def handle_bind(self, args: ExtenderBindingArgs) -> ExtenderBindingResult:
+        if self.bind_fn is None:
+            return ExtenderBindingResult(error="binding not supported")
+        try:
+            self.bind_fn(args)
+        except Exception as e:
+            return ExtenderBindingResult(error=str(e))
+        return ExtenderBindingResult()
+
+    def handle_preemption(self, args: ExtenderPreemptionArgs) -> ExtenderPreemptionResult:
+        """Validate the scheduler's victim map against our cache: drop
+        candidate nodes we don't know and victims that are already gone
+        (core/extender.go ProcessPreemption → convertToMetaVictims)."""
+        out: Dict[str, MetaVictims] = {}
+        snap = self.cache.snapshot
+        if args.node_name_to_meta_victims:
+            for node, mv in args.node_name_to_meta_victims.items():
+                ni = snap.get(node)
+                if ni is None:
+                    continue
+                known = {p.uid for p in ni.pods}
+                uids = [u for u in mv.pod_uids if u in known]
+                if len(uids) == len(mv.pod_uids):
+                    out[node] = MetaVictims(pod_uids=uids, num_pdb_violations=mv.num_pdb_violations)
+        else:
+            for node, v in args.node_name_to_victims.items():
+                ni = snap.get(node)
+                if ni is None:
+                    continue
+                # same validation as the meta branch: every named victim must
+                # still exist on the node (match by UID, or by namespace/name
+                # when the sender's UIDs don't line up with ours)
+                known_uids = {p.uid for p in ni.pods}
+                known_keys = {p.key() for p in ni.pods}
+                if all(p.uid in known_uids or p.key() in known_keys for p in v.pods):
+                    out[node] = MetaVictims(
+                        pod_uids=[p.uid for p in v.pods],
+                        num_pdb_violations=v.num_pdb_violations,
+                    )
+        return ExtenderPreemptionResult(node_name_to_meta_victims=out)
+
+    # -- http plumbing -------------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):  # quiet
+                pass
+
+            def _reply(self, obj: dict, code: int = 200) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self) -> None:
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    self._reply({"Error": "bad json"}, 400)
+                    return
+                path = self.path.rstrip("/")
+                try:
+                    if path.endswith("/filter"):
+                        res = server.handle_filter(ExtenderArgs.from_json(payload))
+                        self._reply(res.to_json())
+                    elif path.endswith("/prioritize"):
+                        hp = server.handle_prioritize(ExtenderArgs.from_json(payload))
+                        self._reply([h.to_json() for h in hp])
+                    elif path.endswith("/bind"):
+                        res = server.handle_bind(ExtenderBindingArgs.from_json(payload))
+                        self._reply(res.to_json())
+                    elif path.endswith("/preemption"):
+                        res = server.handle_preemption(
+                            ExtenderPreemptionArgs.from_json(payload)
+                        )
+                        self._reply(res.to_json())
+                    else:
+                        self._reply({"Error": f"unknown verb {path}"}, 404)
+                except Exception as e:  # never crash the serving thread
+                    self._reply({"Error": str(e)}, 500)
+
+            def do_GET(self) -> None:
+                if self.path.rstrip("/").endswith("/healthz"):
+                    self._reply({"ok": True})
+                else:
+                    self._reply({"Error": "POST only"}, 404)
+
+        return Handler

@@ -65,6 +65,13 @@ class SolveOutput:
     node_fallback_any: bool  # some node rows excluded from the fast path
 
 
+class ExtenderError(Exception):
+    """A non-ignorable extender wire failure. Distinct from 'no fit': the
+    reference treats extender errors as scheduling ERRORS (retry via the
+    error path) — never as FitError, so they must not trigger preemption
+    (core/generic_scheduler.go:531-557 error return vs FitError)."""
+
+
 class Binder:
     """Default binder: callable hook (pod, node_name) -> None, raising on
     failure — the equivalent of POST pods/<p>/binding (factory.go:713)."""
@@ -116,6 +123,7 @@ class Scheduler:
         event_fn: Optional[Callable[[Pod, str, str], None]] = None,
         pdb_lister: Optional[Callable[[], List[PodDisruptionBudget]]] = None,
         delete_fn: Optional[Callable[[Pod], None]] = None,
+        extenders: Optional[List] = None,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -136,6 +144,10 @@ class Scheduler:
         # informer remove the pod; with no API, fall back to direct removal.
         self.pdb_lister = pdb_lister or (lambda: [])
         self.delete_fn = delete_fn
+        # HTTP extenders (core/extender.go): consulted per pod on the host
+        # commit path at Filter/Prioritize time, and at Bind when one
+        # handles binding (scheduler_interface.go:28-73)
+        self.extenders: List = list(extenders or [])
         self._bind_workers = bind_workers
         self._bind_pool = ThreadPoolExecutor(max_workers=bind_workers, thread_name_prefix="bind")
         self._rng_seed = seed
@@ -244,6 +256,11 @@ class Scheduler:
         self.stats["solve_s"] += time.perf_counter() - t1
         return out
 
+    def _pod_extenders(self, pod: Pod) -> List:
+        """Extenders interested in this pod (IsInterested,
+        core/extender.go:450)."""
+        return [e for e in self.extenders if e.is_interested(pod)]
+
     def _oracle_place(
         self, pod: Pod, score_row: np.ndarray, meta, state: Optional[CycleState] = None
     ) -> Optional[str]:
@@ -275,6 +292,32 @@ class Scheduler:
         if fw.has_plugins("post_filter"):
             if not fw.run_post_filter(state, pod, feasible, {}).is_success():
                 return None
+        # HTTP extenders: Filter narrows (findNodesThatFit :531-557),
+        # Prioritize adds weighted scores (PrioritizeNodes :813). Ignorable
+        # extenders' wire failures are skipped; others fail the pod.
+        ext_scores: Dict[str, int] = {}
+        for e in self._pod_extenders(pod):
+            snap_nodes = [self.cache.snapshot.node_infos[n].node for n in feasible]
+            if e.supports_filter():
+                try:
+                    names, _failed = e.filter(pod, snap_nodes)
+                except Exception as err:
+                    if e.is_ignorable():
+                        names = feasible
+                    else:
+                        raise ExtenderError(str(err)) from err
+                keep = set(names)
+                feasible = [n for n in feasible if n in keep]
+                if not feasible:
+                    return None
+                snap_nodes = [self.cache.snapshot.node_infos[n].node for n in feasible]
+            if e.supports_prioritize():
+                try:
+                    for n, s in e.prioritize(pod, snap_nodes).items():
+                        ext_scores[n] = ext_scores.get(n, 0) + s
+                except Exception as err:
+                    if not e.is_ignorable():
+                        raise ExtenderError(str(err)) from err
         plugin_scores: Dict[str, int] = {}
         if fw.has_plugins("score"):
             plugin_scores = fw.run_scores(state, pod, feasible)
@@ -283,7 +326,7 @@ class Scheduler:
         for cand in feasible:
             row = self.mirror.row_of.get(cand)
             s = int(score_row[row]) if row is not None and row < len(score_row) else 0
-            s += plugin_scores.get(cand, 0)
+            s += plugin_scores.get(cand, 0) + ext_scores.get(cand, 0)
             if best_score is None or s > best_score:
                 best, best_score = cand, s
         return best
@@ -325,11 +368,24 @@ class Scheduler:
             if not st.is_success():
                 self._unbind(info, assumed, node_name, state, cycle, f"prebind: {st.message}")
                 return
+            ext_b = next(
+                (
+                    e
+                    for e in self.extenders
+                    if e.supports_bind() and e.is_interested(pod)
+                ),
+                None,
+            )
             try:
-                st = self.framework.run_bind(state, pod, node_name)
-                if st.code != 0 and st.code != 4:  # not SUCCESS, not SKIP
-                    raise RuntimeError(st.message)
-                self.binder.bind(pod, node_name)
+                if ext_b is not None:
+                    # extender-delegated binding (scheduler_interface.go:53,
+                    # scheduler.go:557-571 via extendersBinding)
+                    ext_b.bind(pod, node_name)
+                else:
+                    st = self.framework.run_bind(state, pod, node_name)
+                    if st.code != 0 and st.code != 4:  # not SUCCESS, not SKIP
+                        raise RuntimeError(st.message)
+                    self.binder.bind(pod, node_name)
             except Exception as e:  # bind RPC failed → forget + requeue
                 self._unbind(info, assumed, node_name, state, cycle, f"bind: {e}")
                 return
@@ -366,6 +422,32 @@ class Scheduler:
         )
         if node is None:
             return False
+        # extenders with a preemption verb get to veto/trim the victim set
+        # (processPreemptionWithExtenders, core/generic_scheduler.go:323-345;
+        # simplification: consulted on the chosen candidate rather than the
+        # full candidate map — a veto fails this preemption attempt)
+        preempt_exts = [
+            e
+            for e in self.extenders
+            if e.supports_preemption() and e.is_interested(pod)
+        ]
+        if preempt_exts:
+            from ..extender.types import Victims as WireVictims
+
+            for e in preempt_exts:
+                try:
+                    result = e.process_preemption(
+                        pod, {node: WireVictims(pods=list(victims))}
+                    )
+                except Exception:
+                    if e.is_ignorable():
+                        continue
+                    return False
+                mv = result.get(node)
+                if mv is None:
+                    return False  # extender vetoed the candidate node
+                keep = set(mv.pod_uids)
+                victims = [v for v in victims if v.uid in keep]
         for v in victims:
             if self.delete_fn is not None:
                 # API delete: the informer's delete event removes it from the
@@ -455,53 +537,81 @@ class Scheduler:
                 or host_filter
                 or _needs_oracle_recheck(pod)
             )
-            if node_name is not None and force_host_rank:
-                # Score/PostFilter plugins participate in selection — skip
-                # validating the device pick and re-rank host-side directly
-                self.stats["oracle_places"] += 1
-                meta = compute_predicate_metadata(pod, self.cache.snapshot)
-                node_name = self._oracle_place(pod, out.score[i], meta, state)
-            elif node_name is not None and (needs_recheck or nominated_fn(node_name)):
-                self.stats["oracle_rechecks"] += 1
-                meta = compute_predicate_metadata(pod, self.cache.snapshot)
-                ok = self.cache.snapshot.get(node_name) is not None and fits_considering_nominated(
-                    pod, node_name, self.cache.snapshot, nominated_fn, meta=meta
+            pod_host_rank = force_host_rank or (
+                bool(self.extenders)
+                and any(
+                    e.supports_filter() or e.supports_prioritize()
+                    for e in self._pod_extenders(pod)
                 )
-                if ok and host_filter:
-                    ni = self.cache.snapshot.get(node_name)
-                    ok = fw.run_filter(state, pod, ni).is_success()
-                if not ok:
-                    # invalidated by an earlier commit in this batch (the
-                    # solver carry tracks only resources) — re-place via the
-                    # oracle against the CURRENT snapshot, ranking candidates
-                    # by the device score row (sequential-equivalent filter,
-                    # batch-stale scores)
-                    node_name = self._oracle_place(pod, out.score[i], meta, state)
-            elif node_name is not None and residuals_diverged:
-                # constraint-free pod, but an earlier re-placement moved
-                # capacity the solver didn't account for: cheap scalar
-                # resource check against the LIVE snapshot; full oracle
-                # re-place only if it fails
-                ni = self.cache.snapshot.get(node_name)
-                if ni is None or not pod_fits_resources(pod, ni):
+            )
+            placed_attempted = False  # _oracle_place already ran for this pod
+            try:
+                if node_name is not None and pod_host_rank:
+                    # Score/PostFilter plugins and HTTP extenders participate
+                    # in selection — skip validating the device pick and
+                    # re-rank host-side directly
+                    self.stats["oracle_places"] += 1
                     meta = compute_predicate_metadata(pod, self.cache.snapshot)
                     node_name = self._oracle_place(pod, out.score[i], meta, state)
-            if node_name is None and (
-                out.fallback[i]
-                or out.existing_overflow
-                or out.node_fallback_any
-                or residuals_diverged
-                or _needs_oracle_recheck(pod)
-            ):
-                # the device mask may be conservatively wrong (encoding
-                # overflow / excluded node rows / capacity the carry charged
-                # to a node an earlier pod vacated / a topology constraint
-                # SATISFIED by an earlier in-batch commit, e.g. a required
-                # pod-affinity anchor arriving in the same batch) — full
-                # scalar fallback before declaring the pod unschedulable
-                self.stats["oracle_places"] += 1
-                meta = compute_predicate_metadata(pod, self.cache.snapshot)
-                node_name = self._oracle_place(pod, out.score[i], meta, state)
+                    placed_attempted = True
+                elif node_name is not None and (needs_recheck or nominated_fn(node_name)):
+                    self.stats["oracle_rechecks"] += 1
+                    meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                    ok = self.cache.snapshot.get(node_name) is not None and fits_considering_nominated(
+                        pod, node_name, self.cache.snapshot, nominated_fn, meta=meta
+                    )
+                    if ok and host_filter:
+                        ni = self.cache.snapshot.get(node_name)
+                        ok = fw.run_filter(state, pod, ni).is_success()
+                    if not ok:
+                        # invalidated by an earlier commit in this batch (the
+                        # solver carry tracks only resources) — re-place via
+                        # the oracle against the CURRENT snapshot, ranking
+                        # candidates by the device score row
+                        # (sequential-equivalent filter, batch-stale scores)
+                        node_name = self._oracle_place(pod, out.score[i], meta, state)
+                        placed_attempted = True
+                elif node_name is not None and residuals_diverged:
+                    # constraint-free pod, but an earlier re-placement moved
+                    # capacity the solver didn't account for: cheap scalar
+                    # resource check against the LIVE snapshot; full oracle
+                    # re-place only if it fails
+                    ni = self.cache.snapshot.get(node_name)
+                    if ni is None or not pod_fits_resources(pod, ni):
+                        meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                        node_name = self._oracle_place(pod, out.score[i], meta, state)
+                        placed_attempted = True
+                if (
+                    node_name is None
+                    and not placed_attempted
+                    and (
+                        out.fallback[i]
+                        or out.existing_overflow
+                        or out.node_fallback_any
+                        or residuals_diverged
+                        or _needs_oracle_recheck(pod)
+                    )
+                ):
+                    # the device mask may be conservatively wrong (encoding
+                    # overflow / excluded node rows / capacity the carry
+                    # charged to a node an earlier pod vacated / a topology
+                    # constraint SATISFIED by an earlier in-batch commit,
+                    # e.g. a required pod-affinity anchor arriving in the
+                    # same batch) — full scalar fallback before declaring the
+                    # pod unschedulable
+                    self.stats["oracle_places"] += 1
+                    meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                    node_name = self._oracle_place(pod, out.score[i], meta, state)
+            except ExtenderError as ee:
+                # wire failure, not a FitError: error path, never preemption
+                # (MakeDefaultErrorFunc re-queue, factory.go:646)
+                res.errors += 1
+                if device_choice is not None:
+                    residuals_diverged = True
+                if self.error_fn:
+                    self.error_fn(pod, ee)
+                self._fail(info, cycle, f"extender error: {ee}")
+                continue
             if node_name is None:
                 if device_choice is not None:
                     # the solver charged this pod's request to a node it never

@@ -1,0 +1,284 @@
+"""HTTP SchedulerExtender integration tests.
+
+Modeled on test/integration/scheduler/extender_test.go: real HTTP servers,
+real wire JSON. Two directions:
+  * server: a fake kube-scheduler client POSTs extender/v1 filter /
+    prioritize / bind / preemption args at our solver-backed ExtenderServer
+    (both nodeCacheCapable wire modes);
+  * client: our Scheduler driver consults an out-of-tree extender via
+    HTTPExtender and its answers change assignments.
+"""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Container,
+    Node,
+    Pod,
+    Quantity,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    RESOURCE_PODS,
+    node_to_k8s,
+    pod_to_k8s,
+)
+from kubernetes_tpu.extender import (
+    ExtenderConfig,
+    ExtenderServer,
+    HTTPExtender,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+
+def _post(url: str, obj, timeout: float = 120) -> dict:
+    # generous timeout: the device-path request pays the first XLA compile
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(), headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture
+def server():
+    cache = SchedulerCache()
+    for i in range(6):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30))
+    binds = []
+    srv = ExtenderServer(cache=cache, bind_fn=lambda args: binds.append(args)).start()
+    srv.test_binds = binds
+    yield srv
+    srv.stop()
+
+
+def test_filter_node_cache_capable(server):
+    pod = make_pod("p0", cpu_milli=100, mem=0)
+    args = {"Pod": pod_to_k8s(pod), "NodeNames": ["n0", "n1", "ghost"]}
+    res = _post(server.url + "/filter", args)
+    assert sorted(res["NodeNames"]) == ["n0", "n1"]
+    assert res["FailedNodes"] == {"ghost": "node unknown"}
+    assert not res["Error"]
+
+
+def test_filter_full_nodes_mode(server):
+    # non-cache-capable: full v1.Node objects on the wire, transient snapshot
+    pod = make_pod("p0", cpu_milli=3000, mem=0)
+    big = make_node("big", cpu_milli=4000, mem=8 * 2**30)
+    small = make_node("small", cpu_milli=1000, mem=8 * 2**30)
+    args = {"Pod": pod_to_k8s(pod), "Nodes": {"items": [node_to_k8s(big), node_to_k8s(small)]}}
+    res = _post(server.url + "/filter", args)
+    names = [n["metadata"]["name"] for n in res["Nodes"]["items"]]
+    assert names == ["big"]
+    assert "small" in res["FailedNodes"]
+
+
+def test_prioritize(server):
+    # one node already carries load → LeastRequested prefers the others
+    loaded = make_pod("existing", cpu_milli=3500, mem=2**30)
+    loaded.node_name = "n0"
+    server.cache.add_pod(loaded)
+    pod = make_pod("p0", cpu_milli=100, mem=0)
+    args = {"Pod": pod_to_k8s(pod), "NodeNames": ["n0", "n1", "n2"]}
+    res = _post(server.url + "/prioritize", args)
+    scores = {d["Host"]: d["Score"] for d in res}
+    assert set(scores) == {"n0", "n1", "n2"}
+    assert scores["n0"] < scores["n1"] == scores["n2"]
+    assert all(0 <= s <= 10 for s in scores.values())
+
+
+def test_bind_and_healthz(server):
+    args = {"PodName": "p0", "PodNamespace": "default", "PodUID": "u1", "Node": "n3"}
+    res = _post(server.url + "/bind", args)
+    assert res["Error"] == ""
+    assert server.test_binds[0].node == "n3"
+    with urllib.request.urlopen(server.url + "/healthz", timeout=5) as r:
+        assert json.loads(r.read())["ok"] is True
+
+
+def test_preemption_validates_victims(server):
+    victim = make_pod("victim", cpu_milli=100, mem=0)
+    victim.node_name = "n1"
+    server.cache.add_pod(victim)
+    pod = make_pod("preemptor", cpu_milli=100, mem=0)
+    args = {
+        "Pod": pod_to_k8s(pod),
+        "NodeNameToMetaVictims": {
+            "n1": {"Pods": [{"UID": victim.uid}], "NumPDBViolations": 0},
+            "n2": {"Pods": [{"UID": "unknown-uid"}], "NumPDBViolations": 0},
+            "ghost": {"Pods": [{"UID": victim.uid}], "NumPDBViolations": 0},
+        },
+    }
+    res = _post(server.url + "/preemption", args)
+    out = res["NodeNameToMetaVictims"]
+    assert list(out) == ["n1"]
+    assert out["n1"]["Pods"] == [{"UID": victim.uid}]
+
+
+# --- client direction: our driver consults an out-of-tree extender ---------
+
+
+class _FakeExtender(BaseHTTPRequestHandler):
+    """An out-of-tree extender in the style of extender_test.go's
+    fakeExtender: only allows nodes whose name ends in an even digit and
+    strongly prefers the highest-numbered of those."""
+
+    def log_message(self, fmt, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(n))
+        if self.path.endswith("/filter"):
+            names = payload["NodeNames"]
+            keep = [x for x in names if int(x[-1]) % 2 == 0]
+            out = {"NodeNames": keep, "FailedNodes": {}, "Error": ""}
+        elif self.path.endswith("/prioritize"):
+            names = payload["NodeNames"]
+            out = [{"Host": x, "Score": int(x[-1])} for x in names]
+        else:
+            out = {"Error": "unknown"}
+        body = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def fake_extender():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _FakeExtender)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_driver_consults_extender(fake_extender):
+    cache = SchedulerCache()
+    for i in range(6):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30))
+    ext = HTTPExtender(ExtenderConfig(
+        url_prefix=fake_extender, filter_verb="filter", prioritize_verb="prioritize",
+        weight=100, node_cache_capable=True,
+    ))
+    binds = []
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=Binder(lambda p, n: binds.append((p.name, n))),
+        extenders=[ext], deterministic=True,
+    )
+    for i in range(3):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100, mem=0))
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 3
+    # extender filter: only even nodes; extender prioritize x100 dominates
+    # the default scores: highest even node (n4) wins for everyone
+    assert set(res.assignments.values()) == {"n4"}
+
+
+def test_driver_extender_filters_all_nodes_out(fake_extender):
+    cache = SchedulerCache()
+    cache.add_node(make_node("n1", cpu_milli=4000, mem=8 * 2**30))  # odd → filtered out
+    ext = HTTPExtender(ExtenderConfig(
+        url_prefix=fake_extender, filter_verb="filter", node_cache_capable=True,
+    ))
+    sched = Scheduler(cache=cache, queue=PriorityQueue(), extenders=[ext],
+                      deterministic=True, enable_preemption=False)
+    sched.queue.add(make_pod("p0", cpu_milli=100, mem=0))
+    res = sched.schedule_batch()
+    assert res.scheduled == 0 and res.unschedulable == 1
+
+
+def test_driver_extender_wire_failure_is_error_not_fiterror():
+    """A non-ignorable extender outage is a scheduling ERROR: the pod goes
+    back to the queue via the error path and preemption must NOT fire
+    (the reference never preempts on extender errors)."""
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=4000, mem=8 * 2**30))
+    victim = make_pod("running", cpu_milli=100, mem=0)
+    victim.node_name = "n0"
+    cache.add_pod(victim)
+    dead = HTTPExtender(ExtenderConfig(
+        url_prefix="http://127.0.0.1:1", filter_verb="filter",
+        node_cache_capable=True, timeout_s=0.2,
+    ))
+    deleted = []
+    sched = Scheduler(cache=cache, queue=PriorityQueue(), extenders=[dead],
+                      deterministic=True, enable_preemption=True,
+                      delete_fn=lambda p: deleted.append(p))
+    p = make_pod("p0", cpu_milli=100, mem=0)
+    p.priority = 1000
+    sched.queue.add(p)
+    res = sched.schedule_batch()
+    assert res.errors == 1
+    assert res.scheduled == 0 and res.unschedulable == 0
+    assert res.preempted == 0 and deleted == []  # no eviction on a blip
+    assert sched.queue.pending_count() == 1  # re-queued for retry
+
+
+def test_driver_ignorable_extender_outage_is_skipped():
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=4000, mem=8 * 2**30))
+    dead = HTTPExtender(ExtenderConfig(
+        url_prefix="http://127.0.0.1:1", filter_verb="filter",
+        node_cache_capable=True, ignorable=True, timeout_s=0.2,
+    ))
+    sched = Scheduler(cache=cache, queue=PriorityQueue(), extenders=[dead],
+                      deterministic=True)
+    sched.queue.add(make_pod("p0", cpu_milli=100, mem=0))
+    res = sched.schedule_batch()
+    assert res.scheduled == 1  # ignorable extender outage doesn't block
+
+
+def test_filter_device_path_matches_oracle():
+    """With device_threshold lowered, /filter runs the fused [1, N] device
+    mask over the mirror — results must match the oracle path."""
+    cache = SchedulerCache()
+    for i in range(8):
+        cache.add_node(make_node(f"n{i}", cpu_milli=1000 if i % 2 else 4000, mem=8 * 2**30))
+    srv = ExtenderServer(cache=cache, device_threshold=4).start()
+    try:
+        pod = make_pod("p0", cpu_milli=2000, mem=0)
+        names = [f"n{i}" for i in range(8)] + ["ghost"]
+        res = _post(srv.url + "/filter", {"Pod": pod_to_k8s(pod), "NodeNames": names})
+        assert sorted(res["NodeNames"]) == ["n0", "n2", "n4", "n6"]
+        assert set(res["FailedNodes"]) == {"n1", "n3", "n5", "n7", "ghost"}
+        assert res["FailedNodes"]["ghost"] == "node unknown"
+    finally:
+        srv.stop()
+
+
+def test_end_to_end_server_as_extender_for_fake_scheduler(server):
+    """The fake-kube-scheduler flow end-to-end against ExtenderServer:
+    filter → prioritize → bind round trip picking the best feasible node."""
+    # load n0..n4 heavily; n5 stays empty (LeastRequested will prefer it)
+    for i in range(5):
+        p = make_pod(f"load{i}", cpu_milli=2500, mem=2**30)
+        p.node_name = f"n{i}"
+        server.cache.add_pod(p)
+    pod = make_pod("incoming", cpu_milli=1000, mem=2**28)
+    names = [f"n{i}" for i in range(6)]
+    fres = _post(server.url + "/filter", {"Pod": pod_to_k8s(pod), "NodeNames": names})
+    feasible = fres["NodeNames"]
+    assert "n5" in feasible and len(feasible) == 6  # all still fit 1000m
+    pres = _post(server.url + "/prioritize", {"Pod": pod_to_k8s(pod), "NodeNames": feasible})
+    best = max(pres, key=lambda d: d["Score"])["Host"]
+    assert best == "n5"
+    bres = _post(server.url + "/bind", {
+        "PodName": pod.name, "PodNamespace": pod.namespace, "PodUID": pod.uid, "Node": best,
+    })
+    assert bres["Error"] == ""
+    assert server.test_binds[-1].node == "n5"
