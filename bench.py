@@ -162,11 +162,26 @@ def cfg4_interpod():
     return nodes, pods
 
 
+def cfg5_gang():
+    from kubernetes_tpu.scheduler.driver import POD_GROUP_LABEL
+
+    n = _n(2000)
+    nodes = [mk_node(i) for i in range(n)]
+    pods = []
+    n_groups = _n(1000)
+    for g in range(n_groups):
+        for m in range(64):
+            p = mk_pod(g * 64 + m, labels={"app": f"gang-{g}", POD_GROUP_LABEL: f"gang-{g}"})
+            pods.append(p)
+    return nodes, pods
+
+
 CONFIGS = {
     "1": ("5k_pods_500_nodes_resources", cfg1_resources),
     "2": ("50k_pods_5k_nodes_taint_nodeaffinity", cfg2_taint_affinity),
     "3": ("100k_pods_10k_nodes_topology_spread", cfg3_spread),
     "4": ("20k_pods_2k_nodes_interpod_affinity", cfg4_interpod),
+    "5": ("64k_pods_1k_gangs_2k_nodes", cfg5_gang),
 }
 
 
@@ -228,7 +243,7 @@ def run_config(name, build):
 
 
 def main():
-    which = os.environ.get("BENCH_CONFIGS", "1,2,3,4").split(",")
+    which = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
     details = []
     for key in which:
         key = key.strip()

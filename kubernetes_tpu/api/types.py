@@ -183,6 +183,31 @@ class TopologySpreadConstraint:
 
 
 @dataclass
+class Volume:
+    """v1.Volume — the scheduling-visible sources (predicates.go volume
+    predicates read exactly these): PVC references, the attachable in-tree
+    disks (GCE PD / AWS EBS / Azure Disk), and the NoDiskConflict sources
+    (GCE PD, AWS EBS, RBD, ISCSI). Everything else (emptyDir, configMap,
+    ...) is scheduling-neutral and represented only by `name`."""
+
+    name: str = ""
+    pvc_claim_name: str = ""  # persistentVolumeClaim.claimName
+    gce_pd_name: str = ""
+    gce_pd_read_only: bool = False
+    aws_volume_id: str = ""
+    aws_read_only: bool = False
+    azure_disk_name: str = ""
+    rbd_pool: str = ""
+    rbd_image: str = ""
+    rbd_monitors: Tuple[str, ...] = ()
+    rbd_read_only: bool = False
+    iscsi_target_portal: str = ""
+    iscsi_iqn: str = ""
+    iscsi_lun: int = 0
+    iscsi_read_only: bool = False
+
+
+@dataclass
 class Pod:
     name: str = ""
     namespace: str = "default"
@@ -207,6 +232,7 @@ class Pod:
     topology_spread_constraints: List[TopologySpreadConstraint] = field(default_factory=list)
     scheduler_name: str = "default-scheduler"
     host_network: bool = False
+    volumes: List[Volume] = field(default_factory=list)
 
     # status
     phase: str = "Pending"
@@ -438,6 +464,64 @@ def _format_time(t: float) -> str:
     )
 
 
+def _volume_from(d: dict) -> Volume:
+    v = Volume(name=d.get("name", ""))
+    pvc = d.get("persistentVolumeClaim")
+    if pvc:
+        v.pvc_claim_name = pvc.get("claimName", "")
+    gce = d.get("gcePersistentDisk")
+    if gce:
+        v.gce_pd_name = gce.get("pdName", "")
+        v.gce_pd_read_only = bool(gce.get("readOnly", False))
+    aws = d.get("awsElasticBlockStore")
+    if aws:
+        v.aws_volume_id = aws.get("volumeID", "")
+        v.aws_read_only = bool(aws.get("readOnly", False))
+    az = d.get("azureDisk")
+    if az:
+        v.azure_disk_name = az.get("diskName", "")
+    rbd = d.get("rbd")
+    if rbd:
+        v.rbd_pool = rbd.get("pool", "rbd")
+        v.rbd_image = rbd.get("image", "")
+        v.rbd_monitors = tuple(rbd.get("monitors") or [])
+        v.rbd_read_only = bool(rbd.get("readOnly", False))
+    iscsi = d.get("iscsi")
+    if iscsi:
+        v.iscsi_target_portal = iscsi.get("targetPortal", "")
+        v.iscsi_iqn = iscsi.get("iqn", "")
+        v.iscsi_lun = int(iscsi.get("lun", 0))
+        v.iscsi_read_only = bool(iscsi.get("readOnly", False))
+    return v
+
+
+def _volume_to(v: Volume) -> dict:
+    d: Dict[str, Any] = {"name": v.name}
+    if v.pvc_claim_name:
+        d["persistentVolumeClaim"] = {"claimName": v.pvc_claim_name}
+    if v.gce_pd_name:
+        d["gcePersistentDisk"] = {"pdName": v.gce_pd_name, "readOnly": v.gce_pd_read_only}
+    if v.aws_volume_id:
+        d["awsElasticBlockStore"] = {"volumeID": v.aws_volume_id, "readOnly": v.aws_read_only}
+    if v.azure_disk_name:
+        d["azureDisk"] = {"diskName": v.azure_disk_name}
+    if v.rbd_image:
+        d["rbd"] = {
+            "pool": v.rbd_pool,
+            "image": v.rbd_image,
+            "monitors": list(v.rbd_monitors),
+            "readOnly": v.rbd_read_only,
+        }
+    if v.iscsi_iqn:
+        d["iscsi"] = {
+            "targetPortal": v.iscsi_target_portal,
+            "iqn": v.iscsi_iqn,
+            "lun": v.iscsi_lun,
+            "readOnly": v.iscsi_read_only,
+        }
+    return d
+
+
 def pod_from_k8s(obj: dict) -> Pod:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
@@ -483,6 +567,7 @@ def pod_from_k8s(obj: dict) -> Pod:
         ],
         scheduler_name=spec.get("schedulerName", "default-scheduler"),
         host_network=bool(spec.get("hostNetwork", False)),
+        volumes=[_volume_from(v) for v in spec.get("volumes") or []],
         phase=status.get("phase", "Pending"),
         nominated_node_name=status.get("nominatedNodeName", ""),
         conditions=list(status.get("conditions") or []),
@@ -582,6 +667,8 @@ def pod_to_k8s(pod: Pod) -> dict:
         ]
     if pod.affinity is not None:
         spec["affinity"] = _affinity_to(pod.affinity)
+    if pod.volumes:
+        spec["volumes"] = [_volume_to(v) for v in pod.volumes]
     status: Dict[str, Any] = {"phase": pod.phase}
     if pod.nominated_node_name:
         status["nominatedNodeName"] = pod.nominated_node_name

@@ -21,9 +21,30 @@ import jax.numpy as jnp
 from . import filters as F
 from . import scores as S
 from . import topology as T
-from .solver import pop_order, solve_greedy
+from .solver import pop_order, solve_gang, solve_greedy
 
 Arrays = Dict[str, jnp.ndarray]
+
+
+def mask_and_score(
+    na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays, au: Arrays, ids: Arrays
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The fused Filter+Score stage shared by every solve entry point
+    (plain, gang, sharded) — one definition so they can never diverge."""
+    base = F.combined_mask(na, pa, ids)
+    sel = F.pod_match_node_selector(na, pa)
+    mask = (
+        base
+        & T.spread_filter(na, ea, ta, sel)
+        & T.interpod_filter(na, ea, ta, au, xa, pa)
+    )
+    score = (
+        S.score_matrix(na, pa)
+        + T.interpod_score(na, ea, ta, xa, pa)
+        + T.spread_score(na, ea, ta, au, sel)
+        + T.selector_spread_score(na, ea, ta, au)
+    )
+    return mask, score
 
 
 @partial(jax.jit, static_argnames=("deterministic",))
@@ -39,19 +60,7 @@ def solve_pipeline(
     deterministic: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """mask → score → greedy solve. Returns (assign [B], score [B, N])."""
-    base = F.combined_mask(na, pa, ids)
-    sel = F.pod_match_node_selector(na, pa)
-    mask = (
-        base
-        & T.spread_filter(na, ea, ta, sel)
-        & T.interpod_filter(na, ea, ta, au, xa, pa)
-    )
-    score = (
-        S.score_matrix(na, pa)
-        + T.interpod_score(na, ea, ta, xa, pa)
-        + T.spread_score(na, ea, ta, au, sel)
-        + T.selector_spread_score(na, ea, ta, au)
-    )
+    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids)
     free0 = na["alloc"] - na["requested"]
     b = pa["valid"].shape[0]
     order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
@@ -68,6 +77,43 @@ def solve_pipeline(
         req_any=pa["req_any"],
     )
     return assign, score
+
+
+@partial(jax.jit, static_argnames=("deterministic",))
+def solve_pipeline_gang(
+    na: Arrays,
+    pa: Arrays,
+    ea: Arrays,
+    ta: Arrays,
+    xa: Arrays,
+    au: Arrays,
+    ids: Arrays,
+    key,
+    group: jnp.ndarray,  # [B] group id, -1 = ungrouped
+    deterministic: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Gang variant: same fused mask/score, then the all-or-nothing
+    two-pass solve (ops/solver.solve_gang). Returns (assign, score,
+    gang_ok) — members of dropped groups come back assign=-1, gang_ok
+    False, and their capacity is released to other pods in pass 2."""
+    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids)
+    free0 = na["alloc"] - na["requested"]
+    b = pa["valid"].shape[0]
+    order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
+    assign, gang_ok = solve_gang(
+        mask,
+        score,
+        pa["req"],
+        free0,
+        na["pod_count"].astype(free0.dtype),
+        na["allowed_pods"].astype(free0.dtype),
+        order,
+        group,
+        key,
+        deterministic=deterministic,
+        req_any=pa["req_any"],
+    )
+    return assign, score, gang_ok
 
 
 def encode_solve_args(snapshot, pods, spread_selectors=None, key=None):

@@ -35,9 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import filters as F
-from ..ops import scores as S
-from ..ops import topology as T
+from ..ops.pipeline import mask_and_score
 from ..ops.solver import pop_order
 from .mesh import AXIS_NODES, AXIS_PODS
 
@@ -128,22 +126,11 @@ def make_sharded_pipeline(mesh: Mesh):
         n_local = N // n_shards
         # pin every per-node bank array's leading axis to the mesh
         na = {k: _c(v, AXIS_NODES) for k, v in na.items()}
-        # mask/score compute: nodes sharded, batch optionally data-parallel
-        base = F.combined_mask(na, pa, ids)
-        sel = F.pod_match_node_selector(na, pa)
-        mask = _c(
-            base
-            & T.spread_filter(na, ea, ta, sel)
-            & T.interpod_filter(na, ea, ta, au, xa, pa),
-            AXIS_PODS, AXIS_NODES,
-        )
-        score = _c(
-            S.score_matrix(na, pa)
-            + T.interpod_score(na, ea, ta, xa, pa)
-            + T.spread_score(na, ea, ta, au, sel)
-            + T.selector_spread_score(na, ea, ta, au),
-            AXIS_PODS, AXIS_NODES,
-        )
+        # mask/score compute (shared stage — identical math to the
+        # single-device pipelines): nodes sharded, batch data-parallel
+        mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids)
+        mask = _c(mask, AXIS_PODS, AXIS_NODES)
+        score = _c(score, AXIS_PODS, AXIS_NODES)
         # the greedy commit is a strict sequential order over the whole
         # batch: gather the batch axis, keep nodes sharded
         mask = _c(mask, None, AXIS_NODES)

@@ -39,6 +39,7 @@ from ..state.cache import SchedulerCache, TensorMirror
 from ..state.queue import PodInfo, PriorityQueue
 from ..state.tensors import KeySlotOverflow, PodBatch, _bucket
 from ..state.terms import compile_batch_terms
+from ..volume.predicates import scheduling_relevant_volumes
 from . import preemption as preemption_mod
 from .preemption import fits_considering_nominated, fits_with_nominees
 
@@ -63,6 +64,7 @@ class SolveOutput:
     has_anti: np.ndarray  # [len(pods)] bool: pod carries required anti-affinity
     existing_overflow: bool  # existing pods' terms truncated → recheck all
     node_fallback_any: bool  # some node rows excluded from the fast path
+    gang_ok: Optional[np.ndarray] = None  # [len(pods)] all-or-nothing verdict
 
 
 class ExtenderError(Exception):
@@ -82,6 +84,30 @@ class Binder:
     def bind(self, pod: Pod, node_name: str) -> None:
         if self._fn is not None:
             self._fn(pod, node_name)
+
+
+# Gang/co-scheduling group marker (the coscheduling plugin's PodGroup label,
+# absent upstream in this version — the batched formulation makes
+# all-or-nothing natural, SURVEY §7 stage 7). Label preferred; annotation
+# accepted.
+POD_GROUP_LABEL = "pod-group.scheduling.sigs.k8s.io/name"
+POD_GROUP_MIN_AVAILABLE = "pod-group.scheduling.sigs.k8s.io/min-available"
+
+
+def pod_group_name(pod: Pod) -> str:
+    return pod.labels.get(POD_GROUP_LABEL, "") or pod.annotations.get(POD_GROUP_LABEL, "")
+
+
+def pod_group_min_available(pod: Pod) -> int:
+    """The group's declared size: when set, a batch holding fewer members
+    (the rest not yet created/queued) must not bind its slice."""
+    raw = pod.labels.get(POD_GROUP_MIN_AVAILABLE, "") or pod.annotations.get(
+        POD_GROUP_MIN_AVAILABLE, ""
+    )
+    try:
+        return int(raw)
+    except ValueError:
+        return 0
 
 
 def _needs_oracle_recheck(pod: Pod) -> bool:
@@ -124,6 +150,8 @@ class Scheduler:
         pdb_lister: Optional[Callable[[], List[PodDisruptionBudget]]] = None,
         delete_fn: Optional[Callable[[Pod], None]] = None,
         extenders: Optional[List] = None,
+        volume_checker: Optional[Callable] = None,
+        volume_binder=None,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -148,6 +176,11 @@ class Scheduler:
         # commit path at Filter/Prioritize time, and at Bind when one
         # handles binding (scheduler_interface.go:28-73)
         self.extenders: List = list(extenders or [])
+        # volume predicates (volume.make_volume_checker) + binder seam
+        # (volumebinder/volume_binder.go): pods carrying scheduling-relevant
+        # volumes route through the host commit path where these run
+        self.volume_checker = volume_checker
+        self.volume_binder = volume_binder
         self._bind_workers = bind_workers
         self._bind_pool = ThreadPoolExecutor(max_workers=bind_workers, thread_name_prefix="bind")
         self._rng_seed = seed
@@ -233,7 +266,7 @@ class Scheduler:
         ids = self._ids
         self._cycle += 1
         key = jax.random.PRNGKey(self._rng_seed + self._cycle)
-        assign, score = solve_pipeline(
+        args = (
             self.mirror.nodes.arrays(),
             batch.arrays(),
             self.mirror.eps.arrays(),
@@ -242,8 +275,25 @@ class Scheduler:
             aux,
             ids,
             key,
-            deterministic=self.deterministic,
         )
+        # gang/co-scheduling: group-annotated pods go through the
+        # all-or-nothing two-pass solve (ops/solver.solve_gang)
+        group_names = [pod_group_name(p) for p in pods]
+        gang_ok_arr = None
+        if any(group_names):
+            from ..ops.pipeline import solve_pipeline_gang
+
+            gid_map: Dict[str, int] = {}
+            garr = np.full(batch.capacity, -1, np.int32)
+            for i, gn in enumerate(group_names):
+                if gn:
+                    garr[i] = gid_map.setdefault(gn, len(gid_map))
+            assign, score, gang_ok = solve_pipeline_gang(
+                *args, garr, deterministic=self.deterministic
+            )
+            gang_ok_arr = np.asarray(gang_ok)[: len(pods)]
+        else:
+            assign, score = solve_pipeline(*args, deterministic=self.deterministic)
         n = len(pods)
         out = SolveOutput(
             assign=np.asarray(assign)[:n],
@@ -252,6 +302,7 @@ class Scheduler:
             has_anti=np.asarray(aux["has_anti"])[:n],
             existing_overflow=existing_overflow,
             node_fallback_any=bool((self.mirror.nodes.fallback & self.mirror.nodes.valid).any()),
+            gang_ok=gang_ok_arr,
         )
         self.stats["solve_s"] += time.perf_counter() - t1
         return out
@@ -278,6 +329,8 @@ class Scheduler:
         feasible: List[str] = []
         for cand, ni in self.cache.snapshot.node_infos.items():
             if not pod_fits_on_node(pod, ni, meta=meta)[0]:
+                continue
+            if self.volume_checker is not None and not self.volume_checker(pod, ni)[0]:
                 continue
             if run_filter is not None and not run_filter(state, pod, ni).is_success():
                 continue
@@ -333,33 +386,72 @@ class Scheduler:
 
     # -- commit path ---------------------------------------------------------
 
-    def _commit(
-        self, info: PodInfo, node_name: str, cycle: int, state: Optional[CycleState] = None
-    ) -> bool:
-        """reserve → assume → async(permit → prebind → bind → postbind).
-        `state` is the pod's CycleState carried from PreFilter onward, so
-        plugins share per-cycle data across extension points
-        (cycle_state.go)."""
+    def _prepare_commit(
+        self, info: PodInfo, node_name: str, cycle: int, state: CycleState
+    ) -> Optional[Pod]:
+        """First half of the commit: volume-assume → reserve → cache-assume.
+        Returns the assumed pod, or None after _fail. Gang groups prepare
+        every member before any bind is submitted, so an incomplete group
+        can roll back cleanly (_rollback_prepared)."""
         pod = info.pod
-        state = state if state is not None else CycleState()
+        if self.volume_binder is not None:
+            # AssumePodVolumes (scheduler.go:643): tentatively match unbound
+            # claims (zone-checked against the chosen node) before
+            # reserve/assume so concurrent pods can't double-claim a PV
+            ok = self.volume_binder.assume_pod_volumes(
+                pod, node_name, self.cache.snapshot.get(node_name)
+            )
+            if not ok:
+                self._fail(info, cycle, "volume assume failed: no bindable PV")
+                return None
         st = self.framework.run_reserve(state, pod, node_name)
         if not st.is_success():
+            if self.volume_binder is not None:
+                self.volume_binder.forget_pod_volumes(pod)
             self._fail(info, cycle, f"reserve: {st.message}")
-            return False
+            return None
         import dataclasses
 
         assumed = dataclasses.replace(pod, node_name=node_name)
         try:
             self.cache.assume_pod(assumed)
         except ValueError:
+            if self.volume_binder is not None:
+                self.volume_binder.forget_pod_volumes(pod)
+            self.framework.run_unreserve(state, pod, node_name)
             self._fail(info, cycle, "already assumed")
-            return False
+            return None
         # the pod is no longer a pending nominee anywhere — drop it from the
         # queue's nominated index (DeleteNominatedPodIfExists at assume time,
         # scheduler.go:529) so it isn't double-counted on its node
         self.queue.clear_nomination(pod.key())
+        return assumed
+
+    def _rollback_prepared(
+        self, info: PodInfo, assumed: Pod, node_name: str, state: CycleState, cycle: int, msg: str
+    ) -> None:
+        """Undo _prepare_commit for a gang member whose group fell apart."""
+        self.cache.forget_pod(assumed)
+        if self.volume_binder is not None:
+            self.volume_binder.forget_pod_volumes(info.pod)
+        self.framework.run_unreserve(state, info.pod, node_name)
+        self._fail(info, cycle, msg)
+
+    def _finalize_commit(
+        self, info: PodInfo, assumed: Pod, node_name: str, cycle: int, state: CycleState
+    ) -> None:
+        """Second half: submit the async permit → prebind → bind → postbind
+        pipeline (scheduler.go:631-743)."""
+        pod = info.pod
 
         def bind_async():
+            if self.volume_binder is not None:
+                # bindVolumes first in the async path (scheduler.go:676)
+                try:
+                    self.volume_binder.bind_pod_volumes(pod)
+                except Exception as e:
+                    self._unbind(info, assumed, node_name, state, cycle, f"bindVolumes: {e}")
+                    return
             st = self.framework.run_permit(state, pod, node_name)
             if not st.is_success():
                 self._unbind(info, assumed, node_name, state, cycle, f"permit: {st.message}")
@@ -394,10 +486,25 @@ class Scheduler:
             self.event_fn(pod, "Scheduled", f"bound to {node_name}")
 
         self._bind_pool.submit(bind_async)
+
+    def _commit(
+        self, info: PodInfo, node_name: str, cycle: int, state: Optional[CycleState] = None
+    ) -> bool:
+        """reserve → assume → async(permit → prebind → bind → postbind).
+        `state` is the pod's CycleState carried from PreFilter onward, so
+        plugins share per-cycle data across extension points
+        (cycle_state.go)."""
+        state = state if state is not None else CycleState()
+        assumed = self._prepare_commit(info, node_name, cycle, state)
+        if assumed is None:
+            return False
+        self._finalize_commit(info, assumed, node_name, cycle, state)
         return True
 
     def _unbind(self, info: PodInfo, assumed: Pod, node_name: str, state, cycle: int, msg: str) -> None:
         self.cache.forget_pod(assumed)
+        if self.volume_binder is not None:
+            self.volume_binder.forget_pod_volumes(info.pod)
         self.framework.run_unreserve(state, info.pod, node_name)
         self._fail(info, cycle, msg)
 
@@ -419,6 +526,13 @@ class Scheduler:
             # locally while the async bind completes would desync the cache
             # from the node's real occupancy
             can_disrupt=lambda p: not self.cache.is_assumed(p.key()),
+            # evictions can't cure volume conflicts — candidate nodes must
+            # pass the volume predicates for the preemptor too
+            extra_fit=(
+                (lambda p, ni: self.volume_checker(p, ni)[0])
+                if self.volume_checker is not None
+                else None
+            ),
         )
         if node is None:
             return False
@@ -469,6 +583,13 @@ class Scheduler:
         infos = self.queue.pop_batch(max_pods or self.batch_size)
         if not infos:
             return res
+        # gang completeness: every QUEUED member of any group present in the
+        # batch joins it, so all-or-nothing is decided over the whole group
+        groups_in_batch = {
+            g for g in (pod_group_name(i.pod) for i in infos) if g
+        }
+        if groups_in_batch:
+            infos.extend(self.queue.pop_all_in_groups(groups_in_batch, pod_group_name))
         cycle = self.queue.scheduling_cycle()
         self.stats["batches"] += 1
         t_sync = time.perf_counter()
@@ -505,6 +626,22 @@ class Scheduler:
         # oracle re-placement), the scan carry's residuals are stale for the
         # rest of the batch — later device picks need a resource validation
         residuals_diverged = False
+        # gang groups: members are PREPARED (reserve+assume) as decided but
+        # their binds are submitted only once the whole group has landed;
+        # one failing member rolls back the group (all-or-nothing)
+        gang_staged: Dict[str, List[Tuple[PodInfo, Pod, str, CycleState]]] = {}
+        gang_failed: set = set()
+
+        def rollback_group(g: str) -> None:
+            nonlocal residuals_diverged
+            gang_failed.add(g)
+            for s_info, s_assumed, s_node, s_state in gang_staged.pop(g, []):
+                self._rollback_prepared(
+                    s_info, s_assumed, s_node, s_state, cycle, "gang incomplete"
+                )
+                res.unschedulable += 1
+                residuals_diverged = True  # staged capacity released
+
         t_commit = time.perf_counter()
 
         # commit in pop order so oracle re-checks see earlier assumes,
@@ -517,6 +654,17 @@ class Scheduler:
             info = infos[i]
             pod = info.pod
             state = CycleState()
+            group = pod_group_name(pod)
+            if group and group in gang_failed:
+                res.unschedulable += 1
+                self._fail(info, cycle, "gang incomplete")
+                continue
+            if group and out.gang_ok is not None and not out.gang_ok[i]:
+                # the device solver dropped the whole group in pass 2
+                rollback_group(group)
+                res.unschedulable += 1
+                self._fail(info, cycle, "gang does not fit")
+                continue
             row = int(out.assign[i])
             node_name = self.mirror.node_name_of_row(row) if row >= 0 else None
             device_choice = node_name
@@ -536,6 +684,10 @@ class Scheduler:
                 or anti_committed
                 or host_filter
                 or _needs_oracle_recheck(pod)
+                or (
+                    self.volume_checker is not None
+                    and bool(scheduling_relevant_volumes(pod))
+                )
             )
             pod_host_rank = force_host_rank or (
                 bool(self.extenders)
@@ -560,6 +712,9 @@ class Scheduler:
                     ok = self.cache.snapshot.get(node_name) is not None and fits_considering_nominated(
                         pod, node_name, self.cache.snapshot, nominated_fn, meta=meta
                     )
+                    if ok and self.volume_checker is not None:
+                        ni = self.cache.snapshot.get(node_name)
+                        ok = self.volume_checker(pod, ni)[0]
                     if ok and host_filter:
                         ni = self.cache.snapshot.get(node_name)
                         ok = fw.run_filter(state, pod, ni).is_success()
@@ -617,6 +772,14 @@ class Scheduler:
                     # the solver charged this pod's request to a node it never
                     # occupied — later device picks may be too conservative
                     residuals_diverged = True
+                if group:
+                    # one member without a home sinks the whole group; no
+                    # preemption on behalf of gang members (keep the
+                    # all-or-nothing contract simple and deterministic)
+                    rollback_group(group)
+                    res.unschedulable += 1
+                    self._fail(info, cycle, "gang member: no fit")
+                    continue
                 res.unschedulable += 1
                 preempted_now = self.enable_preemption and self._try_preempt(info)
                 if preempted_now:
@@ -628,7 +791,18 @@ class Scheduler:
                     # retries after its backoff expires
                     self.queue.move_all_to_active()
                 continue
-            if self._commit(info, node_name, cycle, state):
+            if group:
+                assumed = self._prepare_commit(info, node_name, cycle, state)
+                if assumed is None:
+                    rollback_group(group)
+                    res.unschedulable += 1
+                    continue
+                gang_staged.setdefault(group, []).append((info, assumed, node_name, state))
+                if out.has_anti[i]:
+                    anti_committed = True
+                if node_name != device_choice:
+                    residuals_diverged = True
+            elif self._commit(info, node_name, cycle, state):
                 res.scheduled += 1
                 res.assignments[pod.key()] = node_name
                 if out.has_anti[i]:
@@ -639,6 +813,19 @@ class Scheduler:
                 res.unschedulable += 1
                 if device_choice is not None:
                     residuals_diverged = True
+        # complete groups: submit every member's bind pipeline — unless the
+        # declared min-available says part of the group hasn't even been
+        # created yet, in which case binding this slice would break
+        # all-or-nothing across batches
+        for g, members in list(gang_staged.items()):
+            need = max((pod_group_min_available(m[0].pod) for m in members), default=0)
+            if need and len(members) < need:
+                rollback_group(g)
+                continue
+            for s_info, s_assumed, s_node, s_state in members:
+                self._finalize_commit(s_info, s_assumed, s_node, cycle, s_state)
+                res.scheduled += 1
+                res.assignments[s_info.pod.key()] = s_node
         self.stats["commit_s"] += time.perf_counter() - t_commit
         return res
 

@@ -170,6 +170,7 @@ def select_victims_on_node(
     snapshot: Snapshot,
     pdbs: Sequence[PodDisruptionBudget] = (),
     can_disrupt: Optional[Callable[[Pod], bool]] = None,
+    extra_fit: Optional[Callable[[Pod, object], bool]] = None,
 ) -> Optional[Victims]:
     """selectVictimsOnNode (:1104): remove ALL lower-priority pods; if the
     pod then fits, reprieve candidates most-important-first — PDB-protected
@@ -199,6 +200,10 @@ def select_victims_on_node(
 
     meta = compute_predicate_metadata(pod, shadow)
     fits, _ = pod_fits_on_node(pod, sni, meta=meta)
+    if fits and extra_fit is not None:
+        # volume predicates etc.: evicting pods cannot cure a zone/volume
+        # conflict, so the extra predicates must hold on the shadow node too
+        fits = extra_fit(pod, sni)
     if not fits:
         return None
 
@@ -210,6 +215,8 @@ def select_victims_on_node(
         sni.pods.append(p)
         meta = compute_predicate_metadata(pod, shadow)
         still_fits, _ = pod_fits_on_node(pod, sni, meta=meta)
+        if still_fits and extra_fit is not None:
+            still_fits = extra_fit(pod, sni)
         if not still_fits:
             sni.pods.remove(p)
             victims.append(p)
@@ -269,6 +276,7 @@ def preempt(
     pdbs: Sequence[PodDisruptionBudget] = (),
     nominated_fn: Optional[NominatedFn] = None,
     can_disrupt: Optional[Callable[[Pod], bool]] = None,
+    extra_fit: Optional[Callable[[Pod, object], bool]] = None,
 ) -> Tuple[Optional[str], List[Pod], List[str]]:
     """Preempt (:313): returns (node, victims, nominated pod keys to clear).
     The third element lists LOWER-priority pods nominated to the chosen node
@@ -279,7 +287,9 @@ def preempt(
     potential = nodes_where_preemption_might_help(pod, snapshot)
     candidates: Dict[str, Victims] = {}
     for name in potential:
-        v = select_victims_on_node(pod, name, snapshot, pdbs=pdbs, can_disrupt=can_disrupt)
+        v = select_victims_on_node(
+            pod, name, snapshot, pdbs=pdbs, can_disrupt=can_disrupt, extra_fit=extra_fit
+        )
         if v is not None:
             candidates[name] = v
     chosen = pick_one_node_for_preemption(candidates)

@@ -154,6 +154,27 @@ class PriorityQueue:
                 self._scheduling_cycle += 1
             return out
 
+    def pop_all_in_groups(self, groups, group_fn) -> List[PodInfo]:
+        """Drain EVERY queued pod whose group_fn(pod) is in `groups`,
+        regardless of batch size — gang groups must be decided atomically,
+        so a batch containing any member pulls in all queued members
+        (otherwise a group straddling the batch boundary would have its
+        first slice bound before the rest was ever considered)."""
+        with self._lock:
+            take = [e for e in self._active if group_fn(self._infos[e.key].pod) in groups]
+            if not take:
+                return []
+            taken_keys = {e.key for e in take}
+            self._active = [e for e in self._active if e.key not in taken_keys]
+            heapq.heapify(self._active)
+            out = []
+            for e in sorted(take):
+                self._in_active.discard(e.key)
+                info = self._infos[e.key]
+                info.attempts += 1
+                out.append(info)
+            return out
+
     def add_unschedulable(self, info: PodInfo, pod_scheduling_cycle: Optional[int] = None) -> None:
         """AddUnschedulableIfNotPresent (:353): if a move request arrived
         since this pod's cycle started, go to backoffQ (retry soon) instead

@@ -1,0 +1,55 @@
+"""Volume-aware scheduling: storage API objects, the volume predicate set
+(defaults.go:40-56), and the volume binder seam
+(pkg/scheduler/volumebinder)."""
+
+from .binder import VolumeBinder
+from .predicates import (
+    AZURE_DISK_FILTER,
+    DEFAULT_MAX_AZURE_DISK_VOLUMES,
+    DEFAULT_MAX_EBS_VOLUMES,
+    DEFAULT_MAX_GCE_PD_VOLUMES,
+    EBS_FILTER,
+    GCE_PD_FILTER,
+    make_volume_checker,
+    max_csi_volume_count,
+    max_pd_volume_count,
+    no_disk_conflict,
+    no_volume_zone_conflict,
+    scheduling_relevant_volumes,
+)
+from .types import (
+    CSINode,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+    csinode_from_k8s,
+    label_zones_to_set,
+    pv_from_k8s,
+    pvc_from_k8s,
+    storage_class_from_k8s,
+)
+
+__all__ = [
+    "VolumeBinder",
+    "AZURE_DISK_FILTER",
+    "DEFAULT_MAX_AZURE_DISK_VOLUMES",
+    "DEFAULT_MAX_EBS_VOLUMES",
+    "DEFAULT_MAX_GCE_PD_VOLUMES",
+    "EBS_FILTER",
+    "GCE_PD_FILTER",
+    "make_volume_checker",
+    "max_csi_volume_count",
+    "max_pd_volume_count",
+    "no_disk_conflict",
+    "no_volume_zone_conflict",
+    "scheduling_relevant_volumes",
+    "CSINode",
+    "PersistentVolume",
+    "PersistentVolumeClaim",
+    "StorageClass",
+    "csinode_from_k8s",
+    "label_zones_to_set",
+    "pv_from_k8s",
+    "pvc_from_k8s",
+    "storage_class_from_k8s",
+]

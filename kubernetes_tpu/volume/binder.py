@@ -1,0 +1,174 @@
+"""Volume binder: delayed PV binding participating in scheduling.
+
+The seam of pkg/scheduler/volumebinder/volume_binder.go over the logic of
+pkg/controller/volume/scheduling (FindPodVolumes / AssumePodVolumes /
+BindPodVolumes), reduced to the scheduling-visible contract:
+
+  Filter:   find_pod_volumes(pod, node_info) — all bound claims' PVs must
+            be usable on the node (zone labels), and every unbound claim
+            must either match an available PV (by class) or be dynamically
+            provisionable (class exists; WaitForFirstConsumer or Immediate).
+  Reserve:  assume_pod_volumes(pod, node) — record tentative PVC→PV
+            matches so concurrent pods don't double-claim a PV.
+  PreBind:  bind_pod_volumes(pod) — hand the assumed bindings to the
+            API-write hook (the PV controller's business upstream).
+
+The binder is deliberately authoritative-state-free: assumptions are an
+in-memory overlay (like the scheduler cache's assumed pods) that the
+informer-confirmed PVC updates clear.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.types import Pod
+from ..oracle.nodeinfo import (
+    LABEL_ZONE_FAILURE_DOMAIN,
+    LABEL_ZONE_REGION,
+    NodeInfo,
+)
+from .predicates import PVCLister, PVLister, SCLister
+from .types import (
+    VOLUME_BINDING_WAIT,
+    PersistentVolume,
+    label_zones_to_set,
+)
+
+
+class VolumeBinder:
+    def __init__(
+        self,
+        pvc_lister: PVCLister,
+        pv_lister: PVLister,
+        sc_lister: Optional[SCLister] = None,
+        all_pvs: Optional[Callable[[], List[PersistentVolume]]] = None,
+        bind_fn: Optional[Callable[[str, str, str], None]] = None,
+    ):
+        self.pvc_lister = pvc_lister
+        self.pv_lister = pv_lister
+        self.sc_lister = sc_lister or (lambda name: None)
+        self.all_pvs = all_pvs or (lambda: [])
+        self.bind_fn = bind_fn  # (namespace, claim, pv_name) -> None
+        self._lock = threading.Lock()
+        # pod key -> [(namespace, claim, pv_name)] tentative matches
+        self._assumed: Dict[str, List[Tuple[str, str, str]]] = {}
+        self._assumed_pvs: Dict[str, str] = {}  # pv name -> claiming pod key
+
+    # -- Filter --------------------------------------------------------------
+
+    def _pv_usable_on_node(self, pv: PersistentVolume, node_info: NodeInfo) -> bool:
+        node = node_info.node
+        for k, v in pv.labels.items():
+            if k not in (LABEL_ZONE_FAILURE_DOMAIN, LABEL_ZONE_REGION):
+                continue
+            zones = label_zones_to_set(v)
+            if zones and node.labels.get(k, "") not in zones:
+                return False
+        return True
+
+    def _provisionable(self, storage_class_name: str) -> bool:
+        """A claim is dynamically provisionable only if its class has a real
+        provisioner (kubernetes.io/no-provisioner marks local-volume classes
+        that can never provision — FindPodVolumes must fail those)."""
+        sc = self.sc_lister(storage_class_name)
+        return sc is not None and sc.provisioner not in ("", "kubernetes.io/no-provisioner")
+
+    def find_pod_volumes(self, pod: Pod, node_info: NodeInfo) -> Tuple[bool, List[str]]:
+        """FindPodVolumes: (all bound satisfied, all unbound matchable).
+        PV matches are tentative WITHIN the call too: two unbound claims of
+        the same pod can't both be satisfied by one PV."""
+        reasons: List[str] = []
+        with self._lock:
+            used: set = set()  # PVs matched to earlier claims of THIS pod
+            for v in pod.volumes:
+                if not v.pvc_claim_name:
+                    continue
+                pvc = self.pvc_lister(pod.namespace, v.pvc_claim_name)
+                if pvc is None:
+                    reasons.append(f"pvc {v.pvc_claim_name} not found")
+                    continue
+                if pvc.volume_name:
+                    pv = self.pv_lister(pvc.volume_name)
+                    if pv is None:
+                        reasons.append(f"pv {pvc.volume_name} not found")
+                    elif not self._pv_usable_on_node(pv, node_info):
+                        reasons.append("node(s) had volume node affinity conflict")
+                    continue
+                # unbound: find an available matching PV on this node's zone
+                matched = False
+                for pv in self.all_pvs():
+                    if pv.storage_class_name != pvc.storage_class_name:
+                        continue
+                    if pv.name in self._assumed_pvs or pv.name in used:
+                        continue
+                    if self._pv_usable_on_node(pv, node_info):
+                        used.add(pv.name)
+                        matched = True
+                        break
+                if matched:
+                    continue
+                if self._provisionable(pvc.storage_class_name):
+                    continue
+                reasons.append("node(s) didn't find available persistent volumes to bind")
+        return (not reasons), reasons
+
+    # -- Reserve -------------------------------------------------------------
+
+    def assume_pod_volumes(
+        self, pod: Pod, node_name: str, node_info: Optional[NodeInfo] = None
+    ) -> bool:
+        """AssumePodVolumes: tentatively match unbound claims to PVs that
+        are usable on the CHOSEN node (matching Filter's zone logic — the
+        first class-matching PV might live in another zone). Returns ok;
+        False (after rolling back partial matches) when some unbound,
+        non-provisionable claim matched nothing — the caller must fail the
+        pod rather than bind it with a claim that can never bind."""
+        matches: List[Tuple[str, str, str]] = []
+        with self._lock:
+            for v in pod.volumes:
+                if not v.pvc_claim_name:
+                    continue
+                pvc = self.pvc_lister(pod.namespace, v.pvc_claim_name)
+                if pvc is None or pvc.volume_name:
+                    continue
+                matched = False
+                for pv in self.all_pvs():
+                    if (
+                        pv.storage_class_name == pvc.storage_class_name
+                        and pv.name not in self._assumed_pvs
+                        and (node_info is None or self._pv_usable_on_node(pv, node_info))
+                    ):
+                        self._assumed_pvs[pv.name] = pod.key()
+                        matches.append((pod.namespace, v.pvc_claim_name, pv.name))
+                        matched = True
+                        break
+                if not matched and not self._provisionable(pvc.storage_class_name):
+                    for _, _, pv_name in matches:  # roll back partial assumes
+                        self._assumed_pvs.pop(pv_name, None)
+                    return False
+            if matches:
+                self._assumed[pod.key()] = matches
+        return True
+
+    def forget_pod_volumes(self, pod: Pod) -> None:
+        with self._lock:
+            for _, _, pv_name in self._assumed.pop(pod.key(), []):
+                self._assumed_pvs.pop(pv_name, None)
+
+    # -- PreBind -------------------------------------------------------------
+
+    def bind_pod_volumes(self, pod: Pod) -> None:
+        """BindPodVolumes: externalize the assumed matches (API writes)."""
+        with self._lock:
+            matches = list(self._assumed.get(pod.key(), []))
+        for ns, claim, pv_name in matches:
+            if self.bind_fn is not None:
+                self.bind_fn(ns, claim, pv_name)
+        with self._lock:
+            self._assumed.pop(pod.key(), None)
+
+    def assumed_pv_count(self) -> int:
+        with self._lock:
+            return len(self._assumed_pvs)

@@ -1,0 +1,337 @@
+"""Volume predicates: the 6 volume rows of the default provider
+(algorithmprovider/defaults/defaults.go:40-56) plus the CSI count check.
+
+All are host-side scalar predicates (as in the reference — they walk PVC →
+PV → cloud-source chains that have no dense tensor encoding); the driver
+routes pods carrying scheduling-relevant volumes through the host commit
+path, which is the same per-pod cost profile the reference pays for every
+pod.
+
+Listers: callables mirroring the cached-informer interfaces
+(predicates.go:150-225 CachedPersistentVolume[Claim]Info etc.):
+    pvc_lister(namespace, name) -> PersistentVolumeClaim | None
+    pv_lister(name) -> PersistentVolume | None
+    sc_lister(name) -> StorageClass | None
+    csinode_lister(node_name) -> CSINode | None
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Set, Tuple
+
+from ..api.types import Pod, Volume
+from ..oracle.nodeinfo import (
+    LABEL_ZONE_FAILURE_DOMAIN,
+    LABEL_ZONE_REGION,
+    NodeInfo,
+)
+from .types import (
+    VOLUME_BINDING_WAIT,
+    CSINode,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+    label_zones_to_set,
+)
+
+# predicates.go:112-121 / volumeutil.DefaultMaxEBSVolumes
+DEFAULT_MAX_EBS_VOLUMES = 39
+DEFAULT_MAX_GCE_PD_VOLUMES = 16
+DEFAULT_MAX_AZURE_DISK_VOLUMES = 16
+KUBE_MAX_PD_VOLS = "KUBE_MAX_PD_VOLS"
+
+ERR_DISK_CONFLICT = "NoDiskConflict"
+ERR_VOLUME_ZONE_CONFLICT = "NoVolumeZoneConflict"
+ERR_MAX_VOLUME_COUNT = "MaxVolumeCount"
+ERR_VOLUME_BINDING = "VolumeBindingFailed"
+
+PVCLister = Callable[[str, str], Optional[PersistentVolumeClaim]]
+PVLister = Callable[[str], Optional[PersistentVolume]]
+SCLister = Callable[[str], Optional[StorageClass]]
+CSINodeLister = Callable[[str], Optional[CSINode]]
+
+
+def scheduling_relevant_volumes(pod: Pod) -> List[Volume]:
+    """Volumes that can change a scheduling decision (PVC refs or the
+    inline conflict/count sources)."""
+    return [
+        v
+        for v in pod.volumes
+        if v.pvc_claim_name
+        or v.gce_pd_name
+        or v.aws_volume_id
+        or v.azure_disk_name
+        or v.rbd_image
+        or v.iscsi_iqn
+    ]
+
+
+# ---------------------------------------------------------------------------
+# NoDiskConflict (predicates.go:227-293)
+# ---------------------------------------------------------------------------
+
+def _is_volume_conflict(volume: Volume, existing_pod: Pod) -> bool:
+    if not (volume.gce_pd_name or volume.aws_volume_id or volume.rbd_image or volume.iscsi_iqn):
+        return False
+    for ev in existing_pod.volumes:
+        if volume.gce_pd_name and ev.gce_pd_name:
+            if volume.gce_pd_name == ev.gce_pd_name and not (
+                volume.gce_pd_read_only and ev.gce_pd_read_only
+            ):
+                return True
+        if volume.aws_volume_id and ev.aws_volume_id:
+            if volume.aws_volume_id == ev.aws_volume_id:
+                return True
+        if volume.iscsi_iqn and ev.iscsi_iqn:
+            if volume.iscsi_iqn == ev.iscsi_iqn and not (
+                volume.iscsi_read_only and ev.iscsi_read_only
+            ):
+                return True
+        if volume.rbd_image and ev.rbd_image:
+            if (
+                set(volume.rbd_monitors) & set(ev.rbd_monitors)
+                and volume.rbd_pool == ev.rbd_pool
+                and volume.rbd_image == ev.rbd_image
+                and not (volume.rbd_read_only and ev.rbd_read_only)
+            ):
+                return True
+    return False
+
+
+def no_disk_conflict(pod: Pod, node_info: NodeInfo) -> bool:
+    for v in pod.volumes:
+        for ev in node_info.pods:
+            if _is_volume_conflict(v, ev):
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# NoVolumeZoneConflict (predicates.go:698-800)
+# ---------------------------------------------------------------------------
+
+def no_volume_zone_conflict(
+    pod: Pod,
+    node_info: NodeInfo,
+    pvc_lister: PVCLister,
+    pv_lister: PVLister,
+    sc_lister: Optional[SCLister] = None,
+) -> bool:
+    """VolumeZoneChecker.predicate: every bound PV's zone/region label set
+    must contain the node's value for the same key. Unbound claims of a
+    WaitForFirstConsumer class are skipped; other resolution failures fail
+    the node (the reference returns an error, which fails the pod there)."""
+    if not pod.volumes:
+        return True
+    node = node_info.node
+    node_constraints = {
+        k: v
+        for k, v in node.labels.items()
+        if k in (LABEL_ZONE_FAILURE_DOMAIN, LABEL_ZONE_REGION)
+    }
+    if not node_constraints:
+        return True
+    for v in pod.volumes:
+        if not v.pvc_claim_name:
+            continue
+        pvc = pvc_lister(pod.namespace, v.pvc_claim_name)
+        if pvc is None:
+            return False
+        if not pvc.volume_name:
+            sc = sc_lister(pvc.storage_class_name) if sc_lister else None
+            if sc is not None and sc.volume_binding_mode == VOLUME_BINDING_WAIT:
+                continue  # unbound + delayed binding → skip
+            return False
+        pv = pv_lister(pvc.volume_name)
+        if pv is None:
+            return False
+        for k, val in pv.labels.items():
+            if k not in (LABEL_ZONE_FAILURE_DOMAIN, LABEL_ZONE_REGION):
+                continue
+            node_v = node_constraints.get(k, "")
+            zone_set = label_zones_to_set(val)
+            if not zone_set:
+                continue
+            if node_v not in zone_set:
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Max{EBS,GCEPD,AzureDisk}VolumeCount (predicates.go:300-470)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class VolumeFilter:
+    """predicates.go VolumeFilter: map a Volume / PV to its unique id."""
+
+    name: str
+    inline_id: Callable[[Volume], str]
+    pv_id: Callable[[PersistentVolume], str]
+    default_max: int
+
+
+EBS_FILTER = VolumeFilter(
+    name="MaxEBSVolumeCount",
+    inline_id=lambda v: v.aws_volume_id,
+    pv_id=lambda pv: pv.aws_volume_id,
+    default_max=DEFAULT_MAX_EBS_VOLUMES,
+)
+GCE_PD_FILTER = VolumeFilter(
+    name="MaxGCEPDVolumeCount",
+    inline_id=lambda v: v.gce_pd_name,
+    pv_id=lambda pv: pv.gce_pd_name,
+    default_max=DEFAULT_MAX_GCE_PD_VOLUMES,
+)
+AZURE_DISK_FILTER = VolumeFilter(
+    name="MaxAzureDiskVolumeCount",
+    inline_id=lambda v: v.azure_disk_name,
+    pv_id=lambda pv: pv.azure_disk_name,
+    default_max=DEFAULT_MAX_AZURE_DISK_VOLUMES,
+)
+
+
+def max_volume_func(filter_: VolumeFilter) -> int:
+    """getMaxVolLimitFromEnv (predicates.go:370-402): KUBE_MAX_PD_VOLS
+    overrides the per-cloud default."""
+    raw = os.environ.get(KUBE_MAX_PD_VOLS, "")
+    if raw:
+        try:
+            n = int(raw)
+            if n > 0:
+                return n
+        except ValueError:
+            pass
+    return filter_.default_max
+
+
+def _filter_volume_ids(
+    filter_: VolumeFilter,
+    pod: Pod,
+    pvc_lister: PVCLister,
+    pv_lister: PVLister,
+) -> Set[str]:
+    """Unique volume ids of `pod` matching the filter; unbound/unresolvable
+    PVCs count as their own conservative placeholder id
+    (predicates.go:480-540 filterVolumes)."""
+    ids: Set[str] = set()
+    for v in pod.volumes:
+        vid = filter_.inline_id(v)
+        if vid:
+            ids.add(vid)
+            continue
+        if not v.pvc_claim_name:
+            continue
+        pvc = pvc_lister(pod.namespace, v.pvc_claim_name)
+        if pvc is None or not pvc.volume_name:
+            # unknown/unbound claim: conservatively unique per claim
+            ids.add(f"{pod.namespace}/{v.pvc_claim_name}")
+            continue
+        pv = pv_lister(pvc.volume_name)
+        if pv is None:
+            ids.add(pvc.volume_name)
+            continue
+        pvid = filter_.pv_id(pv)
+        if pvid:
+            ids.add(pvid)
+    return ids
+
+
+def max_pd_volume_count(
+    filter_: VolumeFilter,
+    pod: Pod,
+    node_info: NodeInfo,
+    pvc_lister: PVCLister,
+    pv_lister: PVLister,
+) -> bool:
+    new_ids = _filter_volume_ids(filter_, pod, pvc_lister, pv_lister)
+    if not new_ids:
+        return True
+    existing: Set[str] = set()
+    for ep in node_info.pods:
+        existing |= _filter_volume_ids(filter_, ep, pvc_lister, pv_lister)
+    num_new = len(new_ids - existing)
+    return len(existing) + num_new <= max_volume_func(filter_)
+
+
+# ---------------------------------------------------------------------------
+# MaxCSIVolumeCount (csi_volume_predicate.go)
+# ---------------------------------------------------------------------------
+
+def max_csi_volume_count(
+    pod: Pod,
+    node_info: NodeInfo,
+    pvc_lister: PVCLister,
+    pv_lister: PVLister,
+    csinode_lister: Optional[CSINodeLister],
+) -> bool:
+    """Per-driver attachable limits from CSINode. No CSINode / no limits →
+    predicate passes (csi_volume_predicate.go:63-75)."""
+    if csinode_lister is None:
+        return True
+    csinode = csinode_lister(node_info.node.name)
+    if csinode is None or not csinode.driver_limits:
+        return True
+
+    def csi_ids(p: Pod):
+        out = {}
+        for v in p.volumes:
+            if not v.pvc_claim_name:
+                continue
+            pvc = pvc_lister(p.namespace, v.pvc_claim_name)
+            if pvc is None or not pvc.volume_name:
+                continue
+            pv = pv_lister(pvc.volume_name)
+            if pv is None or not pv.csi_driver:
+                continue
+            out[f"{pv.csi_driver}/{pv.csi_volume_handle or pv.name}"] = pv.csi_driver
+        return out
+
+    new = csi_ids(pod)
+    if not new:
+        return True
+    existing = {}
+    for ep in node_info.pods:
+        existing.update(csi_ids(ep))
+    for driver, limit in csinode.driver_limits.items():
+        have = {k for k, d in existing.items() if d == driver}
+        want = {k for k, d in new.items() if d == driver}
+        if len(have | want) > limit:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Combined checker (what the driver installs)
+# ---------------------------------------------------------------------------
+
+def make_volume_checker(
+    pvc_lister: PVCLister,
+    pv_lister: PVLister,
+    sc_lister: Optional[SCLister] = None,
+    csinode_lister: Optional[CSINodeLister] = None,
+    binder=None,
+) -> Callable[[Pod, NodeInfo], Tuple[bool, List[str]]]:
+    """All volume predicates in default-provider order; `binder` adds the
+    CheckVolumeBinding row (volumebinder seam)."""
+
+    def check(pod: Pod, node_info: NodeInfo) -> Tuple[bool, List[str]]:
+        reasons: List[str] = []
+        if not no_disk_conflict(pod, node_info):
+            reasons.append(ERR_DISK_CONFLICT)
+        if not no_volume_zone_conflict(pod, node_info, pvc_lister, pv_lister, sc_lister):
+            reasons.append(ERR_VOLUME_ZONE_CONFLICT)
+        for f in (EBS_FILTER, GCE_PD_FILTER, AZURE_DISK_FILTER):
+            if not max_pd_volume_count(f, pod, node_info, pvc_lister, pv_lister):
+                reasons.append(f.name)
+        if not max_csi_volume_count(pod, node_info, pvc_lister, pv_lister, csinode_lister):
+            reasons.append("MaxCSIVolumeCount")
+        if binder is not None:
+            ok, r = binder.find_pod_volumes(pod, node_info)
+            if not ok:
+                reasons.extend(r or [ERR_VOLUME_BINDING])
+        return (not reasons), reasons
+
+    return check

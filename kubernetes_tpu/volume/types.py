@@ -1,0 +1,116 @@
+"""Storage API objects the scheduler reads.
+
+The scheduling-visible subsets of PersistentVolume, PersistentVolumeClaim,
+StorageClass (storage.k8s.io/v1) and CSINode (storage.k8s.io/v1beta1) —
+exactly the fields the reference's volume predicates and binder consult
+(predicates.go:698-800 VolumeZoneChecker, :300-470 MaxPDVolumeCountChecker,
+csi_volume_predicate.go, volumebinder/volume_binder.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+VOLUME_BINDING_IMMEDIATE = "Immediate"
+VOLUME_BINDING_WAIT = "WaitForFirstConsumer"
+
+# Multi-zone PV label separator (volumehelpers.LabelZonesToSet: "us-a__us-b").
+ZONE_LABEL_SEPARATOR = "__"
+
+
+def label_zones_to_set(value: str) -> set:
+    """volumehelpers.LabelZonesToSet: '__'-separated zone list → set."""
+    return {z for z in value.split(ZONE_LABEL_SEPARATOR) if z} if value else set()
+
+
+@dataclass
+class PersistentVolume:
+    name: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    # sources (exactly one set), for volume-count filters + CSI limits
+    gce_pd_name: str = ""
+    aws_volume_id: str = ""
+    azure_disk_name: str = ""
+    csi_driver: str = ""
+    csi_volume_handle: str = ""
+    storage_class_name: str = ""
+    # simplified NodeAffinity: required zone/region sets already folded into
+    # labels (the reference's PV.NodeAffinity is out of scope in this
+    # version's default predicates; zone labels are the contract)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    name: str = ""
+    namespace: str = "default"
+    volume_name: str = ""  # bound PV name ("" = unbound)
+    storage_class_name: str = ""
+    phase: str = "Pending"
+
+
+@dataclass
+class StorageClass:
+    name: str = ""
+    provisioner: str = ""
+    volume_binding_mode: str = VOLUME_BINDING_IMMEDIATE
+
+
+@dataclass
+class CSINode:
+    """storage.k8s.io CSINode: per-driver attachable volume limits."""
+
+    name: str = ""
+    driver_limits: Dict[str, int] = field(default_factory=dict)
+
+
+def pv_from_k8s(obj: dict) -> PersistentVolume:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    pv = PersistentVolume(
+        name=meta.get("name", ""),
+        labels=dict(meta.get("labels") or {}),
+        storage_class_name=spec.get("storageClassName", ""),
+    )
+    if spec.get("gcePersistentDisk"):
+        pv.gce_pd_name = spec["gcePersistentDisk"].get("pdName", "")
+    if spec.get("awsElasticBlockStore"):
+        pv.aws_volume_id = spec["awsElasticBlockStore"].get("volumeID", "")
+    if spec.get("azureDisk"):
+        pv.azure_disk_name = spec["azureDisk"].get("diskName", "")
+    if spec.get("csi"):
+        pv.csi_driver = spec["csi"].get("driver", "")
+        pv.csi_volume_handle = spec["csi"].get("volumeHandle", "")
+    return pv
+
+
+def pvc_from_k8s(obj: dict) -> PersistentVolumeClaim:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    return PersistentVolumeClaim(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        volume_name=spec.get("volumeName", ""),
+        storage_class_name=spec.get("storageClassName", "") or "",
+        phase=status.get("phase", "Pending"),
+    )
+
+
+def storage_class_from_k8s(obj: dict) -> StorageClass:
+    meta = obj.get("metadata") or {}
+    return StorageClass(
+        name=meta.get("name", ""),
+        provisioner=obj.get("provisioner", ""),
+        volume_binding_mode=obj.get("volumeBindingMode") or VOLUME_BINDING_IMMEDIATE,
+    )
+
+
+def csinode_from_k8s(obj: dict) -> CSINode:
+    meta = obj.get("metadata") or {}
+    limits: Dict[str, int] = {}
+    for drv in (obj.get("spec") or {}).get("drivers") or []:
+        alloc = drv.get("allocatable") or {}
+        if alloc.get("count") is not None:
+            limits[drv.get("name", "")] = int(alloc["count"])
+    return CSINode(name=meta.get("name", ""), driver_limits=limits)

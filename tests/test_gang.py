@@ -1,0 +1,141 @@
+"""Gang / all-or-nothing co-scheduling through the driver."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import (
+    POD_GROUP_LABEL,
+    Binder,
+    Scheduler,
+)
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+
+def _gang_pod(name, group, cpu=500):
+    p = make_pod(name, cpu_milli=cpu, mem=0)
+    p.labels[POD_GROUP_LABEL] = group
+    return p
+
+
+def _mk(n_nodes=4, cpu=2000):
+    cache = SchedulerCache()
+    for i in range(n_nodes):
+        cache.add_node(make_node(f"n{i}", cpu_milli=cpu, mem=8 * 2**30))
+    binds = []
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=Binder(lambda p, n: binds.append((p.name, n))),
+        deterministic=True, enable_preemption=False,
+    )
+    return sched, binds
+
+
+def test_gang_fits_all_members_bind():
+    sched, binds = _mk(n_nodes=4, cpu=2000)
+    for i in range(8):  # 8 × 500m over 4 × 2000m nodes → fits
+        sched.queue.add(_gang_pod(f"g{i}", "job-a"))
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 8
+    assert len(binds) == 8
+
+
+def test_gang_all_or_nothing_rejected():
+    sched, binds = _mk(n_nodes=1, cpu=2000)
+    # 5 × 500m = 2500m > 2000m: group cannot fully fit → nobody lands
+    for i in range(5):
+        sched.queue.add(_gang_pod(f"g{i}", "job-b"))
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 0
+    assert res.unschedulable == 5
+    assert binds == []
+    # capacity untouched: a plain pod can take the whole node afterwards
+    sched.queue.add(make_pod("plain", cpu_milli=2000, mem=0))
+    res2 = sched.schedule_batch()
+    assert res2.scheduled == 1
+
+
+def test_dropped_gang_releases_capacity_to_others():
+    sched, binds = _mk(n_nodes=1, cpu=2000)
+    for i in range(5):  # infeasible gang
+        sched.queue.add(_gang_pod(f"g{i}", "job-c"))
+    sched.queue.add(make_pod("solo", cpu_milli=1500, mem=0))
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    # pass 2 of solve_gang re-solves without the dropped group, so the solo
+    # pod gets the capacity in the SAME batch
+    assert res.assignments.get("default/solo") == "n0"
+    assert res.scheduled == 1 and res.unschedulable == 5
+
+
+def test_two_gangs_independent():
+    sched, binds = _mk(n_nodes=2, cpu=2000)
+    for i in range(4):  # job-d: 4 × 500m = 2000m → fits
+        sched.queue.add(_gang_pod(f"d{i}", "job-d"))
+    for i in range(9):  # job-e: 9 × 500m = 4500m > 4000m total → dropped
+        sched.queue.add(_gang_pod(f"e{i}", "job-e"))
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 4
+    assert res.unschedulable == 9
+    assert {k.split("/")[1][0] for k in res.assignments} == {"d"}
+
+
+def test_gang_straddling_batch_boundary_pulls_whole_group():
+    """A group bigger than batch_size must still be decided atomically:
+    pop_batch pulls in every queued member (review finding r2)."""
+    cache = SchedulerCache()
+    for i in range(8):
+        cache.add_node(make_node(f"n{i}", cpu_milli=2000, mem=8 * 2**30))
+    sched = Scheduler(cache=cache, queue=PriorityQueue(), deterministic=True,
+                      enable_preemption=False, batch_size=4)
+    for i in range(12):  # 12 members, batch_size 4
+        sched.queue.add(_gang_pod(f"g{i}", "big-job"))
+    res = sched.schedule_batch()
+    assert res.scheduled == 12  # one batch decided the whole group
+
+
+def test_gang_min_available_defers_partial_group():
+    """min-available: a slice smaller than the declared group size must not
+    bind even if it fits."""
+    from kubernetes_tpu.scheduler.driver import POD_GROUP_MIN_AVAILABLE
+
+    sched, binds = _mk(n_nodes=4, cpu=2000)
+    for i in range(3):  # only 3 of a declared 8 exist so far
+        p = _gang_pod(f"g{i}", "job-partial")
+        p.labels[POD_GROUP_MIN_AVAILABLE] = "8"
+        sched.queue.add(p)
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 0 and res.unschedulable == 3
+    assert binds == []
+
+
+def test_gang_requeues_and_retries_after_capacity_frees():
+    clock = [0.0]
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=2000, mem=8 * 2**30))
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(now=lambda: clock[0]),
+        deterministic=True, enable_preemption=False,
+    )
+    blocker = make_pod("blocker", cpu_milli=1500, mem=0)
+    blocker.node_name = "n0"
+    sched.cache.add_pod(blocker)
+    for i in range(3):  # 1500m needed, only 500m free
+        sched.queue.add(_gang_pod(f"g{i}", "job-f"))
+    res = sched.schedule_batch()
+    assert res.scheduled == 0 and res.unschedulable == 3
+    # capacity frees; the queue's unschedulable set flushes on a move event,
+    # and the backoff window passes
+    sched.cache.remove_pod(blocker)
+    sched.queue.move_all_to_active()
+    clock[0] += 15.0
+    sched.queue.flush()
+    total = sched.run_until_empty(max_cycles=20)
+    sched.wait_for_binds()
+    assert total.scheduled == 3

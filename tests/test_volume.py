@@ -1,0 +1,365 @@
+"""Volume scheduling: predicates (table-driven, predicates_test.go style),
+binder seam, and driver integration."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import Volume, pod_from_k8s, pod_to_k8s
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.oracle import Snapshot
+from kubernetes_tpu.oracle.nodeinfo import (
+    LABEL_ZONE_FAILURE_DOMAIN,
+    LABEL_ZONE_REGION,
+    NodeInfo,
+)
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+from kubernetes_tpu.volume import (
+    CSINode,
+    EBS_FILTER,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+    VolumeBinder,
+    make_volume_checker,
+    max_csi_volume_count,
+    max_pd_volume_count,
+    no_disk_conflict,
+    no_volume_zone_conflict,
+)
+
+
+def _ni(labels=None, pods=()):
+    n = make_node("n0", cpu_milli=4000, mem=8 * 2**30)
+    n.labels.update(labels or {})
+    ni = NodeInfo(node=n)
+    ni.pods.extend(pods)
+    return ni
+
+
+def _vol_pod(name, *vols):
+    p = make_pod(name, cpu_milli=100, mem=0)
+    p.volumes = list(vols)
+    return p
+
+
+# --- NoDiskConflict (predicates.go:227-293) --------------------------------
+
+DISK_CASES = [
+    # (new volume, existing volume, expect_fit)
+    (Volume(gce_pd_name="pd1"), Volume(gce_pd_name="pd1"), False),
+    (Volume(gce_pd_name="pd1", gce_pd_read_only=True),
+     Volume(gce_pd_name="pd1", gce_pd_read_only=True), True),  # all RO → ok
+    (Volume(gce_pd_name="pd1"), Volume(gce_pd_name="pd2"), True),
+    (Volume(aws_volume_id="v1"), Volume(aws_volume_id="v1"), False),
+    (Volume(aws_volume_id="v1", aws_read_only=True),
+     Volume(aws_volume_id="v1", aws_read_only=True), False),  # EBS: RO irrelevant
+    (Volume(iscsi_iqn="iqn1"), Volume(iscsi_iqn="iqn1"), False),
+    (Volume(iscsi_iqn="iqn1", iscsi_read_only=True),
+     Volume(iscsi_iqn="iqn1", iscsi_read_only=True), True),
+    (Volume(rbd_pool="p", rbd_image="i", rbd_monitors=("m1",)),
+     Volume(rbd_pool="p", rbd_image="i", rbd_monitors=("m1", "m2")), False),
+    (Volume(rbd_pool="p", rbd_image="i", rbd_monitors=("m1",)),
+     Volume(rbd_pool="other", rbd_image="i", rbd_monitors=("m1",)), True),
+]
+
+
+@pytest.mark.parametrize("new,existing,expect", DISK_CASES)
+def test_no_disk_conflict(new, existing, expect):
+    ni = _ni(pods=[_vol_pod("existing", existing)])
+    assert no_disk_conflict(_vol_pod("new", new), ni) is expect
+
+
+# --- NoVolumeZoneConflict (predicates.go:698-800) ---------------------------
+
+def _zone_env():
+    pvcs = {
+        ("default", "claim-a"): PersistentVolumeClaim(
+            name="claim-a", volume_name="pv-a"),
+        ("default", "claim-unbound"): PersistentVolumeClaim(
+            name="claim-unbound", storage_class_name="wait-class"),
+    }
+    pvs = {
+        "pv-a": PersistentVolume(name="pv-a",
+                                 labels={LABEL_ZONE_FAILURE_DOMAIN: "us-a__us-b"}),
+    }
+    scs = {"wait-class": StorageClass(name="wait-class",
+                                      volume_binding_mode="WaitForFirstConsumer")}
+    return (lambda ns, n: pvcs.get((ns, n))), (lambda n: pvs.get(n)), (lambda n: scs.get(n))
+
+
+def test_volume_zone_match():
+    pvc_l, pv_l, sc_l = _zone_env()
+    pod = _vol_pod("p", Volume(pvc_claim_name="claim-a"))
+    assert no_volume_zone_conflict(pod, _ni({LABEL_ZONE_FAILURE_DOMAIN: "us-a"}), pvc_l, pv_l, sc_l)
+    assert no_volume_zone_conflict(pod, _ni({LABEL_ZONE_FAILURE_DOMAIN: "us-b"}), pvc_l, pv_l, sc_l)
+    assert not no_volume_zone_conflict(pod, _ni({LABEL_ZONE_FAILURE_DOMAIN: "us-c"}), pvc_l, pv_l, sc_l)
+
+
+def test_volume_zone_no_node_labels_passes():
+    pvc_l, pv_l, sc_l = _zone_env()
+    pod = _vol_pod("p", Volume(pvc_claim_name="claim-a"))
+    assert no_volume_zone_conflict(pod, _ni({}), pvc_l, pv_l, sc_l)
+
+
+def test_volume_zone_unbound_wait_class_skipped():
+    pvc_l, pv_l, sc_l = _zone_env()
+    pod = _vol_pod("p", Volume(pvc_claim_name="claim-unbound"))
+    assert no_volume_zone_conflict(pod, _ni({LABEL_ZONE_FAILURE_DOMAIN: "us-z"}), pvc_l, pv_l, sc_l)
+
+
+def test_volume_zone_missing_pvc_fails():
+    pvc_l, pv_l, sc_l = _zone_env()
+    pod = _vol_pod("p", Volume(pvc_claim_name="nope"))
+    assert not no_volume_zone_conflict(pod, _ni({LABEL_ZONE_FAILURE_DOMAIN: "us-a"}), pvc_l, pv_l, sc_l)
+
+
+def test_volume_zone_region_label():
+    pvcs = {("default", "c"): PersistentVolumeClaim(name="c", volume_name="pv-r")}
+    pvs = {"pv-r": PersistentVolume(name="pv-r", labels={LABEL_ZONE_REGION: "eu"})}
+    pod = _vol_pod("p", Volume(pvc_claim_name="c"))
+    assert no_volume_zone_conflict(
+        pod, _ni({LABEL_ZONE_REGION: "eu"}), lambda ns, n: pvcs.get((ns, n)), lambda n: pvs.get(n))
+    assert not no_volume_zone_conflict(
+        pod, _ni({LABEL_ZONE_REGION: "us"}), lambda ns, n: pvcs.get((ns, n)), lambda n: pvs.get(n))
+
+
+# --- Max volume counts ------------------------------------------------------
+
+def test_max_ebs_volume_count(monkeypatch):
+    monkeypatch.setenv("KUBE_MAX_PD_VOLS", "2")
+    pvc_l, pv_l = (lambda ns, n: None), (lambda n: None)
+    existing = [
+        _vol_pod("e1", Volume(aws_volume_id="v1")),
+        _vol_pod("e2", Volume(aws_volume_id="v2")),
+    ]
+    ni = _ni(pods=existing)
+    # third distinct volume exceeds the limit of 2
+    assert not max_pd_volume_count(EBS_FILTER, _vol_pod("p", Volume(aws_volume_id="v3")), ni, pvc_l, pv_l)
+    # re-using an attached volume is free
+    assert max_pd_volume_count(EBS_FILTER, _vol_pod("p", Volume(aws_volume_id="v1")), ni, pvc_l, pv_l)
+    # no EBS volumes at all → pass
+    assert max_pd_volume_count(EBS_FILTER, _vol_pod("p"), ni, pvc_l, pv_l)
+
+
+def test_max_ebs_count_via_pvc(monkeypatch):
+    monkeypatch.setenv("KUBE_MAX_PD_VOLS", "1")
+    pvcs = {("default", "c1"): PersistentVolumeClaim(name="c1", volume_name="pv1")}
+    pvs = {"pv1": PersistentVolume(name="pv1", aws_volume_id="vol-9")}
+    pvc_l, pv_l = (lambda ns, n: pvcs.get((ns, n))), (lambda n: pvs.get(n))
+    existing = [_vol_pod("e1", Volume(aws_volume_id="vol-8"))]
+    ni = _ni(pods=existing)
+    assert not max_pd_volume_count(EBS_FILTER, _vol_pod("p", Volume(pvc_claim_name="c1")), ni, pvc_l, pv_l)
+
+
+def test_max_csi_volume_count():
+    pvcs = {
+        ("default", "c1"): PersistentVolumeClaim(name="c1", volume_name="pv1"),
+        ("default", "c2"): PersistentVolumeClaim(name="c2", volume_name="pv2"),
+    }
+    pvs = {
+        "pv1": PersistentVolume(name="pv1", csi_driver="ebs.csi", csi_volume_handle="h1"),
+        "pv2": PersistentVolume(name="pv2", csi_driver="ebs.csi", csi_volume_handle="h2"),
+    }
+    pvc_l, pv_l = (lambda ns, n: pvcs.get((ns, n))), (lambda n: pvs.get(n))
+    csinode = CSINode(name="n0", driver_limits={"ebs.csi": 1})
+    csi_l = lambda name: csinode
+    existing = [_vol_pod("e1", Volume(pvc_claim_name="c1"))]
+    ni = _ni(pods=existing)
+    assert not max_csi_volume_count(_vol_pod("p", Volume(pvc_claim_name="c2")), ni, pvc_l, pv_l, csi_l)
+    # no CSINode limits → pass
+    assert max_csi_volume_count(_vol_pod("p", Volume(pvc_claim_name="c2")), ni, pvc_l, pv_l, lambda n: None)
+
+
+# --- VolumeBinder -----------------------------------------------------------
+
+def test_binder_bound_claim_zone_conflict():
+    pvcs = {("default", "c"): PersistentVolumeClaim(name="c", volume_name="pv")}
+    pvs = {"pv": PersistentVolume(name="pv", labels={LABEL_ZONE_FAILURE_DOMAIN: "us-a"})}
+    b = VolumeBinder(lambda ns, n: pvcs.get((ns, n)), lambda n: pvs.get(n))
+    pod = _vol_pod("p", Volume(pvc_claim_name="c"))
+    ok, _ = b.find_pod_volumes(pod, _ni({LABEL_ZONE_FAILURE_DOMAIN: "us-a"}))
+    assert ok
+    ok, reasons = b.find_pod_volumes(pod, _ni({LABEL_ZONE_FAILURE_DOMAIN: "us-b"}))
+    assert not ok and "node(s) had volume node affinity conflict" in reasons
+
+
+def test_binder_assume_prevents_double_claim_and_bind_externalizes():
+    pvcs = {
+        ("default", "c1"): PersistentVolumeClaim(name="c1", storage_class_name="std"),
+        ("default", "c2"): PersistentVolumeClaim(name="c2", storage_class_name="std"),
+    }
+    the_pv = PersistentVolume(name="pv1", storage_class_name="std")
+    bound = []
+    b = VolumeBinder(
+        lambda ns, n: pvcs.get((ns, n)), lambda n: None,
+        all_pvs=lambda: [the_pv],
+        bind_fn=lambda ns, claim, pv: bound.append((ns, claim, pv)),
+    )
+    p1 = _vol_pod("p1", Volume(pvc_claim_name="c1"))
+    p2 = _vol_pod("p2", Volume(pvc_claim_name="c2"))
+    ok, _ = b.find_pod_volumes(p1, _ni())
+    assert ok
+    assert b.assume_pod_volumes(p1, "n0")  # matched pv1 tentatively
+    assert b.assumed_pv_count() == 1
+    # p2 can no longer match the same PV, and there's no storage class → fail
+    ok, reasons = b.find_pod_volumes(p2, _ni())
+    assert not ok
+    b.bind_pod_volumes(p1)
+    assert bound == [("default", "c1", "pv1")]
+
+
+# --- driver integration -----------------------------------------------------
+
+def test_driver_routes_volume_pods_through_checker():
+    """A pod with a zone-bound PV only lands on the matching zone's node."""
+    cache = SchedulerCache()
+    for i, zone in enumerate(["us-a", "us-b", "us-c"]):
+        n = make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30)
+        n.labels[LABEL_ZONE_FAILURE_DOMAIN] = zone
+        cache.add_node(n)
+    pvcs = {("default", "c"): PersistentVolumeClaim(name="c", volume_name="pv")}
+    pvs = {"pv": PersistentVolume(name="pv", labels={LABEL_ZONE_FAILURE_DOMAIN: "us-b"})}
+    checker = make_volume_checker(lambda ns, n: pvcs.get((ns, n)), lambda n: pvs.get(n))
+    binds = []
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=Binder(lambda p, n: binds.append((p.name, n))),
+        volume_checker=checker, deterministic=True, enable_preemption=False,
+    )
+    pod = _vol_pod("vp", Volume(pvc_claim_name="c"))
+    sched.queue.add(pod)
+    plain = make_pod("plain", cpu_milli=100, mem=0)
+    sched.queue.add(plain)
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 2
+    assert res.assignments["default/vp"] == "n1"  # the us-b node
+
+
+def test_binder_assume_respects_node_zone():
+    """assume must not claim a PV unusable on the CHOSEN node (review r1):
+    first class-matching PV is in us-a, pod lands in us-b → pv-b claimed."""
+    pvcs = {("default", "c"): PersistentVolumeClaim(name="c", storage_class_name="fast")}
+    pv_a = PersistentVolume(name="pv-a", storage_class_name="fast",
+                            labels={LABEL_ZONE_FAILURE_DOMAIN: "us-a"})
+    pv_b = PersistentVolume(name="pv-b", storage_class_name="fast",
+                            labels={LABEL_ZONE_FAILURE_DOMAIN: "us-b"})
+    b = VolumeBinder(lambda ns, n: pvcs.get((ns, n)), lambda n: None,
+                     all_pvs=lambda: [pv_a, pv_b])
+    pod = _vol_pod("p", Volume(pvc_claim_name="c"))
+    node_b = _ni({LABEL_ZONE_FAILURE_DOMAIN: "us-b"})
+    assert b.assume_pod_volumes(pod, "n0", node_b)
+    assert "pv-b" in b._assumed_pvs and "pv-a" not in b._assumed_pvs
+
+
+def test_binder_one_pv_cannot_satisfy_two_claims():
+    pvcs = {
+        ("default", "c1"): PersistentVolumeClaim(name="c1", storage_class_name="fast"),
+        ("default", "c2"): PersistentVolumeClaim(name="c2", storage_class_name="fast"),
+    }
+    only_pv = PersistentVolume(name="pv1", storage_class_name="fast")
+    b = VolumeBinder(lambda ns, n: pvcs.get((ns, n)), lambda n: None,
+                     all_pvs=lambda: [only_pv])
+    pod = _vol_pod("p", Volume(pvc_claim_name="c1"), Volume(pvc_claim_name="c2"))
+    ok, reasons = b.find_pod_volumes(pod, _ni())
+    assert not ok  # second claim has nothing to match (review r3)
+    # assume likewise refuses and rolls back the partial match
+    assert not b.assume_pod_volumes(pod, "n0", _ni())
+    assert b.assumed_pv_count() == 0
+
+
+def test_binder_no_provisioner_class_not_provisionable():
+    pvcs = {("default", "c"): PersistentVolumeClaim(
+        name="c", storage_class_name="local-storage")}
+    scs = {"local-storage": StorageClass(
+        name="local-storage", provisioner="kubernetes.io/no-provisioner",
+        volume_binding_mode="WaitForFirstConsumer")}
+    b = VolumeBinder(lambda ns, n: pvcs.get((ns, n)), lambda n: None,
+                     sc_lister=lambda n: scs.get(n), all_pvs=lambda: [])
+    pod = _vol_pod("p", Volume(pvc_claim_name="c"))
+    ok, reasons = b.find_pod_volumes(pod, _ni())
+    assert not ok  # no PVs + no real provisioner → Filter fails (review r4)
+
+
+def test_preemption_respects_volume_zone():
+    """Preemption must not evict victims on nodes where the preemptor's
+    volume can never attach (review r5)."""
+    cache = SchedulerCache()
+    na = make_node("na", cpu_milli=1000, mem=2**30)
+    na.labels[LABEL_ZONE_FAILURE_DOMAIN] = "us-a"
+    nb = make_node("nb", cpu_milli=1000, mem=2**30)
+    nb.labels[LABEL_ZONE_FAILURE_DOMAIN] = "us-b"
+    cache.add_node(na)
+    cache.add_node(nb)
+    for node in ("na", "nb"):
+        filler = make_pod(f"fill-{node}", cpu_milli=900, mem=0)
+        filler.node_name = node
+        filler.priority = 0
+        cache.add_pod(filler)
+    pvcs = {("default", "c"): PersistentVolumeClaim(name="c", volume_name="pv")}
+    pvs = {"pv": PersistentVolume(name="pv", labels={LABEL_ZONE_FAILURE_DOMAIN: "us-a"})}
+    checker = make_volume_checker(lambda ns, n: pvcs.get((ns, n)), lambda n: pvs.get(n))
+    deleted = []
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(), volume_checker=checker,
+        deterministic=True, delete_fn=lambda p: deleted.append(p.node_name),
+    )
+    preemptor = _vol_pod("pre", Volume(pvc_claim_name="c"))
+    preemptor.priority = 100
+    preemptor.containers[0].requests = dict(
+        make_pod("tmp", cpu_milli=500, mem=0).containers[0].requests)
+    sched.queue.add(preemptor)
+    res = sched.schedule_batch()
+    # the only viable preemption target is the us-a node
+    assert res.preempted == 1
+    assert deleted == ["na"]
+
+
+def test_driver_volume_binder_lifecycle():
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=4000, mem=8 * 2**30))
+    pvcs = {("default", "c"): PersistentVolumeClaim(name="c", storage_class_name="std")}
+    the_pv = PersistentVolume(name="pv1", storage_class_name="std")
+    bound = []
+    vb = VolumeBinder(
+        lambda ns, n: pvcs.get((ns, n)), lambda n: None,
+        all_pvs=lambda: [the_pv],
+        bind_fn=lambda ns, claim, pv: bound.append((ns, claim, pv)),
+    )
+    checker = make_volume_checker(
+        lambda ns, n: pvcs.get((ns, n)), lambda n: None, binder=vb)
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        volume_checker=checker, volume_binder=vb,
+        deterministic=True, enable_preemption=False,
+    )
+    sched.queue.add(_vol_pod("vp", Volume(pvc_claim_name="c")))
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 1
+    assert bound == [("default", "c", "pv1")]
+    assert vb.assumed_pv_count() == 1  # pv stays claimed until informer confirms
+
+
+def test_volume_json_round_trip():
+    pod = pod_from_k8s({
+        "metadata": {"name": "p"},
+        "spec": {
+            "containers": [{"name": "c"}],
+            "volumes": [
+                {"name": "data", "persistentVolumeClaim": {"claimName": "c1"}},
+                {"name": "pd", "gcePersistentDisk": {"pdName": "disk-1", "readOnly": True}},
+                {"name": "scratch", "emptyDir": {}},
+            ],
+        },
+    })
+    assert pod.volumes[0].pvc_claim_name == "c1"
+    assert pod.volumes[1].gce_pd_name == "disk-1" and pod.volumes[1].gce_pd_read_only
+    assert pod.volumes[2].name == "scratch" and not pod.volumes[2].pvc_claim_name
+    back = pod_to_k8s(pod)
+    vols = back["spec"]["volumes"]
+    assert vols[0]["persistentVolumeClaim"]["claimName"] == "c1"
+    assert vols[1]["gcePersistentDisk"] == {"pdName": "disk-1", "readOnly": True}
