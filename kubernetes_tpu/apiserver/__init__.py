@@ -1,0 +1,26 @@
+"""Simulated API server: ObjectTracker-style store, resourceVersion watch
+streams with 410-compaction, pods/binding subresource."""
+
+from .store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    FakeAPIServer,
+    GoneError,
+    NotFoundError,
+    Watcher,
+    WatchEvent,
+)
+
+__all__ = [
+    "ADDED",
+    "DELETED",
+    "MODIFIED",
+    "ConflictError",
+    "FakeAPIServer",
+    "GoneError",
+    "NotFoundError",
+    "Watcher",
+    "WatchEvent",
+]

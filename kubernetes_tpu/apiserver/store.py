@@ -1,0 +1,210 @@
+"""Simulated API server: resourceVersion-ordered store + watch fan-out.
+
+Plays the role the real control plane plays for the scheduler (SURVEY §3.3
+/ §3.4): an ObjectTracker-style store (client-go testing.ObjectTracker is
+what the reference's fake clientset is backed by) with
+
+* a single monotonically-increasing resourceVersion (etcd revision
+  semantics: one global sequence, etcd3/store.go:239 CAS txns),
+* watch streams per kind with a bounded replay window — watchers starting
+  below the window get 410 Gone and must relist, exactly the
+  Reflector.ListAndWatch contract (reflector.go:184, relist-on-410),
+* the pods/binding subresource (what the scheduler's bind POSTs,
+  factory.go:718) and pod status patches,
+* deep copies on every write AND read: shared-object mutation by a client
+  is the bug class client-go's mutation detector exists for
+  (cache/mutation_detector.go) — copying at the boundary makes it
+  impossible by construction.
+
+In-process only: the transport is a queue, not HTTP — the wire format is
+the typed api.types objects (their JSON round-trip lives with them).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+HISTORY_WINDOW = 2048  # events kept per kind before compaction → 410
+
+
+class GoneError(Exception):
+    """HTTP 410: requested resourceVersion compacted away — relist."""
+
+
+class ConflictError(Exception):
+    """HTTP 409: resourceVersion precondition failed."""
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    obj: Any
+    rv: int
+
+
+def _key_of(obj: Any) -> str:
+    k = getattr(obj, "key", None)
+    if callable(k):
+        return k()
+    return obj.name
+
+
+class Watcher:
+    """One watch stream: a queue of WatchEvents; close() ends it."""
+
+    def __init__(self):
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self.closed = False
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            ev = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        return ev
+
+    def _push(self, ev: Optional[WatchEvent]) -> None:
+        self._q.put(ev)
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._q.put(None)
+
+
+class FakeAPIServer:
+    def __init__(self, history_window: int = HISTORY_WINDOW):
+        self._lock = threading.Lock()
+        self._rv = itertools.count(1)
+        self._objects: Dict[str, Dict[str, Any]] = {}
+        self._history: Dict[str, Deque[WatchEvent]] = {}
+        self._watchers: Dict[str, List[Watcher]] = {}
+        self._history_window = history_window
+        self._current_rv = 0
+
+    # -- internals -----------------------------------------------------------
+
+    def _bump(self) -> int:
+        self._current_rv = next(self._rv)
+        return self._current_rv
+
+    def _emit(self, kind: str, type_: str, obj: Any, rv: int) -> None:
+        ev = WatchEvent(type_, obj, rv)
+        hist = self._history.setdefault(kind, deque(maxlen=self._history_window))
+        hist.append(ev)
+        # prune watchers closed by their consumers (reflector restarts would
+        # otherwise leak one dead Watcher per relist)
+        live = [w for w in self._watchers.get(kind, []) if not w.closed]
+        self._watchers[kind] = live
+        for w in live:
+            w._push(WatchEvent(type_, copy.deepcopy(obj), rv))
+
+    # -- REST surface ---------------------------------------------------------
+
+    def create(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = _key_of(obj)
+            if key in objs:
+                raise ConflictError(f"{kind} {key} already exists")
+            stored = copy.deepcopy(obj)
+            stored.resource_version = str(self._bump())
+            objs[key] = stored
+            self._emit(kind, ADDED, copy.deepcopy(stored), self._current_rv)
+            return copy.deepcopy(stored)
+
+    def update(self, kind: str, obj: Any, check_rv: bool = False) -> Any:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = _key_of(obj)
+            if key not in objs:
+                raise NotFoundError(key)
+            if check_rv and obj.resource_version != objs[key].resource_version:
+                raise ConflictError(f"{kind} {key}: resourceVersion mismatch")
+            stored = copy.deepcopy(obj)
+            stored.resource_version = str(self._bump())
+            objs[key] = stored
+            self._emit(kind, MODIFIED, copy.deepcopy(stored), self._current_rv)
+            return copy.deepcopy(stored)
+
+    def delete(self, kind: str, key: str) -> None:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            if key not in objs:
+                raise NotFoundError(key)
+            obj = objs.pop(key)
+            self._emit(kind, DELETED, copy.deepcopy(obj), self._bump())
+
+    def get(self, kind: str, key: str) -> Any:
+        with self._lock:
+            obj = self._objects.get(kind, {}).get(key)
+            if obj is None:
+                raise NotFoundError(key)
+            return copy.deepcopy(obj)
+
+    def list(self, kind: str) -> Tuple[List[Any], int]:
+        """→ (deep-copied items, list resourceVersion)."""
+        with self._lock:
+            items = [copy.deepcopy(o) for o in self._objects.get(kind, {}).values()]
+            return items, self._current_rv
+
+    def watch(self, kind: str, since_rv: int) -> Watcher:
+        """Watch from since_rv (exclusive). 410 when compacted below it."""
+        with self._lock:
+            hist = self._history.setdefault(kind, deque(maxlen=self._history_window))
+            if hist and since_rv < hist[0].rv - 1 and since_rv < self._oldest_live_rv(kind):
+                raise GoneError(f"resourceVersion {since_rv} compacted")
+            w = Watcher()
+            for ev in hist:
+                if ev.rv > since_rv:
+                    w._push(WatchEvent(ev.type, copy.deepcopy(ev.obj), ev.rv))
+            self._watchers.setdefault(kind, []).append(w)
+            return w
+
+    def _oldest_live_rv(self, kind: str) -> int:
+        hist = self._history.get(kind)
+        if not hist or len(hist) < self._history_window:
+            return 0  # nothing compacted yet
+        return hist[0].rv
+
+    def close_watchers(self, kind: Optional[str] = None) -> None:
+        """Drop watch connections (tests simulate apiserver restarts)."""
+        with self._lock:
+            kinds = [kind] if kind else list(self._watchers)
+            for k in kinds:
+                for w in self._watchers.get(k, []):
+                    w.close()
+                self._watchers[k] = []
+
+    # -- scheduler-facing subresources ----------------------------------------
+
+    def bind(self, namespace: str, name: str, node_name: str) -> None:
+        """POST pods/<p>/binding: sets spec.nodeName (registry/core/pod/rest
+        BindingREST semantics — fails if already bound elsewhere)."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pods = self._objects.setdefault("pods", {})
+            pod = pods.get(key)
+            if pod is None:
+                raise NotFoundError(key)
+            if pod.node_name and pod.node_name != node_name:
+                raise ConflictError(f"pod {key} already bound to {pod.node_name}")
+            pod = copy.deepcopy(pod)
+            pod.node_name = node_name
+            pod.resource_version = str(self._bump())
+            pods[key] = pod
+            self._emit("pods", MODIFIED, copy.deepcopy(pod), self._current_rv)

@@ -1,0 +1,5 @@
+"""client-go equivalent: reflector/informer machinery + the API binder."""
+
+from .informer import APIBinder, Informer, start_scheduler_informers
+
+__all__ = ["APIBinder", "Informer", "start_scheduler_informers"]

@@ -1,0 +1,18 @@
+"""Scheduler metrics (pkg/scheduler/metrics) on a component-base-style
+registry with Prometheus text exposition + /metrics+/healthz serving."""
+
+from . import metrics
+from .metrics import registry, timed
+from .registry import Counter, Gauge, Histogram, Registry
+from .serving import MetricsServer
+
+__all__ = [
+    "metrics",
+    "registry",
+    "timed",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "MetricsServer",
+]

@@ -1,0 +1,117 @@
+"""The scheduler's metric series (pkg/scheduler/metrics/metrics.go:91-233).
+
+Same names and semantics where the concept maps 1:1; batch-specific series
+(batch size, device-phase splits) are additions the reference cannot have.
+All registered on a module-level registry (legacyregistry pattern,
+metrics.go:23-24) that serving exposes at /metrics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .registry import Counter, Gauge, Histogram, Registry
+
+registry = Registry()
+
+_DURATION_BUCKETS = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0)
+
+# result labels for schedule_attempts_total (metrics.go:41-47)
+SCHEDULED = "scheduled"
+UNSCHEDULABLE = "unschedulable"
+ERROR = "error"
+
+e2e_scheduling_duration = registry.register(Histogram(
+    "scheduler_e2e_scheduling_duration_seconds",
+    "E2e scheduling latency per pod (scheduling algorithm + binding)",
+    buckets=_DURATION_BUCKETS,
+))
+scheduling_algorithm_duration = registry.register(Histogram(
+    "scheduler_scheduling_algorithm_duration_seconds",
+    "Scheduling algorithm latency (device solve + commit decisions)",
+    buckets=_DURATION_BUCKETS,
+))
+binding_duration = registry.register(Histogram(
+    "scheduler_binding_duration_seconds",
+    "Binding latency",
+    buckets=_DURATION_BUCKETS,
+))
+predicate_evaluation_duration = registry.register(Histogram(
+    "scheduler_scheduling_algorithm_predicate_evaluation_seconds",
+    "Predicate (Filter mask) evaluation latency per batch",
+    buckets=_DURATION_BUCKETS,
+))
+priority_evaluation_duration = registry.register(Histogram(
+    "scheduler_scheduling_algorithm_priority_evaluation_seconds",
+    "Priority (Score matrix) evaluation latency per batch",
+    buckets=_DURATION_BUCKETS,
+))
+preemption_evaluation_duration = registry.register(Histogram(
+    "scheduler_scheduling_algorithm_preemption_evaluation_seconds",
+    "Preemption evaluation latency",
+    buckets=_DURATION_BUCKETS,
+))
+schedule_attempts = registry.register(Counter(
+    "scheduler_schedule_attempts_total",
+    "Scheduling attempts by result (scheduled|unschedulable|error)",
+    label_names=("result",),
+))
+preemption_victims = registry.register(Histogram(
+    "scheduler_preemption_victims",
+    "Number of victims selected per preemption",
+    buckets=(1, 2, 4, 8, 16, 32, 64),
+))
+preemption_attempts = registry.register(Counter(
+    "scheduler_preemption_attempts_total",
+    "Total preemption attempts",
+))
+pending_pods = registry.register(Gauge(
+    "scheduler_pending_pods",
+    "Pending pods by queue (active|backoff|unschedulable)",
+    label_names=("queue",),
+))
+pod_scheduling_duration = registry.register(Histogram(
+    "scheduler_pod_scheduling_duration_seconds",
+    "Time from first attempt to successful scheduling per pod",
+    buckets=_DURATION_BUCKETS,
+))
+pod_scheduling_attempts = registry.register(Histogram(
+    "scheduler_pod_scheduling_attempts",
+    "Attempts needed to schedule a pod",
+    buckets=(1, 2, 4, 8, 16),
+))
+# batch-native additions (no reference counterpart)
+batch_size = registry.register(Histogram(
+    "scheduler_batch_size_pods",
+    "Pods per device-solve batch",
+    buckets=(1, 8, 32, 128, 512, 2048, 8192),
+))
+device_solve_duration = registry.register(Histogram(
+    "scheduler_device_solve_duration_seconds",
+    "Fused mask+score+assign device program latency per batch",
+    buckets=_DURATION_BUCKETS,
+))
+tensor_sync_duration = registry.register(Histogram(
+    "scheduler_tensor_sync_duration_seconds",
+    "Dirty-row tensor mirror patch latency per batch",
+    buckets=_DURATION_BUCKETS,
+))
+
+
+class _Timer:
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
+def timed(hist: Histogram) -> _Timer:
+    return _Timer(hist)

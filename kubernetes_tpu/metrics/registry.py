@@ -1,0 +1,179 @@
+"""Minimal Prometheus-compatible metrics: the component-base/metrics +
+legacyregistry subset the scheduler uses (SURVEY §2.2 component-base row;
+pkg/scheduler/metrics/metrics.go imports component-base/metrics).
+
+Counter / Gauge / Histogram with label support and text exposition
+(text/plain; version=0.0.4) so a real Prometheus can scrape /metrics.
+Thread-safe; lock granularity is per-metric.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(names: Sequence[str], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+
+    def expose(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, *labels: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + by
+
+    def value(self, *labels: str) -> float:
+        with self._lock:
+            return self._values.get(labels, 0.0)
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for labels, v in items:
+            out.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_, label_names=()):
+        super().__init__(name, help_, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def set(self, value: float, *labels: str) -> None:
+        with self._lock:
+            self._values[labels] = float(value)
+
+    def add(self, delta: float, *labels: str) -> None:
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + delta
+
+    def value(self, *labels: str) -> float:
+        with self._lock:
+            return self._values.get(labels, 0.0)
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            items = sorted(self._values.items())
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for labels, v in items:
+            out.append(f"{self.name}{_fmt_labels(self.label_names, labels)} {v}")
+        return out
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, label_names=(), buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, *labels: str) -> None:
+        with self._lock:
+            counts = self._counts.setdefault(labels, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[labels] = self._sums.get(labels, 0.0) + value
+            self._totals[labels] = self._totals.get(labels, 0) + 1
+
+    def count(self, *labels: str) -> int:
+        with self._lock:
+            return self._totals.get(labels, 0)
+
+    def sum(self, *labels: str) -> float:
+        with self._lock:
+            return self._sums.get(labels, 0.0)
+
+    def percentile(self, q: float, *labels: str) -> float:
+        """Approximate quantile from bucket boundaries (upper bound of the
+        bucket holding the q-th observation)."""
+        with self._lock:
+            counts = self._counts.get(labels)
+            total = self._totals.get(labels, 0)
+        if not counts or total == 0:
+            return 0.0
+        target = q * total
+        for i, b in enumerate(self.buckets):
+            if counts[i] >= target:
+                return b
+        return float("inf")
+
+    def expose(self) -> List[str]:
+        with self._lock:
+            keys = sorted(self._counts)
+            snap = {k: (list(self._counts[k]), self._sums[k], self._totals[k]) for k in keys}
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        if not snap and not self.label_names:
+            snap = {(): ([0] * len(self.buckets), 0.0, 0)}
+        for labels, (counts, sum_, total) in snap.items():
+            for i, b in enumerate(self.buckets):
+                lbl = _fmt_labels(self.label_names + ("le",), labels + (repr(b),))
+                out.append(f"{self.name}_bucket{lbl} {counts[i]}")
+            lbl_inf = _fmt_labels(self.label_names + ("le",), labels + ("+Inf",))
+            out.append(f"{self.name}_bucket{lbl_inf} {total}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, labels)} {sum_}")
+            out.append(f"{self.name}_count{_fmt_labels(self.label_names, labels)} {total}")
+        return out
+
+
+class Registry:
+    """legacyregistry equivalent: register + text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"metric {metric.name} already registered")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def expose_text(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,81 @@
+"""Serving mux: /metrics, /healthz, /readyz (cmd/kube-scheduler/app/
+server.go:287-333 newMetricsHandler / newHealthzHandler).
+
+Prometheus scrapes /metrics (text exposition from the module registry);
+healthz answers 200 once the scheduler reports healthy. Runs on a daemon
+thread like the extender server.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Tuple
+
+from .metrics import registry as default_registry
+
+
+class MetricsServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        registry=None,
+        healthy_fn: Optional[Callable[[], bool]] = None,
+    ):
+        self.registry = registry or default_registry
+        self.healthy_fn = healthy_fn or (lambda: True)
+        self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        h, p = self.address
+        return f"http://{h}:{p}"
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *a):
+                pass
+
+            def _send(self, body: bytes, code: int = 200, ctype: str = "text/plain") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0].rstrip("/")
+                if path == "/metrics":
+                    self._send(
+                        server.registry.expose_text().encode(),
+                        ctype="text/plain; version=0.0.4",
+                    )
+                elif path in ("/healthz", "/readyz", "/livez"):
+                    if server.healthy_fn():
+                        self._send(b"ok")
+                    else:
+                        self._send(b"unhealthy", code=500)
+                else:
+                    self._send(b"not found", code=404)
+
+        return Handler

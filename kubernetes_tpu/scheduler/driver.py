@@ -39,6 +39,8 @@ from ..state.cache import SchedulerCache, TensorMirror
 from ..state.queue import PodInfo, PriorityQueue
 from ..state.tensors import KeySlotOverflow, PodBatch, _bucket
 from ..state.terms import compile_batch_terms
+from ..metrics import metrics as M
+from ..utils.trace import Trace
 from ..volume.predicates import scheduling_relevant_volumes
 from . import preemption as preemption_mod
 from .preemption import fits_considering_nominated, fits_with_nominees
@@ -443,6 +445,7 @@ class Scheduler:
         """Second half: submit the async permit → prebind → bind → postbind
         pipeline (scheduler.go:631-743)."""
         pod = info.pod
+        t_decided = time.perf_counter()
 
         def bind_async():
             if self.volume_binder is not None:
@@ -468,6 +471,7 @@ class Scheduler:
                 ),
                 None,
             )
+            t_bind = time.perf_counter()
             try:
                 if ext_b is not None:
                     # extender-delegated binding (scheduler_interface.go:53,
@@ -481,6 +485,15 @@ class Scheduler:
             except Exception as e:  # bind RPC failed → forget + requeue
                 self._unbind(info, assumed, node_name, state, cycle, f"bind: {e}")
                 return
+            now = time.perf_counter()
+            M.binding_duration.observe(now - t_bind)
+            # e2e for this attempt: decision → bound (metrics.go
+            # E2eSchedulingLatency = algorithm + binding)
+            M.e2e_scheduling_duration.observe(now - t_decided)
+            M.pod_scheduling_attempts.observe(info.attempts)
+            # queue-add → bound (PodSchedulingDuration), measured on the
+            # queue's own clock (it is injectable in tests)
+            M.pod_scheduling_duration.observe(max(self.queue.age(info), 0.0))
             self.cache.finish_binding(assumed)
             self.framework.run_post_bind(state, pod, node_name)
             self.event_fn(pod, "Scheduled", f"bound to {node_name}")
@@ -517,6 +530,8 @@ class Scheduler:
         obsolete lower-priority nominations. Runs BEFORE the failed pod is
         re-queued so the queue's nominated index sees the nomination."""
         pod = info.pod
+        M.preemption_attempts.inc()
+        t0 = time.perf_counter()
         node, victims, clear = preemption_mod.preempt(
             pod,
             self.cache.snapshot,
@@ -534,8 +549,10 @@ class Scheduler:
                 else None
             ),
         )
+        M.preemption_evaluation_duration.observe(time.perf_counter() - t0)
         if node is None:
             return False
+        M.preemption_victims.observe(len(victims))
         # extenders with a preemption verb get to veto/trim the victim set
         # (processPreemptionWithExtenders, core/generic_scheduler.go:323-345;
         # simplification: consulted on the chosen candidate rather than the
@@ -592,17 +609,31 @@ class Scheduler:
             infos.extend(self.queue.pop_all_in_groups(groups_in_batch, pod_group_name))
         cycle = self.queue.scheduling_cycle()
         self.stats["batches"] += 1
+        M.batch_size.observe(len(infos))
+        trace = Trace("schedule_batch", pods=len(infos), cycle=cycle)
         t_sync = time.perf_counter()
         self.mirror.sync()
-        self.stats["sync_s"] += time.perf_counter() - t_sync
+        dt_sync = time.perf_counter() - t_sync
+        self.stats["sync_s"] += dt_sync
+        M.tensor_sync_duration.observe(dt_sync)
+        trace.step("tensor mirror sync")
         try:
+            t_solve = time.perf_counter()
             out = self._device_solve(infos)
+            dt_solve = time.perf_counter() - t_solve
+            M.device_solve_duration.observe(dt_solve)
+            # the mask and score stages are ONE fused program — both series
+            # observe the same dispatch (split is meaningless under fusion)
+            M.predicate_evaluation_duration.observe(dt_solve)
+            M.priority_evaluation_duration.observe(dt_solve)
+            trace.step("device solve (mask+score+assign)")
         except Exception as e:
             for info in infos:
                 res.errors += 1
                 if self.error_fn:
                     self.error_fn(info.pod, e)
                 self._fail(info, cycle, f"solve error: {e}")
+            M.schedule_attempts.inc(M.ERROR, by=len(infos))
             return res
 
         nominated_fn = self.queue.nominated_pods_for_node
@@ -827,6 +858,17 @@ class Scheduler:
                 res.scheduled += 1
                 res.assignments[s_info.pod.key()] = s_node
         self.stats["commit_s"] += time.perf_counter() - t_commit
+        trace.step("commit loop")
+        M.scheduling_algorithm_duration.observe(trace.total_seconds())
+        M.schedule_attempts.inc(M.SCHEDULED, by=res.scheduled)
+        M.schedule_attempts.inc(M.UNSCHEDULABLE, by=res.unschedulable)
+        active, backoff, unsched = self.queue.counts()
+        M.pending_pods.set(active, "active")
+        M.pending_pods.set(backoff, "backoff")
+        M.pending_pods.set(unsched, "unschedulable")
+        # the reference's 100ms slow-cycle contract (LogIfLong,
+        # generic_scheduler.go:175-176) — per batch here
+        trace.log_if_long()
         return res
 
     def run_until_empty(self, max_cycles: int = 1000) -> ScheduleResult:

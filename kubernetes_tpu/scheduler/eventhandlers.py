@@ -48,12 +48,22 @@ class EventHandlers:
             self.queue.add(pod)
 
     def on_pod_update(self, old: Pod, new: Pod) -> None:
-        if self._skip_pod_update(old, new):
-            return
+        """The reference registers TWO filtered informers (eventhandlers.go:
+        380-430): assigned pods feed the cache, pending ones the queue. An
+        unassigned→assigned transition (our own bind echo) therefore arrives
+        at the cache side as an ADD — which is what confirms the assumed
+        pod (cache.go AddPod) — and leaves the queue side as a delete.
+        skipPodUpdate (:336) guards only the QUEUE path."""
         if _assigned(new):
-            self.cache.update_pod(old, new)
+            if _assigned(old):
+                self.cache.update_pod(old, new)
+            else:
+                self.cache.add_pod(new)  # bind echo: confirm the assume
+                self.queue.delete(new)
             self.queue.move_all_to_active()
         elif _responsible(new, self.scheduler_name):
+            if self._skip_pod_update(old, new):
+                return
             self.queue.update(old, new)
 
     def on_pod_delete(self, pod: Pod) -> None:

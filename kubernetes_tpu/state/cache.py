@@ -154,6 +154,11 @@ class SchedulerCache:
         with self._lock:
             return key in self._assumed
 
+    def assumed_count(self) -> int:
+        """Pods assumed but not yet confirmed by the informer echo."""
+        with self._lock:
+            return len(self._assumed)
+
     def cleanup_expired(self) -> List[Pod]:
         """cleanupAssumedPods (cache.go:658): drop assumed pods whose bind
         confirmation never arrived within TTL (self-healing after lost

@@ -243,6 +243,11 @@ class PriorityQueue:
             self._remove_nominated(key)
             self._active = [e for e in self._active if e.key != key]
             heapq.heapify(self._active)
+            # purge the backoff heap too: stale entries would otherwise be
+            # counted by counts() (pending_pods gauge) until expiry
+            if any(k == key for _, _, k in self._backoff):
+                self._backoff = [t for t in self._backoff if t[2] != key]
+                heapq.heapify(self._backoff)
 
     def update(self, old: Pod, new: Pod) -> None:
         with self._lock:
@@ -303,3 +308,13 @@ class PriorityQueue:
     def pending_count(self) -> int:
         with self._lock:
             return len(self._active) + len(self._backoff) + len(self._unschedulable)
+
+    def age(self, info: PodInfo) -> float:
+        """Seconds since the pod was (re-)queued, on THIS queue's clock —
+        callers must not mix their own clock with info.timestamp."""
+        return self._now() - info.timestamp
+
+    def counts(self) -> Tuple[int, int, int]:
+        """(active, backoff, unschedulable) — the pending_pods gauge split."""
+        with self._lock:
+            return len(self._active), len(self._backoff), len(self._unschedulable)

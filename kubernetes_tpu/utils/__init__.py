@@ -1,0 +1,14 @@
+"""Cross-cutting utilities: tracing (utiltrace), event recording
+(client-go tools/events subset)."""
+
+from .events import EVENT_TYPE_NORMAL, EVENT_TYPE_WARNING, Event, Recorder
+from .trace import SLOW_CYCLE_THRESHOLD_S, Trace
+
+__all__ = [
+    "EVENT_TYPE_NORMAL",
+    "EVENT_TYPE_WARNING",
+    "Event",
+    "Recorder",
+    "SLOW_CYCLE_THRESHOLD_S",
+    "Trace",
+]

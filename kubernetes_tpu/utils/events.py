@@ -1,0 +1,78 @@
+"""Event recorder (client-go tools/events subset).
+
+The scheduler emits Scheduled / FailedScheduling / Preempted / Nominated
+events attached to pods (recordSchedulingFailure, scheduler.go:419-435).
+This recorder keeps a bounded in-memory log, de-duplicates into per-key
+counts like the events API's series aggregation, and fans out to sinks
+(e.g. the fake apiserver's event store).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    reason: str
+    message: str
+    type: str = EVENT_TYPE_NORMAL
+    object_key: str = ""  # namespace/name of the involved object
+    count: int = 1
+    first_timestamp: float = field(default_factory=time.time)
+    last_timestamp: float = field(default_factory=time.time)
+
+
+class Recorder:
+    def __init__(self, capacity: int = 4096, sink: Optional[Callable[[Event], None]] = None):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._events: Deque[Event] = deque()
+        self._series: Dict[tuple, Event] = {}
+        self.sink = sink
+
+    def event(self, object_key: str, reason: str, message: str, type_: str = EVENT_TYPE_NORMAL) -> None:
+        with self._lock:
+            key = (object_key, reason, type_)
+            ev = self._series.get(key)
+            if ev is not None and ev.message == message:
+                ev.count += 1
+                ev.last_timestamp = time.time()
+            else:
+                ev = Event(reason=reason, message=message, type=type_, object_key=object_key)
+                self._series[key] = ev
+                self._events.append(ev)
+                # bound BOTH structures: evicting from the ring must drop the
+                # series entry too, or memory grows with every unique pod
+                while len(self._events) > self._capacity:
+                    old = self._events.popleft()
+                    okey = (old.object_key, old.reason, old.type)
+                    if self._series.get(okey) is old:
+                        del self._series[okey]
+        if self.sink is not None:
+            self.sink(ev)
+
+    def pod_event_fn(self):
+        """Adapter matching the Scheduler's event_fn(pod, reason, msg)."""
+        warning_reasons = {"FailedScheduling", "Preempted"}
+
+        def fn(pod, reason: str, message: str) -> None:
+            self.event(
+                pod.key(),
+                reason,
+                message,
+                EVENT_TYPE_WARNING if reason in warning_reasons else EVENT_TYPE_NORMAL,
+            )
+
+        return fn
+
+    def events(self, object_key: Optional[str] = None) -> List[Event]:
+        with self._lock:
+            return [e for e in self._events if object_key is None or e.object_key == object_key]

@@ -1,0 +1,46 @@
+"""utiltrace equivalent (vendor/k8s.io/utils/trace/trace.go:55-120).
+
+In-process step timers logged only when the total exceeds a threshold —
+the reference wraps every scheduling cycle in one with a 100ms contract
+(generic_scheduler.go:175-176 LogIfLong). Same here, around the batch
+cycle.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("kubernetes_tpu.trace")
+
+SLOW_CYCLE_THRESHOLD_S = 0.100  # the reference's 100ms LogIfLong contract
+
+
+class Trace:
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.perf_counter()
+        self.steps: List[Tuple[float, str]] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.perf_counter(), msg))
+
+    def total_seconds(self) -> float:
+        return time.perf_counter() - self.start
+
+    def log_if_long(self, threshold_s: float = SLOW_CYCLE_THRESHOLD_S) -> bool:
+        """Emit the step breakdown when the trace exceeded the threshold.
+        Returns True when it logged (tests hook the logger)."""
+        total = self.total_seconds()
+        if total < threshold_s:
+            return False
+        fields = " ".join(f"{k}={v}" for k, v in self.fields.items())
+        lines = [f'Trace "{self.name}" {fields} (total {total * 1000:.1f}ms):']
+        prev = self.start
+        for t, msg in self.steps:
+            lines.append(f"  +{(t - prev) * 1000:.1f}ms {msg}")
+            prev = t
+        logger.warning("\n".join(lines))
+        return True

@@ -1,0 +1,217 @@
+"""Simulated apiserver + list/watch informers + end-to-end churn.
+
+The integration-test tier (SURVEY §4 tier 2): in-process apiserver, real
+informer threads, the scheduler consuming only watch events and writing
+only Bindings — while nodes and pods churn.
+"""
+
+import threading
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.apiserver import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ConflictError,
+    FakeAPIServer,
+    GoneError,
+)
+from kubernetes_tpu.client import APIBinder, Informer, start_scheduler_informers
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.scheduler.eventhandlers import EventHandlers
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+
+# --- store semantics --------------------------------------------------------
+
+def test_store_rv_ordering_and_watch():
+    api = FakeAPIServer()
+    n = api.create("nodes", make_node("n0", cpu_milli=1000, mem=2**30))
+    rv0 = int(n.resource_version)
+    w = api.watch("nodes", 0)
+    ev = w.next(timeout=1)
+    assert ev.type == ADDED and ev.obj.name == "n0" and ev.rv == rv0
+    n.labels["x"] = "y"
+    api.update("nodes", n)
+    ev = w.next(timeout=1)
+    assert ev.type == MODIFIED and ev.obj.labels["x"] == "y"
+    api.delete("nodes", "n0")
+    ev = w.next(timeout=1)
+    assert ev.type == DELETED
+    w.close()
+
+
+def test_store_deep_copies_block_mutation():
+    api = FakeAPIServer()
+    node = make_node("n0", cpu_milli=1000, mem=2**30)
+    api.create("nodes", node)
+    node.labels["mutated"] = "yes"  # caller keeps mutating its object
+    got = api.get("nodes", "n0")
+    assert "mutated" not in got.labels
+    got.labels["also-mutated"] = "yes"
+    assert "also-mutated" not in api.get("nodes", "n0").labels
+
+
+def test_store_watch_compaction_gone():
+    api = FakeAPIServer(history_window=4)
+    for i in range(10):
+        api.create("pods", make_pod(f"p{i}", cpu_milli=1, mem=0))
+    with pytest.raises(GoneError):
+        api.watch("pods", 1)
+
+
+def test_bind_subresource_conflicts():
+    api = FakeAPIServer()
+    api.create("pods", make_pod("p0", cpu_milli=1, mem=0))
+    api.bind("default", "p0", "n1")
+    assert api.get("pods", "default/p0").node_name == "n1"
+    api.bind("default", "p0", "n1")  # idempotent re-bind to same node ok
+    with pytest.raises(ConflictError):
+        api.bind("default", "p0", "n2")
+
+
+# --- informer ---------------------------------------------------------------
+
+def test_informer_sync_watch_and_relist():
+    api = FakeAPIServer(history_window=8)
+    for i in range(3):
+        api.create("nodes", make_node(f"n{i}", cpu_milli=1000, mem=2**30))
+    seen = {"add": [], "update": [], "delete": []}
+    inf = Informer(api, "nodes")
+    inf.add_event_handler(
+        on_add=lambda o: seen["add"].append(o.name),
+        on_update=lambda o, n: seen["update"].append(n.name),
+        on_delete=lambda o: seen["delete"].append(o.name),
+    )
+    inf.start()
+    assert inf.wait_for_sync()
+    deadline = time.time() + 5
+    while len(seen["add"]) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert sorted(seen["add"]) == ["n0", "n1", "n2"]
+    api.create("nodes", make_node("n3", cpu_milli=1000, mem=2**30))
+    n1 = api.get("nodes", "n1")
+    n1.labels["updated"] = "true"
+    api.update("nodes", n1)
+    api.delete("nodes", "n0")
+    deadline = time.time() + 5
+    while (len(seen["add"]) < 4 or not seen["update"] or not seen["delete"]) and time.time() < deadline:
+        time.sleep(0.01)
+    assert "n3" in seen["add"] and "n1" in seen["update"] and "n0" in seen["delete"]
+    # simulate apiserver dropping the watch: the informer must relist
+    before = inf.relist_count
+    api.close_watchers("nodes")
+    deadline = time.time() + 5
+    while inf.relist_count == before and time.time() < deadline:
+        time.sleep(0.01)
+    assert inf.relist_count > before
+    assert {o.name for o in inf.list()} == {"n1", "n2", "n3"}
+    inf.stop()
+
+
+# --- full loop: watch → schedule → bind → confirm ---------------------------
+
+def _spin_up(api, scheduler_name="default-scheduler"):
+    cache = SchedulerCache()
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=Binder(APIBinder(api).bind),
+        deterministic=True, enable_preemption=False,
+    )
+    handlers = EventHandlers(cache, queue, scheduler_name)
+    informers = start_scheduler_informers(api, handlers)
+    for inf in informers.values():
+        assert inf.wait_for_sync()
+    return sched, informers
+
+
+def test_end_to_end_watch_schedule_bind_confirm():
+    api = FakeAPIServer()
+    for i in range(4):
+        api.create("nodes", make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30))
+    for i in range(10):
+        api.create("pods", make_pod(f"p{i}", cpu_milli=500, mem=0))
+    sched, informers = _spin_up(api)
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            sched.schedule_batch()
+            bound = sum(1 for p, _ in [(p, p) for p in api.list("pods")[0]] if p.node_name)
+            if bound == 10:
+                break
+            time.sleep(0.02)
+        sched.wait_for_binds()
+        pods, _ = api.list("pods")
+        assert all(p.node_name for p in pods), [p.name for p in pods if not p.node_name]
+        # the informer echo confirmed every assumed pod into the cache
+        deadline = time.time() + 5
+        while time.time() < deadline and sched.cache.assumed_count() > 0:
+            time.sleep(0.02)
+        assert sched.cache.assumed_count() == 0
+    finally:
+        for inf in informers.values():
+            inf.stop()
+
+
+def test_end_to_end_churn_while_scheduling():
+    """Stream node/pod churn while the scheduling loop runs — the
+    watch→patch→solve loop end-to-end under concurrency (VERDICT item 10)."""
+    api = FakeAPIServer()
+    for i in range(6):
+        api.create("nodes", make_node(f"n{i}", cpu_milli=8000, mem=16 * 2**30))
+    sched, informers = _spin_up(api)
+    stop = threading.Event()
+    created = []
+
+    def churn():
+        for i in range(60):
+            api.create("pods", make_pod(f"c{i}", cpu_milli=200, mem=0))
+            created.append(f"default/c{i}")
+            if i % 10 == 5:
+                api.create("nodes", make_node(f"extra{i}", cpu_milli=8000, mem=16 * 2**30))
+            if i % 15 == 7:
+                api.delete("nodes", f"n{i % 6}")
+            time.sleep(0.005)
+        stop.set()
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            sched.schedule_batch()
+            if stop.is_set():
+                pods, _ = api.list("pods")
+                if len(pods) == 60 and all(p.node_name for p in pods):
+                    break
+            time.sleep(0.01)
+        t.join()
+        sched.wait_for_binds()
+        # a couple more cycles for stragglers requeued by node deletions
+        for _ in range(50):
+            sched.queue.move_all_to_active()
+            sched.queue.flush()
+            sched.schedule_batch()
+            pods, _ = api.list("pods")
+            if all(p.node_name for p in pods):
+                break
+            time.sleep(0.05)
+        sched.wait_for_binds()
+        pods, _ = api.list("pods")
+        unbound = [p.name for p in pods if not p.node_name]
+        assert not unbound, f"unbound after churn: {unbound}"
+        # every binding refers to a node that exists (or existed when bound)
+        live_nodes = {n.name for n in api.list("nodes")[0]}
+        on_dead = [p.name for p in pods if p.node_name not in live_nodes]
+        # pods bound to deleted nodes are allowed transiently (the node
+        # lifecycle controller's business) but must be a small minority here
+        assert len(on_dead) <= 20
+    finally:
+        for inf in informers.values():
+            inf.stop()

@@ -1,0 +1,102 @@
+"""Metrics, events, healthz, tracing."""
+
+import json
+import logging
+import time
+import urllib.request
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.metrics import MetricsServer, metrics as M
+from kubernetes_tpu.metrics.registry import Counter, Gauge, Histogram, Registry
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+from kubernetes_tpu.utils import Recorder, Trace
+
+
+def test_registry_exposition_format():
+    r = Registry()
+    c = r.register(Counter("my_total", "a counter", label_names=("result",)))
+    g = r.register(Gauge("my_gauge", "a gauge"))
+    h = r.register(Histogram("my_seconds", "a histogram", buckets=(0.1, 1.0)))
+    c.inc("ok")
+    c.inc("ok")
+    c.inc("bad")
+    g.set(42)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = r.expose_text()
+    assert 'my_total{result="ok"} 2.0' in text
+    assert 'my_total{result="bad"} 1.0' in text
+    assert "my_gauge 42.0" in text
+    assert 'my_seconds_bucket{le="0.1"} 1' in text
+    assert 'my_seconds_bucket{le="1.0"} 2' in text
+    assert 'my_seconds_bucket{le="+Inf"} 3' in text
+    assert "my_seconds_count 3" in text
+    assert h.percentile(0.5) == 1.0
+
+
+def test_scheduler_records_metrics_and_events():
+    before_sched = M.schedule_attempts.value(M.SCHEDULED)
+    before_batches = M.batch_size.count()
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=2000, mem=4 * 2**30))
+    rec = Recorder()
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(), binder=Binder(),
+        event_fn=rec.pod_event_fn(), deterministic=True, enable_preemption=False,
+    )
+    for i in range(3):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=500, mem=0))
+    sched.queue.add(make_pod("toobig", cpu_milli=9999, mem=0))
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 3 and res.unschedulable == 1
+    assert M.schedule_attempts.value(M.SCHEDULED) == before_sched + 3
+    assert M.batch_size.count() == before_batches + 1
+    assert M.device_solve_duration.count() >= 1
+    # events: 3 Scheduled + 1 FailedScheduling
+    assert len(rec.events()) >= 4
+    reasons = {e.reason for e in rec.events()}
+    assert {"Scheduled", "FailedScheduling"} <= reasons
+    failed = [e for e in rec.events() if e.reason == "FailedScheduling"]
+    assert failed[0].type == "Warning"
+
+
+def test_metrics_server_scrape_and_healthz():
+    srv = MetricsServer().start()
+    try:
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=5) as r:
+            body = r.read().decode()
+            assert r.headers["Content-Type"].startswith("text/plain")
+        assert "scheduler_schedule_attempts_total" in body
+        assert "scheduler_e2e_scheduling_duration_seconds_bucket" in body
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=5) as r:
+            assert r.read() == b"ok"
+    finally:
+        srv.stop()
+
+
+def test_trace_logs_only_slow_cycles(caplog):
+    t = Trace("fast_op", pods=1)
+    t.step("a")
+    assert t.log_if_long(threshold_s=10.0) is False
+    slow = Trace("slow_op", pods=2)
+    time.sleep(0.01)
+    slow.step("phase one")
+    with caplog.at_level(logging.WARNING, logger="kubernetes_tpu.trace"):
+        assert slow.log_if_long(threshold_s=0.005) is True
+    assert "slow_op" in caplog.text and "phase one" in caplog.text
+
+
+def test_event_series_deduplication():
+    rec = Recorder()
+    for _ in range(5):
+        rec.event("default/p", "FailedScheduling", "no fit", "Warning")
+    evs = rec.events("default/p")
+    assert len(evs) == 1 and evs[0].count == 5
