@@ -1,0 +1,3 @@
+from .cmd import main
+
+raise SystemExit(main())

@@ -1,0 +1,186 @@
+"""CLI entry (cmd/kube-scheduler/app/server.go:64 NewSchedulerCommand).
+
+Flags mirror the reference's surface where the concept maps; two run
+modes replace the in-cluster deployment:
+
+  extender — serve the batch solver as an HTTP SchedulerExtender (+ the
+             /metrics//healthz mux): the production story for fronting an
+             unmodified kube-scheduler (BASELINE deployment).
+  sim      — kubemark-style self-contained run: fake apiserver, generated
+             cluster, informers, scheduling loop; prints a summary. The
+             integration smoke test of the full standalone stack.
+
+Usage:
+  python -m kubernetes_tpu --mode extender --port 10250
+  python -m kubernetes_tpu --mode sim --nodes 200 --pods 1000
+  python -m kubernetes_tpu --config cfg.json --policy-config-file policy.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubernetes-tpu-scheduler",
+        description="TPU-native batch scheduler (kube-scheduler equivalent)",
+    )
+    p.add_argument("--mode", choices=["extender", "sim"], default="sim")
+    p.add_argument("--config", help="KubeSchedulerConfiguration JSON file")
+    p.add_argument("--policy-config-file", help="Policy JSON file (overrides provider)")
+    p.add_argument("--algorithm-provider", default="DefaultProvider")
+    p.add_argument("--feature-gates", default="", help="A=true,B=false")
+    p.add_argument("--scheduler-name", default="default-scheduler")
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10250, help="extender serving port")
+    p.add_argument("--metrics-port", type=int, default=10251)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--deterministic", action="store_true")
+    # sim mode
+    p.add_argument("--nodes", type=int, default=100)
+    p.add_argument("--pods", type=int, default=500)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--pod-cpu", default="100m", help="sim pod cpu request")
+    p.add_argument(
+        "--feature-rate", type=float, default=0.0,
+        help="fraction of sim pods carrying generated constraints "
+             "(affinity/taints/spread; such pods may be legitimately "
+             "unschedulable against the generated nodes)",
+    )
+    return p
+
+
+def _configurator(args):
+    from .config import Configurator, load_component_config, parse_policy
+    from .utils.featuregate import FeatureGate
+
+    fg = FeatureGate()
+    fg.parse(args.feature_gates)
+    cfgr = Configurator(
+        feature_gates=fg,
+        batch_size=args.batch_size,
+        deterministic=args.deterministic,
+    )
+    if args.config:
+        cc = load_component_config(args.config)
+        if cc.feature_gates:
+            fg.set_from_map(cc.feature_gates)
+        if args.policy_config_file is None and cc.policy_file:
+            args.policy_config_file = cc.policy_file
+        if cc.algorithm_provider:
+            args.algorithm_provider = cc.algorithm_provider
+        if cc.scheduler_name:
+            args.scheduler_name = cc.scheduler_name
+    if args.policy_config_file:
+        with open(args.policy_config_file) as f:
+            return cfgr, cfgr.create_from_config(json.load(f))
+    return cfgr, cfgr.create_from_provider(args.algorithm_provider)
+
+
+def run_extender(args) -> int:
+    from .extender import ExtenderServer
+    from .metrics import MetricsServer
+
+    _, sched = _configurator(args)
+    sc = sched.solve_config
+    srv = ExtenderServer(
+        cache=sched.cache, host=args.address, port=args.port,
+        enabled_predicates=sc.predicates if sc else None,
+        priority_weights=sc.priorities if sc else None,
+    )
+    srv.start()
+    msrv = MetricsServer(host=args.address, port=args.metrics_port).start()
+    print(f"extender serving on {srv.url} (filter/prioritize/bind/preemption)")
+    print(f"metrics on {msrv.url}/metrics, health on {msrv.url}/healthz")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+        msrv.stop()
+    return 0
+
+
+def run_sim(args) -> int:
+    from .apiserver import FakeAPIServer
+    from .client import APIBinder, start_scheduler_informers
+    from .models.generators import ClusterGen
+    from .scheduler.driver import Binder
+    from .scheduler.eventhandlers import EventHandlers
+
+    cfgr, sched = _configurator(args)
+    api = FakeAPIServer()
+    sched.binder = Binder(APIBinder(api).bind)
+    g = ClusterGen(args.seed)
+    nodes, existing = g.cluster(args.nodes, 0, feature_rate=0.3)
+    for n in nodes:
+        api.create("nodes", n)
+    handlers = EventHandlers(sched.cache, sched.queue, args.scheduler_name)
+    informers = start_scheduler_informers(api, handlers)
+    for inf in informers.values():
+        inf.wait_for_sync()
+    from .api.types import Container, Pod, Quantity, RESOURCE_CPU, RESOURCE_MEMORY
+
+    for i in range(args.pods):
+        if args.feature_rate > 0:
+            p = g.pod(10_000 + i, feature_rate=args.feature_rate)
+        else:
+            p = Pod(
+                name=f"sim-{i}", namespace="sim",
+                containers=[Container(name="c", requests={
+                    RESOURCE_CPU: Quantity.parse(args.pod_cpu),
+                    RESOURCE_MEMORY: Quantity.parse("128Mi"),
+                })],
+            )
+        # pods must name THIS scheduler or the handlers drop them
+        # (eventhandlers.go responsibleForPod)
+        p.scheduler_name = args.scheduler_name
+        api.create("pods", p)
+    t0 = time.perf_counter()
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        sched.queue.flush()
+        sched.schedule_batch()
+        pods, _ = api.list("pods")
+        if len(pods) >= args.pods and all(p.node_name for p in pods):
+            break
+        time.sleep(0.01)
+    sched.wait_for_binds()
+    elapsed = time.perf_counter() - t0
+    pods, _ = api.list("pods")
+    bound = sum(1 for p in pods if p.node_name)
+    print(
+        json.dumps(
+            {
+                "mode": "sim",
+                "nodes": args.nodes,
+                "pods": len(pods),
+                "bound": bound,
+                "elapsed_s": round(elapsed, 3),
+                "pods_per_sec": round(bound / elapsed, 1) if elapsed > 0 else 0,
+                "stats": {k: round(v, 4) if isinstance(v, float) else v
+                          for k, v in sched.stats.items()},
+            }
+        )
+    )
+    for inf in informers.values():
+        inf.stop()
+    return 0 if bound == len(pods) else 1
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.mode == "extender":
+        return run_extender(args)
+    return run_sim(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

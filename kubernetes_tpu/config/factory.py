@@ -1,0 +1,95 @@
+"""Configurator (pkg/scheduler/factory/factory.go:133): translate a
+Provider name, Policy, or ComponentConfig into a configured Scheduler.
+
+CreateFromProvider (:294) / CreateFromConfig (:304) / CreateFromKeys
+(:382) semantics: the chosen predicate/priority sets become (a) a
+SolveConfig statically gating the fused device kernels, (b) the oracle
+predicate chain's enabled set (threaded via PredicateMetadata), (c) the
+volume checker's row selection, and (d) HTTPExtender clients.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, List, Optional, Tuple
+
+from ..extender.client import ExtenderConfig, HTTPExtender
+from ..ops.pipeline import SolveConfig
+from ..scheduler.driver import Scheduler
+from ..utils.featuregate import FeatureGate
+from ..volume.predicates import make_volume_checker
+from .componentconfig import KubeSchedulerConfiguration
+from .policy import Policy, parse_policy
+from .provider import VOLUME_PREDICATES, get_provider
+
+
+class Configurator:
+    def __init__(
+        self,
+        feature_gates: Optional[FeatureGate] = None,
+        pvc_lister: Optional[Callable] = None,
+        pv_lister: Optional[Callable] = None,
+        sc_lister: Optional[Callable] = None,
+        csinode_lister: Optional[Callable] = None,
+        volume_binder=None,
+        **scheduler_kwargs,
+    ):
+        self.feature_gates = feature_gates or FeatureGate()
+        self.pvc_lister = pvc_lister
+        self.pv_lister = pv_lister
+        self.sc_lister = sc_lister
+        self.csinode_lister = csinode_lister
+        self.volume_binder = volume_binder
+        self.scheduler_kwargs = scheduler_kwargs
+
+    def create_from_provider(self, name: str = "DefaultProvider") -> Scheduler:
+        predicates, priorities = get_provider(name, self.feature_gates)
+        return self.create_from_keys(predicates, priorities, [])
+
+    def create_from_config(self, policy) -> Scheduler:
+        """policy: a Policy, a parsed JSON dict, or a JSON string."""
+        if isinstance(policy, str):
+            policy = json.loads(policy)
+        if isinstance(policy, dict):
+            policy = parse_policy(policy)
+        assert isinstance(policy, Policy)
+        return self.create_from_keys(
+            policy.predicates, policy.priorities, policy.extenders
+        )
+
+    def create_from_component_config(self, cfg: KubeSchedulerConfiguration) -> Scheduler:
+        if cfg.feature_gates:
+            self.feature_gates.set_from_map(cfg.feature_gates)
+        if cfg.policy_file:
+            with open(cfg.policy_file) as f:
+                return self.create_from_config(json.load(f))
+        return self.create_from_provider(cfg.algorithm_provider or "DefaultProvider")
+
+    def create_from_keys(
+        self,
+        predicates: frozenset,
+        priorities: Tuple[Tuple[str, int], ...],
+        extender_configs: List[ExtenderConfig],
+    ) -> Scheduler:
+        solve_config = SolveConfig(
+            predicates=frozenset(predicates), priorities=tuple(priorities)
+        )
+        volume_checker = None
+        wanted_volume = frozenset(predicates) & VOLUME_PREDICATES
+        if wanted_volume and self.pvc_lister is not None and self.pv_lister is not None:
+            volume_checker = make_volume_checker(
+                self.pvc_lister,
+                self.pv_lister,
+                sc_lister=self.sc_lister,
+                csinode_lister=self.csinode_lister,
+                binder=self.volume_binder if "CheckVolumeBinding" in predicates else None,
+                enabled=wanted_volume,
+            )
+        extenders = [HTTPExtender(c) for c in extender_configs]
+        return Scheduler(
+            solve_config=solve_config,
+            volume_checker=volume_checker,
+            volume_binder=self.volume_binder,
+            extenders=extenders,
+            **self.scheduler_kwargs,
+        )

@@ -1,0 +1,92 @@
+"""The legacy Policy API (pkg/scheduler/api/types.go Policy, loadable from
+a file or ConfigMap — scheduler.go:352-386 initPolicyFrom*).
+
+JSON shape:
+    {"kind": "Policy", "apiVersion": "v1",
+     "predicates": [{"name": "PodFitsResources"}, ...],
+     "priorities": [{"name": "LeastRequestedPriority", "weight": 1}, ...],
+     "extenders": [{"urlPrefix": ..., "filterVerb": ..., ...}],
+     "hardPodAffinitySymmetricWeight": 1}
+
+Empty predicate/priority lists mean "use the defaults" only when the key
+is ABSENT; an explicitly empty list means none (factory.go:304-381
+CreateFromConfig semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..extender.client import ExtenderConfig
+from .provider import KNOWN_PREDICATES, KNOWN_PRIORITIES, default_predicates, default_priorities
+
+DEFAULT_HARD_POD_AFFINITY_WEIGHT = 1
+DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE = 50  # api/types.go:29 (moot: full matrix)
+
+
+class PolicyError(ValueError):
+    pass
+
+
+@dataclass
+class Policy:
+    predicates: Optional[frozenset] = None  # None = defaults
+    priorities: Optional[Tuple[Tuple[str, int], ...]] = None
+    extenders: List[ExtenderConfig] = field(default_factory=list)
+    hard_pod_affinity_symmetric_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT
+
+
+def _extender_from_json(d: dict) -> ExtenderConfig:
+    return ExtenderConfig(
+        url_prefix=d.get("urlPrefix", ""),
+        filter_verb=d.get("filterVerb", ""),
+        prioritize_verb=d.get("prioritizeVerb", ""),
+        bind_verb=d.get("bindVerb", ""),
+        preempt_verb=d.get("preemptVerb", ""),
+        weight=int(d.get("weight", 1)),
+        node_cache_capable=bool(d.get("nodeCacheCapable", False)),
+        ignorable=bool(d.get("ignorable", False)),
+        managed_resources=[
+            r.get("name", "") for r in d.get("managedResources") or []
+        ],
+        timeout_s=float(d.get("httpTimeout", 5.0)),
+    )
+
+
+def parse_policy(obj: dict) -> Policy:
+    if obj.get("kind") not in (None, "Policy"):
+        raise PolicyError(f"not a Policy: kind={obj.get('kind')!r}")
+    policy = Policy()
+    # Go json semantics: an ABSENT or NULL slice means "use defaults"; only
+    # an explicitly-empty list means none (factory.go CreateFromConfig)
+    if obj.get("predicates") is not None:
+        names = set()
+        for p in obj["predicates"] or []:
+            name = p.get("name", "")
+            if name not in KNOWN_PREDICATES:
+                raise PolicyError(f"unknown predicate {name!r}")
+            names.add(name)
+        policy.predicates = frozenset(names)
+    else:
+        policy.predicates = default_predicates()
+    if obj.get("priorities") is not None:
+        pairs = []
+        for p in obj["priorities"] or []:
+            name = p.get("name", "")
+            if name not in KNOWN_PRIORITIES:
+                raise PolicyError(f"unknown priority {name!r}")
+            weight = int(p.get("weight", 1))
+            if weight < 0:
+                raise PolicyError(f"negative weight for {name}")
+            pairs.append((name, weight))
+        policy.priorities = tuple(pairs)
+    else:
+        policy.priorities = default_priorities()
+    policy.extenders = [_extender_from_json(e) for e in obj.get("extenders") or []]
+    w = obj.get("hardPodAffinitySymmetricWeight")
+    if w is not None:
+        if not (0 <= int(w) <= 100):
+            raise PolicyError("hardPodAffinitySymmetricWeight must be in [0, 100]")
+        policy.hard_pod_affinity_symmetric_weight = int(w)
+    return policy

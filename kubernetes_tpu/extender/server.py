@@ -62,10 +62,16 @@ class ExtenderServer:
         host: str = "127.0.0.1",
         port: int = 0,
         device_threshold: int = 256,
+        enabled_predicates: Optional[frozenset] = None,
+        priority_weights=None,  # tuple of (registration name, weight)
     ):
         self.cache = cache or SchedulerCache()
         self.bind_fn = bind_fn
         self.device_threshold = device_threshold
+        # Policy/provider selection (config.factory): gates the oracle
+        # chain, the device mask, and the prioritize weights
+        self.enabled_predicates = enabled_predicates
+        self.priority_weights = tuple(priority_weights) if priority_weights else None
         self._mirror: Optional[TensorMirror] = None
         self._mirror_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
@@ -136,11 +142,13 @@ class ExtenderServer:
                 xa = dev(etb.arrays())
                 au = dev(aux)
                 ids = F.make_ids(mirror.vocab)
-                base = F.combined_mask(na, pa, ids)
+                en = self.enabled_predicates
+                mask = F.combined_mask(na, pa, ids, predicates=en)
                 sel = F.pod_match_node_selector(na, pa)
-                mask = base & T.spread_filter(na, ea, ta, sel) & T.interpod_filter(
-                    na, ea, ta, au, xa, pa
-                )
+                if en is None or "EvenPodsSpread" in en:
+                    mask = mask & T.spread_filter(na, ea, ta, sel)
+                if en is None or "MatchInterPodAffinity" in en:
+                    mask = mask & T.interpod_filter(na, ea, ta, au, xa, pa)
                 row = np.asarray(mask)[0]
                 return {
                     name: bool(row[mirror.row_of[name]])
@@ -177,7 +185,7 @@ class ExtenderServer:
                 else:
                     failed[name] = "node unknown" if ok is None else "does not fit"
         else:
-            meta = compute_predicate_metadata(pod, snap)
+            meta = compute_predicate_metadata(pod, snap, enabled=self.enabled_predicates)
             for name in names:
                 ni = snap.get(name)
                 if ni is None:
@@ -200,7 +208,13 @@ class ExtenderServer:
         if pod is None:
             return []
         snap, names, _ = self._resolve(args)
-        scores = prioritize_nodes(pod, snap)
+        weights = None
+        if self.priority_weights is not None:
+            from ..oracle.priorities import DEFAULT_PRIORITY_WEIGHTS
+
+            weights = {name: 0 for name in DEFAULT_PRIORITY_WEIGHTS}
+            weights.update(dict(self.priority_weights))
+        scores = prioritize_nodes(pod, snap, weights=weights)
         # rescale the weighted sum into extender range [0, 10]
         relevant = {n: scores.get(n, 0) for n in names}
         hi = max(relevant.values(), default=0)

@@ -192,16 +192,43 @@ def filter_masks(nodes: Arrays, pods: Arrays, ids: Arrays) -> Dict[str, jnp.ndar
     }
 
 
-@jax.jit
-def combined_mask(nodes: Arrays, pods: Arrays, ids: Arrays) -> jnp.ndarray:
-    """findNodesThatFit's feasibility matrix [B, N]: AND of all predicates,
-    masked by row/col validity."""
+# mask key → Policy/provider registration name (predicates.go:56-110;
+# GeneralPredicates expands per predicates.go:1204)
+_MASK_PRED_NAMES = {
+    "unschedulable": "CheckNodeUnschedulable",
+    "host": "HostName",
+    "ports": "PodFitsHostPorts",
+    "selector": "MatchNodeSelector",
+    "resources": "PodFitsResources",
+    "taints": "PodToleratesNodeTaints",
+}
+_GENERAL = frozenset({"HostName", "PodFitsHostPorts", "MatchNodeSelector", "PodFitsResources"})
+
+
+@partial(jax.jit, static_argnames=("predicates",))
+def combined_mask(
+    nodes: Arrays, pods: Arrays, ids: Arrays, predicates=None
+) -> jnp.ndarray:
+    """findNodesThatFit's feasibility matrix [B, N]: AND of the ENABLED
+    predicates (None = all; a Policy's set gates at trace time — each
+    distinct set is one extra compile, not a runtime branch), masked by
+    row/col validity."""
     m = filter_masks(nodes, pods, ids)
-    out = m["unschedulable"] & m["host"] & m["ports"] & m["selector"] & m["resources"] & m["taints"]
+    out = pods["valid"][:, None] & jnp.ones_like(m["resources"])
+
+    def on(key: str) -> bool:
+        if predicates is None:
+            return True
+        name = _MASK_PRED_NAMES[key]
+        return name in predicates or (name in _GENERAL and "GeneralPredicates" in predicates)
+
+    for key in ("unschedulable", "host", "ports", "selector", "resources", "taints"):
+        if on(key):
+            out = out & m[key]
     # nodes whose structures overflowed the encoding are excluded from the
     # fast path entirely (conservative; the driver may oracle-check them)
     ok_nodes = nodes["valid"] & ~nodes.get("fallback", jnp.zeros_like(nodes["valid"]))
-    return out & ok_nodes[None, :] & pods["valid"][:, None]
+    return out & ok_nodes[None, :]
 
 
 def make_ids(vocab) -> Dict[str, jnp.ndarray]:

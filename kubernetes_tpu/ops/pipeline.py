@@ -12,8 +12,9 @@ batch.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,28 +27,63 @@ from .solver import pop_order, solve_gang, solve_greedy
 Arrays = Dict[str, jnp.ndarray]
 
 
+@dataclass(frozen=True)
+class SolveConfig:
+    """Device-solve policy (hashable → one XLA compile per distinct config):
+    which predicates gate the mask and which (priority, weight) pairs sum
+    into the score — the algorithm-provider / Policy selection
+    (factory.go CreateFromKeys) expressed as jit statics. None = the
+    default provider."""
+
+    predicates: Optional[frozenset] = None
+    priorities: Optional[Tuple[Tuple[str, int], ...]] = None
+
+    def priority_weight(self, name: str, default: int) -> int:
+        if self.priorities is None:
+            return default
+        for n, w in self.priorities:
+            if n == name:
+                return w
+        return 0
+
+
+DEFAULT_SOLVE_CONFIG = SolveConfig()
+
+
 def mask_and_score(
-    na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays, au: Arrays, ids: Arrays
+    na: Arrays,
+    pa: Arrays,
+    ea: Arrays,
+    ta: Arrays,
+    xa: Arrays,
+    au: Arrays,
+    ids: Arrays,
+    config: Optional[SolveConfig] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The fused Filter+Score stage shared by every solve entry point
     (plain, gang, sharded) — one definition so they can never diverge."""
-    base = F.combined_mask(na, pa, ids)
+    cfg = config or DEFAULT_SOLVE_CONFIG
+    preds = cfg.predicates
+    mask = F.combined_mask(na, pa, ids, predicates=preds)
     sel = F.pod_match_node_selector(na, pa)
-    mask = (
-        base
-        & T.spread_filter(na, ea, ta, sel)
-        & T.interpod_filter(na, ea, ta, au, xa, pa)
-    )
-    score = (
-        S.score_matrix(na, pa)
-        + T.interpod_score(na, ea, ta, xa, pa)
-        + T.spread_score(na, ea, ta, au, sel)
-        + T.selector_spread_score(na, ea, ta, au)
-    )
+    if preds is None or "EvenPodsSpread" in preds:
+        mask = mask & T.spread_filter(na, ea, ta, sel)
+    if preds is None or "MatchInterPodAffinity" in preds:
+        mask = mask & T.interpod_filter(na, ea, ta, au, xa, pa)
+    score = S.score_matrix(na, pa, priorities=cfg.priorities)
+    w = cfg.priority_weight("InterPodAffinityPriority", 1)
+    if w:
+        score = score + w * T.interpod_score(na, ea, ta, xa, pa)
+    w = cfg.priority_weight("EvenPodsSpreadPriority", 1)
+    if w:
+        score = score + w * T.spread_score(na, ea, ta, au, sel)
+    w = cfg.priority_weight("SelectorSpreadPriority", 1)
+    if w:
+        score = score + w * T.selector_spread_score(na, ea, ta, au)
     return mask, score
 
 
-@partial(jax.jit, static_argnames=("deterministic",))
+@partial(jax.jit, static_argnames=("deterministic", "config"))
 def solve_pipeline(
     na: Arrays,  # NodeBank arrays
     pa: Arrays,  # PodBatch arrays
@@ -58,9 +94,10 @@ def solve_pipeline(
     ids: Arrays,  # interned constants (filters.make_ids)
     key,  # PRNG key for selectHost tie-breaks
     deterministic: bool = False,
+    config: Optional[SolveConfig] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """mask → score → greedy solve. Returns (assign [B], score [B, N])."""
-    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids)
+    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config)
     free0 = na["alloc"] - na["requested"]
     b = pa["valid"].shape[0]
     order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
@@ -79,7 +116,7 @@ def solve_pipeline(
     return assign, score
 
 
-@partial(jax.jit, static_argnames=("deterministic",))
+@partial(jax.jit, static_argnames=("deterministic", "config"))
 def solve_pipeline_gang(
     na: Arrays,
     pa: Arrays,
@@ -91,12 +128,13 @@ def solve_pipeline_gang(
     key,
     group: jnp.ndarray,  # [B] group id, -1 = ungrouped
     deterministic: bool = False,
+    config: Optional[SolveConfig] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Gang variant: same fused mask/score, then the all-or-nothing
     two-pass solve (ops/solver.solve_gang). Returns (assign, score,
     gang_ok) — members of dropped groups come back assign=-1, gang_ok
     False, and their capacity is released to other pods in pass 2."""
-    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids)
+    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config)
     free0 = na["alloc"] - na["requested"]
     b = pa["valid"].shape[0]
     order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])

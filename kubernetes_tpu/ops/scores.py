@@ -18,6 +18,7 @@ topology.py. Parity: tests/test_score_parity.py.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict
 
 import jax
@@ -162,20 +163,45 @@ DEFAULT_WEIGHTS = {
 }
 
 
-@jax.jit
-def score_matrix(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
-    """Weighted sum of the non-topology priorities → [B, N] int64. The
-    topology scores (topology.py) are added by the solver before argmax."""
-    total = (
-        DEFAULT_WEIGHTS["least_requested"] * least_requested(nodes, pods)
-        + DEFAULT_WEIGHTS["balanced_allocation"] * balanced_allocation(nodes, pods)
-        + DEFAULT_WEIGHTS["node_affinity"] * node_affinity(nodes, pods)
-        + DEFAULT_WEIGHTS["taint_toleration"] * taint_toleration(nodes, pods)
-        + DEFAULT_WEIGHTS["prefer_avoid_pods"] * prefer_avoid_pods(nodes, pods)
-    )
-    if "image_scaled" in nodes:
-        total = total + DEFAULT_WEIGHTS["image_locality"] * image_locality(nodes, pods)
-    return total
+# Policy/provider registration name → kernel (priorities.go:21-56)
+_PRIORITY_KERNELS = {
+    "LeastRequestedPriority": least_requested,
+    "MostRequestedPriority": most_requested,
+    "BalancedResourceAllocation": balanced_allocation,
+    "NodeAffinityPriority": node_affinity,
+    "TaintTolerationPriority": taint_toleration,
+    "NodePreferAvoidPodsPriority": prefer_avoid_pods,
+    "ImageLocalityPriority": image_locality,
+}
+
+# the default provider's weighted sum in registration-name form
+DEFAULT_PRIORITY_TUPLE = (
+    ("LeastRequestedPriority", 1),
+    ("BalancedResourceAllocation", 1),
+    ("NodeAffinityPriority", 1),
+    ("TaintTolerationPriority", 1),
+    ("NodePreferAvoidPodsPriority", 10000),
+    ("ImageLocalityPriority", 1),
+)
+
+
+@partial(jax.jit, static_argnames=("priorities",))
+def score_matrix(nodes: Arrays, pods: Arrays, priorities=None) -> jnp.ndarray:
+    """Weighted sum of the enabled non-topology priorities → [B, N] int64
+    (None = default provider weights). The topology scores (topology.py)
+    are added by the solver before argmax. `priorities` is a static tuple
+    of (registration name, weight) — each distinct config compiles once."""
+    pairs = priorities if priorities is not None else DEFAULT_PRIORITY_TUPLE
+    total = jnp.zeros((), jnp.int64)
+    for name, weight in pairs:
+        kernel = _PRIORITY_KERNELS.get(name)
+        if kernel is None:
+            continue  # host-only priorities (SelectorSpread etc.) add later
+        if name == "ImageLocalityPriority" and "image_scaled" not in nodes:
+            continue
+        total = total + weight * kernel(nodes, pods)
+    b, n = pods["valid"].shape[0], nodes["valid"].shape[0]
+    return jnp.broadcast_to(total, (b, n)) if total.ndim == 0 else total
 
 
 @jax.jit

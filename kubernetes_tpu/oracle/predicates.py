@@ -349,19 +349,58 @@ def inter_pod_affinity_matches(
 # Combined runner (findNodesThatFit semantics for one pod)
 # ---------------------------------------------------------------------------
 
+# Registration names (predicates.go:56-110) — what Policy files and the
+# algorithm provider registry refer to.
+CHECK_NODE_UNSCHEDULABLE_PRED = "CheckNodeUnschedulable"
+GENERAL_PRED = "GeneralPredicates"
+HOST_NAME_PRED = "HostName"
+POD_FITS_HOST_PORTS_PRED = "PodFitsHostPorts"
+MATCH_NODE_SELECTOR_PRED = "MatchNodeSelector"
+POD_FITS_RESOURCES_PRED = "PodFitsResources"
+POD_TOLERATES_NODE_TAINTS_PRED = "PodToleratesNodeTaints"
+EVEN_PODS_SPREAD_PRED = "EvenPodsSpread"
+MATCH_INTER_POD_AFFINITY_PRED = "MatchInterPodAffinity"
+
+# GeneralPredicates expands to these (predicates.go:1204 noncriticalPredicates
+# + EssentialPredicates)
+_GENERAL_SET = frozenset(
+    {HOST_NAME_PRED, POD_FITS_HOST_PORTS_PRED, MATCH_NODE_SELECTOR_PRED, POD_FITS_RESOURCES_PRED}
+)
+
+
+def predicate_enabled(name: str, enabled) -> bool:
+    """Is `name` on, given an enabled-set from Policy/provider config?
+    None = default provider (everything the oracle implements)."""
+    if enabled is None:
+        return True
+    if name in enabled:
+        return True
+    return name in _GENERAL_SET and GENERAL_PRED in enabled
+
+
 @dataclass
 class PredicateMetadata:
     """GetPredicateMetadata (metadata.go:333) equivalent: the per-cycle
-    precomputation for one incoming pod against a snapshot."""
+    precomputation for one incoming pod against a snapshot. Carries the
+    config's enabled-predicate set so every consumer (driver, preemption,
+    nominated-pods two-pass) applies the same policy."""
 
     even_pods_spread: Optional[EvenPodsSpreadMetadata]
     pod_affinity: PodAffinityMetadata
+    enabled: Optional[frozenset] = None
 
 
-def compute_predicate_metadata(pod: Pod, snapshot: Snapshot) -> PredicateMetadata:
+def compute_predicate_metadata(
+    pod: Pod, snapshot: Snapshot, enabled: Optional[frozenset] = None
+) -> PredicateMetadata:
     return PredicateMetadata(
-        even_pods_spread=compute_even_pods_spread_metadata(pod, snapshot),
+        even_pods_spread=(
+            compute_even_pods_spread_metadata(pod, snapshot)
+            if predicate_enabled(EVEN_PODS_SPREAD_PRED, enabled)
+            else None
+        ),
         pod_affinity=compute_pod_affinity_metadata(pod, snapshot),
+        enabled=enabled,
     )
 
 
@@ -373,26 +412,55 @@ def pod_fits_on_node(
 ) -> Tuple[bool, List[str]]:
     """All default-provider predicates in predicates.Ordering()
     (predicates.go:147-153), short-circuiting like podFitsOnNode
-    (core/generic_scheduler.go:612 with alwaysCheckAllPredicates=false).
-    Volume predicates (NoVolumeZoneConflict, MaxVolumeCounts, NoDiskConflict,
-    CheckVolumeBinding) are vacuously true until volumes are modeled."""
+    (core/generic_scheduler.go:612 with alwaysCheckAllPredicates=false),
+    honoring meta.enabled (Policy/provider predicate selection). Volume
+    predicates run separately (volume.make_volume_checker — the driver's
+    volume_checker seam)."""
     if meta is None:
         assert snapshot is not None, "need snapshot to compute metadata"
         meta = compute_predicate_metadata(pod, snapshot)
+    enabled = meta.enabled
     checks = [
-        (ERR_NODE_UNSCHEDULABLE, lambda: check_node_unschedulable(pod, node_info)),
-        (ERR_POD_NOT_FIT_HOST, lambda: pod_fits_host(pod, node_info)),
-        (ERR_POD_NOT_FIT_PORTS, lambda: pod_fits_host_ports(pod, node_info)),
-        (ERR_NODE_SELECTOR_NOT_MATCH, lambda: pod_match_node_selector(pod, node_info)),
-        (ERR_INSUFFICIENT.format("resources"), lambda: pod_fits_resources(pod, node_info)),
-        (ERR_TAINTS, lambda: pod_tolerates_node_taints(pod, node_info)),
         (
+            CHECK_NODE_UNSCHEDULABLE_PRED,
+            ERR_NODE_UNSCHEDULABLE,
+            lambda: check_node_unschedulable(pod, node_info),
+        ),
+        (HOST_NAME_PRED, ERR_POD_NOT_FIT_HOST, lambda: pod_fits_host(pod, node_info)),
+        (
+            POD_FITS_HOST_PORTS_PRED,
+            ERR_POD_NOT_FIT_PORTS,
+            lambda: pod_fits_host_ports(pod, node_info),
+        ),
+        (
+            MATCH_NODE_SELECTOR_PRED,
+            ERR_NODE_SELECTOR_NOT_MATCH,
+            lambda: pod_match_node_selector(pod, node_info),
+        ),
+        (
+            POD_FITS_RESOURCES_PRED,
+            ERR_INSUFFICIENT.format("resources"),
+            lambda: pod_fits_resources(pod, node_info),
+        ),
+        (
+            POD_TOLERATES_NODE_TAINTS_PRED,
+            ERR_TAINTS,
+            lambda: pod_tolerates_node_taints(pod, node_info),
+        ),
+        (
+            EVEN_PODS_SPREAD_PRED,
             ERR_TOPOLOGY_SPREAD,
             lambda: even_pods_spread_predicate(pod, node_info, meta.even_pods_spread),
         ),
-        (ERR_POD_AFFINITY, lambda: inter_pod_affinity_matches(pod, node_info, meta.pod_affinity)),
+        (
+            MATCH_INTER_POD_AFFINITY_PRED,
+            ERR_POD_AFFINITY,
+            lambda: inter_pod_affinity_matches(pod, node_info, meta.pod_affinity),
+        ),
     ]
-    for reason, fn in checks:
+    for name, reason, fn in checks:
+        if not predicate_enabled(name, enabled):
+            continue
         if not fn():
             return False, [reason]
     return True, []

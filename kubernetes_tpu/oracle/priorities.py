@@ -471,6 +471,9 @@ DEFAULT_PRIORITY_WEIGHTS = {
     "TaintTolerationPriority": 1,
     "ImageLocalityPriority": 1,
     "EvenPodsSpreadPriority": 1,
+    # not in the default provider (ClusterAutoscalerProvider swaps it in for
+    # LeastRequested); weight 0 unless a config raises it
+    "MostRequestedPriority": 0,
 }
 
 
@@ -487,6 +490,7 @@ def prioritize_nodes(
     results: Dict[str, Scores] = {
         "SelectorSpreadPriority": selector_spread_priority(pod, snapshot, spread_selectors),
         "InterPodAffinityPriority": inter_pod_affinity_priority(pod, snapshot),
+        "MostRequestedPriority": most_requested_priority(pod, snapshot),
         "LeastRequestedPriority": least_requested_priority(pod, snapshot),
         "BalancedResourceAllocation": balanced_resource_allocation(pod, snapshot),
         "NodePreferAvoidPodsPriority": node_prefer_avoid_pods_priority(pod, snapshot),
@@ -498,7 +502,9 @@ def prioritize_nodes(
         results["EvenPodsSpreadPriority"] = even_pods_spread_priority(pod, snapshot)
     total: Scores = {name: 0 for name in snapshot.node_infos}
     for pname, scores in results.items():
-        weight = w.get(pname, 1)
+        weight = w.get(pname, 0)
+        if not weight:
+            continue
         for node_name, s in scores.items():
             total[node_name] += weight * s
     return total

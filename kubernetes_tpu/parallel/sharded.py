@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.pipeline import mask_and_score
+from ..ops.pipeline import SolveConfig, mask_and_score
 from ..ops.solver import pop_order
 from .mesh import AXIS_NODES, AXIS_PODS
 
@@ -116,10 +116,11 @@ def make_sharded_pipeline(mesh: Mesh):
     def _c(x: jnp.ndarray, *spec) -> jnp.ndarray:
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
-    @partial(jax.jit, static_argnames=("deterministic",))
+    @partial(jax.jit, static_argnames=("deterministic", "config"))
     def pipeline(
         na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays,
         au: Arrays, ids: Arrays, key, deterministic: bool = False,
+        config: "SolveConfig" = None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         N = na["valid"].shape[0]
         assert N % n_shards == 0, f"node capacity {N} not divisible by {n_shards} shards"
@@ -128,7 +129,7 @@ def make_sharded_pipeline(mesh: Mesh):
         na = {k: _c(v, AXIS_NODES) for k, v in na.items()}
         # mask/score compute (shared stage — identical math to the
         # single-device pipelines): nodes sharded, batch data-parallel
-        mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids)
+        mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config)
         mask = _c(mask, AXIS_PODS, AXIS_NODES)
         score = _c(score, AXIS_PODS, AXIS_NODES)
         # the greedy commit is a strict sequential order over the whole

@@ -154,6 +154,7 @@ class Scheduler:
         extenders: Optional[List] = None,
         volume_checker: Optional[Callable] = None,
         volume_binder=None,
+        solve_config=None,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -183,6 +184,11 @@ class Scheduler:
         # volumes route through the host commit path where these run
         self.volume_checker = volume_checker
         self.volume_binder = volume_binder
+        # Policy/provider selection (ops.pipeline.SolveConfig): statically
+        # gates the device mask/score AND the oracle predicate chain; each
+        # distinct config is one extra XLA compile
+        self.solve_config = solve_config
+        self._enabled_preds = solve_config.predicates if solve_config is not None else None
         self._bind_workers = bind_workers
         self._bind_pool = ThreadPoolExecutor(max_workers=bind_workers, thread_name_prefix="bind")
         self._rng_seed = seed
@@ -291,11 +297,13 @@ class Scheduler:
                 if gn:
                     garr[i] = gid_map.setdefault(gn, len(gid_map))
             assign, score, gang_ok = solve_pipeline_gang(
-                *args, garr, deterministic=self.deterministic
+                *args, garr, deterministic=self.deterministic, config=self.solve_config
             )
             gang_ok_arr = np.asarray(gang_ok)[: len(pods)]
         else:
-            assign, score = solve_pipeline(*args, deterministic=self.deterministic)
+            assign, score = solve_pipeline(
+                *args, deterministic=self.deterministic, config=self.solve_config
+            )
         n = len(pods)
         out = SolveOutput(
             assign=np.asarray(assign)[:n],
@@ -329,8 +337,12 @@ class Scheduler:
         state = state if state is not None else CycleState()
         run_filter = fw.run_filter if fw.has_plugins("filter") else None
         feasible: List[str] = []
-        for cand, ni in self.cache.snapshot.node_infos.items():
-            if not pod_fits_on_node(pod, ni, meta=meta)[0]:
+        # zone-interleaved iteration (NodeTree semantics): first-max-wins
+        # tie-breaks below spread across zones like the reference's
+        # node_tree.go:162 round-robin
+        for cand in self.cache.node_order():
+            ni = self.cache.snapshot.get(cand)
+            if ni is None or not pod_fits_on_node(pod, ni, meta=meta)[0]:
                 continue
             if self.volume_checker is not None and not self.volume_checker(pod, ni)[0]:
                 continue
@@ -339,7 +351,9 @@ class Scheduler:
             nominees = preemption_mod.eligible_nominees(
                 pod, cand, self.queue.nominated_pods_for_node
             )
-            if nominees and not fits_with_nominees(pod, cand, self.cache.snapshot, nominees):
+            if nominees and not fits_with_nominees(
+                pod, cand, self.cache.snapshot, nominees, enabled=self._enabled_preds
+            ):
                 continue
             feasible.append(cand)
         if not feasible:
@@ -541,6 +555,7 @@ class Scheduler:
             # locally while the async bind completes would desync the cache
             # from the node's real occupancy
             can_disrupt=lambda p: not self.cache.is_assumed(p.key()),
+            enabled=self._enabled_preds,
             # evictions can't cure volume conflicts — candidate nodes must
             # pass the volume predicates for the preemptor too
             extra_fit=(
@@ -734,12 +749,12 @@ class Scheduler:
                     # in selection — skip validating the device pick and
                     # re-rank host-side directly
                     self.stats["oracle_places"] += 1
-                    meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                    meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
                     node_name = self._oracle_place(pod, out.score[i], meta, state)
                     placed_attempted = True
                 elif node_name is not None and (needs_recheck or nominated_fn(node_name)):
                     self.stats["oracle_rechecks"] += 1
-                    meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                    meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
                     ok = self.cache.snapshot.get(node_name) is not None and fits_considering_nominated(
                         pod, node_name, self.cache.snapshot, nominated_fn, meta=meta
                     )
@@ -764,7 +779,7 @@ class Scheduler:
                     # re-place only if it fails
                     ni = self.cache.snapshot.get(node_name)
                     if ni is None or not pod_fits_resources(pod, ni):
-                        meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                        meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
                         node_name = self._oracle_place(pod, out.score[i], meta, state)
                         placed_attempted = True
                 if (
@@ -786,7 +801,7 @@ class Scheduler:
                     # same batch) — full scalar fallback before declaring the
                     # pod unschedulable
                     self.stats["oracle_places"] += 1
-                    meta = compute_predicate_metadata(pod, self.cache.snapshot)
+                    meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
                     node_name = self._oracle_place(pod, out.score[i], meta, state)
             except ExtenderError as ee:
                 # wire failure, not a FitError: error path, never preemption

@@ -87,11 +87,15 @@ def fits_considering_nominated(
         return False
     if not nominees:
         return True
-    return fits_with_nominees(pod, node_name, snapshot, nominees)
+    return fits_with_nominees(pod, node_name, snapshot, nominees, enabled=meta.enabled)
 
 
 def fits_with_nominees(
-    pod: Pod, node_name: str, snapshot: Snapshot, nominees: Sequence[Pod]
+    pod: Pod,
+    node_name: str,
+    snapshot: Snapshot,
+    nominees: Sequence[Pod],
+    enabled: Optional[frozenset] = None,
 ) -> bool:
     """The with-nominated-pods pass alone (callers have already verified the
     plain pass)."""
@@ -101,7 +105,7 @@ def fits_with_nominees(
     sni = shadow.get(node_name)
     for p in nominees:
         sni.pods.append(dataclasses.replace(p, node_name=node_name))
-    meta2 = compute_predicate_metadata(pod, shadow)
+    meta2 = compute_predicate_metadata(pod, shadow, enabled=enabled)
     return pod_fits_on_node(pod, sni, meta=meta2)[0]
 
 
@@ -171,6 +175,7 @@ def select_victims_on_node(
     pdbs: Sequence[PodDisruptionBudget] = (),
     can_disrupt: Optional[Callable[[Pod], bool]] = None,
     extra_fit: Optional[Callable[[Pod, object], bool]] = None,
+    enabled: Optional[frozenset] = None,
 ) -> Optional[Victims]:
     """selectVictimsOnNode (:1104): remove ALL lower-priority pods; if the
     pod then fits, reprieve candidates most-important-first — PDB-protected
@@ -198,7 +203,7 @@ def select_victims_on_node(
     victims_set = {id(p) for p in potential}
     sni.pods = [p for p in sni.pods if id(p) not in victims_set]
 
-    meta = compute_predicate_metadata(pod, shadow)
+    meta = compute_predicate_metadata(pod, shadow, enabled=enabled)
     fits, _ = pod_fits_on_node(pod, sni, meta=meta)
     if fits and extra_fit is not None:
         # volume predicates etc.: evicting pods cannot cure a zone/volume
@@ -213,7 +218,7 @@ def select_victims_on_node(
 
     def reprieve(p: Pod) -> bool:
         sni.pods.append(p)
-        meta = compute_predicate_metadata(pod, shadow)
+        meta = compute_predicate_metadata(pod, shadow, enabled=enabled)
         still_fits, _ = pod_fits_on_node(pod, sni, meta=meta)
         if still_fits and extra_fit is not None:
             still_fits = extra_fit(pod, sni)
@@ -277,6 +282,7 @@ def preempt(
     nominated_fn: Optional[NominatedFn] = None,
     can_disrupt: Optional[Callable[[Pod], bool]] = None,
     extra_fit: Optional[Callable[[Pod, object], bool]] = None,
+    enabled: Optional[frozenset] = None,
 ) -> Tuple[Optional[str], List[Pod], List[str]]:
     """Preempt (:313): returns (node, victims, nominated pod keys to clear).
     The third element lists LOWER-priority pods nominated to the chosen node
@@ -288,7 +294,8 @@ def preempt(
     candidates: Dict[str, Victims] = {}
     for name in potential:
         v = select_victims_on_node(
-            pod, name, snapshot, pdbs=pdbs, can_disrupt=can_disrupt, extra_fit=extra_fit
+            pod, name, snapshot, pdbs=pdbs, can_disrupt=can_disrupt,
+            extra_fit=extra_fit, enabled=enabled,
         )
         if v is not None:
             candidates[name] = v

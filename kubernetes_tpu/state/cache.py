@@ -58,6 +58,11 @@ class SchedulerCache:
         self._assumed: Set[str] = set()
         self.dirty_nodes: Set[str] = set()  # generation-equivalent dirty set
         self.removed_nodes: Set[str] = set()
+        # zone-interleaved iteration (internal/cache/node_tree.go) for the
+        # host-side placement loops' tie distribution
+        from .node_tree import NodeTree
+
+        self.node_tree = NodeTree()
 
     # -- helpers -------------------------------------------------------------
 
@@ -182,7 +187,9 @@ class SchedulerCache:
             ni = self.snapshot.get(node.name)
             if ni is None:
                 self.snapshot.add_node(node)
+                self.node_tree.add_node(node)
             else:
+                self.node_tree.update_node(ni.node, node)
                 ni.node = node  # was a headless placeholder
             self.dirty_nodes.add(node.name)
             self.removed_nodes.discard(node.name)
@@ -194,11 +201,23 @@ class SchedulerCache:
         with self._lock:
             ni = self.snapshot.node_infos.pop(name, None)
             if ni is not None:
+                self.node_tree.remove_node(ni.node)
                 for p in ni.pods:
                     self._pod_states.pop(p.key(), None)
                     self._assumed.discard(p.key())
             self.dirty_nodes.discard(name)
             self.removed_nodes.add(name)
+
+    def node_order(self) -> List[str]:
+        """Zone-interleaved iteration order (NodeTree.Next semantics) for
+        host-side placement loops; falls back to insertion order for nodes
+        the tree doesn't know (headless placeholders)."""
+        with self._lock:
+            order = [n for n in self.node_tree.order() if n in self.snapshot.node_infos]
+            if len(order) != len(self.snapshot.node_infos):
+                seen = set(order)
+                order.extend(n for n in self.snapshot.node_infos if n not in seen)
+            return order
 
     # -- counters ------------------------------------------------------------
 

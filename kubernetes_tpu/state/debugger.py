@@ -1,0 +1,80 @@
+"""Cache debugger (pkg/scheduler/internal/cache/debugger/): dump the
+cache/queue state and compare the cache against the informer's view.
+
+The reference wires these to SIGUSR2 (debugger/signal.go); install_signal
+does the same here. The comparer is the drift detector: cache contents are
+DERIVED state (rebuilt from the watch stream) and must match the
+informers' authoritative lists.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+from typing import Iterable, List, Optional, Tuple
+
+from ..api.types import Node, Pod
+
+
+class CacheDumper:
+    """debugger/dumper.go: log the cache + queue state."""
+
+    def __init__(self, cache, queue=None):
+        self.cache = cache
+        self.queue = queue
+
+    def dump(self) -> str:
+        lines: List[str] = ["Dump of cached NodeInfo:"]
+        snap = self.cache.snapshot
+        for name, ni in sorted(snap.node_infos.items()):
+            req = ni.requested()
+            lines.append(
+                f"  node {name}: pods={len(ni.pods)} requested={req} "
+                f"ports={len(ni.used_host_ports())}"
+            )
+            for p in ni.pods:
+                mark = " (assumed)" if self.cache.is_assumed(p.key()) else ""
+                lines.append(f"    pod {p.key()}{mark}")
+        if self.queue is not None:
+            a, b, u = self.queue.counts()
+            lines.append(f"Scheduling queue: active={a} backoff={b} unschedulable={u}")
+        return "\n".join(lines)
+
+
+class CacheComparer:
+    """debugger/comparer.go: cache vs informer lists → (missed, redundant)."""
+
+    def __init__(self, cache):
+        self.cache = cache
+
+    def compare_nodes(self, informer_nodes: Iterable[Node]) -> Tuple[List[str], List[str]]:
+        actual = set(self.cache.snapshot.node_infos)
+        expected = {n.name for n in informer_nodes}
+        return sorted(expected - actual), sorted(actual - expected)
+
+    def compare_pods(self, informer_pods: Iterable[Pod]) -> Tuple[List[str], List[str]]:
+        """Assigned pods the cache should know. Assumed-but-unconfirmed pods
+        are cache-only by design and not counted redundant
+        (comparer.go ComparePods: cached + assumed vs nodeinfo lists)."""
+        cached = {
+            p.key()
+            for ni in self.cache.snapshot.node_infos.values()
+            for p in ni.pods
+        }
+        expected = {p.key() for p in informer_pods if p.node_name}
+        missed = sorted(expected - cached)
+        redundant = sorted(
+            k for k in cached - expected if not self.cache.is_assumed(k)
+        )
+        return missed, redundant
+
+
+def install_signal(cache, queue=None, sig=signal.SIGUSR2, out=sys.stderr):
+    """debugger/signal.go: SIGUSR2 → dump to stderr. Returns the handler."""
+    dumper = CacheDumper(cache, queue)
+
+    def handler(signum, frame):
+        print(dumper.dump(), file=out, flush=True)
+
+    signal.signal(sig, handler)
+    return handler

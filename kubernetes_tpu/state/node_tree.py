@@ -1,0 +1,107 @@
+"""NodeTree: zone-interleaved round-robin node iteration
+(pkg/scheduler/internal/cache/node_tree.go:31, Next() :162).
+
+The reference iterates nodes zone-by-zone round-robin so that, combined
+with adaptive sampling, feasible-node discovery (and therefore score ties)
+spreads across zones. The batch solver evaluates the full matrix and
+breaks ties uniformly at random (selectHost semantics), which already
+de-biases zones — but the HOST paths (oracle re-placement, extender
+/filter answering with ordered name lists) iterate nodes in some order,
+and first-max-wins tie-breaks there inherit it. NodeTree supplies the
+zone-interleaved order for those paths.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..api.types import Node
+from ..oracle.nodeinfo import get_zone_key
+
+
+class NodeTree:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tree: Dict[str, List[str]] = {}  # zone key -> node names
+        self._zones: List[str] = []  # insertion-ordered zone keys
+        self._zone_index = 0
+        self._last_index: Dict[str, int] = {}
+        self._rotation = 0  # order() starting offset (rotating tie de-bias)
+        self.num_nodes = 0
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            zone = get_zone_key(node)
+            arr = self._tree.get(zone)
+            if arr is None:
+                self._tree[zone] = [node.name]
+                self._zones.append(zone)
+            elif node.name not in arr:
+                arr.append(node.name)
+            else:
+                return
+            self.num_nodes += 1
+
+    def remove_node(self, node: Node) -> None:
+        with self._lock:
+            zone = get_zone_key(node)
+            arr = self._tree.get(zone)
+            if arr is None or node.name not in arr:
+                return
+            arr.remove(node.name)
+            self.num_nodes -= 1
+            if not arr:
+                del self._tree[zone]
+                self._zones.remove(zone)
+                self._last_index.pop(zone, None)
+
+    def update_node(self, old: Optional[Node], new: Node) -> None:
+        if old is not None and get_zone_key(old) != get_zone_key(new):
+            self.remove_node(old)
+        # always (re-)register: headless placeholders promoted to real nodes
+        # were never added, and add_node dedups known names
+        self.add_node(new)
+
+    def next(self) -> Optional[str]:
+        """Next(): one node name, round-robining across zones; a zone's
+        nodes are consumed one per visit (node_tree.go:162-186)."""
+        with self._lock:
+            if not self._zones:
+                return None
+            for _ in range(len(self._zones)):
+                zone = self._zones[self._zone_index % len(self._zones)]
+                self._zone_index += 1
+                idx = self._last_index.get(zone, 0)
+                arr = self._tree[zone]
+                if idx >= len(arr):
+                    self._last_index[zone] = 0
+                    idx = 0
+                self._last_index[zone] = idx + 1
+                return arr[idx]
+            return None
+
+    def order(self) -> List[str]:
+        """One full zone-interleaved pass over every node — the iteration
+        order host-side placement loops should use. Successive calls rotate
+        the starting point (the stateful-Next round-robin de-bias,
+        node_tree.go:162) so first-max-wins tie-breaks don't hotspot the
+        same node every cycle."""
+        with self._lock:
+            if not self._zones:
+                return []
+            out: List[str] = []
+            idx = 0
+            remaining = True
+            while remaining:
+                remaining = False
+                for zone in self._zones:
+                    arr = self._tree[zone]
+                    if idx < len(arr):
+                        out.append(arr[idx])
+                        if idx + 1 < len(arr):
+                            remaining = True
+                idx += 1
+            r = self._rotation % len(out)
+            self._rotation = (self._rotation + 1) % len(out)
+            return out[r:] + out[:r]

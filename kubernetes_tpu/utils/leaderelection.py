@@ -1,0 +1,169 @@
+"""Leader election (client-go tools/leaderelection/leaderelection.go:197).
+
+The scheduler's HA story is active-passive (SURVEY §2.3): replicas race
+for a lease; the holder runs, renewals extend it, and losing the lease is
+fatal for the loop (the reference klog.Fatalf's — here on_stopped_leading
+fires and run() returns). Locks are CAS-guarded records — the LeaseLock
+below rides the fake apiserver's resourceVersion conflicts, exactly the
+resourceVersion-precondition discipline of the real Lease objects.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Callable, Optional
+
+from ..apiserver.store import ConflictError, FakeAPIServer, NotFoundError
+
+
+@dataclass
+class LeaderElectionRecord:
+    """resourcelock.LeaderElectionRecord."""
+
+    holder_identity: str = ""
+    lease_duration_s: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    leader_transitions: int = 0
+    # lock bookkeeping (apiserver object contract)
+    name: str = "kube-scheduler"
+    resource_version: str = ""
+
+    def key(self) -> str:
+        return self.name
+
+
+class LeaseLock:
+    """resourcelock.Interface over the fake apiserver ("leases" kind):
+    get/create/update with resourceVersion CAS — two racing candidates
+    cannot both win (ConflictError loses)."""
+
+    def __init__(self, api: FakeAPIServer, name: str = "kube-scheduler"):
+        self.api = api
+        self.name = name
+
+    def get(self) -> Optional[LeaderElectionRecord]:
+        try:
+            return self.api.get("leases", self.name)
+        except NotFoundError:
+            return None
+
+    def create(self, record: LeaderElectionRecord) -> bool:
+        record = replace(record, name=self.name)
+        try:
+            self.api.create("leases", record)
+            return True
+        except ConflictError:
+            return False
+
+    def update(self, record: LeaderElectionRecord) -> bool:
+        record = replace(record, name=self.name)
+        try:
+            self.api.update("leases", record, check_rv=True)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        lock: LeaseLock,
+        identity: str,
+        lease_duration_s: float = 15.0,
+        renew_deadline_s: float = 10.0,
+        retry_period_s: float = 2.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        now: Callable[[], float] = time.monotonic,
+    ):
+        assert lease_duration_s > renew_deadline_s > retry_period_s > 0
+        self.lock = lock
+        self.identity = identity
+        self.lease_duration_s = lease_duration_s
+        self.renew_deadline_s = renew_deadline_s
+        self.retry_period_s = retry_period_s
+        self.on_started_leading = on_started_leading or (lambda: None)
+        self.on_stopped_leading = on_stopped_leading or (lambda: None)
+        self._now = now
+        self._observed: Optional[LeaderElectionRecord] = None
+        self._observed_at = 0.0
+        self._stop = threading.Event()
+
+    # -- acquire/renew (leaderelection.go:237-259) ---------------------------
+
+    def is_leader(self) -> bool:
+        return bool(self._observed and self._observed.holder_identity == self.identity)
+
+    def try_acquire_or_renew(self) -> bool:
+        now = self._now()
+        current = self.lock.get()
+        if current is None:
+            rec = LeaderElectionRecord(
+                holder_identity=self.identity,
+                lease_duration_s=self.lease_duration_s,
+                acquire_time=now,
+                renew_time=now,
+            )
+            if not self.lock.create(rec):
+                return False
+            self._observed = self.lock.get()
+            self._observed_at = now
+            return True
+        # observe changes for expiry tracking
+        if self._observed is None or (
+            current.holder_identity != self._observed.holder_identity
+            or current.renew_time != self._observed.renew_time
+        ):
+            self._observed = current
+            self._observed_at = now
+        held_by_other = current.holder_identity and current.holder_identity != self.identity
+        lease_valid = self._observed_at + current.lease_duration_s > now
+        if held_by_other and lease_valid:
+            return False  # someone else holds an unexpired lease
+        rec = LeaderElectionRecord(
+            holder_identity=self.identity,
+            lease_duration_s=self.lease_duration_s,
+            acquire_time=current.acquire_time if not held_by_other else now,
+            renew_time=now,
+            leader_transitions=current.leader_transitions + (1 if held_by_other else 0),
+            resource_version=current.resource_version,
+        )
+        if not self.lock.update(rec):
+            return False  # CAS lost: another candidate raced us
+        self._observed = self.lock.get()
+        self._observed_at = now
+        return True
+
+    # -- run loop ------------------------------------------------------------
+
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """Block until leadership is acquired, call on_started_leading, keep
+        renewing; on renewal failure past the deadline call
+        on_stopped_leading and return (the caller decides to die or rejoin)."""
+        stop = stop or self._stop
+        while not stop.is_set():
+            if self.try_acquire_or_renew():
+                break
+            stop.wait(self.retry_period_s)
+        if stop.is_set():
+            return
+        # client-go runs OnStartedLeading in a goroutine: the holder's
+        # (typically blocking) work must not starve lease renewal
+        threading.Thread(
+            target=self.on_started_leading, daemon=True, name="leading"
+        ).start()
+        deadline = self._now() + self.renew_deadline_s
+        while not stop.is_set():
+            if self.try_acquire_or_renew():
+                deadline = self._now() + self.renew_deadline_s
+            elif self._now() >= deadline:
+                self.on_stopped_leading()
+                return
+            stop.wait(self.retry_period_s)
+        # voluntary stop: release by letting the lease expire
+
+    def stop(self) -> None:
+        self._stop.set()

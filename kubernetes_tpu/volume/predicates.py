@@ -313,22 +313,31 @@ def make_volume_checker(
     sc_lister: Optional[SCLister] = None,
     csinode_lister: Optional[CSINodeLister] = None,
     binder=None,
+    enabled: Optional[frozenset] = None,
 ) -> Callable[[Pod, NodeInfo], Tuple[bool, List[str]]]:
-    """All volume predicates in default-provider order; `binder` adds the
+    """The volume predicates in default-provider order, filtered by the
+    Policy/provider `enabled` set (None = all); `binder` adds the
     CheckVolumeBinding row (volumebinder seam)."""
+
+    def on(name: str) -> bool:
+        return enabled is None or name in enabled
 
     def check(pod: Pod, node_info: NodeInfo) -> Tuple[bool, List[str]]:
         reasons: List[str] = []
-        if not no_disk_conflict(pod, node_info):
+        if on("NoDiskConflict") and not no_disk_conflict(pod, node_info):
             reasons.append(ERR_DISK_CONFLICT)
-        if not no_volume_zone_conflict(pod, node_info, pvc_lister, pv_lister, sc_lister):
+        if on("NoVolumeZoneConflict") and not no_volume_zone_conflict(
+            pod, node_info, pvc_lister, pv_lister, sc_lister
+        ):
             reasons.append(ERR_VOLUME_ZONE_CONFLICT)
         for f in (EBS_FILTER, GCE_PD_FILTER, AZURE_DISK_FILTER):
-            if not max_pd_volume_count(f, pod, node_info, pvc_lister, pv_lister):
+            if on(f.name) and not max_pd_volume_count(f, pod, node_info, pvc_lister, pv_lister):
                 reasons.append(f.name)
-        if not max_csi_volume_count(pod, node_info, pvc_lister, pv_lister, csinode_lister):
+        if on("MaxCSIVolumeCountPred") and not max_csi_volume_count(
+            pod, node_info, pvc_lister, pv_lister, csinode_lister
+        ):
             reasons.append("MaxCSIVolumeCount")
-        if binder is not None:
+        if binder is not None and on("CheckVolumeBinding"):
             ok, r = binder.find_pod_volumes(pod, node_info)
             if not ok:
                 reasons.extend(r or [ERR_VOLUME_BINDING])

