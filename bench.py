@@ -58,7 +58,7 @@ from kubernetes_tpu.state.cache import SchedulerCache
 from kubernetes_tpu.state.queue import PriorityQueue
 
 SCALE = float(os.environ.get("BENCH_SCALE", "1.0"))
-BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
+BATCH = int(os.environ.get("BENCH_BATCH", "4096"))
 ZONES = [f"zone-{i}" for i in range(8)]
 
 

@@ -145,12 +145,23 @@ def run_sim(args) -> int:
         api.create("pods", p)
     t0 = time.perf_counter()
     deadline = time.time() + 300
+    idle = 0
     while time.time() < deadline:
         sched.queue.flush()
-        sched.schedule_batch()
+        r = sched.schedule_batch()
         pods, _ = api.list("pods")
         if len(pods) >= args.pods and all(p.node_name for p in pods):
             break
+        # quiescence: nothing scheduled AND nothing left to try — pods stuck
+        # in unschedulableQ wait for cluster events that a static sim never
+        # produces, so stop instead of spinning out the deadline
+        if r.scheduled == 0 and r.errors == 0 and r.preempted == 0 and len(pods) >= args.pods:
+            idle += 1
+            active, backoff, _ = sched.queue.counts()
+            if idle >= 3 and active == 0 and backoff == 0:
+                break
+        else:
+            idle = 0
         time.sleep(0.01)
     sched.wait_for_binds()
     elapsed = time.perf_counter() - t0

@@ -24,16 +24,19 @@ from .policy import DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE
 
 
 def _parse_duration(v, default_s: float) -> float:
-    """'15s'/'2m'/number → seconds."""
+    """Go duration → seconds: '15s', '2m', '1m30s', '1h2m3.5s', or a bare
+    number."""
     if v is None:
         return default_s
     if isinstance(v, (int, float)):
         return float(v)
+    import re
+
     s = str(v).strip()
     units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
-    for suffix, mult in sorted(units.items(), key=lambda kv: -len(kv[0])):
-        if s.endswith(suffix):
-            return float(s[: -len(suffix)]) * mult
+    parts = re.findall(r"([0-9]*\.?[0-9]+)(ms|s|m|h)", s)
+    if parts and "".join(n + u for n, u in parts) == s:
+        return sum(float(n) * units[u] for n, u in parts)
     return float(s)
 
 

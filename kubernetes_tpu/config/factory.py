@@ -67,10 +67,16 @@ class Configurator:
 
     def create_from_keys(
         self,
-        predicates: frozenset,
-        priorities: Tuple[Tuple[str, int], ...],
+        predicates: Optional[frozenset],
+        priorities: Optional[Tuple[Tuple[str, int], ...]],
         extender_configs: List[ExtenderConfig],
     ) -> Scheduler:
+        from .provider import default_predicates, default_priorities
+
+        if predicates is None:
+            predicates = default_predicates(self.feature_gates)
+        if priorities is None:
+            priorities = default_priorities(self.feature_gates)
         solve_config = SolveConfig(
             predicates=frozenset(predicates), priorities=tuple(priorities)
         )

@@ -110,7 +110,7 @@ class ExtenderServer:
             import numpy as np
 
             from ..ops import filters as F
-            from ..ops import topology as T
+            from ..ops.pipeline import SolveConfig, filter_mask
             from ..state.tensors import PodBatch, _bucket
             from ..state.terms import compile_batch_terms
 
@@ -135,21 +135,21 @@ class ExtenderServer:
                 if etb.overflow_owners:
                     return None
                 dev = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
-                na = dev(mirror.nodes.arrays())
+                # incremental device-resident banks: only dirty rows cross
+                # the wire (state/cache.py device_arrays)
+                na, ea = mirror.device_arrays()
                 pa = dev(batch.arrays())
-                ea = dev(mirror.eps.arrays())
                 ta = dev(tb.arrays())
                 xa = dev(etb.arrays())
                 au = dev(aux)
                 ids = F.make_ids(mirror.vocab)
-                en = self.enabled_predicates
-                mask = F.combined_mask(na, pa, ids, predicates=en)
-                sel = F.pod_match_node_selector(na, pa)
-                if en is None or "EvenPodsSpread" in en:
-                    mask = mask & T.spread_filter(na, ea, ta, sel)
-                if en is None or "MatchInterPodAffinity" in en:
-                    mask = mask & T.interpod_filter(na, ea, ta, au, xa, pa)
-                row = np.asarray(mask)[0]
+                cfg = (
+                    SolveConfig(predicates=self.enabled_predicates)
+                    if self.enabled_predicates is not None
+                    else None
+                )
+                mask = filter_mask(na, pa, ea, ta, xa, au, ids, config=cfg)
+                row = np.asarray(mask[0])
                 return {
                     name: bool(row[mirror.row_of[name]])
                     for name in names

@@ -202,7 +202,7 @@ _MASK_PRED_NAMES = {
     "resources": "PodFitsResources",
     "taints": "PodToleratesNodeTaints",
 }
-_GENERAL = frozenset({"HostName", "PodFitsHostPorts", "MatchNodeSelector", "PodFitsResources"})
+from ..oracle.predicates import GENERAL_PREDICATES_EXPANSION as _GENERAL
 
 
 @partial(jax.jit, static_argnames=("predicates",))

@@ -83,6 +83,24 @@ def mask_and_score(
     return mask, score
 
 
+@partial(jax.jit, static_argnames=("config",))
+def filter_mask(
+    na: Arrays,
+    pa: Arrays,
+    ea: Arrays,
+    ta: Arrays,
+    xa: Arrays,
+    au: Arrays,
+    ids: Arrays,
+    config: Optional[SolveConfig] = None,
+) -> jnp.ndarray:
+    """Filter-only entry point (the extender /filter path): shares
+    mask_and_score so the gating can never diverge; XLA dead-code-eliminates
+    the unused score computation."""
+    mask, _ = mask_and_score(na, pa, ea, ta, xa, au, ids, config)
+    return mask
+
+
 @partial(jax.jit, static_argnames=("deterministic", "config"))
 def solve_pipeline(
     na: Arrays,  # NodeBank arrays

@@ -362,10 +362,12 @@ EVEN_PODS_SPREAD_PRED = "EvenPodsSpread"
 MATCH_INTER_POD_AFFINITY_PRED = "MatchInterPodAffinity"
 
 # GeneralPredicates expands to these (predicates.go:1204 noncriticalPredicates
-# + EssentialPredicates)
-_GENERAL_SET = frozenset(
+# + EssentialPredicates). THE one definition — the device mask
+# (ops/filters.py) and the provider registry import it.
+GENERAL_PREDICATES_EXPANSION = frozenset(
     {HOST_NAME_PRED, POD_FITS_HOST_PORTS_PRED, MATCH_NODE_SELECTOR_PRED, POD_FITS_RESOURCES_PRED}
 )
+_GENERAL_SET = GENERAL_PREDICATES_EXPANSION
 
 
 def predicate_enabled(name: str, enabled) -> bool:
@@ -386,7 +388,7 @@ class PredicateMetadata:
     nominated-pods two-pass) applies the same policy."""
 
     even_pods_spread: Optional[EvenPodsSpreadMetadata]
-    pod_affinity: PodAffinityMetadata
+    pod_affinity: Optional[PodAffinityMetadata]
     enabled: Optional[frozenset] = None
 
 
@@ -399,7 +401,11 @@ def compute_predicate_metadata(
             if predicate_enabled(EVEN_PODS_SPREAD_PRED, enabled)
             else None
         ),
-        pod_affinity=compute_pod_affinity_metadata(pod, snapshot),
+        pod_affinity=(
+            compute_pod_affinity_metadata(pod, snapshot)
+            if predicate_enabled(MATCH_INTER_POD_AFFINITY_PRED, enabled)
+            else None
+        ),
         enabled=enabled,
     )
 

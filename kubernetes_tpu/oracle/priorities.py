@@ -487,24 +487,25 @@ def prioritize_nodes(
     w = dict(DEFAULT_PRIORITY_WEIGHTS)
     if weights:
         w.update(weights)
-    results: Dict[str, Scores] = {
-        "SelectorSpreadPriority": selector_spread_priority(pod, snapshot, spread_selectors),
-        "InterPodAffinityPriority": inter_pod_affinity_priority(pod, snapshot),
-        "MostRequestedPriority": most_requested_priority(pod, snapshot),
-        "LeastRequestedPriority": least_requested_priority(pod, snapshot),
-        "BalancedResourceAllocation": balanced_resource_allocation(pod, snapshot),
-        "NodePreferAvoidPodsPriority": node_prefer_avoid_pods_priority(pod, snapshot),
-        "NodeAffinityPriority": node_affinity_priority(pod, snapshot),
-        "TaintTolerationPriority": taint_toleration_priority(pod, snapshot),
-        "ImageLocalityPriority": image_locality_priority(pod, snapshot),
+    # each map is O(nodes×pods): only compute the ones with weight > 0
+    makers: Dict[str, Callable[[], Scores]] = {
+        "SelectorSpreadPriority": lambda: selector_spread_priority(pod, snapshot, spread_selectors),
+        "InterPodAffinityPriority": lambda: inter_pod_affinity_priority(pod, snapshot),
+        "MostRequestedPriority": lambda: most_requested_priority(pod, snapshot),
+        "LeastRequestedPriority": lambda: least_requested_priority(pod, snapshot),
+        "BalancedResourceAllocation": lambda: balanced_resource_allocation(pod, snapshot),
+        "NodePreferAvoidPodsPriority": lambda: node_prefer_avoid_pods_priority(pod, snapshot),
+        "NodeAffinityPriority": lambda: node_affinity_priority(pod, snapshot),
+        "TaintTolerationPriority": lambda: taint_toleration_priority(pod, snapshot),
+        "ImageLocalityPriority": lambda: image_locality_priority(pod, snapshot),
     }
     if enable_even_pods_spread:
-        results["EvenPodsSpreadPriority"] = even_pods_spread_priority(pod, snapshot)
+        makers["EvenPodsSpreadPriority"] = lambda: even_pods_spread_priority(pod, snapshot)
     total: Scores = {name: 0 for name in snapshot.node_infos}
-    for pname, scores in results.items():
+    for pname, make in makers.items():
         weight = w.get(pname, 0)
         if not weight:
             continue
-        for node_name, s in scores.items():
+        for node_name, s in make().items():
             total[node_name] += weight * s
     return total

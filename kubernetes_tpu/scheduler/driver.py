@@ -32,8 +32,10 @@ from ..api.types import Pod, PodDisruptionBudget
 from ..framework.interface import CycleState, Framework, Status
 from ..oracle.predicates import (
     compute_predicate_metadata,
+    get_pod_anti_affinity_terms,
     pod_fits_on_node,
     pod_fits_resources,
+    pod_matches_term,
 )
 from ..state.cache import SchedulerCache, TensorMirror
 from ..state.queue import PodInfo, PriorityQueue
@@ -55,6 +57,45 @@ class ScheduleResult:
     assignments: Dict[str, str] = field(default_factory=dict)
 
 
+class ScoreRows:
+    """Lazy per-row view of the device score matrix. Fetching the full
+    [B, N] matrix is the single most expensive transfer in the system on a
+    remote-attached TPU (100+ MB at ~15 MB/s for the 10k-node config);
+    only the handful of rows the oracle re-placement path actually ranks
+    with may cross the wire (ops/pipeline.gather_score_rows)."""
+
+    def __init__(self, score_dev):
+        self._dev = score_dev
+        self._cache: Dict[int, np.ndarray] = {}
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        row = self._cache.get(i)
+        if row is None:
+            self.prefetch([i])
+            row = self._cache[i]
+        return row
+
+    def prefetch(self, indices) -> None:
+        """Fetch many rows in ONE gather+transfer. The per-row path pays the
+        ~100ms round-trip fixed cost per pod — a host-rank batch (Score
+        plugins / prioritize extenders) must bulk-fetch instead. The index
+        count is padded to a power-of-two bucket (repeating the first index)
+        so the jitted gather compiles once per bucket, not per batch."""
+        from ..state.tensors import _bucket
+        from ..ops.pipeline import gather_score_rows
+
+        import jax.numpy as jnp
+
+        want = [i for i in indices if i not in self._cache]
+        if not want:
+            return
+        nb = min(_bucket(len(want)), int(self._dev.shape[0]))
+        padded = (want + [want[0]] * nb)[:nb]
+        rows = np.asarray(gather_score_rows(self._dev, jnp.asarray(padded)))
+        for j, i in enumerate(padded[: len(want)]):
+            self._cache[i] = rows[j]
+
+
 @dataclass
 class SolveOutput:
     """Device-solve result + the host-side caveats the commit loop must
@@ -62,7 +103,7 @@ class SolveOutput:
 
     assign: np.ndarray  # [len(pods)] node row or -1
     fallback: np.ndarray  # [len(pods)] bool: encoding/term overflow → oracle
-    score: np.ndarray  # [len(pods), N] device score rows (for oracle ranking)
+    score: "ScoreRows"  # lazy [len(pods), N] device score rows (oracle ranking)
     has_anti: np.ndarray  # [len(pods)] bool: pod carries required anti-affinity
     existing_overflow: bool  # existing pods' terms truncated → recheck all
     node_fallback_any: bool  # some node rows excluded from the fast path
@@ -112,25 +153,42 @@ def pod_group_min_available(pod: Pod) -> int:
         return 0
 
 
-def _needs_oracle_recheck(pod: Pod) -> bool:
-    """Pods whose FEASIBILITY can be perturbed by earlier pods in the same
-    batch (the solver's carry only tracks resources and pod counts):
-    DoNotSchedule topology-spread, required (anti-)affinity terms, or host
-    ports (two ported pods can collide on the node the pre-batch mask
-    cleared for both). ScheduleAnyway spread and preferred affinity only
-    shift SCORES — batch-stale scores are an accepted part of the batching
-    contract (see ops/solver.py), so those pods stay on the fast path."""
-    if any(c.when_unsatisfiable == "DoNotSchedule" for c in pod.topology_spread_constraints):
-        return True
+RECHECK_NONE = 0
+RECHECK_LIGHT = 1  # validate against THIS BATCH's commits only (cheap)
+RECHECK_FULL = 2  # full scalar oracle pass (O(cluster) metadata)
+
+
+def _recheck_level(pod: Pod) -> int:
+    """How much validation a pod's device placement needs against earlier
+    commits in the same batch (the solver's carry only tracks resources and
+    pod counts).
+
+    FULL — the commit can be invalidated in ways only the oracle sees:
+      * DoNotSchedule topology-spread (commits shift domain counts), or
+      * required pod AFFINITY (the pod's anchor may itself be an in-batch
+        commit — the first-pod-in-series escape let the mask pass
+        everywhere, but sequential semantics pin later pods to the
+        anchor's domain, predicates.go:1269).
+    LIGHT — only BATCH COMMITS can break it, so checking against them
+      suffices (they are already assumed into the live snapshot):
+      * required ANTI-affinity (either direction), and
+      * host ports (two ported pods colliding on one node).
+    ScheduleAnyway spread and preferred affinity only shift SCORES —
+    batch-stale scores are the accepted batching contract (ops/solver.py)."""
     a = pod.affinity
-    if a is not None and (
-        (a.pod_affinity is not None and a.pod_affinity.required)
-        or (a.pod_anti_affinity is not None and a.pod_anti_affinity.required)
-    ):
-        return True
+    if any(c.when_unsatisfiable == "DoNotSchedule" for c in pod.topology_spread_constraints):
+        return RECHECK_FULL
+    if a is not None and a.pod_affinity is not None and a.pod_affinity.required:
+        return RECHECK_FULL
+    if a is not None and a.pod_anti_affinity is not None and a.pod_anti_affinity.required:
+        return RECHECK_LIGHT
     if pod.host_ports():
-        return True
-    return False
+        return RECHECK_LIGHT
+    return RECHECK_NONE
+
+
+def _needs_oracle_recheck(pod: Pod) -> bool:
+    return _recheck_level(pod) != RECHECK_NONE
 
 
 class Scheduler:
@@ -209,6 +267,7 @@ class Scheduler:
             "solve_s": 0.0,
             "commit_s": 0.0,
             "oracle_rechecks": 0,
+            "light_rechecks": 0,
             "oracle_places": 0,
             "batches": 0,
         }
@@ -274,12 +333,22 @@ class Scheduler:
         ids = self._ids
         self._cycle += 1
         key = jax.random.PRNGKey(self._rng_seed + self._cycle)
+        # device-RESIDENT banks patched by dirty rows (TensorMirror
+        # .device_arrays); existing-terms bank device copy memoized on the
+        # cached host object — per batch only the pod batch, the batch term
+        # tables, and the dirty row slices cross the host→device wire
+        na_dev, ea_dev = self.mirror.device_arrays()
+        if etb is not getattr(self, "_etb_host", None):
+            import jax.numpy as jnp
+
+            self._etb_dev = {k: jnp.asarray(v) for k, v in etb.arrays().items()}
+            self._etb_host = etb
         args = (
-            self.mirror.nodes.arrays(),
+            na_dev,
             batch.arrays(),
-            self.mirror.eps.arrays(),
+            ea_dev,
             tb.arrays(),
-            etb.arrays(),
+            self._etb_dev,
             aux,
             ids,
             key,
@@ -299,16 +368,18 @@ class Scheduler:
             assign, score, gang_ok = solve_pipeline_gang(
                 *args, garr, deterministic=self.deterministic, config=self.solve_config
             )
+            assign, gang_ok = jax.device_get((assign, gang_ok))  # one transfer
             gang_ok_arr = np.asarray(gang_ok)[: len(pods)]
         else:
             assign, score = solve_pipeline(
                 *args, deterministic=self.deterministic, config=self.solve_config
             )
+            assign = jax.device_get(assign)
         n = len(pods)
         out = SolveOutput(
             assign=np.asarray(assign)[:n],
             fallback=np.asarray(batch.fallback)[:n],
-            score=np.asarray(score)[:n],
+            score=ScoreRows(score),
             has_anti=np.asarray(aux["has_anti"])[:n],
             existing_overflow=existing_overflow,
             node_fallback_any=bool((self.mirror.nodes.fallback & self.mirror.nodes.valid).any()),
@@ -321,6 +392,58 @@ class Scheduler:
         """Extenders interested in this pod (IsInterested,
         core/extender.go:450)."""
         return [e for e in self.extenders if e.is_interested(pod)]
+
+    def _intra_batch_conflict(
+        self,
+        pod: Pod,
+        node_name: str,
+        commits: List[Tuple[Pod, str]],
+        committed_anti: List[Tuple[Pod, str]],
+    ) -> bool:
+        """Can an earlier commit of THIS batch invalidate pod→node_name?
+        The cheap replacement for the full oracle pass (which is O(cluster)
+        per pod): the device mask already validated everything against the
+        pre-batch snapshot bit-for-bit, so only batch commits can break a
+        LIGHT-level pod — host-port collisions on the node (commits are
+        assumed into the live NodeInfo) and required anti-affinity in
+        either direction (satisfiesExistingPodsAntiAffinity semantics,
+        predicates.go:1284: both nodes must carry the topology key with
+        equal values)."""
+        snap = self.cache.snapshot
+        ni = snap.get(node_name)
+        if ni is None:
+            return True
+
+        def same_topology(node_a, node_b, key: str) -> bool:
+            if not key:
+                return False
+            va = node_a.labels.get(key)
+            return va is not None and va == node_b.labels.get(key)
+
+        if pod.host_ports() and ni.host_port_conflict(pod):
+            return True
+        node = ni.node
+        for c, n_c in committed_anti:
+            c_ni = snap.get(n_c)
+            if c_ni is None:
+                continue
+            for term in get_pod_anti_affinity_terms(c.affinity):
+                if same_topology(node, c_ni.node, term.topology_key) and pod_matches_term(
+                    pod, c, term
+                ):
+                    return True
+        a = pod.affinity
+        if a is not None and a.pod_anti_affinity is not None:
+            for term in a.pod_anti_affinity.required:
+                for c, n_c in commits:
+                    c_ni = snap.get(n_c)
+                    if c_ni is None:
+                        continue
+                    if same_topology(node, c_ni.node, term.topology_key) and pod_matches_term(
+                        c, pod, term
+                    ):
+                        return True
+        return False
 
     def _oracle_place(
         self, pod: Pod, score_row: np.ndarray, meta, state: Optional[CycleState] = None
@@ -662,11 +785,18 @@ class Scheduler:
         # Score/PostFilter participate in SELECTION, not just validation —
         # the device's argmax pick must be re-ranked host-side
         force_host_rank = fw.has_plugins("score") or fw.has_plugins("post_filter")
+        if force_host_rank:
+            # EVERY pod will take the host-rank path: one bulk gather instead
+            # of a ~100ms device round-trip per pod
+            out.score.prefetch(range(len(infos)))
         # once a pod carrying required anti-affinity commits, its terms can
         # invalidate ANY later pod's device placement (the mask predates the
-        # batch) — force the oracle re-check for the rest of the batch
-        # (reference: sequential loop sees it via
-        # satisfiesExistingPodsAntiAffinity, predicates.go:1284)
+        # batch) — later pods get the cheap intra-batch check against these
+        # lists instead of an O(cluster) oracle pass (reference: the
+        # sequential loop sees it via satisfiesExistingPodsAntiAffinity,
+        # predicates.go:1284)
+        batch_commits: List[Tuple[Pod, str]] = []
+        committed_anti: List[Tuple[Pod, str]] = []
         anti_committed = False
         # once ANY pod commits to a different node than the solver chose (an
         # oracle re-placement), the scan carry's residuals are stale for the
@@ -685,6 +815,14 @@ class Scheduler:
                 self._rollback_prepared(
                     s_info, s_assumed, s_node, s_state, cycle, "gang incomplete"
                 )
+                # the rolled-back members no longer occupy any node: prune
+                # them so later LIGHT pods don't see phantom conflicts and
+                # escalate to the O(cluster) oracle path
+                entry = (s_info.pod, s_node)
+                if entry in batch_commits:
+                    batch_commits.remove(entry)
+                if entry in committed_anti:
+                    committed_anti.remove(entry)
                 res.unschedulable += 1
                 residuals_diverged = True  # staged capacity released
 
@@ -724,17 +862,18 @@ class Scheduler:
                         residuals_diverged = True
                     self._fail(info, cycle, f"prefilter: {st.message}")
                     continue
-            needs_recheck = (
+            level = _recheck_level(pod)
+            needs_full = (
                 out.fallback[i]
                 or out.existing_overflow
-                or anti_committed
                 or host_filter
-                or _needs_oracle_recheck(pod)
+                or level == RECHECK_FULL
                 or (
                     self.volume_checker is not None
                     and bool(scheduling_relevant_volumes(pod))
                 )
             )
+            needs_light = level == RECHECK_LIGHT or anti_committed
             pod_host_rank = force_host_rank or (
                 bool(self.extenders)
                 and any(
@@ -752,7 +891,7 @@ class Scheduler:
                     meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
                     node_name = self._oracle_place(pod, out.score[i], meta, state)
                     placed_attempted = True
-                elif node_name is not None and (needs_recheck or nominated_fn(node_name)):
+                elif node_name is not None and (needs_full or nominated_fn(node_name)):
                     self.stats["oracle_rechecks"] += 1
                     meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
                     ok = self.cache.snapshot.get(node_name) is not None and fits_considering_nominated(
@@ -770,6 +909,21 @@ class Scheduler:
                         # the oracle against the CURRENT snapshot, ranking
                         # candidates by the device score row
                         # (sequential-equivalent filter, batch-stale scores)
+                        node_name = self._oracle_place(pod, out.score[i], meta, state)
+                        placed_attempted = True
+                elif node_name is not None and needs_light:
+                    # cheap intra-batch validation: only this batch's commits
+                    # can invalidate a LIGHT pod's device placement
+                    self.stats["light_rechecks"] += 1
+                    ok = not self._intra_batch_conflict(
+                        pod, node_name, batch_commits, committed_anti
+                    )
+                    if ok and residuals_diverged:
+                        ni = self.cache.snapshot.get(node_name)
+                        ok = ni is not None and pod_fits_resources(pod, ni)
+                    if not ok:
+                        self.stats["oracle_places"] += 1
+                        meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
                         node_name = self._oracle_place(pod, out.score[i], meta, state)
                         placed_attempted = True
                 elif node_name is not None and residuals_diverged:
@@ -844,15 +998,19 @@ class Scheduler:
                     res.unschedulable += 1
                     continue
                 gang_staged.setdefault(group, []).append((info, assumed, node_name, state))
+                batch_commits.append((pod, node_name))
                 if out.has_anti[i]:
                     anti_committed = True
+                    committed_anti.append((pod, node_name))
                 if node_name != device_choice:
                     residuals_diverged = True
             elif self._commit(info, node_name, cycle, state):
                 res.scheduled += 1
                 res.assignments[pod.key()] = node_name
+                batch_commits.append((pod, node_name))
                 if out.has_anti[i]:
                     anti_committed = True
+                    committed_anti.append((pod, node_name))
                 if node_name != device_choice:
                     residuals_diverged = True
             else:

@@ -37,6 +37,27 @@ from .tensors import (
 
 DEFAULT_ASSUME_TTL = 30.0  # cache.go durationToExpireAssumedPod (30s default)
 
+_ROW_SCATTER = None
+
+
+def _row_scatter_fn():
+    """One jitted row-scatter over a whole bank dict: a single dispatch
+    updates every array's dirty rows (compiled once per (row-bucket,
+    structure) pair)."""
+    global _ROW_SCATTER
+    if _ROW_SCATTER is None:
+        import jax
+
+        @jax.jit
+        def scatter(dev, idx, updates):
+            out = dict(dev)
+            for k, u in updates.items():
+                out[k] = dev[k].at[idx].set(u)
+            return out
+
+        _ROW_SCATTER = scatter
+    return _ROW_SCATTER
+
 
 @dataclass
 class _PodState:
@@ -249,6 +270,17 @@ class TensorMirror:
         self.rebuild_count = -1  # constructor's build doesn't count
         self._min_nodes = 1
         self._min_pods = 1
+        # device-resident copies of the banks, patched by dirty ROW SLICES:
+        # on a remote-attached TPU, re-uploading whole banks every batch
+        # costs seconds (10s of MB at ~15 MB/s tunnel bandwidth) — only the
+        # changed rows may cross the wire (the device half of the
+        # UpdateNodeInfoSnapshot generation walk, cache.go:206-242)
+        self._dev_nodes = None
+        self._dev_eps = None
+        self._device_stale = True
+        self._image_stale = False
+        self._pending_node_rows: Set[int] = set()
+        self._pending_pod_rows: Set[int] = set()
         self._rebuild()
 
     def reserve(self, n_nodes: int, n_pods: int) -> None:
@@ -299,6 +331,9 @@ class TensorMirror:
         self.cache.dirty_nodes.clear()
         self.cache.removed_nodes.clear()
         self._etb = None  # cached existing-terms bank (compile_existing_terms)
+        self._device_stale = True  # shapes may have changed: full re-upload
+        self._pending_node_rows.clear()
+        self._pending_pod_rows.clear()
         self.generation = 0
 
     @staticmethod
@@ -309,6 +344,7 @@ class TensorMirror:
         for row in self._node_pod_rows.pop(name, ()):
             self.eps.valid[row] = False
             self._free_pod_rows.append(row)
+            self._pending_pod_rows.add(row)
 
     def _encode_node_pods(self, name: str, ni: NodeInfo) -> None:
         """Re-encode one node's pods into freshly allocated eps rows. Raises
@@ -322,6 +358,7 @@ class TensorMirror:
             row = self._free_pod_rows.pop()
             self.eps.set_pod(row, pod, node_row)
             rows.append(row)
+            self._pending_pod_rows.add(row)
         self._node_pod_rows[name] = rows
 
     def sync(self) -> bool:
@@ -348,6 +385,7 @@ class TensorMirror:
                         self.nodes.clear_node(row)
                         self.name_of_row[row] = None
                         self._free_rows.append(row)
+                        self._pending_node_rows.add(row)
                     self._release_node_pods(name)
                     self._image_sig.pop(name, None)
                 for name in new_nodes:
@@ -361,6 +399,7 @@ class TensorMirror:
                     if ni is None or name not in self.row_of:
                         continue
                     self.nodes.set_node(self.row_of[name], ni)
+                    self._pending_node_rows.add(self.row_of[name])
                     # pods: release this node's old rows, re-encode current
                     old_rows = self._node_pod_rows.get(name, [])
                     had_affinity = any(
@@ -379,6 +418,7 @@ class TensorMirror:
                     # and node count → recompute the whole table (rare: image
                     # states and node membership change far less than pods)
                     ImageTable(self.vocab).apply(self.nodes, cache.snapshot, self.row_of)
+                    self._image_stale = True
                 if affinity_changed:
                     self._etb = None
             except KeySlotOverflow:
@@ -386,6 +426,70 @@ class TensorMirror:
                 return True
             self.generation += 1
             return False
+
+    def device_arrays(self):
+        """(nodes, eps) as DEVICE-resident dicts, patched with only the rows
+        sync() touched since the last call. Full upload only after a rebuild
+        (shape change) — otherwise each changed array ships one [rows, ...]
+        slice + scatter."""
+        import jax.numpy as jnp
+
+        host_n = self.nodes.arrays()
+        host_e = self.eps.arrays()
+        if self._dev_nodes is None or self._device_stale:
+            self._dev_nodes = {k: jnp.asarray(v) for k, v in host_n.items()}
+            self._dev_eps = {k: jnp.asarray(v) for k, v in host_e.items()}
+            self._device_stale = False
+            self._image_stale = False
+            self._pending_node_rows.clear()
+            self._pending_pod_rows.clear()
+            return self._dev_nodes, self._dev_eps
+
+        import numpy as _np
+
+        scatter = _row_scatter_fn()
+
+        import jax.dtypes
+
+        def patch(dev: Dict, host: Dict, rows: List[int], skip=()) -> Dict:
+            # full re-upload for new/resized arrays (rare: vocab growth);
+            # compare against the CANONICALIZED dtype — with x64 disabled
+            # jnp.asarray downcasts int64 host banks to int32 on device, and
+            # a raw string compare would flag those every batch, shipping
+            # whole banks and silently defeating the dirty-row design
+            changed = {
+                k: h
+                for k, h in host.items()
+                if k not in dev
+                or dev[k].shape != h.shape
+                or dev[k].dtype != jax.dtypes.canonicalize_dtype(h.dtype)
+                or k in skip
+            }
+            if changed:
+                dev = dict(dev)
+                dev.update({k: jnp.asarray(v) for k, v in changed.items()})
+            if not rows:
+                return dev
+            cap = next(iter(host.values())).shape[0]
+            # pad the row count to a power-of-two bucket so the jitted
+            # scatter compiles once per bucket, not once per batch (every
+            # fresh shape is a multi-second XLA compile on a remote TPU);
+            # padding repeats row[0] — an idempotent overwrite
+            rb = min(_bucket(len(rows)), cap)
+            padded = list(rows[:rb]) + [rows[0]] * max(rb - len(rows), 0)
+            idx = _np.asarray(padded, _np.int32)
+            updates = {k: _np.ascontiguousarray(h[idx]) for k, h in host.items()}
+            return scatter(dev, jnp.asarray(idx), updates)
+
+        nrows = sorted(self._pending_node_rows)
+        prows = sorted(self._pending_pod_rows)
+        skip_n = ("image_scaled",) if self._image_stale else ()
+        self._dev_nodes = patch(self._dev_nodes, host_n, nrows, skip=skip_n)
+        self._image_stale = False
+        self._dev_eps = patch(self._dev_eps, host_e, prows)
+        self._pending_node_rows.clear()
+        self._pending_pod_rows.clear()
+        return self._dev_nodes, self._dev_eps
 
     def existing_terms(self):
         """Cached compile_existing_terms over the current snapshot —
