@@ -92,6 +92,7 @@ def run_extender(args) -> int:
         cache=sched.cache, host=args.address, port=args.port,
         enabled_predicates=sc.predicates if sc else None,
         priority_weights=sc.priorities if sc else None,
+        rtcr=sc.rtcr if sc else None,
     )
     srv.start()
     msrv = MetricsServer(host=args.address, port=args.metrics_port).start()

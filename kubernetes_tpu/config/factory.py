@@ -54,7 +54,7 @@ class Configurator:
             policy = parse_policy(policy)
         assert isinstance(policy, Policy)
         return self.create_from_keys(
-            policy.predicates, policy.priorities, policy.extenders
+            policy.predicates, policy.priorities, policy.extenders, rtcr=policy.rtcr
         )
 
     def create_from_component_config(self, cfg: KubeSchedulerConfiguration) -> Scheduler:
@@ -70,6 +70,7 @@ class Configurator:
         predicates: Optional[frozenset],
         priorities: Optional[Tuple[Tuple[str, int], ...]],
         extender_configs: List[ExtenderConfig],
+        rtcr=None,
     ) -> Scheduler:
         from .provider import default_predicates, default_priorities
 
@@ -78,7 +79,7 @@ class Configurator:
         if priorities is None:
             priorities = default_priorities(self.feature_gates)
         solve_config = SolveConfig(
-            predicates=frozenset(predicates), priorities=tuple(priorities)
+            predicates=frozenset(predicates), priorities=tuple(priorities), rtcr=rtcr
         )
         volume_checker = None
         wanted_volume = frozenset(predicates) & VOLUME_PREDICATES

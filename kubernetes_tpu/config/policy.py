@@ -35,6 +35,10 @@ class Policy:
     priorities: Optional[Tuple[Tuple[str, int], ...]] = None
     extenders: List[ExtenderConfig] = field(default_factory=list)
     hard_pod_affinity_symmetric_weight: int = DEFAULT_HARD_POD_AFFINITY_WEIGHT
+    # requestedToCapacityRatioArguments, when a priority entry supplies it
+    # (api/types.go:139-152): (shape points (utilization, score), resource
+    # weights (name, weight))
+    rtcr: Optional[Tuple[Tuple[Tuple[int, int], ...], Tuple[Tuple[str, int], ...]]] = None
 
 
 def _extender_from_json(d: dict) -> ExtenderConfig:
@@ -52,6 +56,44 @@ def _extender_from_json(d: dict) -> ExtenderConfig:
         ],
         timeout_s=float(d.get("httpTimeout", 5.0)),
     )
+
+
+def _parse_rtcr_arguments(d: dict):
+    """RequestedToCapacityRatioArguments (api/types.go:139-152 →
+    buildScoringFunctionShapeFromRequestedToCapacityRatioArguments,
+    plugins.go:416-438): shape points validated by NewFunctionShape; empty
+    resources default to cpu/memory weight 1; zero weights become 1."""
+    from ..oracle.priorities import validate_function_shape
+
+    shape = tuple(
+        (int(pt.get("utilization", 0)), int(pt.get("score", 0)))
+        for pt in d.get("shape") or []
+    )
+    try:
+        validate_function_shape(shape)
+    except ValueError as e:
+        raise PolicyError(f"invalid RequestedToCapacityRatio arguments: {e}")
+    res = d.get("resources") or []
+    if not res:
+        resources = (("cpu", 1), ("memory", 1))
+    else:
+        for r in res:
+            if int(r.get("weight", 0)) < 0:
+                raise PolicyError(
+                    f"RequestedToCapacityRatio resource {r.get('name')!r} "
+                    "weight must not be negative"
+                )
+        # an omitted/zero weight becomes 1 (plugins.go:432-435)
+        resources = tuple(
+            (r.get("name", ""), int(r.get("weight", 0)) or 1) for r in res
+        )
+    for rname, _ in resources:
+        if rname not in ("cpu", "memory"):
+            raise PolicyError(
+                f"RequestedToCapacityRatio resource {rname!r} not supported by "
+                "the device score path (cpu/memory only)"
+            )
+    return shape, resources
 
 
 def parse_policy(obj: dict) -> Policy:
@@ -74,11 +116,25 @@ def parse_policy(obj: dict) -> Policy:
         pairs = []
         for p in obj["priorities"] or []:
             name = p.get("name", "")
-            if name not in KNOWN_PRIORITIES:
-                raise PolicyError(f"unknown priority {name!r}")
             weight = int(p.get("weight", 1))
             if weight < 0:
                 raise PolicyError(f"negative weight for {name}")
+            rtcr_args = (p.get("argument") or {}).get("requestedToCapacityRatioArguments")
+            if rtcr_args is not None:
+                # custom priority carrying its own name; register it under
+                # the canonical kernel name (plugins.go:389-393 builds an
+                # RTCR function for whatever name the Policy chose). Only ONE
+                # such entry is representable — a second would silently
+                # shadow the first's shape, so reject it.
+                if policy.rtcr is not None:
+                    raise PolicyError(
+                        "multiple priorities with requestedToCapacityRatioArguments"
+                    )
+                policy.rtcr = _parse_rtcr_arguments(rtcr_args)
+                pairs.append(("RequestedToCapacityRatioPriority", weight))
+                continue
+            if name not in KNOWN_PRIORITIES:
+                raise PolicyError(f"unknown priority {name!r}")
             pairs.append((name, weight))
         policy.priorities = tuple(pairs)
     else:

@@ -59,6 +59,12 @@ KNOWN_PRIORITIES = frozenset(
         "SelectorSpreadPriority",
         "InterPodAffinityPriority",
         "EvenPodsSpreadPriority",
+        # feature-gated (ResourceLimits, defaults.go:106-111)
+        "ResourceLimitsPriority",
+        # Policy-argument custom priority (plugins.go:389-393); the
+        # registration name used when a Policy supplies
+        # requestedToCapacityRatioArguments
+        "RequestedToCapacityRatioPriority",
     }
 )
 
@@ -98,6 +104,9 @@ def default_priorities(fg: Optional[FeatureGate] = None) -> Tuple[Tuple[str, int
     ]
     if fg.enabled("EvenPodsSpread"):
         pairs.append(("EvenPodsSpreadPriority", 1))
+    if fg.enabled("ResourceLimits"):
+        # ResourceLimitsPriorityFunction gate (defaults.go:106-111)
+        pairs.append(("ResourceLimitsPriority", 1))
     return tuple(pairs)
 
 

@@ -64,6 +64,7 @@ class ExtenderServer:
         device_threshold: int = 256,
         enabled_predicates: Optional[frozenset] = None,
         priority_weights=None,  # tuple of (registration name, weight)
+        rtcr=None,  # RequestedToCapacityRatio (shape, resources) Policy args
     ):
         self.cache = cache or SchedulerCache()
         self.bind_fn = bind_fn
@@ -72,6 +73,7 @@ class ExtenderServer:
         # chain, the device mask, and the prioritize weights
         self.enabled_predicates = enabled_predicates
         self.priority_weights = tuple(priority_weights) if priority_weights else None
+        self.rtcr = rtcr
         self._mirror: Optional[TensorMirror] = None
         self._mirror_lock = threading.Lock()
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
@@ -214,7 +216,7 @@ class ExtenderServer:
 
             weights = {name: 0 for name in DEFAULT_PRIORITY_WEIGHTS}
             weights.update(dict(self.priority_weights))
-        scores = prioritize_nodes(pod, snap, weights=weights)
+        scores = prioritize_nodes(pod, snap, weights=weights, rtcr=self.rtcr)
         # rescale the weighted sum into extender range [0, 10]
         relevant = {n: scores.get(n, 0) for n in names}
         hi = max(relevant.values(), default=0)

@@ -37,6 +37,9 @@ class SolveConfig:
 
     predicates: Optional[frozenset] = None
     priorities: Optional[Tuple[Tuple[str, int], ...]] = None
+    # RequestedToCapacityRatio Policy argument: (shape points, resource
+    # weights), both tuples (api/types.go RequestedToCapacityRatioArguments)
+    rtcr: Optional[Tuple[Tuple[Tuple[int, int], ...], Tuple[Tuple[str, int], ...]]] = None
 
     def priority_weight(self, name: str, default: int) -> int:
         if self.priorities is None:
@@ -70,7 +73,7 @@ def mask_and_score(
         mask = mask & T.spread_filter(na, ea, ta, sel)
     if preds is None or "MatchInterPodAffinity" in preds:
         mask = mask & T.interpod_filter(na, ea, ta, au, xa, pa)
-    score = S.score_matrix(na, pa, priorities=cfg.priorities)
+    score = S.score_matrix(na, pa, priorities=cfg.priorities, rtcr=cfg.rtcr)
     w = cfg.priority_weight("InterPodAffinityPriority", 1)
     if w:
         score = score + w * T.interpod_score(na, ea, ta, xa, pa)

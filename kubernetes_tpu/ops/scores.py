@@ -152,6 +152,79 @@ def image_locality(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
     return MAX_NODE_SCORE * (clamped - IMAGE_MIN) // (IMAGE_MAX - IMAGE_MIN)
 
 
+def resource_limits(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
+    """ResourceLimitsPriorityMap (resource_limits.go:36-88): 1 when the node
+    can hold the pod's cpu OR memory limit (both quantities nonzero), else
+    0 — an unnormalized tie-breaker (Reduce nil)."""
+    lc = pods["limit_req"][:, 0][:, None]  # [B, 1]
+    lm = pods["limit_req"][:, 1][:, None]
+    ac = nodes["alloc"][:, 0][None, :]  # [1, N]
+    am = nodes["alloc"][:, 1][None, :]
+    cpu_ok = (lc != 0) & (ac != 0) & (lc <= ac)
+    mem_ok = (lm != 0) & (am != 0) & (lm <= am)
+    return (cpu_ok | mem_ok).astype(jnp.int64)
+
+
+# default shape prefers least-utilized nodes (requested_to_capacity_ratio.go:40)
+DEFAULT_RTCR_SHAPE = ((0, 10), (100, 0))
+DEFAULT_RTCR_RESOURCES = (("cpu", 1), ("memory", 1))
+# device-bank column for each RTCR-scorable resource: (alloc col, nonzero
+# col, scoring col) — extended resources are host-path only
+_RTCR_COLUMNS = {"cpu": 0, "memory": 1}
+
+
+def _go_div(a: jnp.ndarray, b) -> jnp.ndarray:
+    """Go integer division truncates toward zero; // floors. Matters on
+    down-sloping shape segments where the numerator is negative."""
+    q = jnp.abs(a) // abs(b)
+    return jnp.where((a < 0) != (b < 0), -q, q)
+
+
+def requested_to_capacity_ratio(
+    nodes: Arrays,
+    pods: Arrays,
+    shape=DEFAULT_RTCR_SHAPE,
+    resources=DEFAULT_RTCR_RESOURCES,
+) -> jnp.ndarray:
+    """RequestedToCapacityRatio (requested_to_capacity_ratio.go:115-167):
+    per resource, utilization% through the broken-linear shape (full or
+    absent capacity evaluates at 100%); resources scoring 0 are excluded
+    from the weighted mean, which rounds half away from zero (math.Round).
+    `shape`/`resources` are static — one compile per Policy."""
+
+    def raw(p: jnp.ndarray) -> jnp.ndarray:
+        # unrolled piecewise-linear: evaluate segments back-to-front so the
+        # first matching `p <= u_i` wins (buildBrokenLinearFunction)
+        out = jnp.full_like(p, shape[-1][1])
+        for i in range(len(shape) - 1, -1, -1):
+            u, s = shape[i]
+            if i == 0:
+                val = jnp.full_like(p, s)
+            else:
+                u0, s0 = shape[i - 1]
+                val = s0 + _go_div((s - s0) * (p - u0), u - u0)
+            out = jnp.where(p <= u, val, out)
+        return out
+
+    node_score = jnp.zeros((), jnp.int64)
+    weight_sum = jnp.zeros((), jnp.int64)
+    for rname, weight in resources:
+        col = _RTCR_COLUMNS[rname]
+        cap = nodes["alloc"][:, col][None, :]
+        req = nodes["nonzero_req"][:, col][None, :] + pods["scoring_req"][:, col][:, None]
+        full = (cap == 0) | (req > cap)
+        p = jnp.where(full, 100, 100 - (cap - req) * 100 // jnp.maximum(cap, 1))
+        s = raw(p)
+        pos = s > 0
+        node_score = node_score + jnp.where(pos, s * weight, 0)
+        weight_sum = weight_sum + jnp.where(pos, weight, 0)
+    return jnp.where(
+        weight_sum > 0,
+        (2 * node_score + weight_sum) // jnp.maximum(2 * weight_sum, 1),
+        0,
+    )
+
+
 # default-provider weights (algorithmprovider/defaults/defaults.go:128)
 DEFAULT_WEIGHTS = {
     "least_requested": 1,
@@ -172,6 +245,7 @@ _PRIORITY_KERNELS = {
     "TaintTolerationPriority": taint_toleration,
     "NodePreferAvoidPodsPriority": prefer_avoid_pods,
     "ImageLocalityPriority": image_locality,
+    "ResourceLimitsPriority": resource_limits,
 }
 
 # the default provider's weighted sum in registration-name form
@@ -185,15 +259,21 @@ DEFAULT_PRIORITY_TUPLE = (
 )
 
 
-@partial(jax.jit, static_argnames=("priorities",))
-def score_matrix(nodes: Arrays, pods: Arrays, priorities=None) -> jnp.ndarray:
+@partial(jax.jit, static_argnames=("priorities", "rtcr"))
+def score_matrix(nodes: Arrays, pods: Arrays, priorities=None, rtcr=None) -> jnp.ndarray:
     """Weighted sum of the enabled non-topology priorities → [B, N] int64
     (None = default provider weights). The topology scores (topology.py)
     are added by the solver before argmax. `priorities` is a static tuple
-    of (registration name, weight) — each distinct config compiles once."""
+    of (registration name, weight) — each distinct config compiles once.
+    `rtcr` is the optional (shape, resources) Policy argument for
+    RequestedToCapacityRatioPriority."""
     pairs = priorities if priorities is not None else DEFAULT_PRIORITY_TUPLE
     total = jnp.zeros((), jnp.int64)
     for name, weight in pairs:
+        if name == "RequestedToCapacityRatioPriority":
+            shape, res = rtcr if rtcr is not None else (DEFAULT_RTCR_SHAPE, DEFAULT_RTCR_RESOURCES)
+            total = total + weight * requested_to_capacity_ratio(nodes, pods, shape, res)
+            continue
         kernel = _PRIORITY_KERNELS.get(name)
         if kernel is None:
             continue  # host-only priorities (SelectorSpread etc.) add later

@@ -159,6 +159,145 @@ def balanced_resource_allocation(pod: Pod, snapshot: Snapshot) -> Scores:
 
 
 # ---------------------------------------------------------------------------
+# RequestedToCapacityRatio (requested_to_capacity_ratio.go) + ResourceLimits
+# (resource_limits.go) — Policy-configurable / feature-gated resource scores
+# ---------------------------------------------------------------------------
+
+# default shape prefers least-utilized nodes: f(0%)=10, f(100%)=0
+# (requested_to_capacity_ratio.go:40)
+DEFAULT_RTCR_SHAPE: Tuple[Tuple[int, int], ...] = ((0, 10), (100, 0))
+DEFAULT_RTCR_RESOURCES: Tuple[Tuple[str, int], ...] = ((RESOURCE_CPU, 1), (RESOURCE_MEMORY, 1))
+
+
+def _go_div(a: int, b: int) -> int:
+    """Go integer division truncates toward zero; Python // floors — the
+    difference shows on down-sloping shape segments (negative numerators)."""
+    q = abs(a) // abs(b)
+    return q if (a < 0) == (b < 0) else -q
+
+
+def validate_function_shape(shape) -> None:
+    """NewFunctionShape preconditions (requested_to_capacity_ratio.go:53-86):
+    nonempty, strictly increasing utilization in [0, 100], score in [0, 10]."""
+    if not shape:
+        raise ValueError("at least one point must be specified")
+    for i, (u, s) in enumerate(shape):
+        if i and shape[i - 1][0] >= u:
+            raise ValueError("utilization values must be sorted")
+        if not (0 <= u <= 100):
+            raise ValueError("utilization values must be in [0, 100]")
+        if not (0 <= s <= MAX_NODE_SCORE):
+            raise ValueError("score values must be in [0, 10]")
+
+
+def _broken_linear(shape: Tuple[Tuple[int, int], ...], p: int) -> int:
+    """buildBrokenLinearFunction (requested_to_capacity_ratio.go:144-167):
+    piecewise-linear through (utilization, score) points, integer math,
+    constant extrapolation outside the shape's utilization range."""
+    for i, (u, s) in enumerate(shape):
+        if p <= u:
+            if i == 0:
+                return shape[0][1]
+            u0, s0 = shape[i - 1]
+            return s0 + _go_div((s - s0) * (p - u0), u - u0)
+    return shape[-1][1]
+
+
+def _rtcr_resource_values(pod: Pod, ni: NodeInfo, resource: str) -> Tuple[int, int]:
+    """calculateResourceAllocatableRequest (resource_allocation.go:101-123):
+    cpu/memory use the non-zero-defaulted accumulation + the incoming pod's
+    scoring request; other resources use the plain requested accumulation.
+    Unknown resources score (0, 0)."""
+    if resource in (RESOURCE_CPU, RESOURCE_MEMORY):
+        ac, rc, am, rm = _allocatable_and_requested(pod, ni)
+        return (ac, rc) if resource == RESOURCE_CPU else (am, rm)
+    a = ni.node.allocatable_int().get(resource)
+    if a is None:
+        return 0, 0
+    node_req = ni.requested().get(resource, 0)
+    pod_req = 0
+    for c in pod.containers:
+        q = c.requests.get(resource)
+        if q is not None:
+            pod_req += q.value()
+    return a, node_req + pod_req
+
+
+def requested_to_capacity_ratio_priority(
+    pod: Pod,
+    snapshot: Snapshot,
+    shape: Tuple[Tuple[int, int], ...] = DEFAULT_RTCR_SHAPE,
+    resources: Tuple[Tuple[str, int], ...] = DEFAULT_RTCR_RESOURCES,
+) -> Scores:
+    """RequestedToCapacityRatioResourceAllocationPriority
+    (requested_to_capacity_ratio.go:115-142): per resource, utilization% is
+    mapped through the broken-linear shape; full/overflowing nodes evaluate
+    at 100% utilization. Resources scoring 0 are EXCLUDED from the weighted
+    mean (both numerator and denominator — a reference quirk), and the mean
+    is rounded half away from zero (math.Round)."""
+
+    def fn(ni: NodeInfo) -> int:
+        node_score = 0
+        weight_sum = 0
+        for resource, weight in resources:
+            cap, req = _rtcr_resource_values(pod, ni, resource)
+            if cap == 0 or req > cap:
+                p = 100
+            else:
+                p = 100 - (cap - req) * 100 // cap
+            s = _broken_linear(shape, p)
+            if s > 0:
+                node_score += s * weight
+                weight_sum += weight
+        if weight_sum == 0:
+            return 0
+        # math.Round for a non-negative ratio == floor(x + 1/2)
+        return (2 * node_score + weight_sum) // (2 * weight_sum)
+
+    return _score_list(snapshot, fn)
+
+
+def _pod_resource_limits(pod: Pod) -> Tuple[int, int]:
+    """getResourceLimits (resource_limits.go:92-107): sum of container
+    limits, then elementwise max against each init container's limits.
+    CPU in millicores, memory in bytes (Resource.Add semantics)."""
+    cpu = 0
+    mem = 0
+    for c in pod.containers:
+        q = c.limits.get(RESOURCE_CPU)
+        if q is not None:
+            cpu += q.milli_value()
+        q = c.limits.get(RESOURCE_MEMORY)
+        if q is not None:
+            mem += q.value()
+    for ic in pod.init_containers:
+        q = ic.limits.get(RESOURCE_CPU)
+        if q is not None:
+            cpu = max(cpu, q.milli_value())
+        q = ic.limits.get(RESOURCE_MEMORY)
+        if q is not None:
+            mem = max(mem, q.value())
+    return cpu, mem
+
+
+def resource_limits_priority(pod: Pod, snapshot: Snapshot) -> Scores:
+    """ResourceLimitsPriorityMap (resource_limits.go:36-80): score 1 when the
+    node can satisfy the pod's cpu OR memory limit (both quantities nonzero),
+    else 0 — a deliberate coarse tie-breaker, no normalization (Reduce nil)."""
+    limit_cpu, limit_mem = _pod_resource_limits(pod)
+
+    def fn(ni: NodeInfo) -> int:
+        alloc = ni.node.allocatable_int()
+        ac = alloc.get(RESOURCE_CPU, 0)
+        am = alloc.get(RESOURCE_MEMORY, 0)
+        cpu_ok = limit_cpu != 0 and ac != 0 and limit_cpu <= ac
+        mem_ok = limit_mem != 0 and am != 0 and limit_mem <= am
+        return 1 if (cpu_ok or mem_ok) else 0
+
+    return _score_list(snapshot, fn)
+
+
+# ---------------------------------------------------------------------------
 # NodeAffinity / TaintToleration / NodePreferAvoidPods / ImageLocality
 # ---------------------------------------------------------------------------
 
@@ -474,6 +613,11 @@ DEFAULT_PRIORITY_WEIGHTS = {
     # not in the default provider (ClusterAutoscalerProvider swaps it in for
     # LeastRequested); weight 0 unless a config raises it
     "MostRequestedPriority": 0,
+    # Policy-argument custom priority (requested_to_capacity_ratio.go) and
+    # the ResourceLimits feature-gated tie-breaker (resource_limits.go):
+    # active only when a config names them
+    "RequestedToCapacityRatioPriority": 0,
+    "ResourceLimitsPriority": 0,
 }
 
 
@@ -483,10 +627,15 @@ def prioritize_nodes(
     weights: Optional[Dict[str, int]] = None,
     spread_selectors: Optional[List[LabelSelector]] = None,
     enable_even_pods_spread: bool = True,
+    rtcr: Optional[Tuple[Tuple[Tuple[int, int], ...], Tuple[Tuple[str, int], ...]]] = None,
 ) -> Scores:
     w = dict(DEFAULT_PRIORITY_WEIGHTS)
     if weights:
         w.update(weights)
+    rtcr_shape, rtcr_resources = rtcr if rtcr is not None else (
+        DEFAULT_RTCR_SHAPE,
+        DEFAULT_RTCR_RESOURCES,
+    )
     # each map is O(nodes×pods): only compute the ones with weight > 0
     makers: Dict[str, Callable[[], Scores]] = {
         "SelectorSpreadPriority": lambda: selector_spread_priority(pod, snapshot, spread_selectors),
@@ -498,6 +647,10 @@ def prioritize_nodes(
         "NodeAffinityPriority": lambda: node_affinity_priority(pod, snapshot),
         "TaintTolerationPriority": lambda: taint_toleration_priority(pod, snapshot),
         "ImageLocalityPriority": lambda: image_locality_priority(pod, snapshot),
+        "RequestedToCapacityRatioPriority": lambda: requested_to_capacity_ratio_priority(
+            pod, snapshot, rtcr_shape, rtcr_resources
+        ),
+        "ResourceLimitsPriority": lambda: resource_limits_priority(pod, snapshot),
     }
     if enable_even_pods_spread:
         makers["EvenPodsSpreadPriority"] = lambda: even_pods_spread_priority(pod, snapshot)

@@ -62,7 +62,11 @@ from ..oracle.nodeinfo import (
     normalized_image_name,
     pod_non_zero_request,
 )
-from ..oracle.priorities import PREFER_AVOID_PODS_ANNOTATION, _pod_scoring_request
+from ..oracle.priorities import (
+    PREFER_AVOID_PODS_ANNOTATION,
+    _pod_resource_limits,
+    _pod_scoring_request,
+)
 from .interner import ABSENT, StringInterner
 
 # --- operator codes for compiled node-selector requirements -----------------
@@ -474,6 +478,7 @@ class PodBatch:
     req: np.ndarray = None  # [B, R] int64 (GetResourceRequest: incl. init max)
     req_any: np.ndarray = None  # [B] bool: pod requests anything at all
     scoring_req: np.ndarray = None  # [B, 2] int64 (calculatePodResourceRequest)
+    limit_req: np.ndarray = None  # [B, 2] int64 (getResourceLimits: cpu milli, mem bytes)
     priority: np.ndarray = None  # [B] int32
     node_name_id: np.ndarray = None  # [B] int32 spec.nodeName pin (0 = none)
     ns_id: np.ndarray = None  # [B] int32
@@ -522,6 +527,7 @@ class PodBatch:
         self.req = np.zeros((b, c.resource_slots), np.int64)
         self.req_any = np.zeros(b, bool)
         self.scoring_req = np.zeros((b, 2), np.int64)
+        self.limit_req = np.zeros((b, 2), np.int64)  # getResourceLimits (cpu milli, mem bytes)
         self.priority = np.zeros(b, np.int32)
         self.node_name_id = np.zeros(b, np.int32)
         self.ns_id = np.zeros(b, np.int32)
@@ -623,6 +629,9 @@ class PodBatch:
         s_cpu, s_mem = _pod_scoring_request(pod)
         self.scoring_req[b, 0] = s_cpu
         self.scoring_req[b, 1] = s_mem
+        l_cpu, l_mem = _pod_resource_limits(pod)
+        self.limit_req[b, 0] = l_cpu
+        self.limit_req[b, 1] = l_mem
         self.priority[b] = pod.get_priority()
         self.node_name_id[b] = v.id(pod.node_name) if pod.node_name else 0
         self.ns_id[b] = v.id(pod.namespace)
@@ -736,6 +745,7 @@ class PodBatch:
             "req": self.req,
             "req_any": self.req_any,
             "scoring_req": self.scoring_req,
+            "limit_req": self.limit_req,
             "priority": self.priority,
             "node_name_id": self.node_name_id,
             "ns_id": self.ns_id,

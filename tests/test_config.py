@@ -163,3 +163,89 @@ def test_cli_sim_mode(tmp_path, capsys):
     result = json.loads(out)
     assert rc == 0
     assert result["bound"] == result["pods"] == 20
+
+
+def test_policy_rtcr_arguments_parse_and_validate():
+    from kubernetes_tpu.config.policy import PolicyError, parse_policy
+
+    pol = parse_policy({
+        "kind": "Policy",
+        "priorities": [{
+            "name": "CustomBinPack",
+            "weight": 2,
+            "argument": {"requestedToCapacityRatioArguments": {
+                "shape": [{"utilization": 0, "score": 0},
+                          {"utilization": 100, "score": 10}],
+                "resources": [{"name": "cpu", "weight": 3},
+                              {"name": "memory"}],
+            }},
+        }],
+    })
+    assert pol.rtcr == (((0, 0), (100, 10)), (("cpu", 3), ("memory", 1)))
+    assert ("RequestedToCapacityRatioPriority", 2) in pol.priorities
+
+    # unsorted shape rejected (NewFunctionShape preconditions)
+    with pytest.raises(PolicyError):
+        parse_policy({"priorities": [{"name": "x", "argument": {
+            "requestedToCapacityRatioArguments": {
+                "shape": [{"utilization": 50, "score": 1},
+                          {"utilization": 50, "score": 2}]}}}]})
+    # extended resources not supported on the device path
+    with pytest.raises(PolicyError):
+        parse_policy({"priorities": [{"name": "x", "argument": {
+            "requestedToCapacityRatioArguments": {
+                "shape": [{"utilization": 0, "score": 10}],
+                "resources": [{"name": "nvidia.com/gpu", "weight": 1}]}}}]})
+
+
+def test_policy_rtcr_bin_packing_changes_selection():
+    """A bin-packing shape (score grows with utilization) packs the busy
+    node, where the default shape would spread to the empty one."""
+    cache = SchedulerCache()
+    for name in ("packed", "empty"):
+        cache.add_node(make_node(name, cpu_milli=4000, mem=8 * 2**30))
+    filler = make_pod("filler", cpu_milli=3000, mem=0)
+    filler.node_name = "packed"
+    cache.add_pod(filler)
+
+    binpack = _sched_from_policy({
+        "predicates": [{"name": "GeneralPredicates"}],
+        "priorities": [{
+            "name": "RequestedToCapacityRatio",
+            "weight": 1,
+            "argument": {"requestedToCapacityRatioArguments": {
+                "shape": [{"utilization": 0, "score": 0},
+                          {"utilization": 100, "score": 10}]}},
+        }],
+    }, cache)
+    binpack.queue.add(make_pod("c", cpu_milli=100, mem=0))
+    r = binpack.schedule_batch()
+    assert r.assignments["default/c"] == "packed"
+
+
+def test_resource_limits_feature_gate_registration():
+    from kubernetes_tpu.config.provider import default_priorities
+    from kubernetes_tpu.utils.featuregate import FeatureGate
+
+    off = default_priorities(FeatureGate())
+    assert not any(n == "ResourceLimitsPriority" for n, _ in off)
+    fg = FeatureGate()
+    fg.parse("ResourceLimits=true")
+    on = default_priorities(fg)
+    assert ("ResourceLimitsPriority", 1) in on
+
+
+def test_policy_rtcr_negative_weight_and_duplicates_rejected():
+    from kubernetes_tpu.config.policy import PolicyError, parse_policy
+
+    shape = [{"utilization": 0, "score": 10}, {"utilization": 100, "score": 0}]
+    with pytest.raises(PolicyError):
+        parse_policy({"priorities": [{"name": "x", "argument": {
+            "requestedToCapacityRatioArguments": {
+                "shape": shape,
+                "resources": [{"name": "cpu", "weight": -2}]}}}]})
+    with pytest.raises(PolicyError):
+        parse_policy({"priorities": [
+            {"name": "a", "argument": {"requestedToCapacityRatioArguments": {"shape": shape}}},
+            {"name": "b", "argument": {"requestedToCapacityRatioArguments": {"shape": shape}}},
+        ]})

@@ -73,3 +73,113 @@ def test_prefer_avoid_pods_signature():
     assert got[0, 0] == 0 and got[0, 1] == S.MAX_NODE_SCORE
     expect = opri.node_prefer_avoid_pods_priority(pod, snap)
     assert expect["n-avoid"] == 0 and expect["n-ok"] == 10
+
+
+# ---------------------------------------------------------------------------
+# RequestedToCapacityRatio (requested_to_capacity_ratio.go) + ResourceLimits
+# (resource_limits.go)
+# ---------------------------------------------------------------------------
+
+from kubernetes_tpu.api.types import Quantity, RESOURCE_CPU, RESOURCE_MEMORY
+
+RTCR_SHAPES = [
+    S.DEFAULT_RTCR_SHAPE,  # least-utilized preferred
+    ((0, 0), (100, 10)),  # bin-packing: most-utilized preferred
+    ((0, 0), (40, 6), (60, 6), (100, 2)),  # plateau with down-slope tail
+]
+RTCR_RESOURCE_SETS = [
+    S.DEFAULT_RTCR_RESOURCES,
+    (("cpu", 3), ("memory", 1)),
+    (("memory", 2),),
+]
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+@pytest.mark.parametrize("shape_i", range(len(RTCR_SHAPES)))
+def test_requested_to_capacity_ratio_parity(seed, shape_i):
+    shape = RTCR_SHAPES[shape_i]
+    resources = RTCR_RESOURCE_SETS[shape_i]
+    g = ClusterGen(seed)
+    nodes, existing = g.cluster(16, 50, feature_rate=0.4)
+    snap = Snapshot(nodes, existing)
+    pods = [g.pod(80_000 + i, feature_rate=0.3) for i in range(8)]
+    na, pa = _encode(snap, pods)
+    device = np.asarray(S.requested_to_capacity_ratio(na, pa, shape, resources))
+    node_names = list(snap.node_infos.keys())
+    for b, p in enumerate(pods):
+        expect = opri.requested_to_capacity_ratio_priority(p, snap, shape, resources)
+        for n, node_name in enumerate(node_names):
+            assert int(device[b, n]) == expect[node_name], (
+                f"seed={seed} shape={shape} pod={p.name} node={node_name} "
+                f"oracle={expect[node_name]} device={int(device[b, n])}"
+            )
+
+
+def test_rtcr_full_node_evaluates_at_100_percent():
+    from kubernetes_tpu.models.generators import make_node, make_pod
+
+    n_full = make_node("n-full", cpu_milli=100, mem=2**30)
+    n_big = make_node("n-big", cpu_milli=64_000, mem=64 * 2**30)
+    snap = Snapshot([n_full, n_big], [])
+    pod = make_pod("p", cpu_milli=500)
+    expect = opri.requested_to_capacity_ratio_priority(pod, snap)
+    # cpu requested (500m) > capacity (100m) → p=100 → cpu score 0, which the
+    # reference EXCLUDES from the weighted mean; memory (128Mi/1Gi = 13%
+    # utilization) scores 10 + trunc(-10*13/100) = 9 and carries the mean
+    assert expect["n-full"] == 9
+    # both resources near-idle on the big node → full score
+    assert expect["n-big"] == 10
+    na, pa = _encode(snap, [pod])
+    got = np.asarray(S.requested_to_capacity_ratio(na, pa))
+    names = list(snap.node_infos.keys())
+    for i, nm in enumerate(names):
+        assert int(got[0, i]) == expect[nm]
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_resource_limits_parity(seed):
+    g = ClusterGen(seed)
+    nodes, existing = g.cluster(12, 30, feature_rate=0.4)
+    snap = Snapshot(nodes, existing)
+    pods = []
+    for i in range(6):
+        p = g.pod(90_000 + i, feature_rate=0.3)
+        # attach limits the generator doesn't produce: mix of none / cpu-only
+        # / huge (unsatisfiable) / both
+        if i % 4 == 1:
+            p.containers[0].limits = {RESOURCE_CPU: Quantity.parse("500m")}
+        elif i % 4 == 2:
+            p.containers[0].limits = {
+                RESOURCE_CPU: Quantity.parse("9999"),
+                RESOURCE_MEMORY: Quantity.parse("9999Ti"),
+            }
+        elif i % 4 == 3:
+            p.containers[0].limits = {
+                RESOURCE_CPU: Quantity.parse("1"),
+                RESOURCE_MEMORY: Quantity.parse("1Gi"),
+            }
+        pods.append(p)
+    na, pa = _encode(snap, pods)
+    device = np.asarray(S.resource_limits(na, pa))
+    node_names = list(snap.node_infos.keys())
+    for b, p in enumerate(pods):
+        expect = opri.resource_limits_priority(p, snap)
+        for n, node_name in enumerate(node_names):
+            assert int(device[b, n]) == expect[node_name]
+
+
+def test_resource_limits_init_container_max():
+    from kubernetes_tpu.api.types import Container
+    from kubernetes_tpu.models.generators import make_node, make_pod
+
+    node = make_node("n", cpu_milli=4000, mem=8 * 2**30)
+    snap = Snapshot([node], [])
+    pod = make_pod("p")
+    pod.containers[0].limits = {RESOURCE_CPU: Quantity.parse("1")}
+    # init container limit larger than the container sum → max wins
+    pod.init_containers = [
+        Container(name="init", limits={RESOURCE_CPU: Quantity.parse("8")})
+    ]
+    assert opri._pod_resource_limits(pod) == (8000, 0)
+    # 8 cores > 4 allocatable and no mem limit → score 0
+    assert opri.resource_limits_priority(pod, snap)["n"] == 0

@@ -1,0 +1,153 @@
+"""Threaded stress tests for the queue / cache / bind-pool interplay.
+
+The reference leans on `go test -race` plus the informer cache mutation
+detector (client-go `tools/cache/mutation_detector.go`) to keep the
+scheduler's three mutable shared structures honest under concurrency:
+the scheduling queue (scheduling_queue.go), the scheduler cache
+(internal/cache/cache.go), and the async bind goroutines
+(scheduler.go:631-673). Python has no race detector, so this file takes
+the other road: hammer the same interleavings from many writer threads
+while the batch loop runs, then assert global invariants — every bound
+pod landed on a node that exists, the incremental cache state matches a
+from-scratch recomputation (CacheComparer plays the
+cache_comparer.go:71 role), and the tensor mirror stays rebuildable.
+"""
+
+import threading
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.scheduler.eventhandlers import EventHandlers
+from kubernetes_tpu.state.cache import SchedulerCache, TensorMirror
+from kubernetes_tpu.state.debugger import CacheComparer
+from kubernetes_tpu.state.queue import PriorityQueue
+
+
+def test_concurrent_event_writers_while_scheduling():
+    """4 writer threads fire pod/node events straight at EventHandlers (the
+    informer serializes per-resource; direct calls are strictly harsher)
+    while the main thread drives schedule_batch. No exceptions, no
+    deadlock, and the end state is consistent."""
+    cache = SchedulerCache()
+    for i in range(8):
+        cache.add_node(make_node(f"n{i}", cpu_milli=32_000, mem=64 * 2**30))
+    queue = PriorityQueue()
+    bound = {}
+    bound_lock = threading.Lock()
+
+    def bind_fn(pod, node_name):
+        # simulate bind RPC latency so binds genuinely overlap the solve
+        time.sleep(0.001)
+        with bound_lock:
+            bound[pod.key()] = node_name
+
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=Binder(bind_fn=bind_fn),
+        batch_size=64, enable_preemption=False,
+    )
+    handlers = EventHandlers(cache, queue)
+    errors = []
+    live_nodes = {f"n{i}" for i in range(8)}
+    node_lock = threading.Lock()
+
+    def pod_writer(base):
+        try:
+            for i in range(80):
+                handlers.on_pod_add(make_pod(f"w{base}-{i}", cpu_milli=50, mem=0))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def node_churner():
+        try:
+            for i in range(30):
+                name = f"extra-{i}"
+                n = make_node(name, cpu_milli=32_000, mem=64 * 2**30)
+                handlers.on_node_add(n)
+                with node_lock:
+                    live_nodes.add(name)
+                time.sleep(0.002)
+                if i % 3 == 0:
+                    # update path: relabel (dirty row, MoveAllToActive)
+                    n2 = make_node(name, cpu_milli=32_000, mem=64 * 2**30,
+                                   labels={"churned": "yes"})
+                    handlers.on_node_update(n, n2)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    writers = [threading.Thread(target=pod_writer, args=(k,)) for k in range(3)]
+    writers.append(threading.Thread(target=node_churner))
+    for t in writers:
+        t.start()
+    deadline = time.time() + 120
+    total_pods = 3 * 80
+    while time.time() < deadline:
+        sched.queue.flush()
+        sched.schedule_batch()
+        if all(not t.is_alive() for t in writers):
+            with bound_lock:
+                done = len(bound)
+            if done >= total_pods:
+                break
+        time.sleep(0.001)
+    for t in writers:
+        t.join()
+    # drain stragglers deterministically
+    for _ in range(30):
+        sched.queue.move_all_to_active()
+        sched.queue.flush()
+        sched.schedule_batch()
+    sched.wait_for_binds()
+
+    assert not errors, errors
+    with bound_lock:
+        assert len(bound) == total_pods, f"bound {len(bound)}/{total_pods}"
+        for key, node in bound.items():
+            with node_lock:
+                assert node in live_nodes, f"{key} bound to unknown node {node}"
+    # incremental cache state == from-scratch recomputation
+    comparer = CacheComparer(cache)
+    nodes_now = [cache.snapshot.node_infos[n].node for n in cache.snapshot.node_infos]
+    missing, stale = comparer.compare_nodes(nodes_now)
+    assert not missing and not stale
+    # the mirror can still rebuild cleanly from the post-stress cache
+    mirror = TensorMirror(cache)
+    assert mirror.nodes.valid.sum() == len(cache.snapshot.node_infos)
+
+
+def test_assume_expire_requeue_under_concurrent_binds():
+    """Binds succeed but the informer confirmation never arrives: once the
+    post-bind TTL lapses, every assumed pod is rolled out of the cache with
+    node accounting intact (cleanupAssumedPods, cache.go:658 — the TTL
+    clock starts at FinishBinding, cache.go:300, so in-flight binds are
+    never expired)."""
+    cache = SchedulerCache(ttl=0.05)
+    cache.add_node(make_node("n0", cpu_milli=4000, mem=8 * 2**30))
+    queue = PriorityQueue()
+
+    def bind_ok_no_confirm(pod, node_name):
+        time.sleep(0.02)  # overlap the binds
+
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=Binder(bind_fn=bind_ok_no_confirm),
+        batch_size=8, enable_preemption=False,
+    )
+    for i in range(4):
+        queue.add(make_pod(f"p{i}", cpu_milli=100, mem=0))
+    r = sched.schedule_batch()
+    assert r.scheduled == 4
+    # while binds are still in flight the pods must NOT be expirable
+    expired_early = cache.cleanup_expired()
+    assert expired_early == []
+    sched.wait_for_binds()  # finish_binding has now stamped each deadline
+    assert cache.assumed_count() == 4
+    time.sleep(0.1)  # outlive the 50ms TTL with no informer add_pod echo
+    expired = cache.cleanup_expired()
+    assert len(expired) == 4
+    ni = cache.snapshot.get("n0")
+    assert len(ni.pods) == 0
+    assert ni.requested().get("cpu", 0) == 0
