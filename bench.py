@@ -30,6 +30,18 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import jax
+
+try:
+    # persistent XLA compile cache: first-batch compiles at the big bucket
+    # shapes cost 1-2 minutes each on the remote-attached chip — cache them
+    # across bench runs so re-runs measure the scheduler, not the compiler
+    jax.config.update("jax_compilation_cache_dir", os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass  # older jax or unsupported backend: run without the cache
+
 import numpy as np
 
 from kubernetes_tpu.api.types import (
@@ -58,7 +70,10 @@ from kubernetes_tpu.state.cache import SchedulerCache
 from kubernetes_tpu.state.queue import PriorityQueue
 
 SCALE = float(os.environ.get("BENCH_SCALE", "1.0"))
-BATCH = int(os.environ.get("BENCH_BATCH", "4096"))
+# 1024 measured best on the remote-attached chip: steady-state is
+# ~150ms/batch there, while the 4096-pod bucket's first compile at 8k-node
+# shapes runs tens of minutes (XLA compile scales badly on this config)
+BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
 ZONES = [f"zone-{i}" for i in range(8)]
 
 

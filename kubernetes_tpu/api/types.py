@@ -319,6 +319,37 @@ class PodDisruptionBudget:
     disruptions_allowed: int = 0
 
 
+@dataclass
+class Service:
+    """core/v1 Service — the scheduling-visible subset: the label selector
+    that groups pods, consumed by the ServiceAffinity custom predicate
+    (predicates.go:1051) and the ServiceAntiAffinity / SelectorSpread
+    priorities (selector_spreading.go)."""
+
+    name: str = ""
+    namespace: str = "default"
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+def service_from_k8s(obj: dict) -> Service:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    return Service(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        selector=dict(spec.get("selector") or {}),
+    )
+
+
+def service_to_k8s(svc: Service) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": svc.name, "namespace": svc.namespace},
+        "spec": {"selector": dict(svc.selector)},
+    }
+
+
 def _request_value(resource_name: str, q: Quantity) -> int:
     if resource_name == RESOURCE_CPU:
         return q.milli_value()

@@ -41,6 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int, default=10251)
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--deterministic", action="store_true")
+    p.add_argument(
+        "--services-file",
+        help="JSON list of core/v1 Services (scheduling-visible selector "
+             "subset) backing Policy serviceAffinity/serviceAntiAffinity",
+    )
     # sim mode
     p.add_argument("--nodes", type=int, default=100)
     p.add_argument("--pods", type=int, default=500)
@@ -61,10 +66,18 @@ def _configurator(args):
 
     fg = FeatureGate()
     fg.parse(args.feature_gates)
+    service_lister = None
+    if getattr(args, "services_file", None):
+        from .api.types import service_from_k8s
+
+        with open(args.services_file) as f:
+            services = [service_from_k8s(s) for s in json.load(f)]
+        service_lister = lambda: services
     cfgr = Configurator(
         feature_gates=fg,
         batch_size=args.batch_size,
         deterministic=args.deterministic,
+        service_lister=service_lister,
     )
     if args.config:
         cc = load_component_config(args.config)

@@ -32,6 +32,7 @@ class Configurator:
         sc_lister: Optional[Callable] = None,
         csinode_lister: Optional[Callable] = None,
         volume_binder=None,
+        service_lister: Optional[Callable] = None,
         **scheduler_kwargs,
     ):
         self.feature_gates = feature_gates or FeatureGate()
@@ -40,6 +41,7 @@ class Configurator:
         self.sc_lister = sc_lister
         self.csinode_lister = csinode_lister
         self.volume_binder = volume_binder
+        self.service_lister = service_lister
         self.scheduler_kwargs = scheduler_kwargs
 
     def create_from_provider(self, name: str = "DefaultProvider") -> Scheduler:
@@ -54,7 +56,12 @@ class Configurator:
             policy = parse_policy(policy)
         assert isinstance(policy, Policy)
         return self.create_from_keys(
-            policy.predicates, policy.priorities, policy.extenders, rtcr=policy.rtcr
+            policy.predicates,
+            policy.priorities,
+            policy.extenders,
+            rtcr=policy.rtcr,
+            custom_predicates=policy.custom_predicates,
+            custom_priorities=policy.custom_priorities,
         )
 
     def create_from_component_config(self, cfg: KubeSchedulerConfiguration) -> Scheduler:
@@ -71,6 +78,8 @@ class Configurator:
         priorities: Optional[Tuple[Tuple[str, int], ...]],
         extender_configs: List[ExtenderConfig],
         rtcr=None,
+        custom_predicates: Tuple[tuple, ...] = (),
+        custom_priorities: Tuple[tuple, ...] = (),
     ) -> Scheduler:
         from .provider import default_predicates, default_priorities
 
@@ -93,10 +102,73 @@ class Configurator:
                 enabled=wanted_volume,
             )
         extenders = [HTTPExtender(c) for c in extender_configs]
-        return Scheduler(
+        sched = Scheduler(
             solve_config=solve_config,
             volume_checker=volume_checker,
             volume_binder=self.volume_binder,
             extenders=extenders,
             **self.scheduler_kwargs,
         )
+        if custom_predicates or custom_priorities:
+            # EXTEND the scheduler's framework (a caller-supplied one came
+            # through scheduler_kwargs and already wired queue-sort) — the
+            # policy shims implement no QueueSort, so appending is safe
+            sched.framework.plugins.extend(
+                self._build_custom_plugins(sched, custom_predicates, custom_priorities)
+            )
+        return sched
+
+    def _build_custom_plugins(self, sched, custom_predicates, custom_priorities):
+        """Policy custom-argument predicates/priorities → framework plugins
+        over the host commit path (RegisterCustomFitPredicate /
+        RegisterCustomPriorityFunction, factory/plugins.go:127,363). The
+        device mask can't host user-named predicates as jit statics; the
+        framework already forces host filtering when Filter plugins exist."""
+        from ..framework.plugins.builtin import (
+            Handle,
+            ServiceAffinityPlugin,
+            predicate_plugin,
+            priority_plugin,
+        )
+        from ..oracle.predicates import check_node_label_presence
+        from ..oracle.priorities import node_label_priority, service_anti_affinity_priority
+
+        services = self.service_lister or (lambda: [])
+        snap = lambda: sched.cache.snapshot
+        plugins = []
+        for spec in custom_predicates:
+            kind = spec[0]
+            if kind == "CheckNodeLabelPresence":
+                _, name, labels, presence = spec
+                plugins.append(predicate_plugin(
+                    name,
+                    lambda pod, ni, _l=labels, _p=presence: check_node_label_presence(
+                        pod, ni, _l, _p
+                    ),
+                    msg="node(s) didn't have the requested labels",
+                ))
+            elif kind == "ServiceAffinity":
+                _, name, labels = spec
+                plugins.append(ServiceAffinityPlugin(name, labels, snap, services))
+        handle = Handle(snap)
+        for spec in custom_priorities:
+            kind = spec[0]
+            if kind == "NodeLabel":
+                _, name, weight, label, presence = spec
+                plugins.append(priority_plugin(
+                    name,
+                    lambda pod, s, _l=label, _p=presence: node_label_priority(pod, s, _l, _p),
+                    handle,
+                    weight=weight,
+                ))
+            elif kind == "ServiceAntiAffinity":
+                _, name, weight, label = spec
+                plugins.append(priority_plugin(
+                    name,
+                    lambda pod, s, _l=label: service_anti_affinity_priority(
+                        pod, s, _l, services()
+                    ),
+                    handle,
+                    weight=weight,
+                ))
+        return plugins

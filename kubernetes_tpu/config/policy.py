@@ -39,6 +39,15 @@ class Policy:
     # (api/types.go:139-152): (shape points (utilization, score), resource
     # weights (name, weight))
     rtcr: Optional[Tuple[Tuple[Tuple[int, int], ...], Tuple[Tuple[str, int], ...]]] = None
+    # Custom-argument predicates/priorities (api/types.go:83-137) — run as
+    # framework Filter/Score plugins over the host commit path (the factory
+    # builds them; RegisterCustomFitPredicate plugins.go:127 semantics):
+    #   ("CheckNodeLabelPresence", name, labels, presence)
+    #   ("ServiceAffinity",        name, labels)
+    custom_predicates: Tuple[tuple, ...] = ()
+    #   ("NodeLabel",           name, weight, label, presence)
+    #   ("ServiceAntiAffinity", name, weight, label)
+    custom_priorities: Tuple[tuple, ...] = ()
 
 
 def _extender_from_json(d: dict) -> ExtenderConfig:
@@ -104,22 +113,65 @@ def parse_policy(obj: dict) -> Policy:
     # an explicitly-empty list means none (factory.go CreateFromConfig)
     if obj.get("predicates") is not None:
         names = set()
+        custom_preds = []
         for p in obj["predicates"] or []:
             name = p.get("name", "")
+            arg = p.get("argument") or {}
+            lp = arg.get("labelsPresence")
+            sa = arg.get("serviceAffinity")
+            if lp is not None:
+                # RegisterCustomFitPredicate (plugins.go:127): user-named
+                # CheckNodeLabelPresence instance
+                custom_preds.append((
+                    "CheckNodeLabelPresence",
+                    name,
+                    tuple(lp.get("labels") or []),
+                    bool(lp.get("presence", False)),
+                ))
+                continue
+            if sa is not None:
+                custom_preds.append((
+                    "ServiceAffinity",
+                    name,
+                    tuple(sa.get("labels") or []),
+                ))
+                continue
             if name not in KNOWN_PREDICATES:
                 raise PolicyError(f"unknown predicate {name!r}")
             names.add(name)
         policy.predicates = frozenset(names)
+        policy.custom_predicates = tuple(custom_preds)
     else:
         policy.predicates = default_predicates()
     if obj.get("priorities") is not None:
         pairs = []
+        custom_pris = []
         for p in obj["priorities"] or []:
             name = p.get("name", "")
             weight = int(p.get("weight", 1))
             if weight < 0:
                 raise PolicyError(f"negative weight for {name}")
-            rtcr_args = (p.get("argument") or {}).get("requestedToCapacityRatioArguments")
+            arg = p.get("argument") or {}
+            lpref = arg.get("labelPreference")
+            saa = arg.get("serviceAntiAffinity")
+            if lpref is not None:
+                custom_pris.append((
+                    "NodeLabel",
+                    name,
+                    weight,
+                    lpref.get("label", ""),
+                    bool(lpref.get("presence", False)),
+                ))
+                continue
+            if saa is not None:
+                custom_pris.append((
+                    "ServiceAntiAffinity",
+                    name,
+                    weight,
+                    saa.get("label", ""),
+                ))
+                continue
+            rtcr_args = arg.get("requestedToCapacityRatioArguments")
             if rtcr_args is not None:
                 # custom priority carrying its own name; register it under
                 # the canonical kernel name (plugins.go:389-393 builds an
@@ -137,6 +189,7 @@ def parse_policy(obj: dict) -> Policy:
                 raise PolicyError(f"unknown priority {name!r}")
             pairs.append((name, weight))
         policy.priorities = tuple(pairs)
+        policy.custom_priorities = tuple(custom_pris)
     else:
         policy.priorities = default_priorities()
     policy.extenders = [_extender_from_json(e) for e in obj.get("extenders") or []]

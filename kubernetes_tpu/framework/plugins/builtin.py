@@ -147,3 +147,50 @@ def priority_plugin(
             return scores.get(node_name, 0), Status.success()
 
     return _Shim()
+
+
+class ServiceAffinityPlugin(Plugin):
+    """Policy serviceAffinity predicate as a plugin: PreFilter runs the
+    once-per-pod anchor-candidate scan (serviceAffinityMetadataProducer,
+    predicates.go:1060), Filter applies the per-node backfill + match
+    (checkServiceAffinity, predicates.go:1123). State travels in
+    CycleState so Filter never rescans the cluster."""
+
+    def __init__(self, plugin_name: str, labels, snapshot_fn, services_fn):
+        self.name = plugin_name
+        self._labels = tuple(labels)
+        self._snapshot_fn = snapshot_fn
+        self._services_fn = services_fn
+
+    def _key(self, pod: Pod) -> str:
+        return f"{self.name}/meta/{pod.key()}"
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Status:
+        from ...oracle.predicates import service_affinity_precompute
+
+        state.write(
+            self._key(pod),
+            service_affinity_precompute(
+                pod, self._snapshot_fn(), self._labels, self._services_fn()
+            ),
+        )
+        return Status.success()
+
+    def filter(self, state: CycleState, pod: Pod, node_info) -> Status:
+        from ...oracle.predicates import (
+            service_affinity_fits,
+            service_affinity_precompute,
+        )
+
+        try:
+            base, cands = state.read(self._key(pod))
+        except KeyError:
+            # resilient like the reference when metadata is missing
+            base, cands = service_affinity_precompute(
+                pod, self._snapshot_fn(), self._labels, self._services_fn()
+            )
+        if service_affinity_fits(
+            pod, node_info, self._snapshot_fn(), self._labels, base, cands
+        ):
+            return Status.success()
+        return Status.unschedulable("node(s) didn't match service affinity")

@@ -481,3 +481,86 @@ def find_nodes_that_fit(pod: Pod, snapshot: Snapshot) -> List[str]:
         for name, ni in snapshot.node_infos.items()
         if pod_fits_on_node(pod, ni, meta=meta)[0]
     ]
+
+
+# ---------------------------------------------------------------------------
+# Policy custom-argument predicates (api/types.go:83-121): labelsPresence →
+# CheckNodeLabelPresence (predicates.go:1033), serviceAffinity →
+# checkServiceAffinity (predicates.go:1123). Registered as framework Filter
+# plugins by the factory — they gate the host commit path, not the device
+# mask (arbitrary user-named predicates can't be jit statics).
+# ---------------------------------------------------------------------------
+
+def check_node_label_presence(pod, node_info, labels, presence: bool) -> bool:
+    """CheckNodeLabelPresence (predicates.go:1033-1048): every listed label
+    key must be present (presence=True) or absent (presence=False) on the
+    node, values ignored."""
+    node_labels = node_info.node.labels
+    for label in labels:
+        exists = label in node_labels
+        if (exists and not presence) or (not exists and presence):
+            return False
+    return True
+
+
+def get_pod_services(pod, services):
+    """GetPodServices (client-go listers/core/v1/service_expansion.go):
+    same-namespace services with a NON-EMPTY selector matching the pod's
+    labels."""
+    out = []
+    for svc in services or []:
+        if svc.namespace != pod.namespace or not svc.selector:
+            continue
+        if all(pod.labels.get(k) == v for k, v in svc.selector.items()):
+            out.append(svc)
+    return out
+
+
+def service_affinity_precompute(pod, snapshot, labels, services):
+    """The once-per-pod half of checkServiceAffinity
+    (serviceAffinityMetadataProducer, predicates.go:1060-1082):
+    (base_labels, anchor_candidates) where base_labels come from the pod's
+    own nodeSelector and anchor_candidates is the ordered list of
+    already-placed same-namespace pods with labels matching OURS —
+    non-empty only when the pod belongs to some service. The per-node half
+    (service_affinity_fits) applies the FilterOutPods exclusion against
+    this list, so Filter stays O(1) amortized per node instead of
+    O(cluster pods)."""
+    base_labels = {k: pod.node_selector[k] for k in labels if k in pod.node_selector}
+    candidates = []
+    if len(labels) > len(base_labels) and get_pod_services(pod, services):
+        for other in snapshot.all_pods():
+            if other.namespace != pod.namespace or not other.node_name:
+                continue
+            if all(other.labels.get(k) == v for k, v in pod.labels.items()):
+                candidates.append(other)
+    return base_labels, candidates
+
+
+def service_affinity_fits(pod, node_info, snapshot, labels, base_labels, candidates) -> bool:
+    """Per-node half of checkServiceAffinity (predicates.go:1123-1160):
+    backfill missing constraint keys from the FIRST anchor candidate not on
+    the node under evaluation (FilterOutPods), then require the node to
+    carry every constrained label with the constrained value."""
+    affinity_labels = dict(base_labels)
+    if len(labels) > len(affinity_labels):
+        for other in candidates:
+            if other.node_name == node_info.node.name:
+                continue
+            anchor_ni = snapshot.get(other.node_name)
+            if anchor_ni is None:
+                continue
+            for k in labels:
+                if k not in affinity_labels and k in anchor_ni.node.labels:
+                    affinity_labels[k] = anchor_ni.node.labels[k]
+            break
+    node_labels = node_info.node.labels
+    return all(node_labels.get(k) == v for k, v in affinity_labels.items())
+
+
+def check_service_affinity(pod, node_info, snapshot, labels, services) -> bool:
+    """checkServiceAffinity (predicates.go:1123-1160): force the listed
+    node-label keys to stay homogeneous across a service's pods. One-shot
+    convenience wrapper; the framework plugin path precomputes per pod."""
+    base, cands = service_affinity_precompute(pod, snapshot, labels, services)
+    return service_affinity_fits(pod, node_info, snapshot, labels, base, cands)

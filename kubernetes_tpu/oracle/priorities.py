@@ -662,3 +662,69 @@ def prioritize_nodes(
         for node_name, s in make().items():
             total[node_name] += weight * s
     return total
+
+
+# ---------------------------------------------------------------------------
+# Policy custom-argument priorities (api/types.go:94-137): labelPreference →
+# NodeLabelPrioritizer (node_label.go:46), serviceAntiAffinity →
+# ServiceAntiAffinity map/reduce (selector_spreading.go:211-277).
+# Registered as framework Score plugins by the factory.
+# ---------------------------------------------------------------------------
+
+def node_label_priority(pod: Pod, snapshot: Snapshot, label: str, presence: bool) -> Scores:
+    """CalculateNodeLabelPriorityMap: MaxNodeScore when the node's
+    has-the-label state matches `presence`, else 0. No normalization."""
+
+    def fn(ni: NodeInfo) -> int:
+        exists = label in ni.node.labels
+        return MAX_NODE_SCORE if exists == presence else 0
+
+    return _score_list(snapshot, fn)
+
+
+def service_anti_affinity_priority(
+    pod: Pod, snapshot: Snapshot, label: str, services
+) -> Scores:
+    """ServiceAntiAffinity map+reduce (selector_spreading.go:211-277):
+    map counts same-namespace pods matching the pod's FIRST service
+    selector per node; reduce groups nodes by the configured label's value
+    and scores maxScore * (total - group) / total — label-less nodes score
+    0, zero service pods scores maxScore everywhere labeled."""
+    from .predicates import get_pod_services
+
+    matching = get_pod_services(pod, services)
+    first_selector = dict(matching[0].selector) if matching else None
+
+    def count_on(ni: NodeInfo) -> int:
+        if first_selector is None:
+            return 0
+        c = 0
+        for p in ni.pods:
+            if p.namespace != pod.namespace:
+                continue
+            if all(p.labels.get(k) == v for k, v in first_selector.items()):
+                c += 1
+        return c
+
+    raw = {name: count_on(ni) for name, ni in snapshot.node_infos.items()}
+    num_service_pods = sum(raw.values())
+    pod_counts: Dict[str, int] = {}
+    label_of: Dict[str, str] = {}
+    for name, ni in snapshot.node_infos.items():
+        if label in ni.node.labels:
+            val = ni.node.labels[label]
+            label_of[name] = val
+            pod_counts[val] = pod_counts.get(val, 0) + raw[name]
+    out: Scores = {}
+    for name in snapshot.node_infos:
+        val = label_of.get(name)
+        if val is None:
+            out[name] = 0
+            continue
+        if num_service_pods > 0:
+            out[name] = int(
+                MAX_NODE_SCORE * (num_service_pods - pod_counts[val]) / num_service_pods
+            )
+        else:
+            out[name] = MAX_NODE_SCORE
+    return out

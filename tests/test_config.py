@@ -249,3 +249,81 @@ def test_policy_rtcr_negative_weight_and_duplicates_rejected():
             {"name": "a", "argument": {"requestedToCapacityRatioArguments": {"shape": shape}}},
             {"name": "b", "argument": {"requestedToCapacityRatioArguments": {"shape": shape}}},
         ]})
+
+
+def test_policy_labels_presence_predicate():
+    """labelsPresence argument (api/types.go:115): presence=False evicts
+    labeled nodes; user-named predicate runs as a framework Filter plugin."""
+    cache = SchedulerCache()
+    cache.add_node(make_node("retiring", labels={"retiring": "2026-01-01"}))
+    cache.add_node(make_node("healthy"))
+    sched = _sched_from_policy({
+        "predicates": [
+            {"name": "GeneralPredicates"},
+            {"name": "NoRetiringNodes",
+             "argument": {"labelsPresence": {"labels": ["retiring"], "presence": False}}},
+        ],
+        "priorities": [{"name": "LeastRequestedPriority", "weight": 1}],
+    }, cache)
+    sched.enable_preemption = False
+    sched.queue.add(make_pod("p", cpu_milli=100, mem=0))
+    r = sched.schedule_batch()
+    assert r.assignments["default/p"] == "healthy"
+
+
+def test_policy_label_preference_priority():
+    """labelPreference argument (api/types.go:130): presence=True prefers
+    labeled nodes."""
+    cache = SchedulerCache()
+    cache.add_node(make_node("plain"))
+    cache.add_node(make_node("ssd", labels={"disktype": "ssd"}))
+    sched = _sched_from_policy({
+        "predicates": [{"name": "GeneralPredicates"}],
+        "priorities": [
+            {"name": "PreferSSD", "weight": 5,
+             "argument": {"labelPreference": {"label": "disktype", "presence": True}}},
+        ],
+    }, cache)
+    sched.enable_preemption = False
+    sched.queue.add(make_pod("p", cpu_milli=100, mem=0))
+    r = sched.schedule_batch()
+    assert r.assignments["default/p"] == "ssd"
+
+
+def test_policy_service_affinity_and_anti_affinity():
+    """serviceAffinity predicate pins a service's pods to one region
+    (predicates.go:1123 implicit-selector backfill); serviceAntiAffinity
+    priority spreads them across zones (selector_spreading.go:211)."""
+    from kubernetes_tpu.api.types import Service
+    from kubernetes_tpu.config.factory import Configurator
+    from kubernetes_tpu.state.cache import TensorMirror
+
+    cache = SchedulerCache()
+    for name, region, zone in (
+        ("r1a", "r1", "a"), ("r1b", "r1", "b"), ("r2a", "r2", "a"),
+    ):
+        cache.add_node(make_node(name, labels={"region": region, "zone": zone}))
+    services = [Service(name="svc", namespace="default", selector={"app": "web"})]
+    cfgr = Configurator(deterministic=True, service_lister=lambda: services)
+    sched = cfgr.create_from_config({
+        "predicates": [
+            {"name": "GeneralPredicates"},
+            {"name": "SvcRegion", "argument": {"serviceAffinity": {"labels": ["region"]}}},
+        ],
+        "priorities": [
+            {"name": "SvcSpread", "weight": 10,
+             "argument": {"serviceAntiAffinity": {"label": "zone"}}},
+        ],
+    })
+    sched.cache = cache
+    sched.mirror = TensorMirror(cache)
+    sched.enable_preemption = False
+    # anchor: one service pod already on r1a
+    anchor = make_pod("w0", labels={"app": "web"}, cpu_milli=100, mem=0)
+    anchor.node_name = "r1a"
+    cache.add_pod(anchor)
+    # next service pod must stay in region r1 (affinity) but prefer the
+    # OTHER zone (anti-affinity): r1b
+    sched.queue.add(make_pod("w1", labels={"app": "web"}, cpu_milli=100, mem=0))
+    r = sched.schedule_batch()
+    assert r.assignments["default/w1"] == "r1b", r.assignments
