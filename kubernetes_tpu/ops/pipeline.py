@@ -108,7 +108,7 @@ def filter_mask(
 def solve_pipeline(
     na: Arrays,  # NodeBank arrays
     pa: Arrays,  # PodBatch arrays
-    ea: Arrays,  # ExistingPodsBank arrays
+    ea: Arrays,  # SigBank arrays (existing-pod label signatures + per-node counts)
     ta: Arrays,  # batch TermBank arrays
     xa: Arrays,  # existing-pods TermBank arrays
     au: Arrays,  # compile_batch_terms aux

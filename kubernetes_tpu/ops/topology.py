@@ -114,6 +114,21 @@ def _seg_sum(values: jnp.ndarray, buckets: jnp.ndarray, num: int) -> jnp.ndarray
     return jax.vmap(lambda v, s: jax.ops.segment_sum(v, s, num_segments=num))(values, buckets)
 
 
+def _sig_cnt_node(m_sig: jnp.ndarray, counts: jnp.ndarray) -> jnp.ndarray:
+    """Per-node match counts from signature matches: [T, S] boolean matches
+    × [N, S] per-node signature counts → [T, N] int32, as ONE f32 MXU
+    matmul (exact: counts and their sums stay far below 2^24). This is the
+    step that replaced per-existing-pod gathers/segment-sums — matching
+    runs against S signature rows, never against individual pods.
+    Precision HIGHEST is REQUIRED: the TPU default truncates f32 matmul
+    operands to bf16, which misrounds any count above 256."""
+    return jnp.matmul(
+        m_sig.astype(jnp.float32),
+        counts.astype(jnp.float32).T,
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(jnp.int32)
+
+
 def _gather_rows(table: jnp.ndarray, buckets: jnp.ndarray) -> jnp.ndarray:
     """table: [TT, V]; buckets: [TT, X] → [TT, X] (per-row gather)."""
     return jax.vmap(lambda t, b: t[b])(table, buckets)
@@ -175,9 +190,10 @@ def spread_filter(
     cand = selector_mask & all_keys & nodes["valid"][None, :]
 
     # existing-pod match per term (same namespace as the incoming pod —
-    # ns_ids were compiled to [pod.namespace] for hard constraints)
-    m_ep = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & hard[:, None]
-    cnt_node = _seg_sum(m_ep.astype(jnp.int32), jnp.broadcast_to(eps["node_idx"][None, :], m_ep.shape), N)  # [TT, N]
+    # ns_ids were compiled to [pod.namespace] for hard constraints),
+    # evaluated against label SIGNATURES then expanded to per-node counts
+    m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & hard[:, None]
+    cnt_node = _sig_cnt_node(m_sig, eps["counts"])  # [TT, N]
     cand_t = cand[owner]  # [TT, N]
     pair_cnt = _seg_sum(jnp.where(cand_t, cnt_node, 0), bucket_n, N)  # [TT, V]
     pair_present = _seg_sum((cand_t & haskey_n).astype(jnp.int32), bucket_n, N) > 0
@@ -216,8 +232,8 @@ def spread_score(
     member = _scatter_and(haskey_n, owner, soft, B) & nodes["valid"][None, :]  # [B, N]
     counting = member & selector_mask
 
-    m_ep = match_terms(terms, eps["label_vals"], None) & eps["valid"][None, :] & soft[:, None]
-    cnt_node = _seg_sum(m_ep.astype(jnp.int32), jnp.broadcast_to(eps["node_idx"][None, :], m_ep.shape), N)
+    m_sig = match_terms(terms, eps["label_vals"], None) & eps["valid"][None, :] & soft[:, None]
+    cnt_node = _sig_cnt_node(m_sig, eps["counts"])
     counting_t = counting[owner]
     member_t = member[owner]
     pair_cnt = _seg_sum(jnp.where(counting_t, cnt_node, 0), bucket_n, N)
@@ -280,29 +296,31 @@ def interpod_filter(
     aff = terms["valid"] & (terms["kind"] == AFF_REQ)
     anti = terms["valid"] & (terms["kind"] == ANTI_REQ)
     owner = terms["owner"]
-    # per-term property match of existing pods
-    m_ep = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :]  # [TT, M]
-    # affinity: existing pod must match ALL of the owner's aff terms
-    matchall = (
-        jnp.ones((B + 1, m_ep.shape[1]), jnp.int32)
+    # per-term property match of existing-pod SIGNATURES
+    m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :]  # [TT, S]
+    # affinity: existing pod must match ALL of the owner's aff terms —
+    # AND across terms happens at the signature level
+    matchall_sig = (
+        jnp.ones((B + 1, m_sig.shape[1]), jnp.int32)
         .at[jnp.where(aff, owner, B)]
-        .min(jnp.where(aff[:, None], m_ep, True).astype(jnp.int32), mode="drop")[:B]
+        .min(jnp.where(aff[:, None], m_sig, True).astype(jnp.int32), mode="drop")[:B]
         .astype(bool)
-    )  # [B, M]
+    )  # [B, S]
 
-    ep_bucket, ep_has = _bucket_of(nodes, terms["topo_slot"], eps["node_idx"])  # [TT, M]
     bucket_n2, haskey_n2 = _bucket_of(nodes, terms["topo_slot"])  # [TT, N]
 
-    contrib_aff = matchall[owner] & ep_has & aff[:, None]  # [TT, M]
-    agg_aff = _seg_sum(contrib_aff.astype(jnp.int32), ep_bucket, N) > 0  # [TT, V]
+    # nodes hosting ≥1 existing pod matching ALL owner terms, per topo bucket
+    cnt_aff_node = _sig_cnt_node(matchall_sig, eps["counts"])  # [B, N]
+    contrib_aff_n = jnp.where(haskey_n2 & aff[:, None], cnt_aff_node[owner], 0)  # [TT, N]
+    agg_aff = _seg_sum(contrib_aff_n, bucket_n2, N) > 0  # [TT, V]
     ok_aff_t = haskey_n2 & _gather_rows(agg_aff, bucket_n2)
     aff_ok = _scatter_and(ok_aff_t, owner, aff, B)
     any_pair = jnp.zeros(B + 1, bool).at[jnp.where(aff, owner, B)].max(jnp.any(agg_aff, axis=1) & aff)[:B]
     escape = ~any_pair & aux["self_aff_match"]
     aff_result = aff_ok | escape[:, None] | ~aux["has_aff"][:, None]
 
-    contrib_anti = m_ep & ep_has & anti[:, None]
-    agg_anti = _seg_sum(contrib_anti.astype(jnp.int32), ep_bucket, N) > 0
+    cnt_anti_node = _sig_cnt_node(m_sig & anti[:, None], eps["counts"])  # [TT, N]
+    agg_anti = _seg_sum(jnp.where(haskey_n2, cnt_anti_node, 0), bucket_n2, N) > 0
     bad_anti_t = haskey_n2 & _gather_rows(agg_anti, bucket_n2)
     anti_bad = _scatter_or(bad_anti_t, owner, anti, B)
 
@@ -320,13 +338,13 @@ def interpod_score(
     B = pods["valid"].shape[0]
     N = nodes["valid"].shape[0]
 
-    # (a) incoming preferred terms vs existing pods
+    # (a) incoming preferred terms vs existing-pod signatures
     pref = terms["valid"] & ((terms["kind"] == AFF_PREF) | (terms["kind"] == ANTI_PREF))
     owner = terms["owner"]
-    m_ep = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & pref[:, None]
-    ep_bucket, ep_has = _bucket_of(nodes, terms["topo_slot"], eps["node_idx"])
-    cnt = _seg_sum((m_ep & ep_has).astype(jnp.int32), ep_bucket, N)  # [TT, V]
+    m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & pref[:, None]
     bucket_n, haskey_n = _bucket_of(nodes, terms["topo_slot"])
+    cnt_node = _sig_cnt_node(m_sig, eps["counts"])  # [TT, N]
+    cnt = _seg_sum(jnp.where(haskey_n, cnt_node, 0), bucket_n, N)  # [TT, V]
     contrib_t = jnp.where(haskey_n, _gather_rows(cnt, bucket_n), 0) * terms["weight"][:, None]
     counts = _scatter_add(contrib_t.astype(jnp.int64), owner, pref, B)  # [B, N]
 
@@ -369,19 +387,17 @@ def selector_spread_score(
     N = nodes["valid"].shape[0]
     ss = terms["valid"] & (terms["kind"] == SEL_SPREAD)
     owner = terms["owner"]
-    m_ep = match_terms(terms, eps["label_vals"], eps["ns_id"])  # ns compiled = pod ns
-    # AND across the pod's selectors
+    m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"])  # ns compiled = pod ns
+    # AND across the pod's selectors, at the signature level
     matchall = (
-        jnp.ones((B + 1, m_ep.shape[1]), jnp.int32)
+        jnp.ones((B + 1, m_sig.shape[1]), jnp.int32)
         .at[jnp.where(ss, owner, B)]
-        .min(jnp.where(ss[:, None], m_ep, True).astype(jnp.int32), mode="drop")[:B]
+        .min(jnp.where(ss[:, None], m_sig, True).astype(jnp.int32), mode="drop")[:B]
         .astype(bool)
     )
     matchall = matchall & eps["valid"][None, :] & ~eps["deleting"][None, :]
     matchall = matchall & (aux["n_sel_spread"] > 0)[:, None]
-    counts = jax.vmap(
-        lambda m: jax.ops.segment_sum(m.astype(jnp.int64), eps["node_idx"], num_segments=N)
-    )(matchall)  # [B, N]
+    counts = _sig_cnt_node(matchall, eps["counts"]).astype(jnp.int64)  # [B, N]
     counts = jnp.where(nodes["valid"][None, :], counts, 0)
 
     max_node = jnp.max(counts, axis=1)  # [B]

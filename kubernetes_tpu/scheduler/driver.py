@@ -338,6 +338,8 @@ class Scheduler:
         # cached host object — per batch only the pod batch, the batch term
         # tables, and the dirty row slices cross the host→device wire
         na_dev, ea_dev = self.mirror.device_arrays()
+        t_patch = time.perf_counter()
+        self.stats["patch_s"] = self.stats.get("patch_s", 0.0) + (t_patch - t1)
         if etb is not getattr(self, "_etb_host", None):
             import jax.numpy as jnp
 
@@ -371,10 +373,18 @@ class Scheduler:
             assign, gang_ok = jax.device_get((assign, gang_ok))  # one transfer
             gang_ok_arr = np.asarray(gang_ok)[: len(pods)]
         else:
+            t_d = time.perf_counter()
             assign, score = solve_pipeline(
                 *args, deterministic=self.deterministic, config=self.solve_config
             )
+            # dispatch_s = host upload + trace-cache lookup + enqueue (async);
+            # fetch_s = device execution + the [B] assign download
+            t_f = time.perf_counter()
+            self.stats["dispatch_s"] = self.stats.get("dispatch_s", 0.0) + (t_f - t_d)
             assign = jax.device_get(assign)
+            self.stats["fetch_s"] = self.stats.get("fetch_s", 0.0) + (
+                time.perf_counter() - t_f
+            )
         n = len(pods)
         out = SolveOutput(
             assign=np.asarray(assign)[:n],

@@ -11,7 +11,8 @@ Reference: pkg/scheduler/internal/cache/cache.go. State machine for a pod
 The cache is never authoritative storage — etcd is (SURVEY.md §5
 checkpoint/resume): on restart everything is rebuilt from a fresh list+watch.
 Device tensors are a further derived layer: `TensorMirror` keeps NodeBank /
-ExistingPodsBank rows in sync with this cache, patching only DIRTY rows per
+SigBank (pod label signatures + per-node counts) in sync with this cache,
+patching only DIRTY rows per
 cycle the way UpdateNodeInfoSnapshot walks its generation-ordered dirty list
 (cache.go:206-242).
 """
@@ -27,10 +28,11 @@ from ..api.types import Node, Pod
 from ..oracle.nodeinfo import NodeInfo, Snapshot
 from .tensors import (
     EncodingConfig,
-    ExistingPodsBank,
     ImageTable,
     KeySlotOverflow,
     NodeBank,
+    SigBank,
+    SigOverflow,
     Vocab,
     _bucket,
 )
@@ -252,12 +254,12 @@ class SchedulerCache:
 
 
 class TensorMirror:
-    """Keeps device-facing banks (NodeBank + ExistingPodsBank) patched from a
+    """Keeps device-facing banks (NodeBank + SigBank) patched from a
     SchedulerCache — the TPU replacement for UpdateNodeInfoSnapshot's
-    generation walk (cache.go:206-242). Rows are allocated per node from a
-    free list; each node's pods get eps rows from a second free list, and
-    sync() touches ONLY the pods of dirty nodes — patch cost is proportional
-    to the delta, not the cluster.
+    generation walk (cache.go:206-242). Node rows are allocated from a free
+    list; each node's pods are COUNTED into label signatures (SigBank), and
+    sync() re-counts ONLY the pods of dirty nodes — patch cost is
+    proportional to the delta, not the cluster.
 
     Capacity overflow (more nodes/pods than the banks, label-key growth)
     triggers a full rebuild at the next bucket size — bounded recompilation
@@ -269,7 +271,7 @@ class TensorMirror:
         self.vocab = vocab or Vocab()
         self.rebuild_count = -1  # constructor's build doesn't count
         self._min_nodes = 1
-        self._min_pods = 1
+        self._min_sigs = 16
         # device-resident copies of the banks, patched by dirty ROW SLICES:
         # on a remote-attached TPU, re-uploading whole banks every batch
         # costs seconds (10s of MB at ~15 MB/s tunnel bandwidth) — only the
@@ -280,20 +282,18 @@ class TensorMirror:
         self._device_stale = True
         self._image_stale = False
         self._pending_node_rows: Set[int] = set()
-        self._pending_pod_rows: Set[int] = set()
         self._rebuild()
 
-    def reserve(self, n_nodes: int, n_pods: int) -> None:
+    def reserve(self, n_nodes: int, n_pods: int = 0) -> None:
         """Pre-size the banks for an expected cluster scale. Every bank
         growth changes array shapes and costs an XLA recompile (minutes on a
         remote TPU), so callers that know their scale up front — benchmarks,
-        a scheduler fed a full initial list — should reserve once."""
+        a scheduler fed a full initial list — should reserve once. Existing
+        pods are held as label SIGNATURES whose distinct count is workload-
+        dependent (not pod-count-dependent), so `n_pods` no longer sizes
+        that bank — the signature bucket grows on demand."""
         self._min_nodes = max(self._min_nodes, n_nodes)
-        self._min_pods = max(self._min_pods, n_pods)
-        if (
-            _bucket(self._min_nodes) > self.nodes.capacity
-            or _bucket(self._min_pods) > self.eps.capacity
-        ):
+        if _bucket(self._min_nodes) > self.nodes.capacity:
             self._rebuild()
 
     def _rebuild(self) -> None:
@@ -311,14 +311,11 @@ class TensorMirror:
                     self.row_of[ni.node.name] = row
                     self.name_of_row[row] = ni.node.name
                     self.nodes.set_node(row, ni)
-                n_pods = max(
-                    sum(len(ni.pods) for ni in snap.node_infos.values()),
-                    self._min_pods,
-                    1,
+                self.eps = SigBank(
+                    self.vocab, _bucket(self._min_sigs), self.nodes.capacity
                 )
-                self.eps = ExistingPodsBank(self.vocab, _bucket(n_pods))
-                self._node_pod_rows: Dict[str, List[int]] = {}
-                self._free_pod_rows = list(range(self.eps.capacity - 1, -1, -1))
+                self._node_sigs: Dict[str, Dict[int, int]] = {}
+                self._node_has_affinity: Dict[str, bool] = {}
                 for name, ni in snap.node_infos.items():
                     self._encode_node_pods(name, ni)
                 ImageTable(self.vocab).apply(self.nodes, snap, self.row_of)
@@ -326,6 +323,8 @@ class TensorMirror:
                     name: self._image_signature(ni) for name, ni in snap.node_infos.items()
                 }
                 break
+            except SigOverflow:
+                self._min_sigs *= 2
             except KeySlotOverflow:
                 continue
         self.cache.dirty_nodes.clear()
@@ -333,7 +332,7 @@ class TensorMirror:
         self._etb = None  # cached existing-terms bank (compile_existing_terms)
         self._device_stale = True  # shapes may have changed: full re-upload
         self._pending_node_rows.clear()
-        self._pending_pod_rows.clear()
+        self.eps.dirty_sig_rows.clear()
         self.generation = 0
 
     @staticmethod
@@ -341,25 +340,28 @@ class TensorMirror:
         return frozenset(ni.image_sizes().items())
 
     def _release_node_pods(self, name: str) -> None:
-        for row in self._node_pod_rows.pop(name, ()):
-            self.eps.valid[row] = False
-            self._free_pod_rows.append(row)
-            self._pending_pod_rows.add(row)
+        held = self._node_sigs.pop(name, None)
+        if held:
+            # callers must release BEFORE freeing the node row (sync() does):
+            # release_node subtracts the held counts, restoring the row's
+            # counts column to zero so a later node can reuse it cleanly
+            row = self.row_of[name]
+            self.eps.release_node(row, held)
+            self._pending_node_rows.add(row)
+        self._node_has_affinity.pop(name, None)
 
     def _encode_node_pods(self, name: str, ni: NodeInfo) -> None:
-        """Re-encode one node's pods into freshly allocated eps rows. Raises
-        KeySlotOverflow when the bank is full (caller rebuilds bigger)."""
+        """Re-count one node's pods into label signatures. Raises
+        SigOverflow/KeySlotOverflow when a bank is full (caller rebuilds
+        bigger)."""
         node_row = self.row_of[name]
-        rows: List[int] = []
-        for pod in ni.pods:
-            if not self._free_pod_rows:
-                self._node_pod_rows[name] = rows  # keep bookkeeping consistent
-                raise KeySlotOverflow()
-            row = self._free_pod_rows.pop()
-            self.eps.set_pod(row, pod, node_row)
-            rows.append(row)
-            self._pending_pod_rows.add(row)
-        self._node_pod_rows[name] = rows
+        self._node_sigs[name] = self.eps.encode_node(node_row, ni.pods)
+        self._node_has_affinity[name] = any(
+            p.affinity is not None
+            and (p.affinity.pod_affinity is not None or p.affinity.pod_anti_affinity is not None)
+            for p in ni.pods
+        )
+        self._pending_node_rows.add(node_row)
 
     def sync(self) -> bool:
         """Apply dirty nodes (and ONLY their pods). Returns True if a full
@@ -380,13 +382,15 @@ class TensorMirror:
                 return False
             try:
                 for name in removed:
+                    # release pods FIRST (zeroes the node's signature-count
+                    # row) so a later node reusing this row starts clean
+                    self._release_node_pods(name)
                     row = self.row_of.pop(name, None)
                     if row is not None:
                         self.nodes.clear_node(row)
                         self.name_of_row[row] = None
                         self._free_rows.append(row)
                         self._pending_node_rows.add(row)
-                    self._release_node_pods(name)
                     self._image_sig.pop(name, None)
                 for name in new_nodes:
                     row = self._free_rows.pop()
@@ -400,11 +404,10 @@ class TensorMirror:
                         continue
                     self.nodes.set_node(self.row_of[name], ni)
                     self._pending_node_rows.add(self.row_of[name])
-                    # pods: release this node's old rows, re-encode current
-                    old_rows = self._node_pod_rows.get(name, [])
-                    had_affinity = any(
-                        self.eps.has_affinity[r] for r in old_rows
-                    ) or any(p.affinity is not None for p in ni.pods)
+                    # pods: release this node's old signature counts, re-count
+                    had_affinity = self._node_has_affinity.get(name, False) or any(
+                        p.affinity is not None for p in ni.pods
+                    )
                     if had_affinity:
                         affinity_changed = True
                     self._release_node_pods(name)
@@ -442,7 +445,7 @@ class TensorMirror:
             self._device_stale = False
             self._image_stale = False
             self._pending_node_rows.clear()
-            self._pending_pod_rows.clear()
+            self.eps.dirty_sig_rows.clear()
             return self._dev_nodes, self._dev_eps
 
         import numpy as _np
@@ -482,13 +485,22 @@ class TensorMirror:
             return scatter(dev, jnp.asarray(idx), updates)
 
         nrows = sorted(self._pending_node_rows)
-        prows = sorted(self._pending_pod_rows)
+        srows = sorted(self.eps.dirty_sig_rows)
         skip_n = ("image_scaled",) if self._image_stale else ()
         self._dev_nodes = patch(self._dev_nodes, host_n, nrows, skip=skip_n)
         self._image_stale = False
-        self._dev_eps = patch(self._dev_eps, host_e, prows)
+        # the eps dict has TWO row spaces: signature metadata ([S]-major,
+        # patched by dirty signature rows) and the per-node count matrix
+        # ([N, S] node-major, patched by dirty NODE rows)
+        meta_host = {k: v for k, v in host_e.items() if k != "counts"}
+        meta_dev = {k: v for k, v in self._dev_eps.items() if k != "counts"}
+        meta_dev = patch(meta_dev, meta_host, srows)
+        cnt_dev = patch(
+            {"counts": self._dev_eps["counts"]}, {"counts": host_e["counts"]}, nrows
+        )
+        self._dev_eps = {**meta_dev, **cnt_dev}
         self._pending_node_rows.clear()
-        self._pending_pod_rows.clear()
+        self.eps.dirty_sig_rows.clear()
         return self._dev_nodes, self._dev_eps
 
     def existing_terms(self):

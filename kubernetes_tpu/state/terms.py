@@ -13,7 +13,7 @@ the tables stay small (sparse encoding of a quadratic problem).
 Term kinds:
   incoming batch:  AFF_REQ, ANTI_REQ (Filter), AFF_PREF, ANTI_PREF (Score),
                    SPREAD_HARD (Filter), SPREAD_SOFT (Score), SEL_SPREAD
-  existing pods:   same AFF_*/ANTI_* kinds with owner = ExistingPodsBank row
+  existing pods:   same AFF_*/ANTI_* kinds with owner = the hosting node's NodeBank row
                    (the symmetric side: existing pods' terms matched against
                    the incoming pod)
 """

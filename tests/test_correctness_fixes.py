@@ -258,22 +258,22 @@ def test_sync_touches_only_dirty_nodes(monkeypatch):
     cache = SchedulerCache()
     for i in range(8):
         cache.add_node(make_node(f"n{i}"))
-    # 30 pods → eps bucket 32 with free rows (no growth-rebuild on +1)
     for i in range(30):
         cache.add_pod(make_pod(f"p{i}", node_name=f"n{i % 8}"))
     mirror = TensorMirror(cache)
 
-    encoded = []
-    orig = type(mirror.eps).set_pod
+    recounted = []
+    orig = type(mirror.eps).encode_node
 
-    def spy(self, j, pod, node_idx):
-        encoded.append(pod.key())
-        return orig(self, j, pod, node_idx)
+    def spy(self, node_row, pods):
+        recounted.append((node_row, sorted(p.key() for p in pods)))
+        return orig(self, node_row, pods)
 
-    monkeypatch.setattr(type(mirror.eps), "set_pod", spy)
+    monkeypatch.setattr(type(mirror.eps), "encode_node", spy)
     cache.add_pod(make_pod("p-new", node_name="n3"))
     mirror.sync()
-    # only n3's pods re-encoded: its 4 originals + the new one
-    assert len(encoded) == 5, encoded
-    assert set(encoded) == {"default/p3", "default/p11", "default/p19",
-                            "default/p27", "default/p-new"}
+    # only n3's pods re-counted: its 4 originals + the new one
+    assert len(recounted) == 1, recounted
+    assert recounted[0][1] == sorted(
+        ["default/p3", "default/p11", "default/p19", "default/p27", "default/p-new"]
+    )
