@@ -218,6 +218,7 @@ def run_config(name, build):
     setup_s = time.perf_counter() - t_setup
 
     batch_times = []
+    batch_sched = []
     t0 = time.perf_counter()
     first_batch_s = None
     scheduled = unsched = 0
@@ -230,12 +231,22 @@ def run_config(name, build):
         if first_batch_s is None:
             first_batch_s = dt
         batch_times.append(dt)
+        batch_sched.append(r.scheduled)
         scheduled += r.scheduled
         unsched += r.unschedulable
     sched.wait_for_binds()
     elapsed = time.perf_counter() - t0
     steady = sum(batch_times[1:]) or 1e-9
     bt = np.array(batch_times) if batch_times else np.array([0.0])
+    # warm throughput: ACTUAL pods scheduled over the LAST half of batches —
+    # excludes the handful of one-time XLA compiles (main program + scatter
+    # row-buckets) that a sum-based "steady" misattributes on short configs,
+    # and credits each batch with what it really scheduled (partial last
+    # batch, unschedulable pods)
+    half = len(batch_times) // 2 if len(batch_times) >= 4 else 0
+    warm_time = sum(batch_times[half:])
+    warm_pods = sum(batch_sched[half:])
+    warm_rate = warm_pods / warm_time if warm_time > 0 else None
     detail = {
         "config": name,
         "nodes": len(nodes),
@@ -246,6 +257,7 @@ def run_config(name, build):
         "pods_per_sec": round(scheduled / elapsed, 1) if elapsed > 0 else 0.0,
         "pods_per_sec_steady": round(
             max(scheduled - BATCH, 0) / steady, 1) if len(batch_times) > 1 else None,
+        "pods_per_sec_warm": round(warm_rate, 1) if warm_rate is not None else None,
         "first_batch_s": round(first_batch_s or 0.0, 3),
         "batch_p50_s": round(float(np.percentile(bt, 50)), 4),
         "batch_p99_s": round(float(np.percentile(bt, 99)), 4),
@@ -283,6 +295,9 @@ def main():
     if headline is None:
         print(json.dumps({"metric": "none", "value": 0, "unit": "pods/s", "vs_baseline": 0}))
         return
+    # headline stays END-TO-END (cold, incl. compiles) — comparable across
+    # rounds and against the reference's end-to-end warn line; the warm
+    # sustained rate is reported alongside in BENCH_DETAILS.json
     value = headline["pods_per_sec"]
     print(json.dumps({
         "metric": f"pods_per_sec_{headline['config']}",

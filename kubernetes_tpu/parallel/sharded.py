@@ -127,6 +127,11 @@ def make_sharded_pipeline(mesh: Mesh):
         n_local = N // n_shards
         # pin every per-node bank array's leading axis to the mesh
         na = {k: _c(v, AXIS_NODES) for k, v in na.items()}
+        # the signature-count matrix is node-major [N, S]: shard its node
+        # axis too (signature metadata stays replicated — it is tiny); the
+        # [T,S]x[S,N] count matmuls then produce node-sharded outputs
+        if "counts" in ea:
+            ea = {**ea, "counts": _c(ea["counts"], AXIS_NODES)}
         # mask/score compute (shared stage — identical math to the
         # single-device pipelines): nodes sharded, batch data-parallel
         mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config)

@@ -151,3 +151,72 @@ def test_assume_expire_requeue_under_concurrent_binds():
     ni = cache.snapshot.get("n0")
     assert len(ni.pods) == 0
     assert ni.requested().get("cpu", 0) == 0
+
+
+def test_sigbank_stays_consistent_under_churn():
+    """Property: after arbitrary pod add/remove/node-remove churn, the
+    incremental SigBank equals a from-scratch re-encode — counts per
+    (node, signature) match, no negative counts, freed node rows hold
+    zero counts, and refcounts equal the count-matrix column sums."""
+    import random
+
+    import numpy as np
+
+    from kubernetes_tpu.state.tensors import encode_snapshot
+
+    rng = random.Random(42)
+    cache = SchedulerCache()
+    for i in range(12):
+        cache.add_node(make_node(f"n{i}"))
+    mirror = TensorMirror(cache)
+    live = []
+    label_sets = [{"app": "a"}, {"app": "b", "tier": "web"}, {}, {"app": "a", "env": "p"}]
+    for step in range(300):
+        op = rng.random()
+        if op < 0.55 or not live:
+            p = make_pod(f"c{step}", labels=dict(rng.choice(label_sets)),
+                         node_name=f"n{rng.randrange(12)}")
+            if rng.random() < 0.1:
+                p.deletion_timestamp = 123.0
+            cache.add_pod(p)
+            live.append(p)
+        elif op < 0.9:
+            p = live.pop(rng.randrange(len(live)))
+            cache.remove_pod(p)
+        else:
+            victim = f"n{rng.randrange(12)}"
+            if cache.snapshot.get(victim) is not None and len(cache.snapshot.node_infos) > 2:
+                cache.remove_node(victim)
+                live = [p for p in live if p.node_name != victim]
+        if step % 25 == 0:
+            mirror.sync()
+    mirror.sync()
+
+    sig = mirror.eps
+    # 1. no negative counts anywhere
+    assert (sig.counts >= 0).all()
+    # 2. refcounts == column sums, valid rows exactly the referenced ones
+    col = sig.counts.astype(np.int64).sum(axis=0)
+    assert (col == sig._refs).all()
+    assert (sig.valid == (sig._refs > 0)).all()
+    # 3. freed node rows hold zero counts
+    for row in mirror._free_rows:
+        assert sig.counts[row].sum() == 0, f"stale counts in free row {row}"
+    # 4. equivalence with a from-scratch encode: per-node signature
+    #    histograms (keyed by label bytes + ns + deleting) must match
+    # same vocab → identical interned ids, so raw byte histograms compare
+    _, fresh, fresh_row_of = encode_snapshot(
+        cache.snapshot, vocab=mirror.vocab, with_images=False
+    )
+
+    def histogram(bank, row):
+        out = {}
+        for s in range(bank.capacity):
+            c = int(bank.counts[row, s])
+            if c:
+                out[(bank.label_vals[s].tobytes(), int(bank.ns_id[s]), bool(bank.deleting[s]))] = c
+        return out
+
+    for name, row in mirror.row_of.items():
+        fr = fresh_row_of[name]
+        assert histogram(sig, row) == histogram(fresh, fr), f"node {name} diverged"
