@@ -238,15 +238,18 @@ def run_config(name, build):
     elapsed = time.perf_counter() - t0
     steady = sum(batch_times[1:]) or 1e-9
     bt = np.array(batch_times) if batch_times else np.array([0.0])
-    # warm throughput: ACTUAL pods scheduled over the LAST half of batches —
-    # excludes the handful of one-time XLA compiles (main program + scatter
-    # row-buckets) that a sum-based "steady" misattributes on short configs,
-    # and credits each batch with what it really scheduled (partial last
-    # batch, unschedulable pods)
+    # warm throughput: MEDIAN per-batch rate (actual scheduled / latency)
+    # over the LAST half of batches — excludes the bounded one-time XLA
+    # compiles AND is robust to the multi-minute stall outliers the
+    # remote-attached tunnel occasionally injects (a mean would smear one
+    # 300s hiccup over the whole tail)
     half = len(batch_times) // 2 if len(batch_times) >= 4 else 0
-    warm_time = sum(batch_times[half:])
-    warm_pods = sum(batch_sched[half:])
-    warm_rate = warm_pods / warm_time if warm_time > 0 else None
+    rates = [s / t for t, s in zip(batch_times[half:], batch_sched[half:]) if t > 0]
+    warm_rate = float(np.median(rates)) if rates else None
+    # honesty counter for the median: batches in the measured tail that ran
+    # >5x the median latency (recompiles or tunnel stalls the median hides)
+    tail_med = float(np.median(batch_times[half:])) if batch_times[half:] else 0.0
+    stall_batches = sum(1 for t in batch_times[half:] if tail_med > 0 and t > 5 * tail_med)
     detail = {
         "config": name,
         "nodes": len(nodes),
@@ -258,6 +261,7 @@ def run_config(name, build):
         "pods_per_sec_steady": round(
             max(scheduled - BATCH, 0) / steady, 1) if len(batch_times) > 1 else None,
         "pods_per_sec_warm": round(warm_rate, 1) if warm_rate is not None else None,
+        "warm_stall_batches": stall_batches,
         "first_batch_s": round(first_batch_s or 0.0, 3),
         "batch_p50_s": round(float(np.percentile(bt, 50)), 4),
         "batch_p99_s": round(float(np.percentile(bt, 99)), 4),

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Optional
@@ -79,6 +80,7 @@ def _configurator(args):
         deterministic=args.deterministic,
         service_lister=service_lister,
     )
+    cc = None
     if args.config:
         cc = load_component_config(args.config)
         if cc.feature_gates:
@@ -91,15 +93,15 @@ def _configurator(args):
             args.scheduler_name = cc.scheduler_name
     if args.policy_config_file:
         with open(args.policy_config_file) as f:
-            return cfgr, cfgr.create_from_config(json.load(f))
-    return cfgr, cfgr.create_from_provider(args.algorithm_provider)
+            return cfgr, cfgr.create_from_config(json.load(f)), cc
+    return cfgr, cfgr.create_from_provider(args.algorithm_provider), cc
 
 
 def run_extender(args) -> int:
     from .extender import ExtenderServer
     from .metrics import MetricsServer
 
-    _, sched = _configurator(args)
+    _, sched, _ = _configurator(args)
     sc = sched.solve_config
     srv = ExtenderServer(
         cache=sched.cache, host=args.address, port=args.port,
@@ -129,9 +131,28 @@ def run_sim(args) -> int:
     from .scheduler.driver import Binder
     from .scheduler.eventhandlers import EventHandlers
 
-    cfgr, sched = _configurator(args)
+    cfgr, sched, cc = _configurator(args)
     api = FakeAPIServer()
     sched.binder = Binder(APIBinder(api).bind)
+    # leaderElection.leaderElect (server.go:157 → leaderelection.RunOrDie):
+    # acquire the lease before scheduling; renew each cycle, stand down on
+    # loss (active-passive replicas, SURVEY §2.3)
+    elector = None
+    if cc is not None and cc.leader_election.leader_elect:
+        import socket
+
+        from .utils.leaderelection import LeaderElector, LeaseLock
+
+        le = cc.leader_election
+        elector = LeaderElector(
+            LeaseLock(api),
+            identity=f"{socket.gethostname()}_{os.getpid()}",
+            lease_duration_s=le.lease_duration_s,
+            renew_deadline_s=le.renew_deadline_s,
+            retry_period_s=le.retry_period_s,
+        )
+        while not elector.try_acquire_or_renew():
+            time.sleep(elector.retry_period_s)
     g = ClusterGen(args.seed)
     nodes, existing = g.cluster(args.nodes, 0, feature_rate=0.3)
     for n in nodes:
@@ -160,7 +181,20 @@ def run_sim(args) -> int:
     t0 = time.perf_counter()
     deadline = time.time() + 300
     idle = 0
+    renew_by = None
     while time.time() < deadline:
+        if elector is not None:
+            # renew each cycle; a single failed CAS is NOT loss — keep
+            # retrying until renewDeadline elapses (leaderelection.go:159)
+            if elector.try_acquire_or_renew():
+                renew_by = time.monotonic() + elector.renew_deadline_s
+            elif renew_by is not None and time.monotonic() >= renew_by:
+                # deposed past the renew deadline: stand down
+                # (OnStoppedLeading → the reference exits)
+                print(json.dumps({"mode": "sim", "error": "lost leader lease"}))
+                for inf in informers.values():
+                    inf.stop()
+                return 1
         sched.queue.flush()
         r = sched.schedule_batch()
         pods, _ = api.list("pods")

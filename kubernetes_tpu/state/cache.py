@@ -324,7 +324,9 @@ class TensorMirror:
                 }
                 break
             except SigOverflow:
-                self._min_sigs *= 2
+                # 4x per growth: each distinct signature capacity is a full
+                # solve recompile — buy headroom, not tight fits
+                self._min_sigs *= 4
             except KeySlotOverflow:
                 continue
         self.cache.dirty_nodes.clear()
@@ -513,9 +515,14 @@ class TensorMirror:
             etb, _ = compile_existing_terms(
                 self.vocab, self.cache.snapshot, self.row_of
             )
-            # monotonic capacity: a shrinking term table would change device
-            # shapes and recompile; reuse the largest bucket seen
+            # monotonic capacity with 4x headroom once the bank starts
+            # GROWING: every distinct capacity is a full solve recompile
+            # (minutes on a remote chip), and affinity-heavy workloads add
+            # terms every batch — pay log4 growth recompiles, not log2
+            # (a shrinking table also reuses the largest bucket seen)
             min_cap = getattr(self, "_etb_min", 16)
+            if etb.capacity > min_cap:
+                min_cap = max(etb.capacity * 4, min_cap)
             if etb.capacity < min_cap:
                 etb, _ = compile_existing_terms(
                     self.vocab, self.cache.snapshot, self.row_of, capacity=min_cap

@@ -327,3 +327,21 @@ def test_policy_service_affinity_and_anti_affinity():
     sched.queue.add(make_pod("w1", labels={"app": "web"}, cpu_milli=100, mem=0))
     r = sched.schedule_batch()
     assert r.assignments["default/w1"] == "r1b", r.assignments
+
+
+def test_cli_sim_leader_election(tmp_path, capsys):
+    """leaderElection.leaderElect=true: the sim acquires the lease before
+    scheduling and records itself as holder (server.go:157 semantics)."""
+    from kubernetes_tpu.cmd import main
+
+    cfg = tmp_path / "cc.json"
+    cfg.write_text(json.dumps({
+        "kind": "KubeSchedulerConfiguration",
+        "leaderElection": {"leaderElect": True, "leaseDuration": "15s",
+                           "renewDeadline": "10s", "retryPeriod": "2s"},
+    }))
+    rc = main(["--mode", "sim", "--config", str(cfg), "--nodes", "6",
+               "--pods", "12", "--deterministic", "--batch-size", "16"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(out)
+    assert rc == 0 and result["bound"] == 12
