@@ -62,31 +62,62 @@ def mask_and_score(
     au: Arrays,
     ids: Arrays,
     config: Optional[SolveConfig] = None,
+    term_kinds: Optional[frozenset] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The fused Filter+Score stage shared by every solve entry point
-    (plain, gang, sharded) — one definition so they can never diverge."""
+    (plain, gang, sharded) — one definition so they can never diverge.
+
+    `term_kinds` (jit static) names the term kinds PRESENT this batch —
+    {"spread_hard","spread_soft","aff_req","anti_req","pref","sel_spread",
+    "et_anti","et_score"}; None means assume everything. The driver
+    computes it host-side so a batch without, say, inter-pod terms never
+    executes (or compiles) the inter-pod kernels: a skipped kernel's
+    term-absent identity (pass-everything mask / zero score) is exact."""
     cfg = config or DEFAULT_SOLVE_CONFIG
     preds = cfg.predicates
+    k = term_kinds
+
+    def have(*names):
+        return k is None or any(n in k for n in names)
+
     mask = F.combined_mask(na, pa, ids, predicates=preds)
     sel = F.pod_match_node_selector(na, pa)
-    if preds is None or "EvenPodsSpread" in preds:
+    if (preds is None or "EvenPodsSpread" in preds) and have("spread_hard"):
         mask = mask & T.spread_filter(na, ea, ta, sel)
     if preds is None or "MatchInterPodAffinity" in preds:
-        mask = mask & T.interpod_filter(na, ea, ta, au, xa, pa)
+        parts = tuple(
+            p for p, kinds in (
+                ("existing", ("et_anti",)),
+                ("aff", ("aff_req",)),
+                ("anti", ("anti_req",)),
+            ) if have(*kinds)
+        )
+        if parts:
+            mask = mask & T.interpod_filter(na, ea, ta, au, xa, pa, parts=parts)
     score = S.score_matrix(na, pa, priorities=cfg.priorities, rtcr=cfg.rtcr)
     w = cfg.priority_weight("InterPodAffinityPriority", 1)
     if w:
-        score = score + w * T.interpod_score(na, ea, ta, xa, pa)
+        parts = tuple(
+            p for p, kinds in (("pref", ("pref",)), ("existing", ("et_score",)))
+            if have(*kinds)
+        )
+        if parts:
+            score = score + w * T.interpod_score(na, ea, ta, xa, pa, parts=parts)
     w = cfg.priority_weight("EvenPodsSpreadPriority", 1)
-    if w:
+    if w and have("spread_soft"):
         score = score + w * T.spread_score(na, ea, ta, au, sel)
     w = cfg.priority_weight("SelectorSpreadPriority", 1)
-    if w:
+    if w and have("sel_spread"):
         score = score + w * T.selector_spread_score(na, ea, ta, au)
+    elif w:
+        # term-absent identity is NOT zero here: a pod with no controller
+        # selectors scores MaxNodeScore on every node (the map counts 0,
+        # the reduce turns all-zero into all-max — selector_spreading.go)
+        score = score + w * T.MAX_NODE_SCORE
     return mask, score
 
 
-@partial(jax.jit, static_argnames=("config",))
+@partial(jax.jit, static_argnames=("config", "term_kinds"))
 def filter_mask(
     na: Arrays,
     pa: Arrays,
@@ -96,15 +127,16 @@ def filter_mask(
     au: Arrays,
     ids: Arrays,
     config: Optional[SolveConfig] = None,
+    term_kinds: Optional[frozenset] = None,
 ) -> jnp.ndarray:
     """Filter-only entry point (the extender /filter path): shares
     mask_and_score so the gating can never diverge; XLA dead-code-eliminates
     the unused score computation."""
-    mask, _ = mask_and_score(na, pa, ea, ta, xa, au, ids, config)
+    mask, _ = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
     return mask
 
 
-@partial(jax.jit, static_argnames=("deterministic", "config"))
+@partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds"))
 def solve_pipeline(
     na: Arrays,  # NodeBank arrays
     pa: Arrays,  # PodBatch arrays
@@ -116,9 +148,10 @@ def solve_pipeline(
     key,  # PRNG key for selectHost tie-breaks
     deterministic: bool = False,
     config: Optional[SolveConfig] = None,
+    term_kinds: Optional[frozenset] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """mask → score → greedy solve. Returns (assign [B], score [B, N])."""
-    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config)
+    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
     free0 = na["alloc"] - na["requested"]
     b = pa["valid"].shape[0]
     order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
@@ -137,7 +170,7 @@ def solve_pipeline(
     return assign, score
 
 
-@partial(jax.jit, static_argnames=("deterministic", "config"))
+@partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds"))
 def solve_pipeline_gang(
     na: Arrays,
     pa: Arrays,
@@ -150,12 +183,13 @@ def solve_pipeline_gang(
     group: jnp.ndarray,  # [B] group id, -1 = ungrouped
     deterministic: bool = False,
     config: Optional[SolveConfig] = None,
+    term_kinds: Optional[frozenset] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Gang variant: same fused mask/score, then the all-or-nothing
     two-pass solve (ops/solver.solve_gang). Returns (assign, score,
     gang_ok) — members of dropped groups come back assign=-1, gang_ok
     False, and their capacity is released to other pods in pass 2."""
-    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config)
+    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
     free0 = na["alloc"] - na["requested"]
     b = pa["valid"].shape[0]
     order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])

@@ -275,89 +275,108 @@ def interpod_filter(
     aux: Arrays,
     ex_terms: Arrays,
     pods: Arrays,
+    parts: tuple = ("existing", "aff", "anti"),
 ) -> jnp.ndarray:
     """InterPodAffinityMatches (predicates.go:1269), metadata path:
       1. existing pods' required anti-affinity blocks same-topology nodes
       2. incoming required affinity: node must match topology of ALL terms
          (with the first-pod-in-series escape)
-      3. incoming required anti-affinity: node matching ANY term fails."""
+      3. incoming required anti-affinity: node matching ANY term fails.
+
+    `parts` is a jit-static subset — the driver drops the parts whose term
+    kinds are provably absent this batch (a skipped part contributes its
+    term-absent identity, so dropping == computing on empty terms)."""
     B = pods["valid"].shape[0]
     N = nodes["valid"].shape[0]
+    result = jnp.ones((B, N), bool)
 
-    # --- 1. existing-pods anti-affinity (ex_terms, owner = node row) -------
-    ex_anti = ex_terms["valid"] & (ex_terms["kind"] == ANTI_REQ)
-    m_et = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_anti[:, None]  # [ET, B]
-    owner_bucket, owner_has = _bucket_of_owner(nodes, ex_terms["topo_slot"], ex_terms["owner"])
-    bucket_n, haskey_n = _bucket_of(nodes, ex_terms["topo_slot"])  # [ET, N]
-    pair_match = owner_has & haskey_n & (bucket_n == owner_bucket)  # [ET, N]
-    fail_existing = jnp.matmul(m_et.astype(jnp.float32).T, pair_match.astype(jnp.float32)) > 0.5  # [B, N]
+    if "existing" in parts:
+        # --- 1. existing-pods anti-affinity (ex_terms, owner = node row) ---
+        ex_anti = ex_terms["valid"] & (ex_terms["kind"] == ANTI_REQ)
+        m_et = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_anti[:, None]  # [ET, B]
+        owner_bucket, owner_has = _bucket_of_owner(nodes, ex_terms["topo_slot"], ex_terms["owner"])
+        bucket_n, haskey_n = _bucket_of(nodes, ex_terms["topo_slot"])  # [ET, N]
+        pair_match = owner_has & haskey_n & (bucket_n == owner_bucket)  # [ET, N]
+        fail_existing = jnp.matmul(m_et.astype(jnp.float32).T, pair_match.astype(jnp.float32)) > 0.5  # [B, N]
+        result = result & ~fail_existing
 
-    # --- 2./3. incoming terms ---------------------------------------------
-    aff = terms["valid"] & (terms["kind"] == AFF_REQ)
-    anti = terms["valid"] & (terms["kind"] == ANTI_REQ)
-    owner = terms["owner"]
-    # per-term property match of existing-pod SIGNATURES
-    m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :]  # [TT, S]
-    # affinity: existing pod must match ALL of the owner's aff terms —
-    # AND across terms happens at the signature level
-    matchall_sig = (
-        jnp.ones((B + 1, m_sig.shape[1]), jnp.int32)
-        .at[jnp.where(aff, owner, B)]
-        .min(jnp.where(aff[:, None], m_sig, True).astype(jnp.int32), mode="drop")[:B]
-        .astype(bool)
-    )  # [B, S]
+    if "aff" in parts or "anti" in parts:
+        # --- 2./3. incoming terms ------------------------------------------
+        aff = terms["valid"] & (terms["kind"] == AFF_REQ)
+        anti = terms["valid"] & (terms["kind"] == ANTI_REQ)
+        owner = terms["owner"]
+        # per-term property match of existing-pod SIGNATURES
+        m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :]  # [TT, S]
+        bucket_n2, haskey_n2 = _bucket_of(nodes, terms["topo_slot"])  # [TT, N]
 
-    bucket_n2, haskey_n2 = _bucket_of(nodes, terms["topo_slot"])  # [TT, N]
+    if "aff" in parts:
+        # affinity: existing pod must match ALL of the owner's aff terms —
+        # AND across terms happens at the signature level
+        matchall_sig = (
+            jnp.ones((B + 1, m_sig.shape[1]), jnp.int32)
+            .at[jnp.where(aff, owner, B)]
+            .min(jnp.where(aff[:, None], m_sig, True).astype(jnp.int32), mode="drop")[:B]
+            .astype(bool)
+        )  # [B, S]
+        # nodes hosting ≥1 existing pod matching ALL owner terms, per bucket
+        cnt_aff_node = _sig_cnt_node(matchall_sig, eps["counts"])  # [B, N]
+        contrib_aff_n = jnp.where(haskey_n2 & aff[:, None], cnt_aff_node[owner], 0)  # [TT, N]
+        agg_aff = _seg_sum(contrib_aff_n, bucket_n2, N) > 0  # [TT, V]
+        ok_aff_t = haskey_n2 & _gather_rows(agg_aff, bucket_n2)
+        aff_ok = _scatter_and(ok_aff_t, owner, aff, B)
+        any_pair = jnp.zeros(B + 1, bool).at[jnp.where(aff, owner, B)].max(jnp.any(agg_aff, axis=1) & aff)[:B]
+        escape = ~any_pair & aux["self_aff_match"]
+        result = result & (aff_ok | escape[:, None] | ~aux["has_aff"][:, None])
 
-    # nodes hosting ≥1 existing pod matching ALL owner terms, per topo bucket
-    cnt_aff_node = _sig_cnt_node(matchall_sig, eps["counts"])  # [B, N]
-    contrib_aff_n = jnp.where(haskey_n2 & aff[:, None], cnt_aff_node[owner], 0)  # [TT, N]
-    agg_aff = _seg_sum(contrib_aff_n, bucket_n2, N) > 0  # [TT, V]
-    ok_aff_t = haskey_n2 & _gather_rows(agg_aff, bucket_n2)
-    aff_ok = _scatter_and(ok_aff_t, owner, aff, B)
-    any_pair = jnp.zeros(B + 1, bool).at[jnp.where(aff, owner, B)].max(jnp.any(agg_aff, axis=1) & aff)[:B]
-    escape = ~any_pair & aux["self_aff_match"]
-    aff_result = aff_ok | escape[:, None] | ~aux["has_aff"][:, None]
+    if "anti" in parts:
+        cnt_anti_node = _sig_cnt_node(m_sig & anti[:, None], eps["counts"])  # [TT, N]
+        agg_anti = _seg_sum(jnp.where(haskey_n2, cnt_anti_node, 0), bucket_n2, N) > 0
+        bad_anti_t = haskey_n2 & _gather_rows(agg_anti, bucket_n2)
+        result = result & ~_scatter_or(bad_anti_t, owner, anti, B)
 
-    cnt_anti_node = _sig_cnt_node(m_sig & anti[:, None], eps["counts"])  # [TT, N]
-    agg_anti = _seg_sum(jnp.where(haskey_n2, cnt_anti_node, 0), bucket_n2, N) > 0
-    bad_anti_t = haskey_n2 & _gather_rows(agg_anti, bucket_n2)
-    anti_bad = _scatter_or(bad_anti_t, owner, anti, B)
-
-    return ~fail_existing & aff_result & ~anti_bad
+    return result
 
 
 def interpod_score(
-    nodes: Arrays, eps: Arrays, terms: Arrays, ex_terms: Arrays, pods: Arrays
+    nodes: Arrays,
+    eps: Arrays,
+    terms: Arrays,
+    ex_terms: Arrays,
+    pods: Arrays,
+    parts: tuple = ("pref", "existing"),
 ) -> jnp.ndarray:
     """CalculateInterPodAffinityPriority (interpod_affinity.go:99): weighted
     same-topology counts from (a) the incoming pod's preferred terms matched
     against existing pods, (b) existing pods' required-affinity (x hard
     weight) and preferred terms matched against the incoming pod; min-max
-    normalized to [0, 10]."""
+    normalized to [0, 10]. `parts` drops a half whose term kinds are
+    provably absent (its contribution would be identically zero)."""
     B = pods["valid"].shape[0]
     N = nodes["valid"].shape[0]
+    counts = jnp.zeros((B, N), jnp.int64)
 
-    # (a) incoming preferred terms vs existing-pod signatures
-    pref = terms["valid"] & ((terms["kind"] == AFF_PREF) | (terms["kind"] == ANTI_PREF))
-    owner = terms["owner"]
-    m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & pref[:, None]
-    bucket_n, haskey_n = _bucket_of(nodes, terms["topo_slot"])
-    cnt_node = _sig_cnt_node(m_sig, eps["counts"])  # [TT, N]
-    cnt = _seg_sum(jnp.where(haskey_n, cnt_node, 0), bucket_n, N)  # [TT, V]
-    contrib_t = jnp.where(haskey_n, _gather_rows(cnt, bucket_n), 0) * terms["weight"][:, None]
-    counts = _scatter_add(contrib_t.astype(jnp.int64), owner, pref, B)  # [B, N]
+    if "pref" in parts:
+        # (a) incoming preferred terms vs existing-pod signatures
+        pref = terms["valid"] & ((terms["kind"] == AFF_PREF) | (terms["kind"] == ANTI_PREF))
+        owner = terms["owner"]
+        m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & pref[:, None]
+        bucket_n, haskey_n = _bucket_of(nodes, terms["topo_slot"])
+        cnt_node = _sig_cnt_node(m_sig, eps["counts"])  # [TT, N]
+        cnt = _seg_sum(jnp.where(haskey_n, cnt_node, 0), bucket_n, N)  # [TT, V]
+        contrib_t = jnp.where(haskey_n, _gather_rows(cnt, bucket_n), 0) * terms["weight"][:, None]
+        counts = counts + _scatter_add(contrib_t.astype(jnp.int64), owner, pref, B)  # [B, N]
 
-    # (b) existing pods' terms vs the incoming pod (MXU matmul)
-    ex_score = ex_terms["valid"] & (
-        (ex_terms["kind"] == AFF_REQ) | (ex_terms["kind"] == AFF_PREF) | (ex_terms["kind"] == ANTI_PREF)
-    )
-    m_et = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_score[:, None]  # [ET, B]
-    owner_bucket, owner_has = _bucket_of_owner(nodes, ex_terms["topo_slot"], ex_terms["owner"])
-    bucket_ne, haskey_ne = _bucket_of(nodes, ex_terms["topo_slot"])
-    pair_match = owner_has & haskey_ne & (bucket_ne == owner_bucket)  # [ET, N]
-    weighted = m_et.astype(jnp.float32) * ex_terms["weight"][:, None].astype(jnp.float32)  # [ET, B]
-    counts = counts + jnp.matmul(weighted.T, pair_match.astype(jnp.float32)).astype(jnp.int64)
+    if "existing" in parts:
+        # (b) existing pods' terms vs the incoming pod (MXU matmul)
+        ex_score = ex_terms["valid"] & (
+            (ex_terms["kind"] == AFF_REQ) | (ex_terms["kind"] == AFF_PREF) | (ex_terms["kind"] == ANTI_PREF)
+        )
+        m_et = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_score[:, None]  # [ET, B]
+        owner_bucket, owner_has = _bucket_of_owner(nodes, ex_terms["topo_slot"], ex_terms["owner"])
+        bucket_ne, haskey_ne = _bucket_of(nodes, ex_terms["topo_slot"])
+        pair_match = owner_has & haskey_ne & (bucket_ne == owner_bucket)  # [ET, N]
+        weighted = m_et.astype(jnp.float32) * ex_terms["weight"][:, None].astype(jnp.float32)  # [ET, B]
+        counts = counts + jnp.matmul(weighted.T, pair_match.astype(jnp.float32)).astype(jnp.int64)
 
     valid = nodes["valid"][None, :] & pods["valid"][:, None]
     masked = jnp.where(valid, counts, 0)

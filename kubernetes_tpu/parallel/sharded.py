@@ -116,11 +116,11 @@ def make_sharded_pipeline(mesh: Mesh):
     def _c(x: jnp.ndarray, *spec) -> jnp.ndarray:
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
-    @partial(jax.jit, static_argnames=("deterministic", "config"))
+    @partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds"))
     def pipeline(
         na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays,
         au: Arrays, ids: Arrays, key, deterministic: bool = False,
-        config: "SolveConfig" = None,
+        config: "SolveConfig" = None, term_kinds=None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         N = na["valid"].shape[0]
         assert N % n_shards == 0, f"node capacity {N} not divisible by {n_shards} shards"
@@ -134,7 +134,7 @@ def make_sharded_pipeline(mesh: Mesh):
             ea = {**ea, "counts": _c(ea["counts"], AXIS_NODES)}
         # mask/score compute (shared stage — identical math to the
         # single-device pipelines): nodes sharded, batch data-parallel
-        mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config)
+        mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
         mask = _c(mask, AXIS_PODS, AXIS_NODES)
         score = _c(score, AXIS_PODS, AXIS_NODES)
         # the greedy commit is a strict sequential order over the whole

@@ -153,6 +153,42 @@ def pod_group_min_available(pod: Pod) -> int:
         return 0
 
 
+def _present_term_kinds(tb, etb, aux) -> frozenset:
+    """Host-side scan of the compiled term banks → the jit-static kind set
+    mask_and_score gates its topology kernels on. Exact: a kind absent here
+    means the corresponding kernel part would compute its identity."""
+    from ..state.terms import (
+        AFF_PREF,
+        AFF_REQ,
+        ANTI_PREF,
+        ANTI_REQ,
+        SEL_SPREAD,
+        SPREAD_HARD,
+        SPREAD_SOFT,
+    )
+
+    kinds = set()
+    present = set(np.unique(tb.kind[tb.valid]))
+    if SPREAD_HARD in present:
+        kinds.add("spread_hard")
+    if SPREAD_SOFT in present:
+        kinds.add("spread_soft")
+    if AFF_REQ in present:
+        kinds.add("aff_req")
+    if ANTI_REQ in present:
+        kinds.add("anti_req")
+    if AFF_PREF in present or ANTI_PREF in present:
+        kinds.add("pref")
+    if SEL_SPREAD in present or bool(np.any(aux["n_sel_spread"] > 0)):
+        kinds.add("sel_spread")
+    et_present = set(np.unique(etb.kind[etb.valid]))
+    if ANTI_REQ in et_present:
+        kinds.add("et_anti")
+    if et_present & {AFF_REQ, AFF_PREF, ANTI_PREF}:
+        kinds.add("et_score")
+    return frozenset(kinds)
+
+
 RECHECK_NONE = 0
 RECHECK_LIGHT = 1  # validate against THIS BATCH's commits only (cheap)
 RECHECK_FULL = 2  # full scalar oracle pass (O(cluster) metadata)
@@ -337,6 +373,16 @@ class Scheduler:
         # .device_arrays); existing-terms bank device copy memoized on the
         # cached host object — per batch only the pod batch, the batch term
         # tables, and the dirty row slices cross the host→device wire
+        # term kinds seen so far (jit statics): batches without a kind never
+        # execute — or compile — that kind's kernels. MONOTONE union across
+        # batches, not the exact per-batch set: a fluctuating workload would
+        # otherwise compile up to 2^8 variants, while the union costs at
+        # most 8 growth compiles and a superset program is still exact
+        # (extra kernels compute their term-absent identities)
+        self._term_kinds = getattr(self, "_term_kinds", frozenset()) | _present_term_kinds(
+            tb, etb, aux
+        )
+        term_kinds = self._term_kinds
         na_dev, ea_dev = self.mirror.device_arrays()
         t_patch = time.perf_counter()
         self.stats["patch_s"] = self.stats.get("patch_s", 0.0) + (t_patch - t1)
@@ -368,14 +414,16 @@ class Scheduler:
                 if gn:
                     garr[i] = gid_map.setdefault(gn, len(gid_map))
             assign, score, gang_ok = solve_pipeline_gang(
-                *args, garr, deterministic=self.deterministic, config=self.solve_config
+                *args, garr, deterministic=self.deterministic,
+                config=self.solve_config, term_kinds=term_kinds,
             )
             assign, gang_ok = jax.device_get((assign, gang_ok))  # one transfer
             gang_ok_arr = np.asarray(gang_ok)[: len(pods)]
         else:
             t_d = time.perf_counter()
             assign, score = solve_pipeline(
-                *args, deterministic=self.deterministic, config=self.solve_config
+                *args, deterministic=self.deterministic,
+                config=self.solve_config, term_kinds=term_kinds,
             )
             # dispatch_s = host upload + trace-cache lookup + enqueue (async);
             # fetch_s = device execution + the [B] assign download

@@ -124,3 +124,36 @@ def test_gang_fits_entirely():
     )
     assert sorted(np.asarray(got).tolist()) == [0, 1]
     assert np.asarray(ok).all()
+
+
+def test_term_kind_gating_is_bit_identical():
+    """solve_pipeline with the host-computed term-kind statics must produce
+    the SAME assignment and scores as the assume-everything program — a
+    skipped kernel's term-absent identity is exact, not approximate."""
+    import numpy as np
+
+    from kubernetes_tpu.models.generators import ClusterGen
+    from kubernetes_tpu.oracle import Snapshot
+    from kubernetes_tpu.ops.pipeline import encode_solve_args, solve_pipeline
+    from kubernetes_tpu.scheduler.driver import _present_term_kinds
+    from kubernetes_tpu.state.tensors import PodBatch, _bucket, encode_snapshot
+    from kubernetes_tpu.state.terms import compile_batch_terms, compile_existing_terms
+
+    for seed, feature_rate in ((5, 0.0), (6, 0.5)):
+        g = ClusterGen(seed)
+        nodes, existing = g.cluster(12, 40, feature_rate=feature_rate)
+        snap = Snapshot(nodes, existing)
+        pods = [g.pod(30_000 + i, feature_rate=feature_rate) for i in range(10)]
+        args = encode_solve_args(snap, pods)
+        # recompute host banks to derive kinds the way the driver does
+        bank, _, row_of = encode_snapshot(snap)
+        batch = PodBatch(bank.vocab, _bucket(len(pods)))
+        for i, p in enumerate(pods):
+            batch.set_pod(i, p)
+        tb, aux = compile_batch_terms(bank.vocab, pods, b_capacity=batch.capacity)
+        etb, _ = compile_existing_terms(bank.vocab, snap, row_of)
+        kinds = _present_term_kinds(tb, etb, aux)
+        a_all, s_all = solve_pipeline(*args, deterministic=True)
+        a_gated, s_gated = solve_pipeline(*args, deterministic=True, term_kinds=kinds)
+        assert np.array_equal(np.asarray(a_all), np.asarray(a_gated)), (seed, kinds)
+        assert np.array_equal(np.asarray(s_all), np.asarray(s_gated)), (seed, kinds)
