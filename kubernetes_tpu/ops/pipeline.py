@@ -136,25 +136,38 @@ def filter_mask(
     return mask
 
 
+def _pod_axis(pa: Arrays, pb: Optional[Arrays]):
+    """Resolve the per-POD axis: (sig, valid, priority, B). `pa` rows are
+    per unique SPEC; `pb` (when given) maps batch positions onto them —
+    replica sets collapse to one mask/score row. pb=None is the identity
+    (one spec row per pod; the pre-dedup contract kept for tests/tools)."""
+    if pb is None:
+        b = pa["valid"].shape[0]
+        return None, pa["valid"], pa["priority"], b
+    sig = pb["sig"]
+    return sig, pb["valid"], pb["priority"], sig.shape[0]
+
+
 @partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds"))
 def solve_pipeline(
     na: Arrays,  # NodeBank arrays
-    pa: Arrays,  # PodBatch arrays
+    pa: Arrays,  # PodBatch arrays (one row per unique pod spec)
     ea: Arrays,  # SigBank arrays (existing-pod label signatures + per-node counts)
     ta: Arrays,  # batch TermBank arrays
     xa: Arrays,  # existing-pods TermBank arrays
     au: Arrays,  # compile_batch_terms aux
     ids: Arrays,  # interned constants (filters.make_ids)
     key,  # PRNG key for selectHost tie-breaks
+    pb: Optional[Arrays] = None,  # per-pod axis: sig/valid/priority [B]
     deterministic: bool = False,
     config: Optional[SolveConfig] = None,
     term_kinds: Optional[frozenset] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """mask → score → greedy solve. Returns (assign [B], score [B, N])."""
+    """mask → score → greedy solve. Returns (assign [B], score [U, N])."""
     mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
     free0 = na["alloc"] - na["requested"]
-    b = pa["valid"].shape[0]
-    order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
+    sig, pvalid, prio, b = _pod_axis(pa, pb)
+    order = pop_order(prio, jnp.arange(b, dtype=jnp.int32), pvalid)
     assign = solve_greedy(
         mask,
         score,
@@ -166,6 +179,8 @@ def solve_pipeline(
         key,
         deterministic=deterministic,
         req_any=pa["req_any"],
+        sig=sig,
+        pod_valid=pvalid,
     )
     return assign, score
 
@@ -180,7 +195,8 @@ def solve_pipeline_gang(
     au: Arrays,
     ids: Arrays,
     key,
-    group: jnp.ndarray,  # [B] group id, -1 = ungrouped
+    group: jnp.ndarray,  # [B] group id, -1 = ungrouped (per batch position)
+    pb: Optional[Arrays] = None,
     deterministic: bool = False,
     config: Optional[SolveConfig] = None,
     term_kinds: Optional[frozenset] = None,
@@ -191,8 +207,8 @@ def solve_pipeline_gang(
     False, and their capacity is released to other pods in pass 2."""
     mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
     free0 = na["alloc"] - na["requested"]
-    b = pa["valid"].shape[0]
-    order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
+    sig, pvalid, prio, b = _pod_axis(pa, pb)
+    order = pop_order(prio, jnp.arange(b, dtype=jnp.int32), pvalid)
     assign, gang_ok = solve_gang(
         mask,
         score,
@@ -205,6 +221,8 @@ def solve_pipeline_gang(
         key,
         deterministic=deterministic,
         req_any=pa["req_any"],
+        sig=sig,
+        pod_valid=pvalid,
     )
     return assign, score, gang_ok
 

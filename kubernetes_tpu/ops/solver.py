@@ -43,71 +43,152 @@ def pop_order(priority: jnp.ndarray, enqueue_seq: jnp.ndarray, valid: jnp.ndarra
     return jnp.lexsort((enqueue_seq, -priority.astype(jnp.int64), ~valid))
 
 
-def _select_host(score: jnp.ndarray, feasible: jnp.ndarray, key) -> jnp.ndarray:
-    """selectHost semantics: uniform among the max-score feasible nodes."""
-    neg = jnp.iinfo(score.dtype).min
-    masked = jnp.where(feasible, score, neg)
-    best = jnp.max(masked)
-    ties = feasible & (masked == best)
-    # random tie-break: pick max over uniform noise restricted to ties
-    noise = jax.random.uniform(key, score.shape)
-    pick = jnp.argmax(jnp.where(ties, noise, -1.0))
-    return jnp.where(jnp.any(feasible), pick, -1)
+def tie_noise(rng_key, b: int, n: int) -> jnp.ndarray:
+    """selectHost tie-break noise for a whole batch in ONE vectorized RNG
+    call — bit-identical to the former per-step `uniform(split(key, B)[i],
+    (N,))` stream (and to parallel/sharded.py's), but ~B× cheaper than
+    running threefry once per scan step."""
+    keys = jax.random.split(rng_key, b)
+    return jax.vmap(lambda k: jax.random.uniform(k, (n,)))(keys)
 
 
-@partial(jax.jit, static_argnames=("deterministic",))
+@partial(jax.jit, static_argnames=("deterministic", "chunk"))
 def solve_greedy(
-    mask: jnp.ndarray,  # [B, N] feasibility from filter kernels
-    score: jnp.ndarray,  # [B, N] weighted priority sums
-    req: jnp.ndarray,  # [B, R] pod requests (GetResourceRequest)
+    mask: jnp.ndarray,  # [U, N] feasibility from filter kernels (spec rows)
+    score: jnp.ndarray,  # [U, N] weighted priority sums
+    req: jnp.ndarray,  # [U, R] pod requests (GetResourceRequest)
     free0: jnp.ndarray,  # [N, R] alloc - requested at batch start
     count0: jnp.ndarray,  # [N] pod counts at batch start
     allowed: jnp.ndarray,  # [N] allowed pod numbers
     order: jnp.ndarray,  # [B] scan order (pop_order)
     rng_key,  # PRNG key for tie-breaks
     deterministic: bool = False,
-    req_any: Optional[jnp.ndarray] = None,  # [B] pod requests anything at all
+    req_any: Optional[jnp.ndarray] = None,  # [U] pod requests anything at all
+    sig: Optional[jnp.ndarray] = None,  # [B] pod → spec row (None: identity)
+    pod_valid: Optional[jnp.ndarray] = None,  # [B] (None: all valid)
+    chunk: int = 64,
 ) -> jnp.ndarray:
     """Greedy-by-priority batch assignment → node row per pod, -1 = no fit.
 
-    Each scan step re-checks resource fit against the carry residuals, so an
-    earlier pod consuming a node's last CPU makes it infeasible for later
-    pods — exactly as if the reference had scheduled them sequentially."""
-    B, N = mask.shape
+    BIT-IDENTICAL to scheduling the pods one at a time in `order` (the
+    reference's scheduleOne sequence): each pod picks the max-score node
+    feasible against the residuals left by every earlier pod, with the
+    selectHost noise tie-break. But instead of a B-step sequential scan
+    (whose per-step overhead dominates at B=1024), pods are processed in
+    CHUNKS: every undecided pod in the chunk computes its choice in one
+    vectorized [K, N] pass, then per-node in-order prefix sums accept all
+    pods up to the first one whose choice no longer fits, and the rest
+    retry against updated residuals (a lax.while_loop, ≥1 pod decided per
+    iteration). Sequential equivalence: an accepted pod's chosen node
+    survives every earlier commit, and the (score, noise) argmax over a
+    subset that retains the superset's maximum is that same maximum — so
+    each accepted choice equals the choice the sequential scan would have
+    made. A pod with no feasible node stays infeasible forever (residuals
+    only shrink), so -1 can be finalized immediately.
+
+    The mask/score/req rows are per unique pod SPEC (replica sets collapse
+    to one row each; state/tensors dedup); `sig` maps each batch position to
+    its spec row. With sig=None the mapping is the identity (one row per
+    pod) — the pre-dedup behavior, kept for tests and small callers."""
+    U, N = mask.shape
     if req_any is None:
         req_any = jnp.any(req > 0, axis=-1)
+    B = order.shape[0]
+    if sig is None:
+        sig = jnp.arange(B, dtype=jnp.int32)
+    if pod_valid is None:
+        pod_valid = jnp.ones((B,), bool)
+    K = min(chunk, B)
+    if B % K:
+        K = B  # non-bucketed caller: one chunk covers everything
+    n_chunks = B // K
+    if deterministic:
+        noise = jnp.zeros((n_chunks, K, 1))  # unused; keeps the scan xs structure
+    else:
+        noise = jnp.reshape(tie_noise(rng_key, B, N), (n_chunks, K, N))
+    neg = jnp.iinfo(score.dtype).min
+    jrange = jnp.arange(K)
 
-    def step(carry, inp):
+    def chunk_step(carry, inp):
         free, count = carry
-        i, key = inp
-        m = mask[i]
-        # PodFitsResources (predicates.go:854): the pod-count check always
-        # applies; the resource rows only when the pod requests anything, so
-        # empty-request pods pass even on overcommitted (free < 0) nodes.
-        res_ok = ~req_any[i] | jnp.all(req[i][None, :] <= free, axis=-1)
-        fits = res_ok & (count + 1 <= allowed)
-        feasible = m & fits
-        if deterministic:
-            neg = jnp.iinfo(score.dtype).min
-            masked = jnp.where(feasible, score[i], neg)
-            choice = jnp.where(jnp.any(feasible), jnp.argmax(masked), -1)
-        else:
-            choice = _select_host(score[i], feasible, key)
-        committed = choice >= 0
-        sel = jnp.where(committed, choice, 0)
-        free = jnp.where(
-            committed,
-            free.at[sel].add(-req[i]),
-            free,
+        idx, nz = inp  # [K] pod positions in order; [K, N] noise rows
+        sg = sig[idx]
+        pv = pod_valid[idx]
+        m_r = mask[sg] & pv[:, None]  # [K, N]
+        s_r = score[sg]
+        r_q = req[sg]  # [K, R]
+        r_any = req_any[sg]  # [K]
+
+        def not_done(st):
+            return ~jnp.all(st[2])
+
+        def body(st):
+            free, count, decided, choice = st
+            # PodFitsResources (predicates.go:854): the pod-count check
+            # always applies; the resource rows only when the pod requests
+            # anything, so empty-request pods pass even on overcommitted
+            # (free < 0) nodes.
+            res_ok = (~r_any[:, None]) | jnp.all(
+                r_q[:, None, :] <= free[None, :, :], axis=-1
+            )  # [K, N]
+            feas = m_r & res_ok & (count[None, :] + 1 <= allowed[None, :])
+            feas = feas & ~decided[:, None]
+            anyf = jnp.any(feas, axis=1)
+            masked = jnp.where(feas, s_r, neg)
+            if deterministic:
+                cand = jnp.argmax(masked, axis=1)
+            else:
+                # selectHost: uniform among max-score nodes — max noise wins
+                best = jnp.max(masked, axis=1, keepdims=True)
+                ties = feas & (masked == best)
+                cand = jnp.argmax(jnp.where(ties, nz, -1.0), axis=1)
+            cand = jnp.where(anyf, cand.astype(jnp.int32), -1)
+            newly_none = ~decided & ~anyf
+            active = ~decided & (cand >= 0)
+            # per-node in-order prefix: what earlier active chunk pods would
+            # consume on this pod's chosen node
+            same = (
+                active[:, None]
+                & active[None, :]
+                & (cand[:, None] == cand[None, :])
+                & (jrange[None, :] < jrange[:, None])
+            )  # [K, K] same-node strictly-earlier
+            # broadcast-sum, not matmul: an s64 dot has no TPU x64 rewrite
+            prefix_req = jnp.sum(
+                same[:, :, None] * r_q[None, :, :], axis=1
+            )  # [K, R]
+            prefix_cnt = jnp.sum(same, axis=1)  # [K]
+            cidx = jnp.where(cand >= 0, cand, 0)
+            fits = (
+                (~r_any) | jnp.all(r_q <= free[cidx] - prefix_req, axis=-1)
+            ) & (count[cidx] + prefix_cnt + 1 <= allowed[cidx])
+            rejected = active & ~fits
+            first_rej = jnp.min(jnp.where(rejected, jrange, K))
+            commit = active & (jrange < first_rej)
+            # apply commits (duplicate indices accumulate; index N drops)
+            target = jnp.where(commit, cand, N)
+            free = free.at[target].add(
+                -(commit[:, None] * r_q), mode="drop"
+            )
+            count = count.at[target].add(
+                commit.astype(count.dtype), mode="drop"
+            )
+            choice = jnp.where(commit, cand, choice)
+            decided = decided | commit | newly_none
+            return free, count, decided, choice
+
+        decided0 = ~pv  # padding/invalid pods are decided at -1
+        choice0 = jnp.full((K,), -1, jnp.int32)
+        free, count, _, choice = jax.lax.while_loop(
+            not_done, body, (free, count, decided0, choice0)
         )
-        count = jnp.where(committed, count.at[sel].add(1), count)
         return (free, count), choice
 
-    keys = jax.random.split(rng_key, B)
-    (_, _), choices = jax.lax.scan(step, (free0, count0), (order, keys))
+    order_c = jnp.reshape(order, (n_chunks, K))
+    (_, _), choices = jax.lax.scan(chunk_step, (free0, count0), (order_c, noise))
     # scatter back to original pod positions
     out = jnp.full((B,), -1, jnp.int32)
-    return out.at[order].set(choices.astype(jnp.int32))
+    return out.at[order].set(jnp.reshape(choices, (B,)))
 
 
 @partial(jax.jit, static_argnames=("deterministic",))
@@ -123,21 +204,32 @@ def solve_gang(
     rng_key,
     deterministic: bool = False,
     req_any: Optional[jnp.ndarray] = None,
+    sig: Optional[jnp.ndarray] = None,
+    pod_valid: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """All-or-nothing gang assignment: two-pass greedy. Pass 1 places
     everything; groups with any unplaced member are dropped and pass 2
     re-solves without them (their capacity is released for other pods).
-    Returns (assignment [B], gang_ok [B])."""
-    B = mask.shape[0]
+    Returns (assignment [B], gang_ok [B]). `group` is per POD (batch
+    position), like `sig`/`pod_valid`."""
+    B = order.shape[0]
     k1, k2 = jax.random.split(rng_key)
-    first = solve_greedy(mask, score, req, free0, count0, allowed, order, k1, deterministic=deterministic, req_any=req_any)
+    first = solve_greedy(mask, score, req, free0, count0, allowed, order, k1,
+                         deterministic=deterministic, req_any=req_any,
+                         sig=sig, pod_valid=pod_valid)
     grouped = group >= 0
     failed_member = grouped & (first < 0)
     # group failed if ANY member failed (segment max over group ids)
     ngroups = B  # group ids are < B by construction
     fail_by_group = jnp.zeros(ngroups, bool).at[jnp.where(grouped, group, 0)].max(failed_member)
     dropped = grouped & fail_by_group[jnp.where(grouped, group, 0)]
-    mask2 = mask & ~dropped[:, None]
-    second = solve_greedy(mask2, score, req, free0, count0, allowed, order, k2, deterministic=deterministic, req_any=req_any)
+    # drop members by invalidating their batch position (dropped is per pod,
+    # so it cannot mask the shared spec rows)
+    alive = (
+        ~dropped if pod_valid is None else (pod_valid & ~dropped)
+    )
+    second = solve_greedy(mask, score, req, free0, count0, allowed, order, k2,
+                          deterministic=deterministic, req_any=req_any,
+                          sig=sig, pod_valid=alive)
     gang_ok = ~dropped
     return jnp.where(dropped, -1, second), gang_ok

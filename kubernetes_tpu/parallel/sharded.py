@@ -22,8 +22,9 @@ Two cooperating pieces (SURVEY §2.4 "TPU-native equivalent"):
    (core/generic_scheduler.go:278): the tie-break noise is generated from
    the same per-step PRNG keys and sliced per shard.
 
-Node capacity is always a power of two (state/tensors._bucket), so any
-power-of-two shard count divides it; no repadding is needed.
+Node capacity is a power of two up to 2048 and a multiple of 2048 above
+(state/tensors._node_bucket), so any power-of-two shard count up to 2048
+divides it; no repadding is needed.
 """
 
 from __future__ import annotations
@@ -35,8 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.pipeline import SolveConfig, mask_and_score
-from ..ops.solver import pop_order
+from ..ops.pipeline import SolveConfig, _pod_axis, mask_and_score
+from ..ops.solver import pop_order, tie_noise
 from .mesh import AXIS_NODES, AXIS_PODS
 
 Arrays = Dict[str, jnp.ndarray]
@@ -45,15 +46,17 @@ _BIG = 2**30
 
 
 def _solver_body(
-    mask: jnp.ndarray,  # [B, Nl] local node columns
-    score: jnp.ndarray,  # [B, Nl]
-    req: jnp.ndarray,  # [B, R] replicated
+    mask: jnp.ndarray,  # [U, Nl] local node columns (spec rows)
+    score: jnp.ndarray,  # [U, Nl]
+    req: jnp.ndarray,  # [U, R] replicated
     free: jnp.ndarray,  # [Nl, R] shard-local residuals
     count: jnp.ndarray,  # [Nl]
     allowed: jnp.ndarray,  # [Nl]
     order: jnp.ndarray,  # [B] replicated scan order
     noise: jnp.ndarray,  # [B, Nl] tie-break noise (or [B, 1] dummy)
-    req_any: jnp.ndarray,  # [B] replicated
+    req_any: jnp.ndarray,  # [U] replicated
+    sig: jnp.ndarray,  # [B] pod → spec row, replicated
+    pod_valid: jnp.ndarray,  # [B] replicated
     *,
     deterministic: bool,
     n_local: int,
@@ -65,13 +68,14 @@ def _solver_body(
     def step(carry, inp):
         free, count = carry
         i, nz = inp
-        m = mask[i]
+        s = sig[i]
+        m = mask[s] & pod_valid[i]
         # PodFitsResources against the residual carry (predicates.go:854
         # semantics: count always, resource rows only when requested)
-        res_ok = ~req_any[i] | jnp.all(req[i][None, :] <= free, axis=-1)
+        res_ok = ~req_any[s] | jnp.all(req[s][None, :] <= free, axis=-1)
         feasible = m & res_ok & (count + 1 <= allowed)
         neg = jnp.iinfo(score.dtype).min
-        masked = jnp.where(feasible, score[i], neg)
+        masked = jnp.where(feasible, score[s], neg)
         local_best = jnp.max(masked)
         global_best = jax.lax.pmax(local_best, AXIS_NODES)
         any_feasible = jax.lax.pmax(jnp.any(feasible), AXIS_NODES)
@@ -96,7 +100,7 @@ def _solver_body(
         committed = choice >= 0
         mine = committed & (choice >= base) & (choice < base + n_local)
         sel = jnp.where(mine, choice - base, 0)
-        free = jnp.where(mine, free.at[sel].add(-req[i]), free)
+        free = jnp.where(mine, free.at[sel].add(-req[s]), free)
         count = jnp.where(mine, count.at[sel].add(1), count)
         return (free, count), choice
 
@@ -119,7 +123,8 @@ def make_sharded_pipeline(mesh: Mesh):
     @partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds"))
     def pipeline(
         na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays,
-        au: Arrays, ids: Arrays, key, deterministic: bool = False,
+        au: Arrays, ids: Arrays, key, pb: Arrays = None,
+        deterministic: bool = False,
         config: "SolveConfig" = None, term_kinds=None,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         N = na["valid"].shape[0]
@@ -145,15 +150,16 @@ def make_sharded_pipeline(mesh: Mesh):
         free0 = na["alloc"] - na["requested"]
         count0 = na["pod_count"].astype(free0.dtype)
         allowed = na["allowed_pods"].astype(free0.dtype)
-        b = pa["valid"].shape[0]
-        order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
+        sig, pvalid, prio, b = _pod_axis(pa, pb)
+        if sig is None:
+            sig = jnp.arange(b, dtype=jnp.int32)
+        order = pop_order(prio, jnp.arange(b, dtype=jnp.int32), pvalid)
         if deterministic:
             noise = jnp.zeros((b, n_shards))
         else:
-            # bit-identical to the single-device _select_host stream:
+            # bit-identical to the single-device solve_greedy stream:
             # per-step keys, full-width uniform rows, sliced per shard
-            keys = jax.random.split(key, b)
-            noise = jax.vmap(lambda k: jax.random.uniform(k, (N,)))(keys)
+            noise = tie_noise(key, b, N)
         solver = jax.shard_map(
             partial(_solver_body, deterministic=deterministic, n_local=n_local),
             mesh=mesh,
@@ -167,11 +173,14 @@ def make_sharded_pipeline(mesh: Mesh):
                 P(),                  # order
                 P(None, AXIS_NODES),  # noise
                 P(),                  # req_any
+                P(),                  # sig
+                P(),                  # pod_valid
             ),
             out_specs=P(),
         )
         choices = solver(
-            mask, score, pa["req"], free0, count0, allowed, order, noise, pa["req_any"]
+            mask, score, pa["req"], free0, count0, allowed, order, noise,
+            pa["req_any"], sig, pvalid,
         )
         assign = jnp.full((b,), -1, jnp.int32).at[order].set(choices)
         return assign, score

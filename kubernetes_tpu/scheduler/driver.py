@@ -59,20 +59,28 @@ class ScheduleResult:
 
 class ScoreRows:
     """Lazy per-row view of the device score matrix. Fetching the full
-    [B, N] matrix is the single most expensive transfer in the system on a
+    [U, N] matrix is the single most expensive transfer in the system on a
     remote-attached TPU (100+ MB at ~15 MB/s for the 10k-node config);
     only the handful of rows the oracle re-placement path actually ranks
-    with may cross the wire (ops/pipeline.gather_score_rows)."""
+    with may cross the wire (ops/pipeline.gather_score_rows).
 
-    def __init__(self, score_dev):
+    The device matrix holds one row per unique pod SPEC; `sig` maps pod
+    batch positions onto spec rows (None = identity). Indexing stays by
+    batch position — duplicates share one fetched row."""
+
+    def __init__(self, score_dev, sig: Optional[Sequence[int]] = None):
         self._dev = score_dev
+        self._sig = sig
         self._cache: Dict[int, np.ndarray] = {}
 
+    def _row_of(self, i: int) -> int:
+        return int(self._sig[i]) if self._sig is not None else i
+
     def __getitem__(self, i: int) -> np.ndarray:
-        row = self._cache.get(i)
+        row = self._cache.get(self._row_of(i))
         if row is None:
             self.prefetch([i])
-            row = self._cache[i]
+            row = self._cache[self._row_of(i)]
         return row
 
     def prefetch(self, indices) -> None:
@@ -86,14 +94,14 @@ class ScoreRows:
 
         import jax.numpy as jnp
 
-        want = [i for i in indices if i not in self._cache]
+        want = sorted({self._row_of(i) for i in indices} - self._cache.keys())
         if not want:
             return
         nb = min(_bucket(len(want)), int(self._dev.shape[0]))
         padded = (want + [want[0]] * nb)[:nb]
         rows = np.asarray(gather_score_rows(self._dev, jnp.asarray(padded)))
-        for j, i in enumerate(padded[: len(want)]):
-            self._cache[i] = rows[j]
+        for j, r in enumerate(padded[: len(want)]):
+            self._cache[r] = rows[j]
 
 
 @dataclass
@@ -187,6 +195,29 @@ def _present_term_kinds(tb, etb, aux) -> frozenset:
     if et_present & {AFF_REQ, AFF_PREF, ANTI_PREF}:
         kinds.add("et_score")
     return frozenset(kinds)
+
+
+def _spec_key(pod: Pod, selectors) -> str:
+    """Canonical key of everything that shapes a pod's device mask/score
+    row and compiled terms (PodBatch.set_pod + terms.compile_batch_terms
+    inputs). Pods sharing a key — every replica of a controller — share ONE
+    row of the [U, N] mask/score matrices; per-pod state (priority, queue
+    order, gang group, volumes) stays on the batch axis. All api.types are
+    plain dataclasses, so repr is value-based and stable."""
+    return repr((
+        pod.namespace,
+        sorted(pod.labels.items()),
+        pod.node_name,
+        pod.containers,
+        pod.init_containers,
+        pod.overhead,
+        pod.tolerations,
+        sorted(pod.node_selector.items()),
+        pod.affinity,
+        pod.topology_spread_constraints,
+        [r for r in pod.owner_references if r.get("controller")],
+        selectors,
+    ))
 
 
 RECHECK_NONE = 0
@@ -293,6 +324,7 @@ class Scheduler:
         # must REUSE the largest shapes seen so far — every fresh shape is a
         # fresh XLA compile (minutes on a remote TPU)
         self._b_bucket = 16
+        self._u_bucket = 16  # unique-spec axis (≤ _b_bucket)
         self._t_bucket = 16
         self._ids = None  # cached device constants (filters.make_ids)
         # per-phase wall-clock accumulators (the utiltrace/LogIfLong
@@ -326,27 +358,37 @@ class Scheduler:
         vocab = self.mirror.vocab
         self._b_bucket = max(self._b_bucket, _bucket(len(pods)))
         custom_sort = getattr(self.queue, "_less", None) is not None
+        selectors = None
+        if self._spread_selectors_fn is not None:
+            selectors = {id(p): self._spread_selectors_fn(p) for p in pods}
+        # collapse the batch to unique pod SPECS: replicas of one controller
+        # share a single row of every [U, N] mask/score matrix (the batch-
+        # side counterpart of SigBank's existing-pod signatures) — the
+        # device work scales with distinct specs, not batch size
+        sig_list: List[int] = []
+        reps: List[Pod] = []
+        spec_index: Dict[str, int] = {}
+        for p in pods:
+            k = _spec_key(p, selectors.get(id(p)) if selectors else None)
+            u = spec_index.get(k)
+            if u is None:
+                u = len(reps)
+                spec_index[k] = u
+                reps.append(p)
+            sig_list.append(u)
+        self._u_bucket = max(self._u_bucket, _bucket(len(reps)))
         while True:
             try:
-                batch = PodBatch(vocab, self._b_bucket)
-                for i, p in enumerate(pods):
+                batch = PodBatch(vocab, self._u_bucket)
+                for i, p in enumerate(reps):
                     batch.set_pod(i, p)
-                if custom_sort:
-                    # a QueueSort plugin's comparator ordered the pop; the
-                    # device scan must consume residuals in that same order,
-                    # so neutralize the priority key and let pop_order fall
-                    # back to the enqueue (= pop) sequence
-                    batch.priority[:] = 0
-                selectors = None
-                if self._spread_selectors_fn is not None:
-                    selectors = {id(p): self._spread_selectors_fn(p) for p in pods}
                 tb, aux = compile_batch_terms(
-                    vocab, pods, spread_selectors=selectors, b_capacity=batch.capacity
+                    vocab, reps, spread_selectors=selectors, b_capacity=batch.capacity
                 )
                 self._t_bucket = max(self._t_bucket, tb.capacity)
                 if tb.capacity < self._t_bucket:
                     tb, aux = compile_batch_terms(
-                        vocab, pods, spread_selectors=selectors,
+                        vocab, reps, spread_selectors=selectors,
                         capacity=self._t_bucket, b_capacity=batch.capacity,
                     )
                 etb = self.mirror.existing_terms()
@@ -354,11 +396,25 @@ class Scheduler:
             except KeySlotOverflow:
                 self.mirror._rebuild()
 
+        # the per-POD axis: spec row, validity, queue priority. With a
+        # QueueSort plugin the comparator ordered the pop — neutralize the
+        # priority key (zeros) so pop_order falls back to the enqueue (= pop)
+        # sequence
+        pb = {
+            "sig": np.zeros(self._b_bucket, np.int32),
+            "valid": np.zeros(self._b_bucket, bool),
+            "priority": np.zeros(self._b_bucket, np.int32),
+        }
+        pb["sig"][: len(pods)] = sig_list
+        pb["valid"][: len(pods)] = True
+        if not custom_sort:
+            pb["priority"][: len(pods)] = [p.get_priority() for p in pods]
+
         # term-table overflow: truncated/dropped terms under- or over-match on
         # device — route the affected pods through the scalar oracle instead
         # (ADVICE r1: overflow_owners was recorded but never consumed)
         for owner in tb.overflow_owners:
-            if 0 <= owner < len(pods):
+            if 0 <= owner < len(reps):
                 batch.fallback[owner] = True
         existing_overflow = bool(etb.overflow_owners)
         t1 = time.perf_counter()
@@ -409,12 +465,12 @@ class Scheduler:
             from ..ops.pipeline import solve_pipeline_gang
 
             gid_map: Dict[str, int] = {}
-            garr = np.full(batch.capacity, -1, np.int32)
+            garr = np.full(self._b_bucket, -1, np.int32)
             for i, gn in enumerate(group_names):
                 if gn:
                     garr[i] = gid_map.setdefault(gn, len(gid_map))
             assign, score, gang_ok = solve_pipeline_gang(
-                *args, garr, deterministic=self.deterministic,
+                *args, garr, pb=pb, deterministic=self.deterministic,
                 config=self.solve_config, term_kinds=term_kinds,
             )
             assign, gang_ok = jax.device_get((assign, gang_ok))  # one transfer
@@ -422,7 +478,7 @@ class Scheduler:
         else:
             t_d = time.perf_counter()
             assign, score = solve_pipeline(
-                *args, deterministic=self.deterministic,
+                *args, pb=pb, deterministic=self.deterministic,
                 config=self.solve_config, term_kinds=term_kinds,
             )
             # dispatch_s = host upload + trace-cache lookup + enqueue (async);
@@ -434,11 +490,13 @@ class Scheduler:
                 time.perf_counter() - t_f
             )
         n = len(pods)
+        sig_arr = np.asarray(sig_list, np.int32)
+        self.stats["batch_specs"] = self.stats.get("batch_specs", 0) + len(reps)
         out = SolveOutput(
             assign=np.asarray(assign)[:n],
-            fallback=np.asarray(batch.fallback)[:n],
-            score=ScoreRows(score),
-            has_anti=np.asarray(aux["has_anti"])[:n],
+            fallback=np.asarray(batch.fallback)[sig_arr],
+            score=ScoreRows(score, sig_arr),
+            has_anti=np.asarray(aux["has_anti"])[sig_arr],
             existing_overflow=existing_overflow,
             node_fallback_any=bool((self.mirror.nodes.fallback & self.mirror.nodes.valid).any()),
             gang_ok=gang_ok_arr,

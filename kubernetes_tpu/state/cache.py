@@ -35,6 +35,7 @@ from .tensors import (
     SigOverflow,
     Vocab,
     _bucket,
+    _node_bucket,
 )
 
 DEFAULT_ASSUME_TTL = 30.0  # cache.go durationToExpireAssumedPod (30s default)
@@ -271,7 +272,11 @@ class TensorMirror:
         self.vocab = vocab or Vocab()
         self.rebuild_count = -1  # constructor's build doesn't count
         self._min_nodes = 1
-        self._min_sigs = 16
+        # distinct (ns, labels) signatures are workload-bounded (hundreds in
+        # 100k-pod clusters); starting at 256 avoids the mid-run SigOverflow
+        # rebuild + solve recompile that a cold 16-slot bank pays on every
+        # realistic workload. counts[N, 256] int16 is ~5 MB at 10k nodes.
+        self._min_sigs = 256
         # device-resident copies of the banks, patched by dirty ROW SLICES:
         # on a remote-attached TPU, re-uploading whole banks every batch
         # costs seconds (10s of MB at ~15 MB/s tunnel bandwidth) — only the
@@ -293,7 +298,7 @@ class TensorMirror:
         dependent (not pod-count-dependent), so `n_pods` no longer sizes
         that bank — the signature bucket grows on demand."""
         self._min_nodes = max(self._min_nodes, n_nodes)
-        if _bucket(self._min_nodes) > self.nodes.capacity:
+        if _node_bucket(self._min_nodes) > self.nodes.capacity:
             self._rebuild()
 
     def _rebuild(self) -> None:
@@ -302,7 +307,7 @@ class TensorMirror:
         while True:
             try:
                 n_nodes = max(len(snap.node_infos), self._min_nodes, 1)
-                self.nodes = NodeBank(self.vocab, _bucket(n_nodes))
+                self.nodes = NodeBank(self.vocab, _node_bucket(n_nodes))
                 self.row_of: Dict[str, int] = {}
                 self.name_of_row: List[Optional[str]] = [None] * self.nodes.capacity
                 self._free_rows = list(range(self.nodes.capacity - 1, -1, -1))

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Warm per-batch cost split on the real chip: host->device upload vs device
+execution, at BASELINE config-3-like shapes (10k nodes, B=1024 spread pods).
+
+Run: python scripts/microbench_batch.py [n_nodes] [batch]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+N_NODES = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+
+from bench import ZONES, mk_node, mk_pod  # noqa: E402
+from kubernetes_tpu.api.types import LabelSelector, TopologySpreadConstraint  # noqa: E402
+from kubernetes_tpu.oracle import Snapshot  # noqa: E402
+from kubernetes_tpu.ops.pipeline import encode_solve_args, solve_pipeline  # noqa: E402
+
+t0 = time.perf_counter()
+nodes = [mk_node(i, zone=ZONES[i % len(ZONES)]) for i in range(N_NODES)]
+pods = []
+for i in range(BATCH):
+    p = mk_pod(i, labels={"app": f"svc-{i % 100}"})
+    p.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1,
+        topology_key="failure-domain.beta.kubernetes.io/zone",
+        when_unsatisfiable="ScheduleAnyway",
+        label_selector=LabelSelector(match_labels={"app": p.labels["app"]}),
+    )]
+    pods.append(p)
+snap = Snapshot(nodes, [])
+print(f"cluster build: {time.perf_counter()-t0:.2f}s", flush=True)
+
+t0 = time.perf_counter()
+args = encode_solve_args(snap, pods)
+print(f"encode: {time.perf_counter()-t0:.2f}s", flush=True)
+
+na, pa, ea, tb, xa, au, ids, key = args
+
+def leaves(d):
+    return list(d.items()) if isinstance(d, dict) else []
+
+inventory = {"na": na, "pa": pa, "ea": ea, "tb": tb, "xa": xa, "au": au, "ids": ids}
+total_leaves = 0
+for name, d in inventory.items():
+    n = len(leaves(d))
+    b = sum(np.asarray(v).nbytes for _, v in leaves(d))
+    total_leaves += n
+    print(f"  {name}: {n} arrays, {b/1e6:.2f} MB")
+print(f"total leaves: {total_leaves}", flush=True)
+
+# hold host copies of the per-batch uploads (what the driver re-sends each batch)
+pa_h = {k: np.asarray(v) for k, v in pa.items()}
+tb_h = {k: np.asarray(v) for k, v in tb.items()}
+au_h = {k: np.asarray(v) for k, v in au.items()}
+per_batch_bytes = sum(v.nbytes for d in (pa_h, tb_h, au_h) for v in d.values())
+per_batch_leaves = sum(len(d) for d in (pa_h, tb_h, au_h))
+print(f"per-batch upload: {per_batch_leaves} arrays, {per_batch_bytes/1e6:.2f} MB", flush=True)
+
+# 1. pure upload cost of the per-batch args (as the driver does: implicit
+#    jnp conversion during dispatch). Measure device_put + block.
+for trial in range(3):
+    t0 = time.perf_counter()
+    put = jax.device_put((pa_h, tb_h, au_h))
+    jax.block_until_ready(put)
+    print(f"upload trial {trial}: {time.perf_counter()-t0:.3f}s", flush=True)
+
+# 2. solve with everything device-resident already (pure device exec)
+dev_args = jax.device_put(args)
+jax.block_until_ready(dev_args)
+term_kinds = frozenset({"spread_soft", "sel_spread"})
+t0 = time.perf_counter()
+out = solve_pipeline(*dev_args, deterministic=False, term_kinds=term_kinds)
+jax.block_until_ready(out)
+print(f"first call (compile): {time.perf_counter()-t0:.1f}s", flush=True)
+for trial in range(5):
+    t0 = time.perf_counter()
+    out = solve_pipeline(*dev_args, deterministic=False, term_kinds=term_kinds)
+    jax.block_until_ready(out)
+    print(f"device-resident solve trial {trial}: {time.perf_counter()-t0:.3f}s", flush=True)
+
+# 3. solve with per-batch args passed as host numpy (the driver's actual path)
+t0 = time.perf_counter()
+out = solve_pipeline(dev_args[0], pa_h, dev_args[2], tb_h, dev_args[4], au_h,
+                     dev_args[6], dev_args[7], deterministic=False, term_kinds=term_kinds)
+jax.block_until_ready(out)
+print(f"host-args solve (maybe compile): {time.perf_counter()-t0:.3f}s", flush=True)
+for trial in range(5):
+    t0 = time.perf_counter()
+    out = solve_pipeline(dev_args[0], pa_h, dev_args[2], tb_h, dev_args[4], au_h,
+                         dev_args[6], dev_args[7], deterministic=False, term_kinds=term_kinds)
+    jax.block_until_ready(out)
+    print(f"host-args solve trial {trial}: {time.perf_counter()-t0:.3f}s", flush=True)
+
+# 4. fetch cost: device->host of the [B] assign only
+assign = out[0]
+for trial in range(3):
+    t0 = time.perf_counter()
+    np.asarray(assign)
+    print(f"fetch assign trial {trial}: {time.perf_counter()-t0:.3f}s", flush=True)

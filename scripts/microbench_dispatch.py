@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Is the 1.5s/call solve_pipeline cost retracing, execution, or transfer?"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from bench import ZONES, mk_node, mk_pod
+from kubernetes_tpu.api.types import LabelSelector, TopologySpreadConstraint
+from kubernetes_tpu.oracle import Snapshot
+from kubernetes_tpu.ops.pipeline import encode_solve_args, solve_pipeline
+
+N_NODES, BATCH = 10000, 1024
+nodes = [mk_node(i, zone=ZONES[i % len(ZONES)]) for i in range(N_NODES)]
+pods = []
+for i in range(BATCH):
+    p = mk_pod(i, labels={"app": f"svc-{i % 100}"})
+    p.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1, topology_key="failure-domain.beta.kubernetes.io/zone",
+        when_unsatisfiable="ScheduleAnyway",
+        label_selector=LabelSelector(match_labels={"app": p.labels["app"]}))]
+    pods.append(p)
+snap = Snapshot(nodes, [])
+args = encode_solve_args(snap, pods)
+dev_args = jax.device_put(args)
+jax.block_until_ready(dev_args)
+term_kinds = frozenset({"spread_soft", "sel_spread"})
+
+kw = dict(deterministic=False, term_kinds=term_kinds)
+
+# warmup
+out = solve_pipeline(*dev_args, **kw)
+jax.block_until_ready(out)
+print("tracing cache size after warmup:", solve_pipeline._cache_size(), flush=True)
+
+for i in range(3):
+    t0 = time.perf_counter()
+    out = solve_pipeline(*dev_args, **kw)
+    t1 = time.perf_counter()
+    jax.block_until_ready(out)
+    t2 = time.perf_counter()
+    np.asarray(out[0])
+    t3 = time.perf_counter()
+    print(f"call {i}: dispatch {t1-t0:.3f}s block {t2-t1:.3f}s fetch-assign {t3-t2:.3f}s",
+          flush=True)
+print("tracing cache size after loop:", solve_pipeline._cache_size(), flush=True)
+
+# AOT compile path
+lowered = solve_pipeline.lower(*dev_args, **kw)
+t0 = time.perf_counter()
+compiled = lowered.compile()
+print(f"AOT compile: {time.perf_counter()-t0:.1f}s", flush=True)
+for i in range(3):
+    t0 = time.perf_counter()
+    out = compiled(*dev_args)
+    t1 = time.perf_counter()
+    jax.block_until_ready(out)
+    t2 = time.perf_counter()
+    print(f"AOT call {i}: dispatch {t1-t0:.3f}s block {t2-t1:.3f}s", flush=True)

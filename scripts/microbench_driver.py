@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Per-batch phase instrumentation of the real driver path on the TPU.
+Runs bench config 1 shapes and prints per-batch deltas of every stat."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np
+
+from bench import CONFIGS, BATCH
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+name, build = CONFIGS[os.environ.get("CFG", "1")]
+nodes, pods = build()
+cache = SchedulerCache()
+for node in nodes:
+    cache.add_node(node)
+queue = PriorityQueue()
+sched = Scheduler(cache=cache, queue=queue, binder=Binder(), batch_size=BATCH,
+                  enable_preemption=False, deterministic=False, bind_workers=16)
+sched.mirror.reserve(len(nodes), len(pods))
+for p in pods:
+    queue.add(p)
+
+prev = dict(sched.stats)
+while True:
+    t0 = time.perf_counter()
+    r = sched.schedule_batch()
+    dt = time.perf_counter() - t0
+    if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+        break
+    cur = dict(sched.stats)
+    delta = {k: round(cur.get(k, 0) - prev.get(k, 0), 3) for k in cur}
+    prev = cur
+    print(f"batch {delta.get('batches')}: {dt:.3f}s sched={r.scheduled} "
+          f"sync={delta.get('sync_s')} enc={delta.get('encode_s')} "
+          f"patch={delta.get('patch_s')} disp={delta.get('dispatch_s')} "
+          f"fetch={delta.get('fetch_s')} commit={delta.get('commit_s')} "
+          f"specs={delta.get('batch_specs')} rebuilds={sched.mirror.rebuild_count}",
+          flush=True)
+sched.wait_for_binds()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Split warm device time: mask+score vs greedy scan vs RNG inside the scan."""
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+N_NODES = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+
+from bench import ZONES, mk_node, mk_pod  # noqa: E402
+from kubernetes_tpu.api.types import LabelSelector, TopologySpreadConstraint  # noqa: E402
+from kubernetes_tpu.oracle import Snapshot  # noqa: E402
+from kubernetes_tpu.ops.pipeline import encode_solve_args, mask_and_score  # noqa: E402
+from kubernetes_tpu.ops.solver import pop_order, solve_greedy  # noqa: E402
+
+nodes = [mk_node(i, zone=ZONES[i % len(ZONES)]) for i in range(N_NODES)]
+pods = []
+for i in range(BATCH):
+    p = mk_pod(i, labels={"app": f"svc-{i % 100}"})
+    p.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1,
+        topology_key="failure-domain.beta.kubernetes.io/zone",
+        when_unsatisfiable="ScheduleAnyway",
+        label_selector=LabelSelector(match_labels={"app": p.labels["app"]}),
+    )]
+    pods.append(p)
+snap = Snapshot(nodes, [])
+args = encode_solve_args(snap, pods)
+dev_args = jax.device_put(args)
+jax.block_until_ready(dev_args)
+na, pa, ea, tb, xa, au, ids, key = dev_args
+term_kinds = frozenset({"spread_soft", "sel_spread"})
+
+ms_jit = jax.jit(partial(mask_and_score, config=None, term_kinds=term_kinds))
+
+
+def timeit(label, fn, n=4):
+    out = fn()
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{label}: {min(ts)*1000:.1f}ms (min of {n})", flush=True)
+    return out
+
+
+mask, score = timeit("mask_and_score", lambda: ms_jit(na, pa, ea, tb, xa, au, ids))
+
+free0 = na["alloc"] - na["requested"]
+b = pa["valid"].shape[0]
+order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
+count0 = na["pod_count"].astype(free0.dtype)
+allowed = na["allowed_pods"].astype(free0.dtype)
+
+timeit("solve_greedy (random tie-break)", lambda: solve_greedy(
+    mask, score, pa["req"], free0, count0, allowed, order, key,
+    deterministic=False, req_any=pa["req_any"]))
+
+timeit("solve_greedy (deterministic)", lambda: solve_greedy(
+    mask, score, pa["req"], free0, count0, allowed, order, key,
+    deterministic=True, req_any=pa["req_any"]))
+
+print(f"shapes: mask {mask.shape} score {score.dtype}{score.shape} free0 {free0.shape}")

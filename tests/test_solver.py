@@ -157,3 +157,118 @@ def test_term_kind_gating_is_bit_identical():
         a_gated, s_gated = solve_pipeline(*args, deterministic=True, term_kinds=kinds)
         assert np.array_equal(np.asarray(a_all), np.asarray(a_gated)), (seed, kinds)
         assert np.array_equal(np.asarray(s_all), np.asarray(s_gated)), (seed, kinds)
+
+
+def _sequential_noise(mask, score, req, free, count, allowed, order, noise, req_any):
+    """Sequential reference WITH the selectHost noise tie-break: pod at scan
+    position p uses noise row p (the tie_noise stream)."""
+    free = free.copy()
+    count = count.copy()
+    out = np.full(mask.shape[0], -1, np.int32)
+    for p, i in enumerate(order):
+        res_ok = (not req_any[i]) or np.all(req[i][None, :] <= free, axis=-1)
+        feas = mask[i] & res_ok & (count + 1 <= allowed)
+        if not feas.any():
+            continue
+        s = np.where(feas, score[i], np.iinfo(score.dtype).min)
+        best = s.max()
+        ties = feas & (s == best)
+        n = int(np.argmax(np.where(ties, noise[p], -1.0)))
+        out[i] = n
+        free[n] -= req[i]
+        count[n] += 1
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+@pytest.mark.parametrize("deterministic", [True, False])
+def test_chunked_contention_matches_sequential(seed, deterministic):
+    """High contention across chunk boundaries: B=256 pods (4 chunks of 64)
+    fighting over 8 tight nodes — the chunked prefix-acceptance repair loop
+    must still be bit-identical to one-pod-at-a-time scheduling."""
+    from kubernetes_tpu.ops.solver import tie_noise
+
+    rng = np.random.RandomState(seed)
+    B, N, R = 256, 8, 2
+    mask = rng.rand(B, N) < 0.9
+    # few distinct scores → massive ties → noise path heavily exercised
+    score = rng.randint(0, 3, (B, N)).astype(np.int64)
+    req = rng.randint(1, 4, (B, R)).astype(np.int64)
+    req_any = np.ones(B, bool)
+    free = rng.randint(10, 30, (N, R)).astype(np.int64)  # ~5% of demand fits
+    count = np.zeros(N, np.int64)
+    allowed = np.full(N, 12, np.int64)
+    order = np.arange(B, dtype=np.int32)
+    key = jax.random.PRNGKey(seed)
+
+    got = np.asarray(solve_greedy(
+        jnp.asarray(mask), jnp.asarray(score), jnp.asarray(req), jnp.asarray(free),
+        jnp.asarray(count), jnp.asarray(allowed), jnp.asarray(order), key,
+        deterministic=deterministic, req_any=jnp.asarray(req_any),
+    ))
+    if deterministic:
+        noise = np.zeros((B, N))  # ties break by argmax first-index
+        expect = _sequential(mask, score, req, free, count, allowed, order)
+    else:
+        noise = np.asarray(tie_noise(key, B, N))
+        expect = _sequential_noise(mask, score, req, free, count, allowed, order,
+                                   noise, req_any)
+    assert (got == expect).all(), np.nonzero(got != expect)
+
+
+def test_chunked_sig_dedup_matches_expanded():
+    """sig-mapped spec rows must behave exactly like materialized per-pod
+    rows, including duplicates contending for the same node."""
+    rng = np.random.RandomState(11)
+    U, B, N, R = 5, 128, 6, 2
+    mask_u = rng.rand(U, N) < 0.8
+    score_u = rng.randint(0, 4, (U, N)).astype(np.int64)
+    req_u = rng.randint(1, 3, (U, R)).astype(np.int64)
+    req_any_u = np.ones(U, bool)
+    sig = rng.randint(0, U, B).astype(np.int32)
+    valid = np.ones(B, bool)
+    valid[100:] = False  # tail padding
+    free = rng.randint(8, 20, (N, R)).astype(np.int64)
+    count = np.zeros(N, np.int64)
+    allowed = np.full(N, 40, np.int64)
+    order = np.arange(B, dtype=np.int32)
+    key = jax.random.PRNGKey(4)
+
+    got = np.asarray(solve_greedy(
+        jnp.asarray(mask_u), jnp.asarray(score_u), jnp.asarray(req_u),
+        jnp.asarray(free), jnp.asarray(count), jnp.asarray(allowed),
+        jnp.asarray(order), key, deterministic=False,
+        req_any=jnp.asarray(req_any_u), sig=jnp.asarray(sig),
+        pod_valid=jnp.asarray(valid),
+    ))
+    # expand spec rows to per-pod rows; invalid pods get an all-false mask
+    mask_b = mask_u[sig] & valid[:, None]
+    expect = np.asarray(solve_greedy(
+        jnp.asarray(mask_b), jnp.asarray(score_u[sig]), jnp.asarray(req_u[sig]),
+        jnp.asarray(free), jnp.asarray(count), jnp.asarray(allowed),
+        jnp.asarray(order), key, deterministic=False,
+        req_any=jnp.asarray(req_any_u[sig]),
+    ))
+    assert (got == expect).all()
+    assert (got[100:] == -1).all()
+
+
+def test_chunk_guard_non_divisible_batch():
+    """B not divisible by the chunk size falls back to one whole-batch
+    chunk instead of mis-reshaping."""
+    rng = np.random.RandomState(2)
+    B, N, R = 96, 5, 2
+    mask = rng.rand(B, N) < 0.8
+    score = rng.randint(0, 10, (B, N)).astype(np.int64)
+    req = np.ones((B, R), np.int64)
+    free = np.full((N, R), 25, np.int64)
+    count = np.zeros(N, np.int64)
+    allowed = np.full(N, 30, np.int64)
+    order = np.arange(B, dtype=np.int32)
+    got = np.asarray(solve_greedy(
+        jnp.asarray(mask), jnp.asarray(score), jnp.asarray(req), jnp.asarray(free),
+        jnp.asarray(count), jnp.asarray(allowed), jnp.asarray(order),
+        jax.random.PRNGKey(0), deterministic=True,
+    ))
+    expect = _sequential(mask, score, req, free, count, allowed, order)
+    assert (got == expect).all()
