@@ -133,16 +133,14 @@ class ExtenderServer:
                 tb, aux = compile_batch_terms(mirror.vocab, [pod], b_capacity=batch.capacity)
                 if tb.overflow_owners:
                     return None
-                etb = mirror.existing_terms()
-                if etb.overflow_owners:
+                if mirror.pats.overflow_rows:
                     return None
                 dev = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
                 # incremental device-resident banks: only dirty rows cross
                 # the wire (state/cache.py device_arrays)
-                na, ea = mirror.device_arrays()
+                na, ea, xa = mirror.device_arrays()
                 pa = dev(batch.arrays())
                 ta = dev(tb.arrays())
-                xa = dev(etb.arrays())
                 au = dev(aux)
                 ids = F.make_ids(mirror.vocab)
                 cfg = (

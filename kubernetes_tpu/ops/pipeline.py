@@ -237,7 +237,7 @@ def encode_solve_args(snapshot, pods, spread_selectors=None, key=None):
     solve_pipeline / make_sharded_pipeline(mesh).
     """
     from ..state.tensors import PodBatch, _bucket, encode_snapshot
-    from ..state.terms import compile_batch_terms, compile_existing_terms
+    from ..state.terms import compile_batch_terms, compile_existing_patterns
 
     bank, epsb, row_of = encode_snapshot(snapshot)
     vocab = bank.vocab
@@ -247,7 +247,7 @@ def encode_solve_args(snapshot, pods, spread_selectors=None, key=None):
     tb, aux = compile_batch_terms(
         vocab, pods, spread_selectors=spread_selectors, b_capacity=batch.capacity
     )
-    etb, _ = compile_existing_terms(vocab, snapshot, row_of)
+    etb = compile_existing_patterns(vocab, snapshot, row_of, bank.capacity)
     dev = lambda d: {k: jnp.asarray(v) for k, v in d.items()}
     return (
         dev(bank.arrays()),

@@ -100,15 +100,6 @@ def _bucket_of(nodes: Arrays, slot: jnp.ndarray, idx: jnp.ndarray = None):
     return jnp.maximum(b, 0), has
 
 
-def _bucket_of_owner(nodes: Arrays, slot: jnp.ndarray, owner: jnp.ndarray):
-    """Dense bucket of each term's OWN node at its own slot → [TT, 1]."""
-    dense = nodes["label_dense"][owner]  # [TT, K]
-    slot_c = jnp.clip(slot, 0, dense.shape[1] - 1)
-    b = jnp.take_along_axis(dense, slot_c[:, None], axis=1)  # [TT, 1]
-    has = (b >= 0) & (slot[:, None] >= 0)
-    return jnp.maximum(b, 0), has
-
-
 def _seg_sum(values: jnp.ndarray, buckets: jnp.ndarray, num: int) -> jnp.ndarray:
     """vmapped segment_sum over the leading term axis."""
     return jax.vmap(lambda v, s: jax.ops.segment_sum(v, s, num_segments=num))(values, buckets)
@@ -291,13 +282,24 @@ def interpod_filter(
     result = jnp.ones((B, N), bool)
 
     if "existing" in parts:
-        # --- 1. existing-pods anti-affinity (ex_terms, owner = node row) ---
+        # --- 1. existing-pods anti-affinity (ex_terms = PATTERN bank with
+        # per-node instance counts; state/terms.PatternBank) ---
         ex_anti = ex_terms["valid"] & (ex_terms["kind"] == ANTI_REQ)
-        m_et = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_anti[:, None]  # [ET, B]
-        owner_bucket, owner_has = _bucket_of_owner(nodes, ex_terms["topo_slot"], ex_terms["owner"])
-        bucket_n, haskey_n = _bucket_of(nodes, ex_terms["topo_slot"])  # [ET, N]
-        pair_match = owner_has & haskey_n & (bucket_n == owner_bucket)  # [ET, N]
-        fail_existing = jnp.matmul(m_et.astype(jnp.float32).T, pair_match.astype(jnp.float32)) > 0.5  # [B, N]
+        m_pt = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_anti[:, None]  # [PT, B]
+        bucket_n, haskey_n = _bucket_of(nodes, ex_terms["topo_slot"])  # [PT, N]
+        # buckets hosting ≥1 instance of the pattern (hosting node must
+        # carry the topology key, like the old owner_has)
+        hosted = jnp.where(haskey_n, ex_terms["counts"].T.astype(jnp.int32), 0)  # [PT, N]
+        present = _seg_sum(hosted, bucket_n, N) > 0  # [PT, V]
+        block_t = haskey_n & _gather_rows(present, bucket_n)  # [PT, N]
+        fail_existing = (
+            jnp.matmul(
+                m_pt.astype(jnp.float32).T,
+                block_t.astype(jnp.float32),
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            > 0.5
+        )  # [B, N]
         result = result & ~fail_existing
 
     if "aff" in parts or "anti" in parts:
@@ -367,16 +369,25 @@ def interpod_score(
         counts = counts + _scatter_add(contrib_t.astype(jnp.int64), owner, pref, B)  # [B, N]
 
     if "existing" in parts:
-        # (b) existing pods' terms vs the incoming pod (MXU matmul)
+        # (b) existing pods' terms vs the incoming pod (pattern counts;
+        # one MXU matmul). A node's contribution is the pattern's instance
+        # count over its topology bucket × the term weight.
         ex_score = ex_terms["valid"] & (
             (ex_terms["kind"] == AFF_REQ) | (ex_terms["kind"] == AFF_PREF) | (ex_terms["kind"] == ANTI_PREF)
         )
-        m_et = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_score[:, None]  # [ET, B]
-        owner_bucket, owner_has = _bucket_of_owner(nodes, ex_terms["topo_slot"], ex_terms["owner"])
-        bucket_ne, haskey_ne = _bucket_of(nodes, ex_terms["topo_slot"])
-        pair_match = owner_has & haskey_ne & (bucket_ne == owner_bucket)  # [ET, N]
-        weighted = m_et.astype(jnp.float32) * ex_terms["weight"][:, None].astype(jnp.float32)  # [ET, B]
-        counts = counts + jnp.matmul(weighted.T, pair_match.astype(jnp.float32)).astype(jnp.int64)
+        m_pt = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_score[:, None]  # [PT, B]
+        bucket_ne, haskey_ne = _bucket_of(nodes, ex_terms["topo_slot"])  # [PT, N]
+        hosted = jnp.where(haskey_ne, ex_terms["counts"].T.astype(jnp.int32), 0)  # [PT, N]
+        cnt_v = _seg_sum(hosted, bucket_ne, N)  # [PT, V]
+        at_node = jnp.where(haskey_ne, _gather_rows(cnt_v, bucket_ne), 0)  # [PT, N]
+        weighted = m_pt.astype(jnp.float32) * ex_terms["weight"][:, None].astype(jnp.float32)  # [PT, B]
+        # HIGHEST precision: at_node holds instance COUNTS (not 0/1) — the
+        # TPU default would truncate them to bf16 and misround above 256
+        counts = counts + jnp.matmul(
+            weighted.T,
+            at_node.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int64)
 
     valid = nodes["valid"][None, :] & pods["valid"][:, None]
     masked = jnp.where(valid, counts, 0)

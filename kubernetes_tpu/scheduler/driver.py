@@ -391,7 +391,6 @@ class Scheduler:
                         vocab, reps, spread_selectors=selectors,
                         capacity=self._t_bucket, b_capacity=batch.capacity,
                     )
-                etb = self.mirror.existing_terms()
                 break
             except KeySlotOverflow:
                 self.mirror._rebuild()
@@ -416,7 +415,7 @@ class Scheduler:
         for owner in tb.overflow_owners:
             if 0 <= owner < len(reps):
                 batch.fallback[owner] = True
-        existing_overflow = bool(etb.overflow_owners)
+        existing_overflow = bool(self.mirror.pats.overflow_rows)
         t1 = time.perf_counter()
         self.stats["encode_s"] += t1 - t0
 
@@ -426,8 +425,7 @@ class Scheduler:
         self._cycle += 1
         key = jax.random.PRNGKey(self._rng_seed + self._cycle)
         # device-RESIDENT banks patched by dirty rows (TensorMirror
-        # .device_arrays); existing-terms bank device copy memoized on the
-        # cached host object — per batch only the pod batch, the batch term
+        # .device_arrays) — per batch only the pod batch, the batch term
         # tables, and the dirty row slices cross the host→device wire
         # term kinds seen so far (jit statics): batches without a kind never
         # execute — or compile — that kind's kernels. MONOTONE union across
@@ -436,23 +434,18 @@ class Scheduler:
         # most 8 growth compiles and a superset program is still exact
         # (extra kernels compute their term-absent identities)
         self._term_kinds = getattr(self, "_term_kinds", frozenset()) | _present_term_kinds(
-            tb, etb, aux
+            tb, self.mirror.pats, aux
         )
         term_kinds = self._term_kinds
-        na_dev, ea_dev = self.mirror.device_arrays()
+        na_dev, ea_dev, xp_dev = self.mirror.device_arrays()
         t_patch = time.perf_counter()
         self.stats["patch_s"] = self.stats.get("patch_s", 0.0) + (t_patch - t1)
-        if etb is not getattr(self, "_etb_host", None):
-            import jax.numpy as jnp
-
-            self._etb_dev = {k: jnp.asarray(v) for k, v in etb.arrays().items()}
-            self._etb_host = etb
         args = (
             na_dev,
             batch.arrays(),
             ea_dev,
             tb.arrays(),
-            self._etb_dev,
+            xp_dev,
             aux,
             ids,
             key,

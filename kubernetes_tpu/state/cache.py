@@ -37,6 +37,7 @@ from .tensors import (
     _bucket,
     _node_bucket,
 )
+from .terms import PatternBank, PatternOverflow
 
 DEFAULT_ASSUME_TTL = 30.0  # cache.go durationToExpireAssumedPod (30s default)
 
@@ -255,10 +256,11 @@ class SchedulerCache:
 
 
 class TensorMirror:
-    """Keeps device-facing banks (NodeBank + SigBank) patched from a
-    SchedulerCache — the TPU replacement for UpdateNodeInfoSnapshot's
+    """Keeps device-facing banks (NodeBank + SigBank + PatternBank) patched
+    from a SchedulerCache — the TPU replacement for UpdateNodeInfoSnapshot's
     generation walk (cache.go:206-242). Node rows are allocated from a free
-    list; each node's pods are COUNTED into label signatures (SigBank), and
+    list; each node's pods are COUNTED into label signatures (SigBank) and
+    their (anti-)affinity terms into term patterns (PatternBank), and
     sync() re-counts ONLY the pods of dirty nodes — patch cost is
     proportional to the delta, not the cluster.
 
@@ -277,6 +279,9 @@ class TensorMirror:
         # rebuild + solve recompile that a cold 16-slot bank pays on every
         # realistic workload. counts[N, 256] int16 is ~5 MB at 10k nodes.
         self._min_sigs = 256
+        # distinct term patterns are even fewer (one per controller spec
+        # carrying affinity, not per replica)
+        self._min_pats = 32
         # device-resident copies of the banks, patched by dirty ROW SLICES:
         # on a remote-attached TPU, re-uploading whole banks every batch
         # costs seconds (10s of MB at ~15 MB/s tunnel bandwidth) — only the
@@ -284,6 +289,7 @@ class TensorMirror:
         # UpdateNodeInfoSnapshot generation walk, cache.go:206-242)
         self._dev_nodes = None
         self._dev_eps = None
+        self._dev_pats = None
         self._device_stale = True
         self._image_stale = False
         self._pending_node_rows: Set[int] = set()
@@ -319,8 +325,11 @@ class TensorMirror:
                 self.eps = SigBank(
                     self.vocab, _bucket(self._min_sigs), self.nodes.capacity
                 )
+                self.pats = PatternBank(
+                    self.vocab, _bucket(self._min_pats), self.nodes.capacity
+                )
                 self._node_sigs: Dict[str, Dict[int, int]] = {}
-                self._node_has_affinity: Dict[str, bool] = {}
+                self._node_pats: Dict[str, Dict[int, int]] = {}
                 for name, ni in snap.node_infos.items():
                     self._encode_node_pods(name, ni)
                 ImageTable(self.vocab).apply(self.nodes, snap, self.row_of)
@@ -332,14 +341,16 @@ class TensorMirror:
                 # 4x per growth: each distinct signature capacity is a full
                 # solve recompile — buy headroom, not tight fits
                 self._min_sigs *= 4
+            except PatternOverflow:
+                self._min_pats *= 4
             except KeySlotOverflow:
                 continue
         self.cache.dirty_nodes.clear()
         self.cache.removed_nodes.clear()
-        self._etb = None  # cached existing-terms bank (compile_existing_terms)
         self._device_stale = True  # shapes may have changed: full re-upload
         self._pending_node_rows.clear()
         self.eps.dirty_sig_rows.clear()
+        self.pats.dirty_pattern_rows.clear()
         self.generation = 0
 
     @staticmethod
@@ -347,26 +358,33 @@ class TensorMirror:
         return frozenset(ni.image_sizes().items())
 
     def _release_node_pods(self, name: str) -> None:
+        # a node can be added AND removed between syncs: it was never
+        # encoded, so there is no row and nothing held
+        row = self.row_of.get(name)
+        if row is None:
+            self._node_sigs.pop(name, None)
+            self._node_pats.pop(name, None)
+            return
         held = self._node_sigs.pop(name, None)
         if held:
             # callers must release BEFORE freeing the node row (sync() does):
             # release_node subtracts the held counts, restoring the row's
             # counts column to zero so a later node can reuse it cleanly
-            row = self.row_of[name]
             self.eps.release_node(row, held)
             self._pending_node_rows.add(row)
-        self._node_has_affinity.pop(name, None)
+        held_p = self._node_pats.pop(name, None)
+        if held_p:
+            self.pats.release_node(row, held_p)
+            self._pending_node_rows.add(row)
 
     def _encode_node_pods(self, name: str, ni: NodeInfo) -> None:
-        """Re-count one node's pods into label signatures. Raises
-        SigOverflow/KeySlotOverflow when a bank is full (caller rebuilds
-        bigger)."""
+        """Re-count one node's pods into label signatures and their terms
+        into patterns. Raises SigOverflow/PatternOverflow/KeySlotOverflow
+        when a bank is full (caller rebuilds bigger)."""
         node_row = self.row_of[name]
         self._node_sigs[name] = self.eps.encode_node(node_row, ni.pods)
-        self._node_has_affinity[name] = any(
-            p.affinity is not None
-            and (p.affinity.pod_affinity is not None or p.affinity.pod_anti_affinity is not None)
-            for p in ni.pods
+        self._node_pats[name] = self.pats.encode_node(
+            node_row, ni.pods_with_affinity()
         )
         self._pending_node_rows.add(node_row)
 
@@ -404,19 +422,14 @@ class TensorMirror:
                     self.row_of[name] = row
                     self.name_of_row[row] = name
                 images_changed = bool(removed) or bool(new_nodes)
-                affinity_changed = bool(removed)
                 for name in dirty | set(new_nodes):
                     ni = cache.snapshot.get(name)
                     if ni is None or name not in self.row_of:
                         continue
                     self.nodes.set_node(self.row_of[name], ni)
                     self._pending_node_rows.add(self.row_of[name])
-                    # pods: release this node's old signature counts, re-count
-                    had_affinity = self._node_has_affinity.get(name, False) or any(
-                        p.affinity is not None for p in ni.pods
-                    )
-                    if had_affinity:
-                        affinity_changed = True
+                    # pods: release this node's old signature + pattern
+                    # counts, re-count
                     self._release_node_pods(name)
                     self._encode_node_pods(name, ni)
                     sig = self._image_signature(ni)
@@ -429,8 +442,6 @@ class TensorMirror:
                     # states and node membership change far less than pods)
                     ImageTable(self.vocab).apply(self.nodes, cache.snapshot, self.row_of)
                     self._image_stale = True
-                if affinity_changed:
-                    self._etb = None
             except KeySlotOverflow:
                 self._rebuild()
                 return True
@@ -438,22 +449,25 @@ class TensorMirror:
             return False
 
     def device_arrays(self):
-        """(nodes, eps) as DEVICE-resident dicts, patched with only the rows
-        sync() touched since the last call. Full upload only after a rebuild
-        (shape change) — otherwise each changed array ships one [rows, ...]
-        slice + scatter."""
+        """(nodes, eps, pats) as DEVICE-resident dicts, patched with only
+        the rows sync() touched since the last call. Full upload only after
+        a rebuild (shape change) — otherwise each changed array ships one
+        [rows, ...] slice + scatter."""
         import jax.numpy as jnp
 
         host_n = self.nodes.arrays()
         host_e = self.eps.arrays()
+        host_p = self.pats.arrays()
         if self._dev_nodes is None or self._device_stale:
             self._dev_nodes = {k: jnp.asarray(v) for k, v in host_n.items()}
             self._dev_eps = {k: jnp.asarray(v) for k, v in host_e.items()}
+            self._dev_pats = {k: jnp.asarray(v) for k, v in host_p.items()}
             self._device_stale = False
             self._image_stale = False
             self._pending_node_rows.clear()
             self.eps.dirty_sig_rows.clear()
-            return self._dev_nodes, self._dev_eps
+            self.pats.dirty_pattern_rows.clear()
+            return self._dev_nodes, self._dev_eps, self._dev_pats
 
         import numpy as _np
 
@@ -493,48 +507,29 @@ class TensorMirror:
 
         nrows = sorted(self._pending_node_rows)
         srows = sorted(self.eps.dirty_sig_rows)
+        prows = sorted(self.pats.dirty_pattern_rows)
         skip_n = ("image_scaled",) if self._image_stale else ()
         self._dev_nodes = patch(self._dev_nodes, host_n, nrows, skip=skip_n)
         self._image_stale = False
-        # the eps dict has TWO row spaces: signature metadata ([S]-major,
-        # patched by dirty signature rows) and the per-node count matrix
-        # ([N, S] node-major, patched by dirty NODE rows)
-        meta_host = {k: v for k, v in host_e.items() if k != "counts"}
-        meta_dev = {k: v for k, v in self._dev_eps.items() if k != "counts"}
-        meta_dev = patch(meta_dev, meta_host, srows)
-        cnt_dev = patch(
-            {"counts": self._dev_eps["counts"]}, {"counts": host_e["counts"]}, nrows
-        )
-        self._dev_eps = {**meta_dev, **cnt_dev}
+
+        # the eps/pats dicts have TWO row spaces each: metadata ([S]/[PT]-
+        # major, patched by dirty signature/pattern rows) and the per-node
+        # count matrix ([N, *] node-major, patched by dirty NODE rows)
+        def patch_bank(dev, host, meta_rows):
+            meta_host = {k: v for k, v in host.items() if k != "counts"}
+            meta_dev = {k: v for k, v in dev.items() if k != "counts"}
+            meta_dev = patch(meta_dev, meta_host, meta_rows)
+            cnt_dev = patch(
+                {"counts": dev["counts"]}, {"counts": host["counts"]}, nrows
+            )
+            return {**meta_dev, **cnt_dev}
+
+        self._dev_eps = patch_bank(self._dev_eps, host_e, srows)
+        self._dev_pats = patch_bank(self._dev_pats, host_p, prows)
         self._pending_node_rows.clear()
         self.eps.dirty_sig_rows.clear()
-        return self._dev_nodes, self._dev_eps
-
-    def existing_terms(self):
-        """Cached compile_existing_terms over the current snapshot —
-        invalidated by sync() only when a dirty node's affinity-pod set could
-        have changed. Raises KeySlotOverflow like the compilers."""
-        if self._etb is None:
-            from .terms import compile_existing_terms
-
-            etb, _ = compile_existing_terms(
-                self.vocab, self.cache.snapshot, self.row_of
-            )
-            # monotonic capacity with 4x headroom once the bank starts
-            # GROWING: every distinct capacity is a full solve recompile
-            # (minutes on a remote chip), and affinity-heavy workloads add
-            # terms every batch — pay log4 growth recompiles, not log2
-            # (a shrinking table also reuses the largest bucket seen)
-            min_cap = getattr(self, "_etb_min", 16)
-            if etb.capacity > min_cap:
-                min_cap = max(etb.capacity * 4, min_cap)
-            if etb.capacity < min_cap:
-                etb, _ = compile_existing_terms(
-                    self.vocab, self.cache.snapshot, self.row_of, capacity=min_cap
-                )
-            self._etb_min = max(min_cap, etb.capacity)
-            self._etb = etb
-        return self._etb
+        self.pats.dirty_pattern_rows.clear()
+        return self._dev_nodes, self._dev_eps, self._dev_pats
 
     def node_name_of_row(self, row: int) -> Optional[str]:
         if 0 <= row < len(self.name_of_row):

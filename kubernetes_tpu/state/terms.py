@@ -139,12 +139,29 @@ class TermBank:
         weight: int = 0,
         self_match: bool = False,
     ) -> int:
-        v = self.vocab
         row = self.count
         if row >= self.capacity:
             self.overflow_owners.add(owner)
             return -1
         self.count += 1
+        self.set_row(row, kind, owner, topo_key, selector, namespaces, ns_any, weight, self_match)
+        return row
+
+    def set_row(
+        self,
+        row: int,
+        kind: int,
+        owner: int,
+        topo_key: str,
+        selector: Optional[LabelSelector],
+        namespaces: Sequence[str] = (),
+        ns_any: bool = False,
+        weight: int = 0,
+        self_match: bool = False,
+    ) -> None:
+        """Encode one term at an explicit row (PatternBank reuses this with
+        its own free-list row allocation)."""
+        v = self.vocab
         self.valid[row] = True
         self.kind[row] = kind
         self.owner[row] = owner
@@ -163,7 +180,24 @@ class TermBank:
             for j, ns in enumerate(nss[: self.ns_cap]):
                 self.ns_ids[row, j] = v.id(ns)
         self._compile_selector(row, selector)
-        return row
+
+    def clear_row(self, row: int) -> None:
+        """Reset a row to padding (every kernel gates on `valid`; the other
+        fields are reset so re-use starts from a clean slate)."""
+        self.valid[row] = False
+        self.kind[row] = 0
+        self.owner[row] = 0
+        self.weight[row] = 0
+        self.self_match[row] = False
+        self.topo_slot[row] = -1
+        self.ns_any[row] = False
+        self.ns_ids[row] = 0
+        self.has_selector[row] = False
+        self.ml_slot[row] = -1
+        self.ml_val[row] = 0
+        self.ex_op[row] = 0
+        self.ex_slot[row] = -1
+        self.ex_vals[row] = -1
 
     def arrays(self) -> Dict[str, np.ndarray]:
         return {
@@ -275,55 +309,165 @@ def compile_batch_terms(
     return bank, aux
 
 
-def compile_existing_terms(
-    vocab: Vocab,
-    snapshot: Snapshot,
-    row_of: Dict[str, int],
-    hard_pod_affinity_weight: int = 1,
-    capacity: Optional[int] = None,
-) -> Tuple[TermBank, Dict[int, int]]:
-    """Compile every existing pod's (anti-)affinity terms. Owner = the row of
-    the pod's NODE in the NodeBank (all the kernels need is the fixed node).
+class PatternOverflow(KeySlotOverflow):
+    """Pattern bank out of rows — rebuild at the next bucket size."""
 
-    Returns (bank, {}). Kind semantics on this bank:
-      ANTI_REQ — existing pod's required anti-affinity (Filter: blocks the
-                 incoming pod on same-topology nodes)
-      AFF_REQ  — existing pod's required affinity (Score: symmetric weight =
-                 hardPodAffinityWeight, interpod_affinity.go:131)
-      AFF_PREF/ANTI_PREF — existing pod's preferred terms (Score, ±weight)
+
+@dataclass
+class PatternBank:
+    """Existing pods' (anti-)affinity terms collapsed to distinct PATTERNS
+    with per-node instance counts — the term-side analogue of
+    state.tensors.SigBank.
+
+    The old encoding gave every (existing pod, term) pair its own TermBank
+    row (owner = hosting node), so affinity-heavy clusters grew the ET axis
+    with pod count: each growth bucket was a full solve recompile, every
+    batch that committed an affinity pod re-walked ALL pods with terms
+    (O(pods) host time) and re-uploaded the whole bank. But the kernels
+    only ever need (a) whether a term matches the incoming pod and (b) how
+    many instances of it live in each topology bucket — both functions of
+    the term's CONTENT, not its owner. Distinct term contents are few
+    (one per controller spec, not per replica), so rows become patterns
+    interned by (kind, topology key, namespaces, weight, selector), and
+    ownership becomes `counts[node, pattern]`, patched incrementally by
+    dirty node rows exactly like SigBank.counts.
+
+    Wire format (`arrays()`): the TermBank fields (valid/kind/topo_slot/
+    weight/ns_*/selector tables; `owner` is the row's own index and unused
+    by the pattern kernels) + `counts` [N, PT] int16.
     """
-    pods_with_terms = []
-    n_terms = 0
-    for ni in snapshot.node_infos.values():
-        for p in ni.pods_with_affinity():
-            aff = p.affinity
-            cnt = len(get_pod_affinity_terms(aff)) + len(get_pod_anti_affinity_terms(aff))
-            if aff.pod_affinity is not None:
-                cnt += len(aff.pod_affinity.preferred)
-            if aff.pod_anti_affinity is not None:
-                cnt += len(aff.pod_anti_affinity.preferred)
-            if cnt:
-                pods_with_terms.append((p, row_of[ni.node.name]))
-                n_terms += cnt
-    bank = TermBank(vocab, capacity or _bucket(max(n_terms, 1)))
-    for p, node_row in pods_with_terms:
-        aff = p.affinity
+
+    vocab: Vocab
+    capacity: int  # PT
+    node_capacity: int  # N rows of the counts matrix
+    hard_pod_affinity_weight: int = 1  # interpod_affinity.go:131
+
+    def __post_init__(self):
+        self.bank = TermBank(self.vocab, self.capacity)
+        self.counts = np.zeros((self.node_capacity, self.capacity), np.int16)
+        self._row_of: Dict[tuple, int] = {}
+        self._key_of_row: Dict[int, tuple] = {}
+        self._refs = np.zeros(self.capacity, np.int64)
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self.dirty_pattern_rows: set = set()
+        self.overflow_rows: set = set()
+
+    # numpy views used by the driver's term-kind gating
+    @property
+    def valid(self) -> np.ndarray:
+        return self.bank.valid
+
+    @property
+    def kind(self) -> np.ndarray:
+        return self.bank.kind
+
+    def _pod_patterns(self, pod: Pod) -> List[tuple]:
+        """One pod's term contents as intern keys' raw args — the same row
+        set the per-pod encoding used to produce."""
+        aff = pod.affinity
+        if aff is None:
+            return []
+        out = []
         for t in get_pod_anti_affinity_terms(aff):
-            bank.add(ANTI_REQ, node_row, t.topology_key, t.label_selector, _term_namespaces(p, t))
+            out.append((ANTI_REQ, t.topology_key, t.label_selector, _term_namespaces(pod, t), 0))
+        hw = self.hard_pod_affinity_weight
         for t in get_pod_affinity_terms(aff):
-            if hard_pod_affinity_weight > 0 and t.topology_key:
-                bank.add(
-                    AFF_REQ, node_row, t.topology_key, t.label_selector,
-                    _term_namespaces(p, t), weight=hard_pod_affinity_weight,
-                )
+            if hw > 0 and t.topology_key:
+                out.append((AFF_REQ, t.topology_key, t.label_selector, _term_namespaces(pod, t), hw))
         if aff.pod_affinity is not None:
             for w in aff.pod_affinity.preferred:
                 if w.weight and w.pod_affinity_term.topology_key:
                     t = w.pod_affinity_term
-                    bank.add(AFF_PREF, node_row, t.topology_key, t.label_selector, _term_namespaces(p, t), weight=w.weight)
+                    out.append((AFF_PREF, t.topology_key, t.label_selector, _term_namespaces(pod, t), w.weight))
         if aff.pod_anti_affinity is not None:
             for w in aff.pod_anti_affinity.preferred:
                 if w.weight and w.pod_affinity_term.topology_key:
                     t = w.pod_affinity_term
-                    bank.add(ANTI_PREF, node_row, t.topology_key, t.label_selector, _term_namespaces(p, t), weight=-w.weight)
-    return bank, {}
+                    out.append((ANTI_PREF, t.topology_key, t.label_selector, _term_namespaces(pod, t), -w.weight))
+        return out
+
+    def _intern(self, kind: int, topo_key: str, selector, namespaces, weight: int) -> int:
+        key = (kind, topo_key, tuple(sorted(namespaces)), weight, repr(selector))
+        row = self._row_of.get(key)
+        if row is None:
+            if not self._free:
+                raise PatternOverflow()
+            row = self._free.pop()
+            self.bank.clear_row(row)
+            self.bank.overflow_owners.discard(row)
+            self.bank.set_row(row, kind, row, topo_key, selector, namespaces, weight=weight)
+            if row in self.bank.overflow_owners:
+                # truncated selector: under/over-matches on device — the
+                # driver must route affected batches through the oracle
+                self.overflow_rows.add(row)
+            self._row_of[key] = row
+            self._key_of_row[row] = key
+            self.dirty_pattern_rows.add(row)
+        return row
+
+    def _unref(self, row: int, n: int) -> None:
+        self._refs[row] -= n
+        if self._refs[row] <= 0:
+            self._refs[row] = 0
+            self.bank.clear_row(row)
+            self.bank.overflow_owners.discard(row)
+            self.overflow_rows.discard(row)
+            key = self._key_of_row.pop(row, None)
+            if key is not None:
+                self._row_of.pop(key, None)
+            self._free.append(row)
+            self.dirty_pattern_rows.add(row)
+
+    def release_node(self, node_row: int, held: Dict[int, int]) -> None:
+        """Undo a node's contribution: `held` is its {pattern: count} map."""
+        for row, n in held.items():
+            self.counts[node_row, row] -= n
+            self._unref(row, n)
+
+    def encode_node(self, node_row: int, pods) -> Dict[int, int]:
+        """Count a node's pods' term instances into patterns → the
+        {pattern: count} map the caller keeps for the matching
+        release_node. Raises KeySlotOverflow/PatternOverflow for the
+        mirror's rebuild-bigger loop (partial refs rolled back first)."""
+        held: Dict[int, int] = {}
+        try:
+            for pod in pods:
+                for kind, topo, sel, nss, w in self._pod_patterns(pod):
+                    row = self._intern(kind, topo, sel, nss, w)
+                    held[row] = held.get(row, 0) + 1
+                    self._refs[row] += 1
+                    self.counts[node_row, row] += 1
+        except KeySlotOverflow:
+            self.release_node(node_row, held)
+            raise
+        return held
+
+    def arrays(self) -> Dict[str, np.ndarray]:
+        out = self.bank.arrays()
+        out["counts"] = self.counts
+        return out
+
+
+def compile_existing_patterns(
+    vocab: Vocab,
+    snapshot: Snapshot,
+    row_of: Dict[str, int],
+    node_capacity: int,
+    hard_pod_affinity_weight: int = 1,
+) -> PatternBank:
+    """One-shot snapshot → PatternBank (tests/tools; the scheduler maintains
+    its bank incrementally through TensorMirror)."""
+    min_pt = 32
+    while True:
+        try:
+            pats = PatternBank(
+                vocab, _bucket(min_pt), node_capacity,
+                hard_pod_affinity_weight=hard_pod_affinity_weight,
+            )
+            for name, ni in snapshot.node_infos.items():
+                pats.encode_node(row_of[name], ni.pods)
+            return pats
+        except PatternOverflow:
+            min_pt *= 2
+        except KeySlotOverflow:
+            continue  # vocab grew; re-encode

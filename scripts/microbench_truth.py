@@ -46,13 +46,10 @@ def chain(label, fn, seed_key, n=8):
     float(jnp.sum(out[0] if isinstance(out, tuple) else out).astype(jnp.float32))
     t0 = time.perf_counter()
     k = seed_key
-    acc = None
     for i in range(n):
         k = jax.random.fold_in(k, i)
         out = fn(k)
         x = out[0] if isinstance(out, tuple) else out
-        acc = x if acc is None else acc + x[: acc.shape[0]] if x.ndim == acc.ndim else acc
-        acc = x  # keep simple: just force each via dependency below
         _ = float(jnp.max(x).astype(jnp.float32))  # scalar fetch forces completion
     dt = (time.perf_counter() - t0) / n
     print(f"{label}: {dt*1000:.1f}ms/call (chained {n})", flush=True)

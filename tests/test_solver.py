@@ -137,7 +137,7 @@ def test_term_kind_gating_is_bit_identical():
     from kubernetes_tpu.ops.pipeline import encode_solve_args, solve_pipeline
     from kubernetes_tpu.scheduler.driver import _present_term_kinds
     from kubernetes_tpu.state.tensors import PodBatch, _bucket, encode_snapshot
-    from kubernetes_tpu.state.terms import compile_batch_terms, compile_existing_terms
+    from kubernetes_tpu.state.terms import compile_batch_terms, compile_existing_patterns
 
     for seed, feature_rate in ((5, 0.0), (6, 0.5)):
         g = ClusterGen(seed)
@@ -151,7 +151,7 @@ def test_term_kind_gating_is_bit_identical():
         for i, p in enumerate(pods):
             batch.set_pod(i, p)
         tb, aux = compile_batch_terms(bank.vocab, pods, b_capacity=batch.capacity)
-        etb, _ = compile_existing_terms(bank.vocab, snap, row_of)
+        etb = compile_existing_patterns(bank.vocab, snap, row_of, bank.capacity)
         kinds = _present_term_kinds(tb, etb, aux)
         a_all, s_all = solve_pipeline(*args, deterministic=True)
         a_gated, s_gated = solve_pipeline(*args, deterministic=True, term_kinds=kinds)

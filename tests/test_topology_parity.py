@@ -14,7 +14,7 @@ from kubernetes_tpu.oracle import Snapshot
 from kubernetes_tpu.oracle import predicates as opred
 from kubernetes_tpu.oracle import priorities as opri
 from kubernetes_tpu.state.tensors import PodBatch, _bucket, encode_snapshot
-from kubernetes_tpu.state.terms import compile_batch_terms, compile_existing_terms
+from kubernetes_tpu.state.terms import compile_batch_terms, compile_existing_patterns
 
 
 def _setup(seed, n_nodes=20, n_existing=80, n_pending=12, feature_rate=0.6, selectors=None):
@@ -28,7 +28,7 @@ def _setup(seed, n_nodes=20, n_existing=80, n_pending=12, feature_rate=0.6, sele
     for i, p in enumerate(pods):
         batch.set_pod(i, p)
     tb, aux = compile_batch_terms(vocab, pods, spread_selectors=selectors)
-    etb, _ = compile_existing_terms(vocab, snap, row_of)
+    etb = compile_existing_patterns(vocab, snap, row_of, bank.capacity)
     na = {k: jnp.asarray(v) for k, v in bank.arrays().items()}
     pa = {k: jnp.asarray(v) for k, v in batch.arrays().items()}
     ea = {k: jnp.asarray(v) for k, v in epsb.arrays().items()}
