@@ -86,14 +86,24 @@ class Quantity:
         return Quantity(base * mult)
 
     def value(self) -> int:
-        """Integer units, rounded up (Quantity.Value semantics)."""
-        v = self.value_exact
-        return -((-v.numerator) // v.denominator)  # ceil for positives, matches Go rounding up
+        """Integer units, rounded up (Quantity.Value semantics). Memoized:
+        the Fraction ceil sits on the oracle/encode hot paths and the
+        dataclass is frozen, so the result can never change."""
+        v = getattr(self, "_value_int", None)
+        if v is None:
+            ve = self.value_exact
+            v = -((-ve.numerator) // ve.denominator)  # ceil, matches Go rounding up
+            object.__setattr__(self, "_value_int", v)
+        return v
 
     def milli_value(self) -> int:
         """1/1000 units, rounded up (Quantity.MilliValue semantics)."""
-        v = self.value_exact * 1000
-        return -((-v.numerator) // v.denominator)
+        v = getattr(self, "_milli_int", None)
+        if v is None:
+            ve = self.value_exact * 1000
+            v = -((-ve.numerator) // ve.denominator)
+            object.__setattr__(self, "_milli_int", v)
+        return v
 
     def is_zero(self) -> bool:
         return self.value_exact == 0

@@ -250,7 +250,15 @@ class Pod:
         """predicates.GetResourceRequest semantics (predicates.go:~800-845):
         max(sum over containers, max over init containers) + overhead.
         cpu is millicores, memory/ephemeral-storage bytes, scalar resources
-        in their own units (milli for hugepages-safety we use value())."""
+        in their own units (milli for hugepages-safety we use value()).
+
+        Memoized after first call (the oracle evaluates it once per
+        candidate NODE): callers must treat the returned dict as
+        read-only, and the pod spec must not change after scheduling
+        first sees it (updates arrive as new Pod objects)."""
+        cached = getattr(self, "_req_cache", None)
+        if cached is not None:
+            return cached
         total: Dict[str, int] = {}
         for c in self.containers:
             for name, q in c.requests.items():
@@ -262,6 +270,7 @@ class Pod:
                     total[name] = v
         for name, q in self.overhead.items():
             total[name] = total.get(name, 0) + _request_value(name, q)
+        self._req_cache = total
         return total
 
     def host_ports(self) -> List[Tuple[str, str, int]]:

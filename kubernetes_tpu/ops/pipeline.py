@@ -63,9 +63,15 @@ def mask_and_score(
     ids: Arrays,
     config: Optional[SolveConfig] = None,
     term_kinds: Optional[frozenset] = None,
+    n_buckets: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """The fused Filter+Score stage shared by every solve entry point
     (plain, gang, sharded) — one definition so they can never diverge.
+
+    `n_buckets` (jit static) bounds the per-topology-value segment axis:
+    the distinct dense values per label key are few (zones, hostnames seen
+    in terms...), so aggregating into a [*, n_buckets] table instead of
+    [*, N] keeps the scatter outputs tiny. None = N (always safe).
 
     `term_kinds` (jit static) names the term kinds PRESENT this batch —
     {"spread_hard","spread_soft","aff_req","anti_req","pref","sel_spread",
@@ -83,7 +89,7 @@ def mask_and_score(
     mask = F.combined_mask(na, pa, ids, predicates=preds)
     sel = F.pod_match_node_selector(na, pa)
     if (preds is None or "EvenPodsSpread" in preds) and have("spread_hard"):
-        mask = mask & T.spread_filter(na, ea, ta, sel)
+        mask = mask & T.spread_filter(na, ea, ta, sel, n_buckets=n_buckets)
     if preds is None or "MatchInterPodAffinity" in preds:
         parts = tuple(
             p for p, kinds in (
@@ -93,7 +99,9 @@ def mask_and_score(
             ) if have(*kinds)
         )
         if parts:
-            mask = mask & T.interpod_filter(na, ea, ta, au, xa, pa, parts=parts)
+            mask = mask & T.interpod_filter(
+                na, ea, ta, au, xa, pa, parts=parts, n_buckets=n_buckets
+            )
     score = S.score_matrix(na, pa, priorities=cfg.priorities, rtcr=cfg.rtcr)
     w = cfg.priority_weight("InterPodAffinityPriority", 1)
     if w:
@@ -102,13 +110,15 @@ def mask_and_score(
             if have(*kinds)
         )
         if parts:
-            score = score + w * T.interpod_score(na, ea, ta, xa, pa, parts=parts)
+            score = score + w * T.interpod_score(
+                na, ea, ta, xa, pa, parts=parts, n_buckets=n_buckets
+            )
     w = cfg.priority_weight("EvenPodsSpreadPriority", 1)
     if w and have("spread_soft"):
-        score = score + w * T.spread_score(na, ea, ta, au, sel)
+        score = score + w * T.spread_score(na, ea, ta, au, sel, n_buckets=n_buckets)
     w = cfg.priority_weight("SelectorSpreadPriority", 1)
     if w and have("sel_spread"):
-        score = score + w * T.selector_spread_score(na, ea, ta, au)
+        score = score + w * T.selector_spread_score(na, ea, ta, au, n_buckets=n_buckets)
     elif w:
         # term-absent identity is NOT zero here: a pod with no controller
         # selectors scores MaxNodeScore on every node (the map counts 0,
@@ -117,7 +127,7 @@ def mask_and_score(
     return mask, score
 
 
-@partial(jax.jit, static_argnames=("config", "term_kinds"))
+@partial(jax.jit, static_argnames=("config", "term_kinds", "n_buckets"))
 def filter_mask(
     na: Arrays,
     pa: Arrays,
@@ -128,11 +138,12 @@ def filter_mask(
     ids: Arrays,
     config: Optional[SolveConfig] = None,
     term_kinds: Optional[frozenset] = None,
+    n_buckets: Optional[int] = None,
 ) -> jnp.ndarray:
     """Filter-only entry point (the extender /filter path): shares
     mask_and_score so the gating can never diverge; XLA dead-code-eliminates
     the unused score computation."""
-    mask, _ = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
+    mask, _ = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds, n_buckets)
     return mask
 
 
@@ -148,7 +159,7 @@ def _pod_axis(pa: Arrays, pb: Optional[Arrays]):
     return sig, pb["valid"], pb["priority"], sig.shape[0]
 
 
-@partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds"))
+@partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds", "n_buckets"))
 def solve_pipeline(
     na: Arrays,  # NodeBank arrays
     pa: Arrays,  # PodBatch arrays (one row per unique pod spec)
@@ -162,9 +173,10 @@ def solve_pipeline(
     deterministic: bool = False,
     config: Optional[SolveConfig] = None,
     term_kinds: Optional[frozenset] = None,
+    n_buckets: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """mask → score → greedy solve. Returns (assign [B], score [U, N])."""
-    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
+    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds, n_buckets)
     free0 = na["alloc"] - na["requested"]
     sig, pvalid, prio, b = _pod_axis(pa, pb)
     order = pop_order(prio, jnp.arange(b, dtype=jnp.int32), pvalid)
@@ -185,7 +197,7 @@ def solve_pipeline(
     return assign, score
 
 
-@partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds"))
+@partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds", "n_buckets"))
 def solve_pipeline_gang(
     na: Arrays,
     pa: Arrays,
@@ -200,12 +212,13 @@ def solve_pipeline_gang(
     deterministic: bool = False,
     config: Optional[SolveConfig] = None,
     term_kinds: Optional[frozenset] = None,
+    n_buckets: Optional[int] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Gang variant: same fused mask/score, then the all-or-nothing
     two-pass solve (ops/solver.solve_gang). Returns (assign, score,
     gang_ok) — members of dropped groups come back assign=-1, gang_ok
     False, and their capacity is released to other pods in pass 2."""
-    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
+    mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds, n_buckets)
     free0 = na["alloc"] - na["requested"]
     sig, pvalid, prio, b = _pod_axis(pa, pb)
     order = pop_order(prio, jnp.arange(b, dtype=jnp.int32), pvalid)

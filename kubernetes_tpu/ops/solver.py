@@ -45,11 +45,12 @@ def pop_order(priority: jnp.ndarray, enqueue_seq: jnp.ndarray, valid: jnp.ndarra
 
 def tie_noise(rng_key, b: int, n: int) -> jnp.ndarray:
     """selectHost tie-break noise for a whole batch in ONE vectorized RNG
-    call — bit-identical to the former per-step `uniform(split(key, B)[i],
-    (N,))` stream (and to parallel/sharded.py's), but ~B× cheaper than
-    running threefry once per scan step."""
+    call (shared by the single-chip and sharded solvers so their streams
+    are identical). Explicit float32: under x64 mode uniform() would
+    default to float64, which the TPU emulates — the f64 threefry for a
+    [1024, 10k] noise block alone costs ~200ms/batch."""
     keys = jax.random.split(rng_key, b)
-    return jax.vmap(lambda k: jax.random.uniform(k, (n,)))(keys)
+    return jax.vmap(lambda k: jax.random.uniform(k, (n,), dtype=jnp.float32))(keys)
 
 
 @partial(jax.jit, static_argnames=("deterministic", "chunk"))

@@ -101,7 +101,22 @@ def _bucket_of(nodes: Arrays, slot: jnp.ndarray, idx: jnp.ndarray = None):
 
 
 def _seg_sum(values: jnp.ndarray, buckets: jnp.ndarray, num: int) -> jnp.ndarray:
-    """vmapped segment_sum over the leading term axis."""
+    """vmapped segment_sum over the leading term axis.
+
+    For a SMALL static segment count (the n_buckets bound: zone-keyed
+    topologies have ~8 distinct values) a scatter would serialize on the
+    massive index collisions — a one-hot batched matmul keeps it on the
+    MXU instead. Exact: the summed counts stay far below 2^24. The one-hot
+    operand materializes [TT, X, V] f32 (XLA cannot fuse it away), so the
+    path is also gated on that transient staying under ~256 MB."""
+    if num <= 64 and values.shape[0] * values.shape[1] * num * 4 <= (1 << 28):
+        onehot = jax.nn.one_hot(buckets, num, dtype=jnp.float32)  # [TT, X, V]
+        return jnp.einsum(
+            "tx,txv->tv",
+            values.astype(jnp.float32),
+            onehot,
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(values.dtype if values.dtype != jnp.bool_ else jnp.int32)
     return jax.vmap(lambda v, s: jax.ops.segment_sum(v, s, num_segments=num))(values, buckets)
 
 
@@ -128,14 +143,22 @@ def _gather_rows(table: jnp.ndarray, buckets: jnp.ndarray) -> jnp.ndarray:
 def _merge_same_key(terms: Arrays, mask: jnp.ndarray, table: jnp.ndarray) -> jnp.ndarray:
     """Sum rows of `table` over terms sharing (owner, topo_slot) — replicates
     the reference's per-(key,value) pair maps being shared across constraints
-    with the same topology key (metadata.go tpPairToMatchNum)."""
+    with the same topology key (metadata.go tpPairToMatchNum).
+
+    Computed as an f32 HIGHEST matmul, not an integer one: XLA lowers
+    integer matmuls to scalar loops (~100x slower than the MXU), and the
+    summed match counts stay far below 2^24 so f32 accumulation is exact."""
     same = (
         mask[:, None]
         & mask[None, :]
         & (terms["owner"][:, None] == terms["owner"][None, :])
         & (terms["topo_slot"][:, None] == terms["topo_slot"][None, :])
     )
-    return jnp.matmul(same.astype(table.dtype), table)
+    return jnp.matmul(
+        same.astype(jnp.float32),
+        table.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(table.dtype)
 
 
 def _scatter_and(ok_t: jnp.ndarray, owner: jnp.ndarray, mask_t: jnp.ndarray, B: int) -> jnp.ndarray:
@@ -165,13 +188,15 @@ def _scatter_add(val_t: jnp.ndarray, owner: jnp.ndarray, mask_t: jnp.ndarray, B:
 # ---------------------------------------------------------------------------
 
 def spread_filter(
-    nodes: Arrays, eps: Arrays, terms: Arrays, selector_mask: jnp.ndarray
+    nodes: Arrays, eps: Arrays, terms: Arrays, selector_mask: jnp.ndarray,
+    n_buckets: int = None,
 ) -> jnp.ndarray:
     """EvenPodsSpreadPredicate (predicates.go:1778) with metadata computed on
     device (metadata.go:399 getEvenPodsSpreadMetadata). selector_mask is the
     PodMatchNodeSelector matrix [B, N] (candidate nodes must pass the
     incoming pod's node selector/affinity)."""
     B, N = selector_mask.shape
+    V = n_buckets or N  # distinct topology values bound (jit static)
     hard = terms["valid"] & (terms["kind"] == SPREAD_HARD)
     owner = terms["owner"]
 
@@ -186,8 +211,8 @@ def spread_filter(
     m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & hard[:, None]
     cnt_node = _sig_cnt_node(m_sig, eps["counts"])  # [TT, N]
     cand_t = cand[owner]  # [TT, N]
-    pair_cnt = _seg_sum(jnp.where(cand_t, cnt_node, 0), bucket_n, N)  # [TT, V]
-    pair_present = _seg_sum((cand_t & haskey_n).astype(jnp.int32), bucket_n, N) > 0
+    pair_cnt = _seg_sum(jnp.where(cand_t, cnt_node, 0), bucket_n, V)  # [TT, V]
+    pair_present = _seg_sum((cand_t & haskey_n).astype(jnp.int32), bucket_n, V) > 0
 
     merged_cnt = _merge_same_key(terms, hard, pair_cnt)
     merged_present = _merge_same_key(terms, hard, pair_present.astype(jnp.int32)) > 0
@@ -208,13 +233,15 @@ def spread_filter(
 
 
 def spread_score(
-    nodes: Arrays, eps: Arrays, terms: Arrays, aux: Arrays, selector_mask: jnp.ndarray
+    nodes: Arrays, eps: Arrays, terms: Arrays, aux: Arrays, selector_mask: jnp.ndarray,
+    n_buckets: int = None,
 ) -> jnp.ndarray:
     """CalculateEvenPodsSpreadPriority (even_pods_spread.go:85): member nodes
     carry all soft topo keys; counts accumulate over nodes ALSO passing the
     pod's node selector; score = 10*(total-count)/(total-min); counts span
     all namespaces (reference quirk)."""
     B, N = selector_mask.shape
+    V = n_buckets or N
     soft = terms["valid"] & (terms["kind"] == SPREAD_SOFT)
     owner = terms["owner"]
     has_soft = jnp.zeros(B + 1, bool).at[jnp.where(soft, owner, B)].max(soft)[:B]
@@ -227,8 +254,8 @@ def spread_score(
     cnt_node = _sig_cnt_node(m_sig, eps["counts"])
     counting_t = counting[owner]
     member_t = member[owner]
-    pair_cnt = _seg_sum(jnp.where(counting_t, cnt_node, 0), bucket_n, N)
-    pair_present = _seg_sum((member_t & haskey_n).astype(jnp.int32), bucket_n, N) > 0
+    pair_cnt = _seg_sum(jnp.where(counting_t, cnt_node, 0), bucket_n, V)
+    pair_present = _seg_sum((member_t & haskey_n).astype(jnp.int32), bucket_n, V) > 0
 
     merged_cnt = _merge_same_key(terms, soft, pair_cnt)
     merged_present = _merge_same_key(terms, soft, pair_present.astype(jnp.int32)) > 0
@@ -267,6 +294,7 @@ def interpod_filter(
     ex_terms: Arrays,
     pods: Arrays,
     parts: tuple = ("existing", "aff", "anti"),
+    n_buckets: int = None,
 ) -> jnp.ndarray:
     """InterPodAffinityMatches (predicates.go:1269), metadata path:
       1. existing pods' required anti-affinity blocks same-topology nodes
@@ -279,6 +307,7 @@ def interpod_filter(
     term-absent identity, so dropping == computing on empty terms)."""
     B = pods["valid"].shape[0]
     N = nodes["valid"].shape[0]
+    V = n_buckets or N
     result = jnp.ones((B, N), bool)
 
     if "existing" in parts:
@@ -290,7 +319,7 @@ def interpod_filter(
         # buckets hosting ≥1 instance of the pattern (hosting node must
         # carry the topology key, like the old owner_has)
         hosted = jnp.where(haskey_n, ex_terms["counts"].T.astype(jnp.int32), 0)  # [PT, N]
-        present = _seg_sum(hosted, bucket_n, N) > 0  # [PT, V]
+        present = _seg_sum(hosted, bucket_n, V) > 0  # [PT, V]
         block_t = haskey_n & _gather_rows(present, bucket_n)  # [PT, N]
         fail_existing = (
             jnp.matmul(
@@ -323,7 +352,7 @@ def interpod_filter(
         # nodes hosting ≥1 existing pod matching ALL owner terms, per bucket
         cnt_aff_node = _sig_cnt_node(matchall_sig, eps["counts"])  # [B, N]
         contrib_aff_n = jnp.where(haskey_n2 & aff[:, None], cnt_aff_node[owner], 0)  # [TT, N]
-        agg_aff = _seg_sum(contrib_aff_n, bucket_n2, N) > 0  # [TT, V]
+        agg_aff = _seg_sum(contrib_aff_n, bucket_n2, V) > 0  # [TT, V]
         ok_aff_t = haskey_n2 & _gather_rows(agg_aff, bucket_n2)
         aff_ok = _scatter_and(ok_aff_t, owner, aff, B)
         any_pair = jnp.zeros(B + 1, bool).at[jnp.where(aff, owner, B)].max(jnp.any(agg_aff, axis=1) & aff)[:B]
@@ -332,7 +361,7 @@ def interpod_filter(
 
     if "anti" in parts:
         cnt_anti_node = _sig_cnt_node(m_sig & anti[:, None], eps["counts"])  # [TT, N]
-        agg_anti = _seg_sum(jnp.where(haskey_n2, cnt_anti_node, 0), bucket_n2, N) > 0
+        agg_anti = _seg_sum(jnp.where(haskey_n2, cnt_anti_node, 0), bucket_n2, V) > 0
         bad_anti_t = haskey_n2 & _gather_rows(agg_anti, bucket_n2)
         result = result & ~_scatter_or(bad_anti_t, owner, anti, B)
 
@@ -346,6 +375,7 @@ def interpod_score(
     ex_terms: Arrays,
     pods: Arrays,
     parts: tuple = ("pref", "existing"),
+    n_buckets: int = None,
 ) -> jnp.ndarray:
     """CalculateInterPodAffinityPriority (interpod_affinity.go:99): weighted
     same-topology counts from (a) the incoming pod's preferred terms matched
@@ -355,6 +385,7 @@ def interpod_score(
     provably absent (its contribution would be identically zero)."""
     B = pods["valid"].shape[0]
     N = nodes["valid"].shape[0]
+    V = n_buckets or N
     counts = jnp.zeros((B, N), jnp.int64)
 
     if "pref" in parts:
@@ -364,7 +395,7 @@ def interpod_score(
         m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"]) & eps["valid"][None, :] & pref[:, None]
         bucket_n, haskey_n = _bucket_of(nodes, terms["topo_slot"])
         cnt_node = _sig_cnt_node(m_sig, eps["counts"])  # [TT, N]
-        cnt = _seg_sum(jnp.where(haskey_n, cnt_node, 0), bucket_n, N)  # [TT, V]
+        cnt = _seg_sum(jnp.where(haskey_n, cnt_node, 0), bucket_n, V)  # [TT, V]
         contrib_t = jnp.where(haskey_n, _gather_rows(cnt, bucket_n), 0) * terms["weight"][:, None]
         counts = counts + _scatter_add(contrib_t.astype(jnp.int64), owner, pref, B)  # [B, N]
 
@@ -378,7 +409,7 @@ def interpod_score(
         m_pt = match_terms(ex_terms, pods["label_vals"], pods["ns_id"]) & ex_score[:, None]  # [PT, B]
         bucket_ne, haskey_ne = _bucket_of(nodes, ex_terms["topo_slot"])  # [PT, N]
         hosted = jnp.where(haskey_ne, ex_terms["counts"].T.astype(jnp.int32), 0)  # [PT, N]
-        cnt_v = _seg_sum(hosted, bucket_ne, N)  # [PT, V]
+        cnt_v = _seg_sum(hosted, bucket_ne, V)  # [PT, V]
         at_node = jnp.where(haskey_ne, _gather_rows(cnt_v, bucket_ne), 0)  # [PT, N]
         weighted = m_pt.astype(jnp.float32) * ex_terms["weight"][:, None].astype(jnp.float32)  # [PT, B]
         # HIGHEST precision: at_node holds instance COUNTS (not 0/1) — the
@@ -408,13 +439,15 @@ def interpod_score(
 # ---------------------------------------------------------------------------
 
 def selector_spread_score(
-    nodes: Arrays, eps: Arrays, terms: Arrays, aux: Arrays
+    nodes: Arrays, eps: Arrays, terms: Arrays, aux: Arrays,
+    n_buckets: int = None,
 ) -> jnp.ndarray:
     """CalculateSpreadPriorityMap/Reduce (selector_spreading.go): count
     same-namespace non-deleting pods matching ALL controller selectors;
     blend 1/3 node-level + 2/3 zone-level, fewer is better."""
     B = aux["n_sel_spread"].shape[0]
     N = nodes["valid"].shape[0]
+    V = n_buckets or N
     ss = terms["valid"] & (terms["kind"] == SEL_SPREAD)
     owner = terms["owner"]
     m_sig = match_terms(terms, eps["label_vals"], eps["ns_id"])  # ns compiled = pod ns
@@ -433,9 +466,17 @@ def selector_spread_score(
     max_node = jnp.max(counts, axis=1)  # [B]
     zone_ok = (nodes["zone_dense"] >= 0) & nodes["valid"]
     zbucket = jnp.clip(nodes["zone_dense"], 0)
-    zcounts = jax.vmap(
-        lambda c: jax.ops.segment_sum(jnp.where(zone_ok, c, 0), zbucket, num_segments=N)
-    )(counts)  # [B, Z]
+    zc_in = jnp.where(zone_ok, counts, 0)
+    if V <= 64:
+        # shared zone buckets → one [B, N] x [N, V] MXU matmul (exact f32)
+        zoh = jax.nn.one_hot(zbucket, V, dtype=jnp.float32)
+        zcounts = jnp.matmul(
+            zc_in.astype(jnp.float32), zoh, precision=jax.lax.Precision.HIGHEST
+        ).astype(counts.dtype)
+    else:
+        zcounts = jax.vmap(
+            lambda c: jax.ops.segment_sum(c, zbucket, num_segments=V)
+        )(zc_in)  # [B, Z]
     max_zone = jnp.max(zcounts, axis=1)
     have_zones = jnp.any(zone_ok)
 

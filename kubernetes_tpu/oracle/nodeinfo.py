@@ -47,10 +47,71 @@ def pod_has_affinity_constraints(pod: Pod) -> bool:
 
 @dataclass
 class NodeInfo:
-    """Per-node scheduling aggregate (reference: nodeinfo/node_info.go:48)."""
+    """Per-node scheduling aggregate (reference: nodeinfo/node_info.go:48).
+
+    Resource/port aggregates are maintained INCREMENTALLY like the
+    reference's calculateResource add/remove path — O(1) per pod change
+    instead of O(pods-on-node) per query (the query sits on the mirror
+    sync and oracle hot paths). Mutate `pods` ONLY through
+    add_pod/remove_pod/remove_pod_key/set_pods; writing the list directly
+    desyncs the running sums."""
 
     node: Node
     pods: List[Pod] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._recount()
+
+    def _recount(self) -> None:
+        self._req: Dict[str, int] = {}
+        self._nz_cpu = 0
+        self._nz_mem = 0
+        self._ports: Dict[Tuple[str, str, int], int] = {}
+        for p in self.pods:
+            self._account(p, 1)
+
+    def _account(self, pod: Pod, sign: int) -> None:
+        req = self._req
+        for name, v in accumulated_request(pod).items():
+            nv = req.get(name, 0) + sign * v
+            if nv:
+                req[name] = nv
+            else:
+                req.pop(name, None)
+        c, m = pod_non_zero_request(pod)
+        self._nz_cpu += sign * c
+        self._nz_mem += sign * m
+        ports = self._ports
+        for t in pod.host_ports():
+            nv = ports.get(t, 0) + sign
+            if nv:
+                ports[t] = nv
+            else:
+                ports.pop(t, None)
+
+    # -- mutations (keep the running aggregates in sync) ---------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        self.pods.append(pod)
+        self._account(pod, 1)
+
+    def remove_pod(self, pod: Pod) -> None:
+        """Remove by object identity (simulation paths)."""
+        self.pods.remove(pod)
+        self._account(pod, -1)
+
+    def remove_pod_key(self, key: str) -> None:
+        for p in self.pods:
+            if p.key() == key:
+                self.pods.remove(p)
+                self._account(p, -1)
+                return
+
+    def set_pods(self, pods: List[Pod]) -> None:
+        self.pods = list(pods)
+        self._recount()
+
+    # -- aggregates ----------------------------------------------------------
 
     def pods_with_affinity(self) -> List[Pod]:
         return [p for p in self.pods if pod_has_affinity_constraints(p)]
@@ -59,23 +120,13 @@ class NodeInfo:
         """RequestedResource per calculateResource (node_info.go): sum of
         container requests + overhead — NOTE: unlike the incoming pod's
         GetResourceRequest, init-container maxima are NOT included."""
-        total: Dict[str, int] = {}
-        for p in self.pods:
-            for name, v in accumulated_request(p).items():
-                total[name] = total.get(name, 0) + v
-        return total
+        return dict(self._req)
 
     def non_zero_requested(self) -> Tuple[int, int]:
         """nonzeroRequest (milliCPU, memoryBytes): per container,
         max(request, default 100m / 200Mi) — priorityutil.GetNonzeroRequests;
         plus overhead when present (calculateResource, node_info.go)."""
-        cpu = 0
-        mem = 0
-        for p in self.pods:
-            c, m = pod_non_zero_request(p)
-            cpu += c
-            mem += m
-        return cpu, mem
+        return self._nz_cpu, self._nz_mem
 
     def allowed_pod_number(self) -> int:
         q = self.node.allocatable.get(RESOURCE_PODS)
@@ -83,10 +134,7 @@ class NodeInfo:
 
     def used_host_ports(self) -> Set[Tuple[str, str, int]]:
         """(protocol, hostIP, hostPort) triples across pods (HostPortInfo)."""
-        used: Set[Tuple[str, str, int]] = set()
-        for p in self.pods:
-            used.update(p.host_ports())  # host_ports() already defaults proto/ip
-        return used
+        return set(self._ports)
 
     def host_port_conflict(self, pod: Pod) -> bool:
         """HostPortInfo.CheckConflict semantics (nodeinfo/host_ports.go):
@@ -182,7 +230,7 @@ class Snapshot:
             # pods on unknown nodes are tracked nowhere in the snapshot
             # (reference keeps a headless NodeInfo; scheduling never sees it)
             return
-        ni.pods.append(pod)
+        ni.add_pod(pod)
 
     def get(self, name: str) -> Optional[NodeInfo]:
         return self.node_infos.get(name)

@@ -197,6 +197,61 @@ def _present_term_kinds(tb, etb, aux) -> frozenset:
     return frozenset(kinds)
 
 
+class _BatchConflictIndex:
+    """Commits of the current batch indexed by (topology key, value) for the
+    LIGHT intra-batch anti-affinity re-check. Two directions
+    (predicates.go:1284 satisfiesExistingPodsAntiAffinity +
+    satisfiesPodsAffinityAntiAffinity, anti half):
+
+      * a committed pod's required anti term blocks later pods on nodes
+        sharing the term's topology value with the commit node;
+      * a later pod's own anti terms block it on nodes sharing a topology
+        value with any commit whose pod the term selects.
+
+    Rolled-back gang members are tombstoned rather than unindexed (rollback
+    is rare; lookups skip them)."""
+
+    def __init__(self):
+        # (key, value of commit node) → [(committed pod, its anti term)]
+        self._anti_by_kv: Dict[Tuple[str, str], List[Tuple[Pod, object]]] = {}
+        # (key, value of commit node) → [committed pods]
+        self._commits_by_kv: Dict[Tuple[str, str], List[Pod]] = {}
+        self._rolled_back: set = set()
+        self.any_anti = False
+
+    def add_commit(self, pod: Pod, node) -> None:
+        for kv in node.labels.items():
+            self._commits_by_kv.setdefault(kv, []).append(pod)
+
+    def add_anti(self, pod: Pod, node) -> None:
+        self.any_anti = True
+        for term in get_pod_anti_affinity_terms(pod.affinity):
+            k = term.topology_key
+            v = node.labels.get(k) if k else None
+            if v is not None:
+                self._anti_by_kv.setdefault((k, v), []).append((pod, term))
+
+    def remove(self, pod: Pod) -> None:
+        self._rolled_back.add(id(pod))
+
+    def anti_conflict(self, pod: Pod, node) -> bool:
+        for kv in node.labels.items():
+            for c, term in self._anti_by_kv.get(kv, ()):
+                if id(c) not in self._rolled_back and pod_matches_term(pod, c, term):
+                    return True
+        a = pod.affinity
+        if a is not None and a.pod_anti_affinity is not None:
+            for term in a.pod_anti_affinity.required:
+                k = term.topology_key
+                v = node.labels.get(k) if k else None
+                if v is None:
+                    continue
+                for c in self._commits_by_kv.get((k, v), ()):
+                    if id(c) not in self._rolled_back and pod_matches_term(c, pod, term):
+                        return True
+        return False
+
+
 def _spec_key(pod: Pod, selectors) -> str:
     """Canonical key of everything that shapes a pod's device mask/score
     row and compiled terms (PodBatch.set_pod + terms.compile_batch_terms
@@ -437,6 +492,23 @@ class Scheduler:
             tb, self.mirror.pats, aux
         )
         term_kinds = self._term_kinds
+        # topology segment-axis bound (jit static): only the slots named by
+        # CURRENT terms matter — zone-keyed terms need ~#zones buckets while
+        # a [*, N] table wastes 1000x at 10k nodes (hostname-keyed terms
+        # genuinely need ~N and get it). MONOTONE bucket to avoid recompiles.
+        pats = self.mirror.pats
+        term_slots = set(np.asarray(tb.topo_slot[tb.valid], np.int64).tolist()) | set(
+            np.asarray(pats.bank.topo_slot[pats.valid], np.int64).tolist()
+        )
+        needed = [vocab.dense_size(int(sl)) for sl in term_slots if sl >= 0]
+        needed.append(vocab.zone_count())  # selector-spread zone blending
+        # NOT clamped to node capacity: dense ids are grow-only, so under
+        # node churn live dense indices can exceed the live node count —
+        # clamping would silently drop those nodes from the segment sums
+        self._v_bucket = max(
+            getattr(self, "_v_bucket", 16), _bucket(max(needed + [1]))
+        )
+        n_buckets = self._v_bucket
         na_dev, ea_dev, xp_dev = self.mirror.device_arrays()
         t_patch = time.perf_counter()
         self.stats["patch_s"] = self.stats.get("patch_s", 0.0) + (t_patch - t1)
@@ -465,6 +537,7 @@ class Scheduler:
             assign, score, gang_ok = solve_pipeline_gang(
                 *args, garr, pb=pb, deterministic=self.deterministic,
                 config=self.solve_config, term_kinds=term_kinds,
+                n_buckets=n_buckets,
             )
             assign, gang_ok = jax.device_get((assign, gang_ok))  # one transfer
             gang_ok_arr = np.asarray(gang_ok)[: len(pods)]
@@ -473,6 +546,7 @@ class Scheduler:
             assign, score = solve_pipeline(
                 *args, pb=pb, deterministic=self.deterministic,
                 config=self.solve_config, term_kinds=term_kinds,
+                n_buckets=n_buckets,
             )
             # dispatch_s = host upload + trace-cache lookup + enqueue (async);
             # fetch_s = device execution + the [B] assign download
@@ -506,8 +580,7 @@ class Scheduler:
         self,
         pod: Pod,
         node_name: str,
-        commits: List[Tuple[Pod, str]],
-        committed_anti: List[Tuple[Pod, str]],
+        index: "_BatchConflictIndex",
     ) -> bool:
         """Can an earlier commit of THIS batch invalidate pod→node_name?
         The cheap replacement for the full oracle pass (which is O(cluster)
@@ -517,42 +590,15 @@ class Scheduler:
         assumed into the live NodeInfo) and required anti-affinity in
         either direction (satisfiesExistingPodsAntiAffinity semantics,
         predicates.go:1284: both nodes must carry the topology key with
-        equal values)."""
-        snap = self.cache.snapshot
-        ni = snap.get(node_name)
+        equal values). Commits are indexed by (topology key, value), so
+        each check touches only same-topology candidates instead of every
+        commit × term."""
+        ni = self.cache.snapshot.get(node_name)
         if ni is None:
             return True
-
-        def same_topology(node_a, node_b, key: str) -> bool:
-            if not key:
-                return False
-            va = node_a.labels.get(key)
-            return va is not None and va == node_b.labels.get(key)
-
         if pod.host_ports() and ni.host_port_conflict(pod):
             return True
-        node = ni.node
-        for c, n_c in committed_anti:
-            c_ni = snap.get(n_c)
-            if c_ni is None:
-                continue
-            for term in get_pod_anti_affinity_terms(c.affinity):
-                if same_topology(node, c_ni.node, term.topology_key) and pod_matches_term(
-                    pod, c, term
-                ):
-                    return True
-        a = pod.affinity
-        if a is not None and a.pod_anti_affinity is not None:
-            for term in a.pod_anti_affinity.required:
-                for c, n_c in commits:
-                    c_ni = snap.get(n_c)
-                    if c_ni is None:
-                        continue
-                    if same_topology(node, c_ni.node, term.topology_key) and pod_matches_term(
-                        c, pod, term
-                    ):
-                        return True
-        return False
+        return index.anti_conflict(pod, ni.node)
 
     def _oracle_place(
         self, pod: Pod, score_row: np.ndarray, meta, state: Optional[CycleState] = None
@@ -900,13 +946,11 @@ class Scheduler:
             out.score.prefetch(range(len(infos)))
         # once a pod carrying required anti-affinity commits, its terms can
         # invalidate ANY later pod's device placement (the mask predates the
-        # batch) — later pods get the cheap intra-batch check against these
-        # lists instead of an O(cluster) oracle pass (reference: the
-        # sequential loop sees it via satisfiesExistingPodsAntiAffinity,
-        # predicates.go:1284)
-        batch_commits: List[Tuple[Pod, str]] = []
-        committed_anti: List[Tuple[Pod, str]] = []
-        anti_committed = False
+        # batch) — later pods get the cheap intra-batch check against this
+        # topology-value index instead of an O(cluster) oracle pass
+        # (reference: the sequential loop sees it via
+        # satisfiesExistingPodsAntiAffinity, predicates.go:1284)
+        conflict_index = _BatchConflictIndex()
         # once ANY pod commits to a different node than the solver chose (an
         # oracle re-placement), the scan carry's residuals are stale for the
         # rest of the batch — later device picks need a resource validation
@@ -927,11 +971,7 @@ class Scheduler:
                 # the rolled-back members no longer occupy any node: prune
                 # them so later LIGHT pods don't see phantom conflicts and
                 # escalate to the O(cluster) oracle path
-                entry = (s_info.pod, s_node)
-                if entry in batch_commits:
-                    batch_commits.remove(entry)
-                if entry in committed_anti:
-                    committed_anti.remove(entry)
+                conflict_index.remove(s_info.pod)
                 res.unschedulable += 1
                 residuals_diverged = True  # staged capacity released
 
@@ -982,7 +1022,7 @@ class Scheduler:
                     and bool(scheduling_relevant_volumes(pod))
                 )
             )
-            needs_light = level == RECHECK_LIGHT or anti_committed
+            needs_light = level == RECHECK_LIGHT or conflict_index.any_anti
             pod_host_rank = force_host_rank or (
                 bool(self.extenders)
                 and any(
@@ -1025,7 +1065,7 @@ class Scheduler:
                     # can invalidate a LIGHT pod's device placement
                     self.stats["light_rechecks"] += 1
                     ok = not self._intra_batch_conflict(
-                        pod, node_name, batch_commits, committed_anti
+                        pod, node_name, conflict_index
                     )
                     if ok and residuals_diverged:
                         ni = self.cache.snapshot.get(node_name)
@@ -1107,19 +1147,21 @@ class Scheduler:
                     res.unschedulable += 1
                     continue
                 gang_staged.setdefault(group, []).append((info, assumed, node_name, state))
-                batch_commits.append((pod, node_name))
-                if out.has_anti[i]:
-                    anti_committed = True
-                    committed_anti.append((pod, node_name))
+                c_node = self.cache.snapshot.get(node_name)
+                if c_node is not None:
+                    conflict_index.add_commit(pod, c_node.node)
+                    if out.has_anti[i]:
+                        conflict_index.add_anti(pod, c_node.node)
                 if node_name != device_choice:
                     residuals_diverged = True
             elif self._commit(info, node_name, cycle, state):
                 res.scheduled += 1
                 res.assignments[pod.key()] = node_name
-                batch_commits.append((pod, node_name))
-                if out.has_anti[i]:
-                    anti_committed = True
-                    committed_anti.append((pod, node_name))
+                c_node = self.cache.snapshot.get(node_name)
+                if c_node is not None:
+                    conflict_index.add_commit(pod, c_node.node)
+                    if out.has_anti[i]:
+                        conflict_index.add_anti(pod, c_node.node)
                 if node_name != device_choice:
                     residuals_diverged = True
             else:

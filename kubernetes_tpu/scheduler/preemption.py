@@ -45,7 +45,7 @@ def _shadow_one(snapshot: Snapshot, node_name: str) -> Snapshot:
     for n, info in snapshot.node_infos.items():
         if n == node_name:
             si = shadow.add_node(info.node)
-            si.pods = list(info.pods)
+            si.set_pods(info.pods)
         else:
             shadow.node_infos[n] = info
     return shadow
@@ -104,7 +104,7 @@ def fits_with_nominees(
     shadow = _shadow_one(snapshot, node_name)
     sni = shadow.get(node_name)
     for p in nominees:
-        sni.pods.append(dataclasses.replace(p, node_name=node_name))
+        sni.add_pod(dataclasses.replace(p, node_name=node_name))
     meta2 = compute_predicate_metadata(pod, shadow, enabled=enabled)
     return pod_fits_on_node(pod, sni, meta=meta2)[0]
 
@@ -201,7 +201,7 @@ def select_victims_on_node(
     shadow = _shadow_one(snapshot, node_name)
     sni = shadow.get(node_name)
     victims_set = {id(p) for p in potential}
-    sni.pods = [p for p in sni.pods if id(p) not in victims_set]
+    sni.set_pods([p for p in sni.pods if id(p) not in victims_set])
 
     meta = compute_predicate_metadata(pod, shadow, enabled=enabled)
     fits, _ = pod_fits_on_node(pod, sni, meta=meta)
@@ -217,13 +217,13 @@ def select_victims_on_node(
     num_violations = 0
 
     def reprieve(p: Pod) -> bool:
-        sni.pods.append(p)
+        sni.add_pod(p)
         meta = compute_predicate_metadata(pod, shadow, enabled=enabled)
         still_fits, _ = pod_fits_on_node(pod, sni, meta=meta)
         if still_fits and extra_fit is not None:
             still_fits = extra_fit(pod, sni)
         if not still_fits:
-            sni.pods.remove(p)
+            sni.remove_pod(p)
             victims.append(p)
         return still_fits
 

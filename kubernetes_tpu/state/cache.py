@@ -101,14 +101,14 @@ class SchedulerCache:
             # imaginary NodeInfo; it becomes real when the node arrives)
             ni = self.snapshot.add_node(Node(name=pod.node_name))
             ni.node.labels = {}
-        ni.pods.append(pod)
+        ni.add_pod(pod)
         self.dirty_nodes.add(pod.node_name)
 
     def _remove_pod_from_node(self, pod: Pod) -> None:
         ni = self.snapshot.get(pod.node_name)
         if ni is None:
             return
-        ni.pods = [p for p in ni.pods if p.key() != pod.key()]
+        ni.remove_pod_key(pod.key())
         self.dirty_nodes.add(pod.node_name)
 
     # -- assumed pod state machine (cache.go:270-388) ------------------------

@@ -188,6 +188,16 @@ class Vocab:
             table[val_id] = idx
         return idx
 
+    def dense_size(self, slot: int) -> int:
+        """Distinct dense values assigned for a key slot (upper bound on its
+        dense indices). The topology kernels' segment axis only needs this
+        many buckets FOR TERMS ON THIS SLOT — zone-keyed terms need ~#zones
+        buckets, not one per node row (ops/pipeline n_buckets)."""
+        return len(self._dense.get(slot, ()))
+
+    def zone_count(self) -> int:
+        return len(self._zone_dense)
+
 
 def _parse_int_label(v: str) -> Tuple[int, bool]:
     """labels.Requirement Gt/Lt parse: base-10 int64 or no match."""

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Per-kernel device-time ablation: run each kernel R times inside one jitted
+fori_loop (loop-carried perturbation defeats hoisting), so tunnel RTT and
+dispatch overhead amortize away. CFG env var picks the bench config."""
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from bench import CONFIGS
+from kubernetes_tpu.oracle import Snapshot
+from kubernetes_tpu.ops import filters as F
+from kubernetes_tpu.ops import scores as S
+from kubernetes_tpu.ops.pipeline import encode_solve_args, mask_and_score
+from kubernetes_tpu.ops.solver import pop_order, solve_greedy
+
+name, build = CONFIGS[os.environ.get("CFG", "2")]
+nodes, pods = build()
+pods = pods[: int(os.environ.get("N_PODS", "128"))]
+snap = Snapshot(nodes, [])
+args = encode_solve_args(snap, pods)
+dev_args = jax.device_put(args)
+na, pa, ea, tb, xa, au, ids, key = dev_args
+print(f"{name}: N={na['valid'].shape[0]} B={pa['valid'].shape[0]}", flush=True)
+
+R = 20
+
+
+def timeit(label, kernel):
+    """kernel(na_perturbed) -> array; repeated R times in-program."""
+
+    @jax.jit
+    def rep(na_, pa_):
+        def body(i, acc):
+            na2 = dict(na_)
+            na2["requested"] = na_["requested"] + i  # defeat loop hoisting
+            return acc + jnp.max(kernel(na2, pa_)).astype(jnp.float32)
+
+        return jax.lax.fori_loop(0, R, body, jnp.float32(0))
+
+    float(rep(na, pa))  # compile
+    t0 = time.perf_counter()
+    float(rep(na, pa))
+    dt = (time.perf_counter() - t0) / R
+    print(f"{label}: {dt*1000:.1f}ms/call", flush=True)
+
+
+timeit("mask_and_score", lambda na_, pa_: mask_and_score(na_, pa_, ea, tb, xa, au, ids)[1])
+timeit("combined_mask", lambda na_, pa_: F.combined_mask(na_, pa_, ids))
+timeit("score_matrix", lambda na_, pa_: S.score_matrix(na_, pa_))
+timeit("least_requested", S.least_requested)
+timeit("balanced_allocation", S.balanced_allocation)
+timeit("node_affinity", S.node_affinity)
+timeit("taint_toleration", S.taint_toleration)
+timeit("prefer_avoid_pods", S.prefer_avoid_pods)
+timeit("image_locality", lambda na_, pa_: S.image_locality(na_, pa_) if "image_scaled" in na_ else jnp.zeros(1))
+timeit("pod_match_node_selector", F.pod_match_node_selector)
+
+b = pa["valid"].shape[0]
+order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
+count0 = na["pod_count"]
+mask, score = mask_and_score(na, pa, ea, tb, xa, au, ids)
+mask, score = jax.device_put((mask, score))
+
+
+def solve_kernel(na_, pa_):
+    free0 = na_["alloc"] - na_["requested"]
+    return solve_greedy(mask, score, pa_["req"], free0,
+                        count0.astype(free0.dtype),
+                        na_["allowed_pods"].astype(free0.dtype),
+                        order, key, deterministic=False, req_any=pa_["req_any"])
+
+
+timeit("solve_greedy", solve_kernel)

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Stage split at a bench config's real shapes: mask_and_score vs
+solve_greedy, chained truthfully. Env: CFG=2 BENCH_SCALE=0.2 N_PODS=1024."""
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import jax.numpy as jnp
+import numpy as np
+
+from bench import CONFIGS
+from kubernetes_tpu.oracle import Snapshot
+from kubernetes_tpu.ops.pipeline import encode_solve_args, mask_and_score
+from kubernetes_tpu.ops.solver import pop_order, solve_greedy, tie_noise
+
+name, build = CONFIGS[os.environ.get("CFG", "2")]
+nodes, pods = build()
+pods = pods[: int(os.environ.get("N_PODS", "1024"))]
+snap = Snapshot(nodes, [])
+args = encode_solve_args(snap, pods)
+dev_args = jax.device_put(args)
+na, pa, ea, tb, xa, au, ids, key = dev_args
+print(f"{name}: N={na['valid'].shape[0]} B={pa['valid'].shape[0]}", flush=True)
+
+ms_jit = jax.jit(partial(mask_and_score, config=None, term_kinds=None))
+
+
+def chain(label, fn, n=6):
+    out = fn(jax.random.fold_in(key, 999))
+    jnp.max(out[0] if isinstance(out, tuple) else out).block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(n):
+        out = fn(jax.random.fold_in(key, i))
+        x = out[0] if isinstance(out, tuple) else out
+        _ = float(jnp.max(x).astype(jnp.float32))
+    print(f"{label}: {(time.perf_counter()-t0)/n*1000:.1f}ms/call", flush=True)
+    return out
+
+
+mask, score = chain("mask_and_score", lambda k: ms_jit(na, pa, ea, tb, xa, au, ids))
+mask, score = jax.device_put((mask, score))
+free0 = na["alloc"] - na["requested"]
+b = pa["valid"].shape[0]
+order = pop_order(pa["priority"], jnp.arange(b, dtype=jnp.int32), pa["valid"])
+count0 = na["pod_count"].astype(free0.dtype)
+allowed = na["allowed_pods"].astype(free0.dtype)
+
+chain("solve_greedy", lambda k: solve_greedy(
+    mask, score, pa["req"], free0, count0, allowed, order, k,
+    deterministic=False, req_any=pa["req_any"]))
+
+chain("tie_noise alone", lambda k: tie_noise(k, b, int(na["valid"].shape[0])))

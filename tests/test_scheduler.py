@@ -184,7 +184,7 @@ def _assert_sequential_equivalent(seed, n_nodes=16, n_existing=40, n_pending=12,
                 f"rejects at commit time (feasible={feasible})"
             )
             ni = snap.get(node)
-            ni.pods.append(dataclasses.replace(p, node_name=node))
+            ni.add_pod(dataclasses.replace(p, node_name=node))
         else:
             assert not feasible, (
                 f"seed={seed}: {p.key()} declared unschedulable but oracle "

@@ -35,7 +35,7 @@ def _ni(labels=None, pods=()):
     n = make_node("n0", cpu_milli=4000, mem=8 * 2**30)
     n.labels.update(labels or {})
     ni = NodeInfo(node=n)
-    ni.pods.extend(pods)
+    ni.set_pods(list(ni.pods) + list(pods))
     return ni
 
 
