@@ -100,12 +100,13 @@ class NodeInfo:
         self.pods.remove(pod)
         self._account(pod, -1)
 
-    def remove_pod_key(self, key: str) -> None:
+    def remove_pod_key(self, key: str) -> Optional[Pod]:
         for p in self.pods:
             if p.key() == key:
                 self.pods.remove(p)
                 self._account(p, -1)
-                return
+                return p
+        return None
 
     def set_pods(self, pods: List[Pod]) -> None:
         self.pods = list(pods)

@@ -25,7 +25,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..api.types import Node, Pod
-from ..oracle.nodeinfo import NodeInfo, Snapshot
+from ..oracle.nodeinfo import NodeInfo, Snapshot, pod_has_affinity_constraints
 from .tensors import (
     EncodingConfig,
     ImageTable,
@@ -83,6 +83,9 @@ class SchedulerCache:
         self._assumed: Set[str] = set()
         self.dirty_nodes: Set[str] = set()  # generation-equivalent dirty set
         self.removed_nodes: Set[str] = set()
+        # (node, pod, ±1) single-pod changes (assume/confirm/remove) — the
+        # overwhelmingly common event; consumed by TensorMirror.sync
+        self.pod_deltas: List[Tuple[str, Pod, int]] = []
         # zone-interleaved iteration (internal/cache/node_tree.go) for the
         # host-side placement loops' tie distribution
         from .node_tree import NodeTree
@@ -101,15 +104,22 @@ class SchedulerCache:
             # imaginary NodeInfo; it becomes real when the node arrives)
             ni = self.snapshot.add_node(Node(name=pod.node_name))
             ni.node.labels = {}
+            ni.add_pod(pod)
+            self.dirty_nodes.add(pod.node_name)
+            return
         ni.add_pod(pod)
-        self.dirty_nodes.add(pod.node_name)
+        # single-pod change: a DELTA, not node dirt — the mirror patches the
+        # node row + signature/pattern counts in O(1) instead of re-counting
+        # every pod on the node
+        self.pod_deltas.append((pod.node_name, pod, 1))
 
     def _remove_pod_from_node(self, pod: Pod) -> None:
         ni = self.snapshot.get(pod.node_name)
         if ni is None:
             return
-        ni.remove_pod_key(pod.key())
-        self.dirty_nodes.add(pod.node_name)
+        removed = ni.remove_pod_key(pod.key())
+        if removed is not None:
+            self.pod_deltas.append((pod.node_name, removed, -1))
 
     # -- assumed pod state machine (cache.go:270-388) ------------------------
 
@@ -347,6 +357,7 @@ class TensorMirror:
                 continue
         self.cache.dirty_nodes.clear()
         self.cache.removed_nodes.clear()
+        self.cache.pod_deltas.clear()  # the rebuild re-counted everything
         self._device_stale = True  # shapes may have changed: full re-upload
         self._pending_node_rows.clear()
         self.eps.dirty_sig_rows.clear()
@@ -389,21 +400,24 @@ class TensorMirror:
         self._pending_node_rows.add(node_row)
 
     def sync(self) -> bool:
-        """Apply dirty nodes (and ONLY their pods). Returns True if a full
-        rebuild happened (device arrays change shape → recompile)."""
+        """Apply dirty nodes (and ONLY their pods) plus single-pod deltas
+        (O(1) each — no per-node re-count). Returns True if a full rebuild
+        happened (device arrays change shape → recompile)."""
         cache = self.cache
         with cache._lock:
             dirty = set(cache.dirty_nodes)
             removed = set(cache.removed_nodes)
+            deltas = list(cache.pod_deltas)
             cache.dirty_nodes.clear()
             cache.removed_nodes.clear()
+            cache.pod_deltas.clear()
             new_nodes = [n for n in cache.snapshot.node_infos if n not in self.row_of]
             if len(self.row_of) - len(removed) + len(new_nodes) > self.nodes.capacity or (
                 new_nodes and not self._free_rows
             ):
                 self._rebuild()
                 return True
-            if not (dirty or removed or new_nodes):
+            if not (dirty or removed or new_nodes or deltas):
                 return False
             try:
                 for name in removed:
@@ -436,6 +450,28 @@ class TensorMirror:
                     if self._image_sig.get(name) != sig:
                         self._image_sig[name] = sig
                         images_changed = True
+                # single-pod deltas last, skipping nodes that were fully
+                # re-encoded above (their counts already include the deltas)
+                reencoded = removed | dirty | set(new_nodes)
+                for name, pod, sign in deltas:
+                    if name in reencoded or name not in self.row_of:
+                        continue
+                    row = self.row_of[name]
+                    ni = cache.snapshot.get(name)
+                    if ni is None:
+                        continue
+                    # node aggregates (requested/ports/pod_count) changed:
+                    # set_node is O(labels+taints) now that NodeInfo keeps
+                    # running sums — the O(pods) re-count is what we skip
+                    self.nodes.set_node(row, ni)
+                    self._pending_node_rows.add(row)
+                    self.eps.apply_delta(
+                        row, pod, sign, self._node_sigs.setdefault(name, {})
+                    )
+                    if pod_has_affinity_constraints(pod):
+                        self.pats.apply_delta(
+                            row, pod, sign, self._node_pats.setdefault(name, {})
+                        )
                 if images_changed:
                     # spread scaling depends on cluster-wide image placement
                     # and node count → recompute the whole table (rare: image

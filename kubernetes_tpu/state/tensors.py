@@ -904,6 +904,28 @@ class SigBank:
             self.counts[node_row, sig] -= n
             self._unref(sig, n)
 
+    def apply_delta(self, node_row: int, pod, sign: int, held: Dict[int, int]) -> None:
+        """O(1) single-pod count change (the mirror's pod-delta path).
+        `held` is the node's {sig: count} bookkeeping map. Raises
+        KeySlotOverflow/SigOverflow like encode_node (caller rebuilds);
+        a remove for an unknown signature means the books are inconsistent
+        — also escalated to a rebuild."""
+        if sign > 0:
+            sig = self._intern(pod)
+            held[sig] = held.get(sig, 0) + 1
+            self._refs[sig] += 1
+            self.counts[node_row, sig] += 1
+            return
+        key, _, _, _ = self._encode_key(pod)
+        sig = self._sig_of.get(key)
+        if sig is None or held.get(sig, 0) <= 0:
+            raise SigOverflow()  # inconsistent books: full rebuild heals
+        held[sig] -= 1
+        if held[sig] == 0:
+            del held[sig]
+        self.counts[node_row, sig] -= 1
+        self._unref(sig, 1)
+
     def encode_node(self, node_row: int, pods) -> Dict[int, int]:
         """Count a node's pods into signatures → the {sig: count} map the
         caller must keep for the matching release_node. Raises

@@ -386,8 +386,12 @@ class PatternBank:
                     out.append((ANTI_PREF, t.topology_key, t.label_selector, _term_namespaces(pod, t), -w.weight))
         return out
 
+    @staticmethod
+    def _key(kind: int, topo_key: str, selector, namespaces, weight: int) -> tuple:
+        return (kind, topo_key, tuple(sorted(namespaces)), weight, repr(selector))
+
     def _intern(self, kind: int, topo_key: str, selector, namespaces, weight: int) -> int:
-        key = (kind, topo_key, tuple(sorted(namespaces)), weight, repr(selector))
+        key = self._key(kind, topo_key, selector, namespaces, weight)
         row = self._row_of.get(key)
         if row is None:
             if not self._free:
@@ -423,6 +427,26 @@ class PatternBank:
         for row, n in held.items():
             self.counts[node_row, row] -= n
             self._unref(row, n)
+
+    def apply_delta(self, node_row: int, pod: Pod, sign: int, held: Dict[int, int]) -> None:
+        """O(1) single-pod term-instance change (the mirror's pod-delta
+        path). Raises KeySlotOverflow/PatternOverflow like encode_node; a
+        remove for an unknown pattern escalates to a rebuild."""
+        for kind, topo, sel, nss, w in self._pod_patterns(pod):
+            if sign > 0:
+                row = self._intern(kind, topo, sel, nss, w)
+                held[row] = held.get(row, 0) + 1
+                self._refs[row] += 1
+                self.counts[node_row, row] += 1
+            else:
+                row = self._row_of.get(self._key(kind, topo, sel, nss, w))
+                if row is None or held.get(row, 0) <= 0:
+                    raise PatternOverflow()  # inconsistent books: rebuild
+                held[row] -= 1
+                if held[row] == 0:
+                    del held[row]
+                self.counts[node_row, row] -= 1
+                self._unref(row, 1)
 
     def encode_node(self, node_row: int, pods) -> Dict[int, int]:
         """Count a node's pods' term instances into patterns → the

@@ -272,8 +272,30 @@ def test_sync_touches_only_dirty_nodes(monkeypatch):
     monkeypatch.setattr(type(mirror.eps), "encode_node", spy)
     cache.add_pod(make_pod("p-new", node_name="n3"))
     mirror.sync()
-    # only n3's pods re-counted: its 4 originals + the new one
-    assert len(recounted) == 1, recounted
-    assert recounted[0][1] == sorted(
-        ["default/p3", "default/p11", "default/p19", "default/p27", "default/p-new"]
-    )
+    # a single-pod change is a DELTA: no node re-count at all (O(1) patch)
+    assert recounted == [], recounted
+    # and the delta-maintained signature counts must equal a from-scratch
+    # encode of the same snapshot
+    from kubernetes_tpu.state.tensors import encode_snapshot
+
+    bank, fresh_eps, row_of = encode_snapshot(cache.snapshot, with_images=False)
+    for name, row in mirror.row_of.items():
+        mine = {
+            s: int(mirror.eps.counts[row, s])
+            for s in range(mirror.eps.capacity)
+            if mirror.eps.counts[row, s]
+        }
+        frow = row_of[name]
+        theirs = {
+            s: int(fresh_eps.counts[frow, s])
+            for s in range(fresh_eps.capacity)
+            if fresh_eps.counts[frow, s]
+        }
+        assert sorted(mine.values()) == sorted(theirs.values()), (name, mine, theirs)
+    recounted.clear()  # the fresh encode above also went through the spy
+    # node-level structural dirt still re-counts that node only
+    cache.update_node(make_node("n5"))
+    mirror.sync()
+    assert len(recounted) == 1 and recounted[0][1] == sorted(
+        ["default/p5", "default/p13", "default/p21", "default/p29"]
+    ), recounted
