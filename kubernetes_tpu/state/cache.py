@@ -111,7 +111,7 @@ class SchedulerCache:
         # single-pod change: a DELTA, not node dirt — the mirror patches the
         # node row + signature/pattern counts in O(1) instead of re-counting
         # every pod on the node
-        self.pod_deltas.append((pod.node_name, pod, 1))
+        self._push_delta(pod.node_name, pod, 1)
 
     def _remove_pod_from_node(self, pod: Pod) -> None:
         ni = self.snapshot.get(pod.node_name)
@@ -119,7 +119,19 @@ class SchedulerCache:
             return
         removed = ni.remove_pod_key(pod.key())
         if removed is not None:
-            self.pod_deltas.append((pod.node_name, removed, -1))
+            self._push_delta(pod.node_name, removed, -1)
+
+    def _push_delta(self, name: str, pod: Pod, sign: int) -> None:
+        # bounded: with no mirror attached (or one that syncs rarely) the
+        # delta log must not pin every churned Pod forever — past the bound,
+        # collapse it into the node-count-bounded dirty set
+        if len(self.pod_deltas) >= max(1024, 4 * len(self.snapshot.node_infos)):
+            for n, _, _ in self.pod_deltas:
+                self.dirty_nodes.add(n)
+            self.pod_deltas.clear()
+            self.dirty_nodes.add(name)
+            return
+        self.pod_deltas.append((name, pod, sign))
 
     # -- assumed pod state machine (cache.go:270-388) ------------------------
 
@@ -453,18 +465,12 @@ class TensorMirror:
                 # single-pod deltas last, skipping nodes that were fully
                 # re-encoded above (their counts already include the deltas)
                 reencoded = removed | dirty | set(new_nodes)
+                delta_nodes: Set[str] = set()
                 for name, pod, sign in deltas:
                     if name in reencoded or name not in self.row_of:
                         continue
                     row = self.row_of[name]
-                    ni = cache.snapshot.get(name)
-                    if ni is None:
-                        continue
-                    # node aggregates (requested/ports/pod_count) changed:
-                    # set_node is O(labels+taints) now that NodeInfo keeps
-                    # running sums — the O(pods) re-count is what we skip
-                    self.nodes.set_node(row, ni)
-                    self._pending_node_rows.add(row)
+                    delta_nodes.add(name)
                     self.eps.apply_delta(
                         row, pod, sign, self._node_sigs.setdefault(name, {})
                     )
@@ -472,6 +478,19 @@ class TensorMirror:
                         self.pats.apply_delta(
                             row, pod, sign, self._node_pats.setdefault(name, {})
                         )
+                # the node row's usage columns are idempotent snapshots of
+                # the CURRENT NodeInfo: refresh once per touched node, not
+                # once per delta
+                for name in delta_nodes:
+                    ni = cache.snapshot.get(name)
+                    if ni is None:
+                        continue
+                    row = self.row_of[name]
+                    # full set_node when the usage update can't represent
+                    # the node (port overflow / fallback rows)
+                    if not self.nodes.update_usage(row, ni):
+                        self.nodes.set_node(row, ni)
+                    self._pending_node_rows.add(row)
                 if images_changed:
                     # spread scaling depends on cluster-wide image placement
                     # and node count → recompute the whole table (rare: image

@@ -363,6 +363,36 @@ class NodeBank:
         # would force the driver's O(nodes) oracle fallback forever
         self.fallback[i] = False
 
+    def update_usage(self, i: int, ni: NodeInfo) -> bool:
+        """Refresh ONLY the pod-driven columns (requested/non-zero/pod
+        count/used ports) — the single-pod delta path. Node identity
+        (labels, taints, zone, avoid signatures) is untouched. Returns
+        False when the caller must fall back to a full set_node (port
+        table overflow changes the fallback flag)."""
+        c = self.vocab.config
+        used_ports = sorted(ni.used_host_ports())
+        if len(used_ports) > c.node_ports or self.fallback[i]:
+            return False
+        self.requested[i] = 0
+        for name, amount in ni.requested().items():
+            if name != RESOURCE_PODS:
+                s = self.vocab.slot_of_resource(name)
+                if s >= self.requested.shape[1]:
+                    raise KeySlotOverflow()
+                self.requested[i, s] = amount
+        nz_cpu, nz_mem = ni.non_zero_requested()
+        self.nonzero_req[i, 0] = nz_cpu
+        self.nonzero_req[i, 1] = nz_mem
+        self.pod_count[i] = len(ni.pods)
+        self.port_proto[i] = 0
+        self.port_ip[i] = 0
+        self.port_num[i] = 0
+        for p_idx, (proto, ip, port) in enumerate(used_ports):
+            self.port_proto[i, p_idx] = self.vocab.id(proto)
+            self.port_ip[i, p_idx] = self.vocab.id(ip)
+            self.port_num[i, p_idx] = port
+        return True
+
     def arrays(self) -> Dict[str, np.ndarray]:
         out = {
             "valid": self.valid,
