@@ -35,6 +35,10 @@ import jax.numpy as jnp
 
 Arrays = Dict[str, jnp.ndarray]
 
+# chunk width of the prefix-acceptance commit loop — shared with the
+# sharded twin (parallel/sharded.py) so the two stay in lockstep
+DEFAULT_CHUNK = 64
+
 
 def pop_order(priority: jnp.ndarray, enqueue_seq: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """Queue pop order: priority desc, then enqueue sequence asc (activeQ
@@ -67,7 +71,7 @@ def solve_greedy(
     req_any: Optional[jnp.ndarray] = None,  # [U] pod requests anything at all
     sig: Optional[jnp.ndarray] = None,  # [B] pod → spec row (None: identity)
     pod_valid: Optional[jnp.ndarray] = None,  # [B] (None: all valid)
-    chunk: int = 64,
+    chunk: int = DEFAULT_CHUNK,
 ) -> jnp.ndarray:
     """Greedy-by-priority batch assignment → node row per pod, -1 = no fit.
 

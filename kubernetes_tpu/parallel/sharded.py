@@ -11,16 +11,19 @@ Two cooperating pieces (SURVEY §2.4 "TPU-native equivalent"):
    (per-topology-value segment sums, min/max normalizations). This is the
    idiomatic pjit recipe: annotate, let the compiler place psum/all-gather.
 
-2. **Greedy commit stage — explicit shard_map.** The sequential
-   pod-by-pod commit (reference scheduleOne order, one pod's residual
-   update visible to the next) keeps per-node residuals SHARD-LOCAL and
-   pays exactly two tiny collectives per pod: a pmax to find the global
-   best score and a pmin to elect the winning (shard, node) — an argmax
-   over ICI. The winning shard alone updates its residual rows. Bit-for-bit
-   identical to ops/solver.solve_greedy on one device (parity-tested in
-   tests/test_parallel.py), including the selectHost random tie-break
-   (core/generic_scheduler.go:278): the tie-break noise is generated from
-   the same per-step PRNG keys and sliced per shard.
+2. **Greedy commit stage — explicit shard_map.** The chunked
+   prefix-acceptance commit (ops/solver.solve_greedy's algorithm,
+   bit-identical to sequential pod-by-pod order) keeps per-node residuals
+   SHARD-LOCAL; each repair iteration elects every chunk pod's winning
+   (shard, node) with a handful of [K]-wide pmax/pmin collectives over
+   ICI and reduces the first locally-rejected order index, so a 1024-pod
+   batch pays ~tens of collective rounds instead of three per pod.
+   Acceptance prefix sums are shard-local because a node lives on exactly
+   one shard. Bit-for-bit identical to ops/solver.solve_greedy on one
+   device (parity-tested in tests/test_parallel.py), including the
+   selectHost random tie-break (core/generic_scheduler.go:278): the
+   tie-break noise comes from the shared tie_noise stream, sliced per
+   shard.
 
 Node capacity is a power of two up to 2048 and a multiple of 2048 above
 (state/tensors._node_bucket), so any power-of-two shard count up to 2048
@@ -37,7 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pipeline import SolveConfig, _pod_axis, mask_and_score
-from ..ops.solver import pop_order, tie_noise
+from ..ops.solver import DEFAULT_CHUNK, pop_order, tie_noise
 from .mesh import AXIS_NODES, AXIS_PODS
 
 Arrays = Dict[str, jnp.ndarray]
@@ -61,51 +64,107 @@ def _solver_body(
     deterministic: bool,
     n_local: int,
 ) -> jnp.ndarray:
-    """shard_map body: the greedy scan with cross-shard argmax election."""
+    """shard_map body: chunked prefix-acceptance greedy (the multi-chip
+    twin of ops.solver.solve_greedy, bit-identical results). Pods are
+    processed in chunks; each repair iteration pays a handful of [K]-wide
+    collectives — global (score, noise) argmax election plus a pmin over
+    the first locally-rejected order index — instead of the former three
+    collectives per POD."""
     shard = jax.lax.axis_index(AXIS_NODES)
     base = (shard * n_local).astype(jnp.int32)
+    B = order.shape[0]
+    K = min(DEFAULT_CHUNK, B)
+    if B % K:
+        K = B
+    n_chunks = B // K
+    neg = jnp.iinfo(score.dtype).min
+    jrange = jnp.arange(K)
+    order_c = jnp.reshape(order, (n_chunks, K))
+    noise_c = jnp.reshape(noise, (n_chunks, K, noise.shape[-1]))
 
-    def step(carry, inp):
+    def chunk_step(carry, inp):
         free, count = carry
-        i, nz = inp
-        s = sig[i]
-        m = mask[s] & pod_valid[i]
-        # PodFitsResources against the residual carry (predicates.go:854
-        # semantics: count always, resource rows only when requested)
-        res_ok = ~req_any[s] | jnp.all(req[s][None, :] <= free, axis=-1)
-        feasible = m & res_ok & (count + 1 <= allowed)
-        neg = jnp.iinfo(score.dtype).min
-        masked = jnp.where(feasible, score[s], neg)
-        local_best = jnp.max(masked)
-        global_best = jax.lax.pmax(local_best, AXIS_NODES)
-        any_feasible = jax.lax.pmax(jnp.any(feasible), AXIS_NODES)
-        if deterministic:
-            # first global max == smallest global index among shard maxima
-            gidx = jnp.where(
-                local_best == global_best, base + jnp.argmax(masked).astype(jnp.int32), _BIG
+        idx, nz = inp  # [K] pod positions; [K, Nl] local noise columns
+        sg = sig[idx]
+        pv = pod_valid[idx]
+        m_r = mask[sg] & pv[:, None]  # [K, Nl]
+        s_r = score[sg]
+        r_q = req[sg]  # [K, R]
+        r_any = req_any[sg]
+
+        def not_done(st):
+            return ~jnp.all(st[2])
+
+        def body(st):
+            free, count, decided, choice = st
+            res_ok = (~r_any[:, None]) | jnp.all(
+                r_q[:, None, :] <= free[None, :, :], axis=-1
             )
-        else:
-            # selectHost: uniform among max-score nodes — max noise wins
-            ties = feasible & (masked == global_best)
-            nzm = jnp.where(ties, nz, -1.0)
-            local_nbest = jnp.max(nzm)
-            global_nbest = jax.lax.pmax(local_nbest, AXIS_NODES)
-            gidx = jnp.where(
-                (local_nbest == global_nbest) & jnp.any(ties),
-                base + jnp.argmax(nzm).astype(jnp.int32),
-                _BIG,
+            feas = m_r & res_ok & (count[None, :] + 1 <= allowed[None, :])
+            feas = feas & ~decided[:, None]
+            anyf = jax.lax.pmax(jnp.any(feas, axis=1), AXIS_NODES)  # [K]
+            masked = jnp.where(feas, s_r, neg)
+            local_best = jnp.max(masked, axis=1)  # [K]
+            global_best = jax.lax.pmax(local_best, AXIS_NODES)
+            if deterministic:
+                # first global max == smallest global index among shard maxima
+                gidx = jnp.where(
+                    local_best == global_best,
+                    base + jnp.argmax(masked, axis=1).astype(jnp.int32),
+                    _BIG,
+                )
+            else:
+                # selectHost: uniform among max-score nodes — max noise wins
+                ties = feas & (masked == global_best[:, None])
+                nzm = jnp.where(ties, nz, -1.0)
+                local_nbest = jnp.max(nzm, axis=1)
+                global_nbest = jax.lax.pmax(local_nbest, AXIS_NODES)
+                gidx = jnp.where(
+                    (local_nbest == global_nbest) & jnp.any(ties, axis=1),
+                    base + jnp.argmax(nzm, axis=1).astype(jnp.int32),
+                    _BIG,
+                )
+            cand = jnp.where(anyf, jax.lax.pmin(gidx, AXIS_NODES), -1)  # [K] global
+            newly_none = ~decided & ~anyf
+            active = ~decided & (cand >= 0)
+            local = active & (cand >= base) & (cand < base + n_local)
+            lidx = jnp.where(local, cand - base, 0)
+            # per-node in-order prefix among pods choosing THIS shard's nodes
+            # (a node lives on exactly one shard, so acceptance is local)
+            same = (
+                local[:, None]
+                & local[None, :]
+                & (cand[:, None] == cand[None, :])
+                & (jrange[None, :] < jrange[:, None])
             )
-        choice = jax.lax.pmin(gidx, AXIS_NODES)
-        choice = jnp.where(any_feasible, choice, -1)
-        committed = choice >= 0
-        mine = committed & (choice >= base) & (choice < base + n_local)
-        sel = jnp.where(mine, choice - base, 0)
-        free = jnp.where(mine, free.at[sel].add(-req[s]), free)
-        count = jnp.where(mine, count.at[sel].add(1), count)
+            # broadcast-sum, not matmul: an s64 dot has no TPU x64 rewrite
+            prefix_req = jnp.sum(same[:, :, None] * r_q[None, :, :], axis=1)
+            prefix_cnt = jnp.sum(same, axis=1)
+            fits = (
+                (~r_any) | jnp.all(r_q <= free[lidx] - prefix_req, axis=-1)
+            ) & (count[lidx] + prefix_cnt + 1 <= allowed[lidx])
+            rejected = local & ~fits
+            first_rej = jax.lax.pmin(
+                jnp.min(jnp.where(rejected, jrange, K)), AXIS_NODES
+            )
+            commit = active & (jrange < first_rej)
+            mine = commit & local
+            target = jnp.where(mine, lidx, n_local)
+            free = free.at[target].add(-(mine[:, None] * r_q), mode="drop")
+            count = count.at[target].add(mine.astype(count.dtype), mode="drop")
+            choice = jnp.where(commit, cand, choice)
+            decided = decided | commit | newly_none
+            return free, count, decided, choice
+
+        decided0 = ~pv
+        choice0 = jnp.full((K,), -1, jnp.int32)
+        free, count, _, choice = jax.lax.while_loop(
+            not_done, body, (free, count, decided0, choice0)
+        )
         return (free, count), choice
 
-    (_, _), choices = jax.lax.scan(step, (free, count), (order, noise))
-    return choices.astype(jnp.int32)
+    (_, _), choices = jax.lax.scan(chunk_step, (free, count), (order_c, noise_c))
+    return jnp.reshape(choices, (B,)).astype(jnp.int32)
 
 
 def make_sharded_pipeline(mesh: Mesh):

@@ -94,3 +94,56 @@ def test_sharded_residuals_bind_within_batch():
     counts = np.bincount(placed, minlength=16)
     assert counts[0] <= 5  # 8 cpu / 1.5 = 5 pods max on the big node
     assert (counts[1:16] <= 1).all()  # 2 cpu / 1.5 = 1 pod per small node
+
+
+def test_sharded_chunked_contention_multi_chunk():
+    """B=256 (4 chunks of 64) fighting over 8 tight nodes on an 8-shard
+    mesh: the cross-shard chunk repair loop (election + pmin(first_rej) +
+    chunk-scan carry) must stay bit-identical to the single-device solver
+    across chunk boundaries, in both tie-break modes."""
+    import numpy as np
+
+    from kubernetes_tpu.ops.solver import pop_order, solve_greedy
+
+    rng = np.random.RandomState(5)
+    B, N, R = 256, 8, 2
+    mask = jnp.asarray(rng.rand(B, N) < 0.9)
+    score = jnp.asarray(rng.randint(0, 3, (B, N)).astype(np.int64))
+    req = jnp.asarray(rng.randint(1, 4, (B, R)).astype(np.int64))
+    req_any = jnp.ones(B, bool)
+    free = jnp.asarray(rng.randint(10, 30, (N, R)).astype(np.int64))
+    count = jnp.zeros(N, jnp.int64)
+    allowed = jnp.full(N, 12, jnp.int64)
+    order = jnp.arange(B, dtype=jnp.int32)
+    key = jax.random.PRNGKey(5)
+    mesh = node_mesh(8)
+    from functools import partial
+
+    from kubernetes_tpu.parallel.sharded import _solver_body
+    from jax.sharding import PartitionSpec as P
+
+    for det in (False, True):
+        expect = np.asarray(solve_greedy(
+            mask, score, req, free, count, allowed, order, key,
+            deterministic=det, req_any=req_any,
+        ))
+        if det:
+            noise = jnp.zeros((B, 8))
+        else:
+            from kubernetes_tpu.ops.solver import tie_noise
+
+            noise = tie_noise(key, B, N)
+        solver = jax.shard_map(
+            partial(_solver_body, deterministic=det, n_local=1),
+            mesh=mesh,
+            in_specs=(P(None, "nodes"), P(None, "nodes"), P(), P("nodes"),
+                      P("nodes"), P("nodes"), P(), P(None, "nodes"), P(),
+                      P(), P()),
+            out_specs=P(),
+        )
+        choices = solver(mask, score, req, free.astype(jnp.int64), count,
+                         allowed, order, noise, req_any,
+                         jnp.arange(B, dtype=jnp.int32), jnp.ones(B, bool))
+        got = np.asarray(jnp.full((B,), -1, jnp.int32).at[order].set(choices))
+        assert (got == expect).all(), (det, np.nonzero(got != expect))
+        assert (got == -1).sum() > 0  # contention actually rejected pods
