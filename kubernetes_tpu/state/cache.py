@@ -423,13 +423,11 @@ class TensorMirror:
             cache.dirty_nodes.clear()
             cache.removed_nodes.clear()
             cache.pod_deltas.clear()
-            new_nodes = [n for n in cache.snapshot.node_infos if n not in self.row_of]
-            if len(self.row_of) - len(removed) + len(new_nodes) > self.nodes.capacity or (
-                new_nodes and not self._free_rows
-            ):
+            has_new = any(n not in self.row_of for n in cache.snapshot.node_infos)
+            if len(cache.snapshot.node_infos) > self.nodes.capacity:
                 self._rebuild()
                 return True
-            if not (dirty or removed or new_nodes or deltas):
+            if not (dirty or removed or has_new or deltas):
                 return False
             try:
                 for name in removed:
@@ -443,6 +441,12 @@ class TensorMirror:
                         self._free_rows.append(row)
                         self._pending_node_rows.add(row)
                     self._image_sig.pop(name, None)
+                new_nodes = [
+                    n for n in cache.snapshot.node_infos if n not in self.row_of
+                ]
+                if len(new_nodes) > len(self._free_rows):
+                    self._rebuild()
+                    return True
                 for name in new_nodes:
                     row = self._free_rows.pop()
                     self.row_of[name] = row

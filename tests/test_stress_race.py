@@ -220,3 +220,86 @@ def test_sigbank_stays_consistent_under_churn():
     for name, row in mirror.row_of.items():
         fr = fresh_row_of[name]
         assert histogram(sig, row) == histogram(fresh, fr), f"node {name} diverged"
+
+
+def test_patternbank_stays_consistent_under_churn():
+    """Property: after arbitrary churn of affinity-carrying pods (delta
+    adds/removes, node removals, periodic syncs), the incremental
+    PatternBank equals a from-scratch compile — per-(node, pattern-key)
+    counts match, refcounts equal column sums, freed rows are clean."""
+    import random
+
+    import numpy as np
+
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        PodAffinity,
+        WeightedPodAffinityTerm,
+    )
+    from kubernetes_tpu.state.terms import compile_existing_patterns
+
+    rng = random.Random(7)
+    cache = SchedulerCache()
+    for i in range(10):
+        cache.add_node(make_node(f"n{i}", labels={"kubernetes.io/hostname": f"n{i}"}))
+    mirror = TensorMirror(cache)
+
+    def mk_affinity(kind: int):
+        term = PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": f"svc-{kind}"}),
+            topology_key="kubernetes.io/hostname",
+        )
+        if kind % 2:
+            return Affinity(pod_anti_affinity=PodAntiAffinity(required=[term]))
+        return Affinity(pod_affinity=PodAffinity(
+            preferred=[WeightedPodAffinityTerm(weight=5 + kind, pod_affinity_term=term)]
+        ))
+
+    live = []
+    for step in range(300):
+        op = rng.random()
+        if op < 0.55 or not live:
+            p = make_pod(f"a{step}", labels={"app": f"svc-{step % 4}"},
+                         node_name=f"n{rng.randrange(10)}")
+            if rng.random() < 0.7:
+                p.affinity = mk_affinity(rng.randrange(6))
+            cache.add_pod(p)
+            live.append(p)
+        elif op < 0.9:
+            p = live.pop(rng.randrange(len(live)))
+            cache.remove_pod(p)
+        else:
+            victim = f"n{rng.randrange(10)}"
+            if cache.snapshot.get(victim) is not None and len(cache.snapshot.node_infos) > 2:
+                cache.remove_node(victim)
+                live = [p for p in live if p.node_name != victim]
+        if step % 20 == 0:
+            mirror.sync()
+    mirror.sync()
+
+    pats = mirror.pats
+    assert (pats.counts >= 0).all()
+    col = pats.counts.astype(np.int64).sum(axis=0)
+    assert (col == pats._refs).all()
+    assert (pats.valid == (pats._refs > 0)).all()
+    for row in mirror._free_rows:
+        assert pats.counts[row].sum() == 0, f"stale pattern counts in free row {row}"
+    # per-(node, pattern-key) histograms equal a from-scratch compile
+    fresh = compile_existing_patterns(
+        mirror.vocab, cache.snapshot, mirror.row_of, mirror.nodes.capacity
+    )
+    for name, row in mirror.row_of.items():
+        mine = {
+            pats._key_of_row[s]: int(pats.counts[row, s])
+            for s in range(pats.capacity)
+            if pats.counts[row, s]
+        }
+        theirs = {
+            fresh._key_of_row[s]: int(fresh.counts[row, s])
+            for s in range(fresh.capacity)
+            if fresh.counts[row, s]
+        }
+        assert mine == theirs, (name, mine, theirs)
