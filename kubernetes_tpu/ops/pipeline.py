@@ -159,7 +159,9 @@ def _pod_axis(pa: Arrays, pb: Optional[Arrays]):
     return sig, pb["valid"], pb["priority"], sig.shape[0]
 
 
-@partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds", "n_buckets"))
+@partial(jax.jit, static_argnames=(
+    "deterministic", "config", "term_kinds", "n_buckets", "return_carry"
+))
 def solve_pipeline(
     na: Arrays,  # NodeBank arrays
     pa: Arrays,  # PodBatch arrays (one row per unique pod spec)
@@ -170,17 +172,37 @@ def solve_pipeline(
     ids: Arrays,  # interned constants (filters.make_ids)
     key,  # PRNG key for selectHost tie-breaks
     pb: Optional[Arrays] = None,  # per-pod axis: sig/valid/priority [B]
+    carry: Optional[Tuple] = None,  # (free, count, nz) from the PREVIOUS batch
     deterministic: bool = False,
     config: Optional[SolveConfig] = None,
     term_kinds: Optional[frozenset] = None,
     n_buckets: Optional[int] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """mask → score → greedy solve. Returns (assign [B], score [U, N])."""
+    return_carry: bool = False,
+):
+    """mask → score → greedy solve. Returns (assign [B], score [U, N])
+    (+ the post-batch (free, count, nz) residual carry when return_carry).
+
+    `carry` enables SPECULATIVE PIPELINING (SURVEY §2.3, the reference's
+    assume-then-async-bind applied to the solve): the previous batch's
+    device-computed residuals replace the pod-driven node columns
+    (requested/pod_count/nonzero_req), so this batch can be dispatched
+    before the host has committed the previous one. Node identity columns
+    (labels/taints/...) are untouched by pod commits, and the driver
+    re-solves from trued-up banks whenever a commit diverged from the
+    device's choice."""
+    if carry is not None:
+        free_in, count_in, nz_in = carry
+        na = {
+            **na,
+            "requested": na["alloc"] - free_in,
+            "pod_count": count_in,
+            "nonzero_req": nz_in,
+        }
     mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds, n_buckets)
     free0 = na["alloc"] - na["requested"]
     sig, pvalid, prio, b = _pod_axis(pa, pb)
     order = pop_order(prio, jnp.arange(b, dtype=jnp.int32), pvalid)
-    assign = solve_greedy(
+    result = solve_greedy(
         mask,
         score,
         pa["req"],
@@ -193,8 +215,14 @@ def solve_pipeline(
         req_any=pa["req_any"],
         sig=sig,
         pod_valid=pvalid,
+        return_carry=return_carry,
+        nz0=na["nonzero_req"].astype(free0.dtype) if return_carry else None,
+        scoring_req=pa["scoring_req"] if return_carry else None,
     )
-    return assign, score
+    if return_carry:
+        assign, carry_out = result
+        return assign, score, carry_out
+    return result, score
 
 
 @partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds", "n_buckets"))

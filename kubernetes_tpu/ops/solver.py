@@ -57,7 +57,7 @@ def tie_noise(rng_key, b: int, n: int) -> jnp.ndarray:
     return jax.vmap(lambda k: jax.random.uniform(k, (n,), dtype=jnp.float32))(keys)
 
 
-@partial(jax.jit, static_argnames=("deterministic", "chunk"))
+@partial(jax.jit, static_argnames=("deterministic", "chunk", "return_carry"))
 def solve_greedy(
     mask: jnp.ndarray,  # [U, N] feasibility from filter kernels (spec rows)
     score: jnp.ndarray,  # [U, N] weighted priority sums
@@ -72,7 +72,10 @@ def solve_greedy(
     sig: Optional[jnp.ndarray] = None,  # [B] pod → spec row (None: identity)
     pod_valid: Optional[jnp.ndarray] = None,  # [B] (None: all valid)
     chunk: int = DEFAULT_CHUNK,
-) -> jnp.ndarray:
+    return_carry: bool = False,
+    nz0: Optional[jnp.ndarray] = None,  # [N, 2] non-zero scoring accumulators
+    scoring_req: Optional[jnp.ndarray] = None,  # [U, 2] per-spec scoring request
+):
     """Greedy-by-priority batch assignment → node row per pod, -1 = no fit.
 
     BIT-IDENTICAL to scheduling the pods one at a time in `order` (the
@@ -113,9 +116,15 @@ def solve_greedy(
         noise = jnp.reshape(tie_noise(rng_key, B, N), (n_chunks, K, N))
     neg = jnp.iinfo(score.dtype).min
     jrange = jnp.arange(K)
+    # non-zero scoring accumulators ride the carry only when the caller
+    # wants the post-batch residual state back (speculative pipelining)
+    if nz0 is None:
+        nz0 = jnp.zeros((N, 2), free0.dtype)
+    if scoring_req is None:
+        scoring_req = jnp.zeros((U, 2), free0.dtype)
 
     def chunk_step(carry, inp):
-        free, count = carry
+        free, count, nzacc = carry
         idx, nz = inp  # [K] pod positions in order; [K, N] noise rows
         sg = sig[idx]
         pv = pod_valid[idx]
@@ -123,12 +132,13 @@ def solve_greedy(
         s_r = score[sg]
         r_q = req[sg]  # [K, R]
         r_any = req_any[sg]  # [K]
+        s_q = scoring_req[sg]  # [K, 2]
 
         def not_done(st):
-            return ~jnp.all(st[2])
+            return ~jnp.all(st[3])
 
         def body(st):
-            free, count, decided, choice = st
+            free, count, nzacc, decided, choice = st
             # PodFitsResources (predicates.go:854): the pod-count check
             # always applies; the resource rows only when the pod requests
             # anything, so empty-request pods pass even on overcommitted
@@ -178,22 +188,28 @@ def solve_greedy(
             count = count.at[target].add(
                 commit.astype(count.dtype), mode="drop"
             )
+            nzacc = nzacc.at[target].add(commit[:, None] * s_q, mode="drop")
             choice = jnp.where(commit, cand, choice)
             decided = decided | commit | newly_none
-            return free, count, decided, choice
+            return free, count, nzacc, decided, choice
 
         decided0 = ~pv  # padding/invalid pods are decided at -1
         choice0 = jnp.full((K,), -1, jnp.int32)
-        free, count, _, choice = jax.lax.while_loop(
-            not_done, body, (free, count, decided0, choice0)
+        free, count, nzacc, _, choice = jax.lax.while_loop(
+            not_done, body, (free, count, nzacc, decided0, choice0)
         )
-        return (free, count), choice
+        return (free, count, nzacc), choice
 
     order_c = jnp.reshape(order, (n_chunks, K))
-    (_, _), choices = jax.lax.scan(chunk_step, (free0, count0), (order_c, noise))
+    (free_f, count_f, nz_f), choices = jax.lax.scan(
+        chunk_step, (free0, count0, nz0), (order_c, noise)
+    )
     # scatter back to original pod positions
     out = jnp.full((B,), -1, jnp.int32)
-    return out.at[order].set(jnp.reshape(choices, (B,)))
+    out = out.at[order].set(jnp.reshape(choices, (B,)))
+    if return_carry:
+        return out, (free_f, count_f, nz_f)
+    return out
 
 
 @partial(jax.jit, static_argnames=("deterministic",))

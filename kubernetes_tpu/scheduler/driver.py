@@ -116,6 +116,10 @@ class SolveOutput:
     existing_overflow: bool  # existing pods' terms truncated → recheck all
     node_fallback_any: bool  # some node rows excluded from the fast path
     gang_ok: Optional[np.ndarray] = None  # [len(pods)] all-or-nothing verdict
+    # solved speculatively against the PREVIOUS batch's device residuals:
+    # topology/affinity counts are one batch stale, so LIGHT re-checks
+    # escalate to the full live-snapshot oracle check
+    speculative: bool = False
 
 
 class ExtenderError(Exception):
@@ -335,6 +339,7 @@ class Scheduler:
         volume_checker: Optional[Callable] = None,
         volume_binder=None,
         solve_config=None,
+        speculate: bool = True,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -382,6 +387,11 @@ class Scheduler:
         self._u_bucket = 16  # unique-spec axis (≤ _b_bucket)
         self._t_bucket = 16
         self._ids = None  # cached device constants (filters.make_ids)
+        # speculative pipelining state: the next batch's pre-dispatched solve
+        # (disp=None when only the pods were popped) + validity snapshot
+        self.speculate = speculate
+        self._spec_pending: Optional[Dict] = None
+        self._last_carry = None
         # per-phase wall-clock accumulators (the utiltrace/LogIfLong
         # equivalent; bench.py and metrics read these)
         self.stats: Dict[str, float] = {
@@ -403,6 +413,19 @@ class Scheduler:
     # -- device solve --------------------------------------------------------
 
     def _device_solve(self, infos: List[PodInfo]) -> SolveOutput:
+        return self._finish_solve(self._dispatch_solve(infos))
+
+    def _dispatch_solve(
+        self, infos: List[PodInfo], carry=None, allow_rebuild: bool = True
+    ) -> Dict:
+        """Encode + dispatch the device solve WITHOUT fetching the result.
+        `carry` is the previous batch's device residual tuple (speculative
+        pipelining); with it, the solve runs against the device's own
+        post-previous-batch state instead of the mirror's columns.
+        `allow_rebuild=False` (speculative dispatch) re-raises encoding
+        overflows instead of rebuilding: a rebuild remaps node rows while
+        the CURRENT batch's assignment (row-indexed) is still being
+        committed."""
         import jax
 
         from ..ops import filters as F
@@ -448,6 +471,8 @@ class Scheduler:
                     )
                 break
             except KeySlotOverflow:
+                if not allow_rebuild:
+                    raise
                 self.mirror._rebuild()
 
         # the per-POD axis: spec row, validity, queue priority. With a
@@ -525,7 +550,8 @@ class Scheduler:
         # gang/co-scheduling: group-annotated pods go through the
         # all-or-nothing two-pass solve (ops/solver.solve_gang)
         group_names = [pod_group_name(p) for p in pods]
-        gang_ok_arr = None
+        gang_dev = None
+        carry_out = None
         if any(group_names):
             from ..ops.pipeline import solve_pipeline_gang
 
@@ -539,37 +565,63 @@ class Scheduler:
                 config=self.solve_config, term_kinds=term_kinds,
                 n_buckets=n_buckets,
             )
-            assign, gang_ok = jax.device_get((assign, gang_ok))  # one transfer
-            gang_ok_arr = np.asarray(gang_ok)[: len(pods)]
+            gang_dev = gang_ok
         else:
             t_d = time.perf_counter()
-            assign, score = solve_pipeline(
-                *args, pb=pb, deterministic=self.deterministic,
+            assign, score, carry_out = solve_pipeline(
+                *args, pb=pb, carry=carry, deterministic=self.deterministic,
                 config=self.solve_config, term_kinds=term_kinds,
-                n_buckets=n_buckets,
+                n_buckets=n_buckets, return_carry=True,
             )
-            # dispatch_s = host upload + trace-cache lookup + enqueue (async);
-            # fetch_s = device execution + the [B] assign download
-            t_f = time.perf_counter()
-            self.stats["dispatch_s"] = self.stats.get("dispatch_s", 0.0) + (t_f - t_d)
-            assign = jax.device_get(assign)
-            self.stats["fetch_s"] = self.stats.get("fetch_s", 0.0) + (
-                time.perf_counter() - t_f
+            # dispatch_s = host upload + trace-cache lookup + enqueue (async)
+            self.stats["dispatch_s"] = self.stats.get("dispatch_s", 0.0) + (
+                time.perf_counter() - t_d
             )
-        n = len(pods)
-        sig_arr = np.asarray(sig_list, np.int32)
         self.stats["batch_specs"] = self.stats.get("batch_specs", 0) + len(reps)
-        out = SolveOutput(
+        self.stats["solve_s"] += time.perf_counter() - t1
+        return dict(
+            infos=infos,
+            pods=pods,
+            batch=batch,
+            aux=aux,
+            sig_arr=np.asarray(sig_list, np.int32),
+            assign_dev=assign,
+            score_dev=score,
+            gang_dev=gang_dev,
+            carry_dev=carry_out,
+            existing_overflow=existing_overflow,
+            speculative=carry is not None,
+        )
+
+    def _finish_solve(self, disp: Dict) -> SolveOutput:
+        """Fetch the dispatched solve's assignment and build SolveOutput."""
+        import jax
+
+        t0 = time.perf_counter()
+        pods = disp["pods"]
+        n = len(pods)
+        sig_arr = disp["sig_arr"]
+        gang_ok_arr = None
+        if disp["gang_dev"] is not None:
+            assign, gang_ok = jax.device_get((disp["assign_dev"], disp["gang_dev"]))
+            gang_ok_arr = np.asarray(gang_ok)[:n]
+        else:
+            # fetch_s = device execution + the [B] assign download
+            assign = jax.device_get(disp["assign_dev"])
+        dt = time.perf_counter() - t0
+        self.stats["fetch_s"] = self.stats.get("fetch_s", 0.0) + dt
+        self.stats["solve_s"] += dt
+        batch = disp["batch"]
+        return SolveOutput(
             assign=np.asarray(assign)[:n],
             fallback=np.asarray(batch.fallback)[sig_arr],
-            score=ScoreRows(score, sig_arr),
-            has_anti=np.asarray(aux["has_anti"])[sig_arr],
-            existing_overflow=existing_overflow,
+            score=ScoreRows(disp["score_dev"], sig_arr),
+            has_anti=np.asarray(disp["aux"]["has_anti"])[sig_arr],
+            existing_overflow=disp["existing_overflow"],
             node_fallback_any=bool((self.mirror.nodes.fallback & self.mirror.nodes.valid).any()),
             gang_ok=gang_ok_arr,
+            speculative=disp["speculative"],
         )
-        self.stats["solve_s"] += time.perf_counter() - t1
-        return out
 
     def _pod_extenders(self, pod: Pod) -> List:
         """Extenders interested in this pod (IsInterested,
@@ -886,15 +938,50 @@ class Scheduler:
         self.event_fn(pod, "Nominated", node)
         return True
 
+    def _speculative_dispatch(self, max_pods: Optional[int]) -> Optional[Dict]:
+        """Pop the next batch and (when it is speculation-safe) dispatch its
+        solve against the current batch's device residual carry. Returns the
+        pending entry, or None when the queue is empty. disp=None means the
+        pods are popped but must be solved fresh next cycle."""
+        infos_next = self.queue.pop_batch(max_pods or self.batch_size)
+        if not infos_next:
+            return None
+        # sentinel validity: until the commit loop blesses the entry, a
+        # consumer falls back to a fresh solve (and an exception mid-commit
+        # cannot lose the popped pods — the entry is already pending)
+        entry: Dict = {
+            "infos": infos_next,
+            "disp": None,
+            "mutation_gen": -1,
+            "rebuild_count": -1,
+            "dispatch_gen": self.cache.mutation_count,
+        }
+        if any(pod_group_name(i.pod) for i in infos_next):
+            return entry  # gang batches need the all-or-nothing path
+        try:
+            disp = self._dispatch_solve(
+                infos_next, carry=self._last_carry, allow_rebuild=False
+            )
+        except Exception:
+            return entry  # encode trouble (e.g. overflow): solve fresh next cycle
+        entry["disp"] = disp
+        return entry
+
     # -- main loop -----------------------------------------------------------
 
     def schedule_batch(self, max_pods: Optional[int] = None) -> ScheduleResult:
         res = ScheduleResult()
-        infos = self.queue.pop_batch(max_pods or self.batch_size)
+        pending = self._spec_pending
+        self._spec_pending = None
+        if pending is not None:
+            infos = pending["infos"]
+        else:
+            infos = self.queue.pop_batch(max_pods or self.batch_size)
         if not infos:
             return res
         # gang completeness: every QUEUED member of any group present in the
         # batch joins it, so all-or-nothing is decided over the whole group
+        # (a speculated batch never contains gang pods — gated at dispatch)
         groups_in_batch = {
             g for g in (pod_group_name(i.pod) for i in infos) if g
         }
@@ -910,9 +997,27 @@ class Scheduler:
         self.stats["sync_s"] += dt_sync
         M.tensor_sync_duration.observe(dt_sync)
         trace.step("tensor mirror sync")
+        # a speculated solve is consumable only if nothing it could not have
+        # accounted for happened since dispatch: no cache mutations beyond
+        # the previous batch's own commits, and no bank rebuild (row remap)
+        use_pending = (
+            pending is not None
+            and pending["disp"] is not None
+            and pending["mutation_gen"] == self.cache.mutation_count
+            and pending["rebuild_count"] == self.mirror.rebuild_count
+        )
         try:
             t_solve = time.perf_counter()
-            out = self._device_solve(infos)
+            if use_pending:
+                self.stats["spec_hits"] = self.stats.get("spec_hits", 0) + 1
+                out = self._finish_solve(pending["disp"])
+                self._last_carry = pending["disp"]["carry_dev"]
+            else:
+                if pending is not None:
+                    self.stats["spec_misses"] = self.stats.get("spec_misses", 0) + 1
+                disp = self._dispatch_solve(infos)
+                out = self._finish_solve(disp)
+                self._last_carry = disp["carry_dev"]
             dt_solve = time.perf_counter() - t_solve
             M.device_solve_duration.observe(dt_solve)
             # the mask and score stages are ONE fused program — both series
@@ -928,6 +1033,20 @@ class Scheduler:
                 self._fail(info, cycle, f"solve error: {e}")
             M.schedule_attempts.inc(M.ERROR, by=len(infos))
             return res
+        # SPECULATIVE PIPELINING (the reference's assume-then-async-bind
+        # discipline applied to the solve, SURVEY §2.3): pop and dispatch the
+        # NEXT batch against this batch's device-computed residual carry
+        # BEFORE committing this one — the device solves k+1 while the host
+        # commits k. The dispatch is optimistic; after the commit loop the
+        # pending entry is kept only if nothing diverged, and consumption
+        # re-validates against cache mutations / bank rebuilds.
+        spec_next = None
+        if self.speculate and out.gang_ok is None and self._last_carry is not None:
+            spec_next = self._speculative_dispatch(max_pods)
+            # pending from this moment: if the commit loop below raises, the
+            # popped pods survive (consumed with the never-matching sentinel
+            # validity, i.e. solved fresh)
+            self._spec_pending = spec_next
 
         nominated_fn = self.queue.nominated_pods_for_node
         fw = self.framework
@@ -1017,6 +1136,9 @@ class Scheduler:
                 or out.existing_overflow
                 or host_filter
                 or level == RECHECK_FULL
+                # speculative solve: topology/port counts are one batch
+                # stale — LIGHT pods escalate to the live-snapshot check
+                or (out.speculative and level == RECHECK_LIGHT)
                 or (
                     self.volume_checker is not None
                     and bool(scheduling_relevant_volumes(pod))
@@ -1182,6 +1304,25 @@ class Scheduler:
                 res.scheduled += 1
                 res.assignments[s_info.pod.key()] = s_node
         self.stats["commit_s"] += time.perf_counter() - t_commit
+        if spec_next is not None:
+            # keep the speculated solve only if this batch went exactly the
+            # way the device predicted: every commit on the device's node
+            # (residual carry exact), no preemption/error side effects, and
+            # no new required-anti pattern the speculated masks missed
+            if (
+                residuals_diverged
+                or res.errors
+                or res.preempted
+                or conflict_index.any_anti
+            ):
+                spec_next["disp"] = None
+            # the blessed mutation level = the level at dispatch plus this
+            # batch's own commits (one assume each); anything else — foreign
+            # pods, async bind failures, informer events — lands on top and
+            # fails the equality check at consume time
+            spec_next["mutation_gen"] = spec_next["dispatch_gen"] + res.scheduled
+            spec_next["rebuild_count"] = self.mirror.rebuild_count
+            self._spec_pending = spec_next
         trace.step("commit loop")
         M.scheduling_algorithm_duration.observe(trace.total_seconds())
         M.schedule_attempts.inc(M.SCHEDULED, by=res.scheduled)

@@ -83,6 +83,9 @@ class SchedulerCache:
         self._assumed: Set[str] = set()
         self.dirty_nodes: Set[str] = set()  # generation-equivalent dirty set
         self.removed_nodes: Set[str] = set()
+        # bumped on every snapshot mutation — the driver's speculative
+        # pipeline uses it to detect state changes it did not account for
+        self.mutation_count = 0
         # (node, pod, ±1) single-pod changes (assume/confirm/remove) — the
         # overwhelmingly common event; consumed by TensorMirror.sync
         self.pod_deltas: List[Tuple[str, Pod, int]] = []
@@ -106,8 +109,10 @@ class SchedulerCache:
             ni.node.labels = {}
             ni.add_pod(pod)
             self.dirty_nodes.add(pod.node_name)
+            self.mutation_count += 1
             return
         ni.add_pod(pod)
+        self.mutation_count += 1
         # single-pod change: a DELTA, not node dirt — the mirror patches the
         # node row + signature/pattern counts in O(1) instead of re-counting
         # every pod on the node
@@ -119,6 +124,7 @@ class SchedulerCache:
             return
         removed = ni.remove_pod_key(pod.key())
         if removed is not None:
+            self.mutation_count += 1
             self._push_delta(pod.node_name, removed, -1)
 
     def _push_delta(self, name: str, pod: Pod, sign: int) -> None:
@@ -240,6 +246,7 @@ class SchedulerCache:
                 ni.node = node  # was a headless placeholder
             self.dirty_nodes.add(node.name)
             self.removed_nodes.discard(node.name)
+            self.mutation_count += 1
 
     def update_node(self, node: Node) -> None:
         self.add_node(node)
@@ -254,6 +261,7 @@ class SchedulerCache:
                     self._assumed.discard(p.key())
             self.dirty_nodes.discard(name)
             self.removed_nodes.add(name)
+            self.mutation_count += 1
 
     def node_order(self) -> List[str]:
         """Zone-interleaved iteration order (NodeTree.Next semantics) for

@@ -201,3 +201,69 @@ def test_sequential_equivalence_random_clusters(seed):
 def test_sequential_equivalence_affinity_heavy(seed):
     # high feature rate → most pods carry affinity/anti-affinity/spread
     _assert_sequential_equivalent(seed, feature_rate=0.9)
+
+
+def test_speculative_pipeline_matches_non_speculative():
+    """Speculation on vs off must produce identical assignments when the
+    workload follows device choices (plain resource pods), and the
+    speculative path must actually engage (spec_hits > 0)."""
+
+    def build(speculate):
+        cache = SchedulerCache()
+        for i in range(24):
+            cache.add_node(make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30))
+        queue = PriorityQueue()
+        binds = {}
+        sched = Scheduler(
+            cache=cache, queue=queue,
+            binder=Binder(lambda p, n: binds.__setitem__(p.key(), n)),
+            batch_size=32, deterministic=True, enable_preemption=False,
+            speculate=speculate,
+        )
+        for i in range(160):
+            queue.add(make_pod(f"p{i}", cpu_milli=300, mem=2**20))
+        r = sched.run_until_empty()
+        sched.wait_for_binds()
+        return r, binds, sched
+
+    r_on, binds_on, s_on = build(True)
+    r_off, binds_off, _ = build(False)
+    assert r_on.scheduled == r_off.scheduled == 160
+    assert binds_on == binds_off
+    assert s_on.stats.get("spec_hits", 0) >= 3, s_on.stats
+
+
+def test_speculation_invalidated_by_anti_affinity_commits():
+    """A batch that commits required anti-affinity pods must not hand its
+    (stale-pattern) speculated solve to the next batch — and the final
+    placements must still respect anti-affinity across batches."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+
+    HOST = "kubernetes.io/hostname"
+    cache = SchedulerCache()
+    for i in range(8):
+        cache.add_node(make_node(f"n{i}", labels={HOST: f"n{i}"}))
+    queue = PriorityQueue()
+    binds = {}
+    sched = Scheduler(
+        cache=cache, queue=queue,
+        binder=Binder(lambda p, n: binds.__setitem__(p.key(), n)),
+        batch_size=4, deterministic=True, enable_preemption=False,
+    )
+    term = PodAffinityTerm(
+        label_selector=LabelSelector(match_labels={"app": "solo"}),
+        topology_key=HOST,
+    )
+    for i in range(6):
+        p = make_pod(f"solo-{i}", labels={"app": "solo"})
+        p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[term]))
+        queue.add(p)
+    r = sched.run_until_empty()
+    sched.wait_for_binds()
+    assert r.scheduled == 6
+    assert len(set(binds.values())) == 6, binds  # one host each, across batches
