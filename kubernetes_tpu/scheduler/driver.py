@@ -392,6 +392,10 @@ class Scheduler:
         self.speculate = speculate
         self._spec_pending: Optional[Dict] = None
         self._last_carry = None
+        # anti-affinity-heavy workloads invalidate every speculation (each
+        # batch commits new anti patterns): after an invalidation, skip a
+        # few dispatches instead of paying wasted encode+device work
+        self._spec_backoff = 0
         # per-phase wall-clock accumulators (the utiltrace/LogIfLong
         # equivalent; bench.py and metrics read these)
         self.stats: Dict[str, float] = {
@@ -1042,11 +1046,14 @@ class Scheduler:
         # re-validates against cache mutations / bank rebuilds.
         spec_next = None
         if self.speculate and out.gang_ok is None and self._last_carry is not None:
-            spec_next = self._speculative_dispatch(max_pods)
-            # pending from this moment: if the commit loop below raises, the
-            # popped pods survive (consumed with the never-matching sentinel
-            # validity, i.e. solved fresh)
-            self._spec_pending = spec_next
+            if self._spec_backoff > 0:
+                self._spec_backoff -= 1
+            else:
+                spec_next = self._speculative_dispatch(max_pods)
+                # pending from this moment: if the commit loop below raises,
+                # the popped pods survive (consumed with the never-matching
+                # sentinel validity, i.e. solved fresh)
+                self._spec_pending = spec_next
 
         nominated_fn = self.queue.nominated_pods_for_node
         fw = self.framework
@@ -1316,6 +1323,9 @@ class Scheduler:
                 or conflict_index.any_anti
             ):
                 spec_next["disp"] = None
+                self._spec_backoff = 4
+            else:
+                self._spec_backoff = 0
             # the blessed mutation level = the level at dispatch plus this
             # batch's own commits (one assume each); anything else — foreign
             # pods, async bind failures, informer events — lands on top and
