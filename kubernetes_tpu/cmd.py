@@ -43,6 +43,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument("--deterministic", action="store_true")
     p.add_argument(
+        "--profile-dir",
+        help="sim mode: write a JAX profiler trace (TensorBoard format) for "
+        "the run — the device-side half of the reference's "
+        "pprof/EnableProfiling surface (cmd/kube-scheduler/app/server.go:"
+        "307-316); the host-side half is utils.trace's 100ms slow-cycle "
+        "logging. Ignored for the long-lived extender server (a whole-"
+        "lifetime trace grows without bound and is lost on SIGTERM).",
+    )
+    p.add_argument(
         "--services-file",
         help="JSON list of core/v1 Services (scheduling-visible selector "
              "subset) backing Policy serviceAffinity/serviceAntiAffinity",
@@ -235,10 +244,20 @@ def run_sim(args) -> int:
 
 
 def main(argv: Optional[list] = None) -> int:
+    import contextlib
+
     args = build_parser().parse_args(argv)
-    if args.mode == "extender":
-        return run_extender(args)
-    return run_sim(args)
+    ctx = contextlib.nullcontext()
+    if args.profile_dir and args.mode == "sim":
+        import jax
+
+        ctx = jax.profiler.trace(args.profile_dir)
+    elif args.profile_dir:
+        print("--profile-dir ignored in extender mode", file=sys.stderr)
+    with ctx:
+        if args.mode == "extender":
+            return run_extender(args)
+        return run_sim(args)
 
 
 if __name__ == "__main__":
