@@ -5,6 +5,7 @@ See mesh.py for the mesh layout rationale and sharded.py for the two-stage
 """
 
 from .mesh import AXIS_NODES, AXIS_PODS, node_mesh, node_shards
+from .multihost import init_distributed, multihost_node_mesh
 from .sharded import make_sharded_pipeline
 
 __all__ = [
@@ -13,4 +14,6 @@ __all__ = [
     "node_mesh",
     "node_shards",
     "make_sharded_pipeline",
+    "init_distributed",
+    "multihost_node_mesh",
 ]

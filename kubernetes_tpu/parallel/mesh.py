@@ -54,8 +54,13 @@ def node_mesh(
         devs = devs[:n_devices]
     if len(devs) % pods_parallel != 0:
         raise ValueError(f"pods_parallel={pods_parallel} does not divide {len(devs)} devices")
-    grid = np.asarray(devs, dtype=object).reshape(pods_parallel, -1)
-    return Mesh(grid, (AXIS_PODS, AXIS_NODES))
+    # jax.devices() is process-major: consecutive devices share a host. The
+    # NODE axis must vary over consecutive devices so that, on multi-host
+    # slices, the pods axis (which gathers the [B, N] mask/score matrices,
+    # sharded.py) stays intra-host/ICI and only the node-axis election
+    # reductions cross DCN.
+    grid = np.asarray(devs, dtype=object).reshape(-1, pods_parallel).T
+    return Mesh(np.ascontiguousarray(grid), (AXIS_PODS, AXIS_NODES))
 
 
 def node_shards(mesh: Mesh) -> int:

@@ -1,0 +1,65 @@
+"""Multi-host (DCN) scale-out for the sharded solve.
+
+SURVEY §2.4: the reference's control plane scales across machines through
+the apiserver's watch fan-out; the TPU build's analogue is sharding the
+NODE axis of the solve across every chip of every host. Within a host the
+solver's election collectives ride ICI; across hosts they ride DCN. The
+layout is deliberately node-major:
+
+  * per-node state (bank rows, residual carry columns, signature/pattern
+    count rows) lives on exactly ONE chip of ONE host — residual updates
+    and acceptance prefix sums never cross a link;
+  * the only cross-host traffic per chunk-repair iteration is the [K]-wide
+    pmax/pmin election reductions (ops are identical over ICI and DCN —
+    XLA routes them), tens of rounds per 1024-pod batch;
+  * the host-side driver runs on process 0 (the elected leader,
+    utils.leaderelection); follower processes run the same program under
+    jax.distributed and participate only in collectives, mirroring the
+    reference's active-passive scheduler replicas (leaderelection.go:197)
+    with the ACTIVE computation data-parallel over every host's chips.
+
+This module only wires jax.distributed + the mesh; the pipeline itself is
+parallel.sharded.make_sharded_pipeline, which is mesh-shape agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from .mesh import node_mesh
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    autodetect: bool = False,
+) -> int:
+    """Initialize the JAX distributed runtime (DCN) and return this
+    process's id. Explicit coordinator/process arguments initialize a
+    fixed-size cluster; `autodetect=True` defers to JAX's standard
+    cluster-environment detection (TPU pod metadata, SLURM, ...). The
+    default — no arguments — is a deliberate single-process no-op so local
+    runs and tests need no cluster environment."""
+    if autodetect:
+        jax.distributed.initialize()
+    elif num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return jax.process_index()
+
+
+def multihost_node_mesh(pods_axis: int = 1) -> Mesh:
+    """Mesh over EVERY device of every connected host — a thin alias of
+    mesh.node_mesh, which already lays the node axis over consecutive
+    (same-host) devices so the pods axis stays intra-host/ICI and only the
+    node-axis election reductions cross DCN. Node capacity
+    (state/tensors._node_bucket: power of two up to 2048, multiples of
+    2048 above) divides any power-of-two total shard count."""
+    return node_mesh(pods_parallel=pods_axis)

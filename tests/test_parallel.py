@@ -147,3 +147,21 @@ def test_sharded_chunked_contention_multi_chunk():
         got = np.asarray(jnp.full((B,), -1, jnp.int32).at[order].set(choices))
         assert (got == expect).all(), (det, np.nonzero(got != expect))
         assert (got == -1).sum() > 0  # contention actually rejected pods
+
+
+def test_multihost_mesh_single_process():
+    """multihost_node_mesh over the 8 virtual devices + the sharded solve:
+    the DCN wiring is a plain Mesh, so the single-process path must produce
+    the same bit-identical assignment as the 1D node mesh."""
+    from kubernetes_tpu.parallel.multihost import init_distributed, multihost_node_mesh
+
+    assert init_distributed() == 0  # single-process no-op path
+    mesh = multihost_node_mesh(pods_axis=2)
+    assert mesh.shape["nodes"] == 4 and mesh.shape["pods"] == 2
+    args = _encode(seed=3)
+    key = jax.random.PRNGKey(3)
+    want_assign, want_score = solve_pipeline(*args, key, deterministic=True)
+    sharded = make_sharded_pipeline(mesh)
+    got_assign, got_score = sharded(*args, key, deterministic=True)
+    assert np.array_equal(np.asarray(want_assign), np.asarray(got_assign))
+    assert np.array_equal(np.asarray(want_score), np.asarray(got_score))
