@@ -55,10 +55,10 @@ def node_mesh(
     if len(devs) % pods_parallel != 0:
         raise ValueError(f"pods_parallel={pods_parallel} does not divide {len(devs)} devices")
     # jax.devices() is process-major: consecutive devices share a host. The
-    # NODE axis must vary over consecutive devices so that, on multi-host
-    # slices, the pods axis (which gathers the [B, N] mask/score matrices,
-    # sharded.py) stays intra-host/ICI and only the node-axis election
-    # reductions cross DCN.
+    # PODS axis gets the stride-1 (same-host) devices so its [B, N]
+    # mask/score gathers (sharded.py) ride ICI on multi-host slices; the
+    # node axis strides across hosts, and the only DCN traffic is its tiny
+    # election reductions. grid[p, n] = devs[n * pods_parallel + p].
     grid = np.asarray(devs, dtype=object).reshape(-1, pods_parallel).T
     return Mesh(np.ascontiguousarray(grid), (AXIS_PODS, AXIS_NODES))
 

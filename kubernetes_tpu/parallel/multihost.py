@@ -57,9 +57,10 @@ def init_distributed(
 
 def multihost_node_mesh(pods_axis: int = 1) -> Mesh:
     """Mesh over EVERY device of every connected host — a thin alias of
-    mesh.node_mesh, which already lays the node axis over consecutive
-    (same-host) devices so the pods axis stays intra-host/ICI and only the
-    node-axis election reductions cross DCN. Node capacity
+    mesh.node_mesh, which gives the pods axis the consecutive (same-host)
+    devices so its [B, N] gathers stay intra-host/ICI, while the node axis
+    strides across hosts and only its tiny election reductions cross DCN.
+    Node capacity
     (state/tensors._node_bucket: power of two up to 2048, multiples of
     2048 above) divides any power-of-two total shard count."""
     return node_mesh(pods_parallel=pods_axis)

@@ -788,10 +788,15 @@ class Scheduler:
         self._fail(info, cycle, msg)
 
     def _finalize_commit(
-        self, info: PodInfo, assumed: Pod, node_name: str, cycle: int, state: CycleState
+        self, info: PodInfo, assumed: Pod, node_name: str, cycle: int,
+        state: CycleState, defer: Optional[List] = None,
     ) -> None:
         """Second half: submit the async permit → prebind → bind → postbind
-        pipeline (scheduler.go:631-743)."""
+        pipeline (scheduler.go:631-743). With `defer`, the pipeline closure
+        is appended there instead of submitted — the caller batches
+        closures into chunked pool submissions (a ThreadPoolExecutor
+        submit costs ~100µs of Future/Event bookkeeping; one per POD was
+        ~10%% of the whole commit loop)."""
         pod = info.pod
         t_decided = time.perf_counter()
 
@@ -846,10 +851,14 @@ class Scheduler:
             self.framework.run_post_bind(state, pod, node_name)
             self.event_fn(pod, "Scheduled", f"bound to {node_name}")
 
-        self._bind_pool.submit(bind_async)
+        if defer is not None:
+            defer.append(bind_async)
+        else:
+            self._bind_pool.submit(bind_async)
 
     def _commit(
-        self, info: PodInfo, node_name: str, cycle: int, state: Optional[CycleState] = None
+        self, info: PodInfo, node_name: str, cycle: int,
+        state: Optional[CycleState] = None, defer: Optional[List] = None,
     ) -> bool:
         """reserve → assume → async(permit → prebind → bind → postbind).
         `state` is the pod's CycleState carried from PreFilter onward, so
@@ -859,7 +868,7 @@ class Scheduler:
         assumed = self._prepare_commit(info, node_name, cycle, state)
         if assumed is None:
             return False
-        self._finalize_commit(info, assumed, node_name, cycle, state)
+        self._finalize_commit(info, assumed, node_name, cycle, state, defer=defer)
         return True
 
     def _unbind(self, info: PodInfo, assumed: Pod, node_name: str, state, cycle: int, msg: str) -> None:
@@ -1102,6 +1111,7 @@ class Scheduler:
                 residuals_diverged = True  # staged capacity released
 
         t_commit = time.perf_counter()
+        bind_jobs: List = []  # deferred bind pipelines, chunk-submitted below
 
         # commit in pop order so oracle re-checks see earlier assumes,
         # reproducing sequential semantics. pop_batch pops the activeQ heap,
@@ -1283,7 +1293,7 @@ class Scheduler:
                         conflict_index.add_anti(pod, c_node.node)
                 if node_name != device_choice:
                     residuals_diverged = True
-            elif self._commit(info, node_name, cycle, state):
+            elif self._commit(info, node_name, cycle, state, defer=bind_jobs):
                 res.scheduled += 1
                 res.assignments[pod.key()] = node_name
                 c_node = self.cache.snapshot.get(node_name)
@@ -1307,9 +1317,33 @@ class Scheduler:
                 rollback_group(g)
                 continue
             for s_info, s_assumed, s_node, s_state in members:
-                self._finalize_commit(s_info, s_assumed, s_node, cycle, s_state)
+                self._finalize_commit(
+                    s_info, s_assumed, s_node, cycle, s_state, defer=bind_jobs
+                )
                 res.scheduled += 1
                 res.assignments[s_info.pod.key()] = s_node
+        # chunked submission: ceil(len/workers) pipelines per pool task
+        # keeps the ~100µs-per-submit overhead off the commit loop while
+        # still spreading the chunks across every worker (IO-bound binders
+        # keep their concurrency). Permit plugins can WAIT on other pods'
+        # allow() (framework/interface.py waiting pods) — sequentializing
+        # those would deadlock a chunk, so they keep per-pod submission.
+        if bind_jobs:
+            if self.framework.has_plugins("permit"):
+                for f in bind_jobs:
+                    self._bind_pool.submit(f)
+            else:
+
+                def _run_chunk(chunk):
+                    for f in chunk:
+                        try:
+                            f()
+                        except Exception:  # one failed bind must not
+                            pass  # abort the rest (each f fails its own pod)
+
+                step = max(1, -(-len(bind_jobs) // self._bind_workers))
+                for i in range(0, len(bind_jobs), step):
+                    self._bind_pool.submit(_run_chunk, bind_jobs[i : i + step])
         self.stats["commit_s"] += time.perf_counter() - t_commit
         if spec_next is not None:
             # keep the speculated solve only if this batch went exactly the
