@@ -242,6 +242,16 @@ class Pod:
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
 
+    def with_node(self, node_name: str) -> "Pod":
+        """Shallow clone bound to a node — the assume-path equivalent of
+        dataclasses.replace(pod, node_name=...), but ~20x cheaper (replace
+        re-runs __init__ over every field; the commit loop pays it once
+        per pod) and it carries the resource-request memo along."""
+        clone = object.__new__(Pod)
+        clone.__dict__.update(self.__dict__)
+        clone.node_name = node_name
+        return clone
+
     def get_priority(self) -> int:
         """podutil.GetPodPriority: nil priority -> 0."""
         return self.priority if self.priority is not None else DEFAULT_POD_PRIORITY

@@ -760,9 +760,7 @@ class Scheduler:
                 self.volume_binder.forget_pod_volumes(pod)
             self._fail(info, cycle, f"reserve: {st.message}")
             return None
-        import dataclasses
-
-        assumed = dataclasses.replace(pod, node_name=node_name)
+        assumed = pod.with_node(node_name)
         try:
             self.cache.assume_pod(assumed)
         except ValueError:

@@ -99,12 +99,10 @@ def fits_with_nominees(
 ) -> bool:
     """The with-nominated-pods pass alone (callers have already verified the
     plain pass)."""
-    import dataclasses
-
     shadow = _shadow_one(snapshot, node_name)
     sni = shadow.get(node_name)
     for p in nominees:
-        sni.add_pod(dataclasses.replace(p, node_name=node_name))
+        sni.add_pod(p.with_node(node_name))
     meta2 = compute_predicate_metadata(pod, shadow, enabled=enabled)
     return pod_fits_on_node(pod, sni, meta=meta2)[0]
 
