@@ -3,11 +3,12 @@
 The reference schedules strictly one pod per cycle (scheduleOne,
 scheduler.go:579): filter -> score -> selectHost -> assume, with the cache
 mutated between pods. Here a whole BATCH of pending pods is solved in one
-compiled XLA program: a lax.scan walks the pods in the same order the
+compiled XLA program, bit-identical to walking the pods in the order the
 reference's queue would pop them (priority desc, then enqueue time asc —
-internal/queue/scheduling_queue.go activeQ comparator), committing each pod
-to its best feasible node and updating the resource residuals in the scan
-carry. One device dispatch replaces B scheduling cycles.
+internal/queue/scheduling_queue.go activeQ comparator): chunks of pods
+choose nodes vectorized, per-node in-order prefix sums accept everything
+up to the first misfit, and the rest repair against updated residuals.
+One device dispatch replaces B scheduling cycles.
 
 Intra-batch semantics contract:
 * Resources and pod counts are EXACT within the batch (the carry).

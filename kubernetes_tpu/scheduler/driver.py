@@ -5,10 +5,12 @@ snapshot → filter → score → selectHost → reserve → assume → async(pe
 prebind → bind → postbind). This driver keeps exactly that lifecycle and
 extension-hook order but amortizes the expensive middle across a BATCH:
 
-    pop_batch → TensorMirror.sync (dirty-row patch) → device kernels
-    (filter+score+topology matrices) → lax.scan greedy solve →
-    per-pod commit: [oracle re-check if topology-coupled] → reserve →
-    assume → async bind pipeline
+    pop_batch → TensorMirror.sync (dirty rows + pod deltas) → device
+    kernels (filter+score+topology matrices over deduped spec rows) →
+    chunked greedy solve → per-pod commit: [oracle re-check if
+    topology-coupled] → reserve → assume → async bind pipeline, with the
+    NEXT batch's solve speculatively dispatched against the device's own
+    residual carry before this batch commits
 
 Failure handling mirrors MakeDefaultErrorFunc (factory.go:646): failed /
 unfitting pods go back through AddUnschedulableIfNotPresent with the cycle
