@@ -269,10 +269,116 @@ class PodAffinityMetadata:
     incoming_anti_pairs: Set[Tuple[str, str]] = field(default_factory=set)
 
 
-def compute_pod_affinity_metadata(pod: Pod, snapshot: Snapshot) -> PodAffinityMetadata:
+class SnapshotAffinityIndex:
+    """The pod-independent structure of the affinity metadata, built ONCE
+    per snapshot epoch instead of re-walking the cluster for every pod:
+
+    * existing pods' required anti-affinity terms grouped by CONTENT
+      (namespace set, selector, topology key) with the set of topology
+      values their hosting nodes carry — one selector match per distinct
+      term content instead of one per (pod, term) instance;
+    * existing pods grouped by (namespace, labels) signature with their
+      hosting nodes' label dicts — the incoming pod's own terms match one
+      group representative instead of every pod.
+
+    Exactness: both halves of compute_pod_affinity_metadata depend on an
+    existing pod only through its namespace + labels (and its node's
+    labels), so grouping by those is a pure dedup. Callers that mutate the
+    snapshot after building (the driver's in-batch commits) pass the new
+    pods through `extra`, which replays the original per-pod logic."""
+
+    def __init__(self, snapshot: Snapshot):
+        self.anti_groups: Dict[tuple, dict] = {}
+        self.pod_groups: Dict[tuple, dict] = {}
+        for ni in snapshot.node_infos.values():
+            labels = ni.node.labels
+            for ep in ni.pods_with_affinity():
+                for term in get_pod_anti_affinity_terms(ep.affinity):
+                    v = labels.get(term.topology_key)
+                    if v is None:
+                        continue
+                    nss = (
+                        tuple(sorted(term.namespaces))
+                        if term.namespaces
+                        else ep.namespace
+                    )
+                    key = (nss, repr(term.label_selector), term.topology_key)
+                    g = self.anti_groups.get(key)
+                    if g is None:
+                        self.anti_groups[key] = g = {
+                            "term": term,
+                            "ep": ep,
+                            "values": set(),
+                        }
+                    g["values"].add(v)
+            for ep in ni.pods:
+                key = (ep.namespace, tuple(sorted(ep.labels.items())))
+                g = self.pod_groups.get(key)
+                if g is None:
+                    self.pod_groups[key] = g = {"ep": ep, "nodes": []}
+                g["nodes"].append(labels)
+
+
+def _affinity_pairs_for_pod(
+    m: PodAffinityMetadata,
+    pod: Pod,
+    ep: Pod,
+    node_labels: Dict[str, str],
+    affinity_terms,
+    anti_terms,
+) -> None:
+    """The original per-(existing pod, node) metadata contribution — used
+    for index `extra` entries (in-batch commits)."""
+    for term in get_pod_anti_affinity_terms(ep.affinity):
+        if pod_matches_term(pod, ep, term) and term.topology_key in node_labels:
+            m.existing_anti_pairs.add((term.topology_key, node_labels[term.topology_key]))
+    if affinity_terms and pod_matches_all_term_properties(ep, pod, affinity_terms):
+        for term in affinity_terms:
+            if term.topology_key in node_labels:
+                m.incoming_affinity_pairs.add(
+                    (term.topology_key, node_labels[term.topology_key])
+                )
+    for term in anti_terms:
+        if pod_matches_term(ep, pod, term) and term.topology_key in node_labels:
+            m.incoming_anti_pairs.add((term.topology_key, node_labels[term.topology_key]))
+
+
+def compute_pod_affinity_metadata(
+    pod: Pod,
+    snapshot: Snapshot,
+    index: Optional[SnapshotAffinityIndex] = None,
+    extra=(),
+) -> PodAffinityMetadata:
     m = PodAffinityMetadata()
     affinity_terms = get_pod_affinity_terms(pod.affinity)
     anti_terms = get_pod_anti_affinity_terms(pod.affinity)
+
+    if index is not None:
+        # grouped fast path: one match per distinct term content / pod
+        # signature (see SnapshotAffinityIndex)
+        for g in index.anti_groups.values():
+            if pod_matches_term(pod, g["ep"], g["term"]):
+                tk = g["term"].topology_key
+                for v in g["values"]:
+                    m.existing_anti_pairs.add((tk, v))
+        if affinity_terms or anti_terms:
+            for g in index.pod_groups.values():
+                rep = g["ep"]
+                if affinity_terms and pod_matches_all_term_properties(rep, pod, affinity_terms):
+                    for term in affinity_terms:
+                        for labels in g["nodes"]:
+                            v = labels.get(term.topology_key)
+                            if v is not None:
+                                m.incoming_affinity_pairs.add((term.topology_key, v))
+                for term in anti_terms:
+                    if pod_matches_term(rep, pod, term):
+                        for labels in g["nodes"]:
+                            v = labels.get(term.topology_key)
+                            if v is not None:
+                                m.incoming_anti_pairs.add((term.topology_key, v))
+        for ep, node_labels in extra:
+            _affinity_pairs_for_pod(m, pod, ep, node_labels, affinity_terms, anti_terms)
+        return m
 
     for ni in snapshot.node_infos.values():
         node = ni.node
@@ -393,7 +499,11 @@ class PredicateMetadata:
 
 
 def compute_predicate_metadata(
-    pod: Pod, snapshot: Snapshot, enabled: Optional[frozenset] = None
+    pod: Pod,
+    snapshot: Snapshot,
+    enabled: Optional[frozenset] = None,
+    affinity_index: Optional["SnapshotAffinityIndex"] = None,
+    affinity_extra=(),
 ) -> PredicateMetadata:
     return PredicateMetadata(
         even_pods_spread=(
@@ -402,7 +512,9 @@ def compute_predicate_metadata(
             else None
         ),
         pod_affinity=(
-            compute_pod_affinity_metadata(pod, snapshot)
+            compute_pod_affinity_metadata(
+                pod, snapshot, index=affinity_index, extra=affinity_extra
+            )
             if predicate_enabled(MATCH_INTER_POD_AFFINITY_PRED, enabled)
             else None
         ),

@@ -224,8 +224,10 @@ class _BatchConflictIndex:
         self._commits_by_kv: Dict[Tuple[str, str], List[Pod]] = {}
         self._rolled_back: set = set()
         self.any_anti = False
+        self.commits: List[Pod] = []  # flat, in commit order
 
     def add_commit(self, pod: Pod, node) -> None:
+        self.commits.append(pod)
         for kv in node.labels.items():
             self._commits_by_kv.setdefault(kv, []).append(pod)
 
@@ -319,6 +321,40 @@ def _needs_oracle_recheck(pod: Pod) -> bool:
     return _recheck_level(pod) != RECHECK_NONE
 
 
+def _minus_one_could_fit(pod: Pod, index: "_BatchConflictIndex", preempted: bool) -> bool:
+    """The device said NO node fits (against the batch-start state). Within
+    the batch, feasibility can only IMPROVE through events this check
+    detects — everything else (anti-affinity, ports, resource consumption)
+    strictly shrinks the feasible set, so -1 stands without the O(nodes)
+    oracle scan:
+      * a preemption freed capacity;
+      * a commit matches the pod's required affinity terms (the in-batch
+        anchor case, predicates.go:1269 semantics);
+      * a same-namespace commit matches a DoNotSchedule spread constraint's
+        selector (raises the domain minimum, loosening the skew bound)."""
+    if _recheck_level(pod) != RECHECK_FULL:
+        return False
+    if preempted:
+        return True
+    a = pod.affinity
+    aff_terms = get_pod_affinity_terms(a) if a is not None else []
+    hard = [
+        c for c in pod.topology_spread_constraints
+        if c.when_unsatisfiable == "DoNotSchedule"
+    ]
+    for c in index.commits:
+        if id(c) in index._rolled_back:
+            continue
+        if aff_terms and pod_matches_all_term_properties(c, pod, aff_terms):
+            return True
+        for con in hard:
+            if c.namespace == pod.namespace and match_label_selector(
+                con.label_selector, c.labels
+            ):
+                return True
+    return False
+
+
 class Scheduler:
     """The driver. One instance per scheduler process (leader)."""
 
@@ -398,6 +434,9 @@ class Scheduler:
         # batch commits new anti patterns): after an invalidation, skip a
         # few dispatches instead of paying wasted encode+device work
         self._spec_backoff = 0
+        # per-batch oracle metadata cache (built lazily on first oracle use)
+        self._aff_index = None
+        self._aff_extra: List = []
         # per-phase wall-clock accumulators (the utiltrace/LogIfLong
         # equivalent; bench.py and metrics read these)
         self.stats: Dict[str, float] = {
@@ -627,6 +666,25 @@ class Scheduler:
             node_fallback_any=bool((self.mirror.nodes.fallback & self.mirror.nodes.valid).any()),
             gang_ok=gang_ok_arr,
             speculative=disp["speculative"],
+        )
+
+    def _pod_meta(self, pod: Pod):
+        """Predicate metadata for the oracle paths, backed by a per-batch
+        SnapshotAffinityIndex (the pod-independent halves built once, not
+        per pod) plus this batch's commits replayed exactly. Invalidated
+        (index=None) whenever the snapshot changes in ways the extras list
+        does not capture — preemption deletes, gang rollbacks."""
+        from ..oracle.predicates import SnapshotAffinityIndex
+
+        if self._aff_index is None:
+            self._aff_index = SnapshotAffinityIndex(self.cache.snapshot)
+            self._aff_extra = []
+        return compute_predicate_metadata(
+            pod,
+            self.cache.snapshot,
+            enabled=self._enabled_preds,
+            affinity_index=self._aff_index,
+            affinity_extra=self._aff_extra,
         )
 
     def _pod_extenders(self, pod: Pod) -> List:
@@ -1010,6 +1068,10 @@ class Scheduler:
         self.stats["sync_s"] += dt_sync
         M.tensor_sync_duration.observe(dt_sync)
         trace.step("tensor mirror sync")
+        # the snapshot moved (sync) — rebuild the oracle metadata index
+        # lazily if this batch needs it
+        self._aff_index = None
+        self._aff_extra = []
         # a speculated solve is consumable only if nothing it could not have
         # accounted for happened since dispatch: no cache mutations beyond
         # the previous batch's own commits, and no bank rebuild (row remap)
@@ -1099,6 +1161,9 @@ class Scheduler:
         def rollback_group(g: str) -> None:
             nonlocal residuals_diverged
             gang_failed.add(g)
+            # rolled-back assumes leave the snapshot: the extras no longer
+            # mirror it — drop the cache (rebuilt lazily from live state)
+            self._aff_index = None
             for s_info, s_assumed, s_node, s_state in gang_staged.pop(g, []):
                 self._rollback_prepared(
                     s_info, s_assumed, s_node, s_state, cycle, "gang incomplete"
@@ -1176,12 +1241,12 @@ class Scheduler:
                     # in selection — skip validating the device pick and
                     # re-rank host-side directly
                     self.stats["oracle_places"] += 1
-                    meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
+                    meta = self._pod_meta(pod)
                     node_name = self._oracle_place(pod, out.score[i], meta, state)
                     placed_attempted = True
                 elif node_name is not None and (needs_full or nominated_fn(node_name)):
                     self.stats["oracle_rechecks"] += 1
-                    meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
+                    meta = self._pod_meta(pod)
                     ok = self.cache.snapshot.get(node_name) is not None and fits_considering_nominated(
                         pod, node_name, self.cache.snapshot, nominated_fn, meta=meta
                     )
@@ -1211,7 +1276,7 @@ class Scheduler:
                         ok = ni is not None and pod_fits_resources(pod, ni)
                     if not ok:
                         self.stats["oracle_places"] += 1
-                        meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
+                        meta = self._pod_meta(pod)
                         node_name = self._oracle_place(pod, out.score[i], meta, state)
                         placed_attempted = True
                 elif node_name is not None and residuals_diverged:
@@ -1221,7 +1286,7 @@ class Scheduler:
                     # re-place only if it fails
                     ni = self.cache.snapshot.get(node_name)
                     if ni is None or not pod_fits_resources(pod, ni):
-                        meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
+                        meta = self._pod_meta(pod)
                         node_name = self._oracle_place(pod, out.score[i], meta, state)
                         placed_attempted = True
                 if (
@@ -1232,7 +1297,9 @@ class Scheduler:
                         or out.existing_overflow
                         or out.node_fallback_any
                         or residuals_diverged
-                        or _needs_oracle_recheck(pod)
+                        or _minus_one_could_fit(
+                            pod, conflict_index, res.preempted > 0
+                        )
                     )
                 ):
                     # the device mask may be conservatively wrong (encoding
@@ -1243,7 +1310,7 @@ class Scheduler:
                     # same batch) — full scalar fallback before declaring the
                     # pod unschedulable
                     self.stats["oracle_places"] += 1
-                    meta = compute_predicate_metadata(pod, self.cache.snapshot, enabled=self._enabled_preds)
+                    meta = self._pod_meta(pod)
                     node_name = self._oracle_place(pod, out.score[i], meta, state)
             except ExtenderError as ee:
                 # wire failure, not a FitError: error path, never preemption
@@ -1272,6 +1339,8 @@ class Scheduler:
                 preempted_now = self.enable_preemption and self._try_preempt(info)
                 if preempted_now:
                     res.preempted += 1
+                    # victim deletions changed the snapshot under the index
+                    self._aff_index = None
                 self._fail(info, cycle, "no fit")
                 if preempted_now:
                     # victim deletions are cluster events: wake the queue
@@ -1289,6 +1358,7 @@ class Scheduler:
                 c_node = self.cache.snapshot.get(node_name)
                 if c_node is not None:
                     conflict_index.add_commit(pod, c_node.node)
+                    self._aff_extra.append((assumed, c_node.node.labels))
                     if out.has_anti[i]:
                         conflict_index.add_anti(pod, c_node.node)
                 if node_name != device_choice:
@@ -1299,6 +1369,7 @@ class Scheduler:
                 c_node = self.cache.snapshot.get(node_name)
                 if c_node is not None:
                     conflict_index.add_commit(pod, c_node.node)
+                    self._aff_extra.append((pod.with_node(node_name), c_node.node.labels))
                     if out.has_anti[i]:
                         conflict_index.add_anti(pod, c_node.node)
                 if node_name != device_choice:

@@ -488,3 +488,38 @@ class TestEndToEnd:
             for name in fits:
                 ok, _ = pod_fits_on_node(pod, snap.get(name), snapshot=snap)
                 assert ok
+
+
+def test_affinity_index_metadata_equivalence():
+    """SnapshotAffinityIndex (grouped, pod-independent halves) must yield
+    EXACTLY the same PodAffinityMetadata pair sets as the per-pod cluster
+    walk, over seeded random clusters — including extras replay for pods
+    committed after the index was built."""
+    from kubernetes_tpu.models.generators import ClusterGen
+    from kubernetes_tpu.oracle.nodeinfo import Snapshot
+    from kubernetes_tpu.oracle.predicates import (
+        SnapshotAffinityIndex,
+        compute_pod_affinity_metadata,
+    )
+
+    for seed in range(12):
+        g = ClusterGen(seed)
+        nodes, existing = g.cluster(14, 60, feature_rate=0.7)
+        snap = Snapshot(nodes, existing)
+        index = SnapshotAffinityIndex(snap)
+        # extras: two additional pods committed after the index build
+        extra_pods = []
+        names = list(snap.node_infos)
+        for j in range(2):
+            p = g.pod(90_000 + j, feature_rate=0.7)
+            ni = snap.node_infos[names[j % len(names)]]
+            bound = p.with_node(ni.node.name)
+            ni.add_pod(bound)
+            extra_pods.append((bound, ni.node.labels))
+        for i in range(8):
+            pod = g.pod(95_000 + i, feature_rate=0.7)
+            legacy = compute_pod_affinity_metadata(pod, snap)
+            fast = compute_pod_affinity_metadata(pod, snap, index=index, extra=extra_pods)
+            assert fast.existing_anti_pairs == legacy.existing_anti_pairs, (seed, i)
+            assert fast.incoming_affinity_pairs == legacy.incoming_affinity_pairs, (seed, i)
+            assert fast.incoming_anti_pairs == legacy.incoming_anti_pairs, (seed, i)
