@@ -307,3 +307,94 @@ def test_speculation_invalidated_by_external_event():
     n1_used = sum(400 for n in binds.values() if n == "n1")
     assert n1_used <= 1000, binds
     assert r1.scheduled + r2.scheduled + r3.scheduled == 4, (r1, r2, r3)
+
+
+def test_in_batch_affinity_anchor_rescues_minus_one():
+    """Regression (round-2 VERDICT weak #1): a required-pod-affinity pod whose
+    ANCHOR lands in the same batch. At batch start no pod matches the term
+    anywhere, so the device mask is all-false (-1); the anchor's in-batch
+    commit satisfies the term (predicates.go:1269 sequential semantics) and
+    the -1 rescue path must oracle-place the dependent — formerly this path
+    raised NameError and aborted the batch."""
+    from kubernetes_tpu.api.types import PodAffinity
+
+    HOST = "kubernetes.io/hostname"
+    nodes = [make_node(f"n{i}", labels={HOST: f"n{i}"}) for i in range(4)]
+    sched, binds = _mk_scheduler(nodes)
+    anchor = make_pod("anchor", labels={"app": "anchor"})
+    anchor.priority = 10  # commits before the dependent in pop order
+    dep = make_pod("dep")
+    dep.priority = 0
+    dep.affinity = Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": "anchor"}),
+            topology_key=HOST,
+        )
+    ]))
+    sched.queue.add(anchor)
+    sched.queue.add(dep)
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.errors == 0, res
+    assert res.scheduled == 2, res
+    # hostname topology: the dependent must share the anchor's node
+    assert res.assignments["default/dep"] == res.assignments["default/anchor"]
+
+
+def test_commit_loop_exception_fails_pod_not_batch():
+    """A per-pod exception inside the commit loop (here: a Filter plugin
+    that raises) must fail THAT pod as an error and keep committing the
+    rest of the batch — never abort schedule_batch mid-commit (round-2
+    VERDICT weak #1, second half)."""
+    from kubernetes_tpu.framework.interface import Framework, Plugin, Status
+
+    class Exploding(Plugin):
+        name = "Exploding"
+
+        def filter(self, state, pod, node_info):
+            if pod.name == "boom":
+                raise RuntimeError("plugin bug")
+            return Status.success()
+
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30))
+    binds = []
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=Binder(lambda p, n: binds.append((p.key(), n))),
+        framework=Framework([Exploding()]), deterministic=True,
+    )
+    for name, prio in [("a", 30), ("boom", 20), ("b", 10)]:
+        p = make_pod(name, cpu_milli=100, mem=2**20)
+        p.priority = prio
+        sched.queue.add(p)
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.errors == 1, res
+    assert res.scheduled == 2, res
+    assert {k for k, _ in binds} == {"default/a", "default/b"}
+    # the failed pod is requeued (error path), not lost
+    assert sched.queue.pending_count() == 1
+
+
+def test_close_requeues_speculative_pending():
+    """Pods popped by a speculative dispatch but never consumed must return
+    to the queue on close() — not silently drop (round-2 ADVICE low)."""
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30))
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=Binder(lambda p, n: None),
+        batch_size=4, deterministic=True, enable_preemption=False,
+    )
+    for i in range(8):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100, mem=2**20))
+    r1 = sched.schedule_batch()  # commits 4, speculatively pops the other 4
+    assert r1.scheduled == 4
+    assert sched._spec_pending is not None
+    assert sched.queue.pending_count() == 0
+    sched.close()
+    assert sched._spec_pending is None
+    assert sched.queue.pending_count() == 4
