@@ -97,11 +97,14 @@ class Quantity:
         return v
 
     def milli_value(self) -> int:
-        """1/1000 units, rounded up (Quantity.MilliValue semantics)."""
+        """1/1000 units, rounded up (Quantity.MilliValue semantics).
+        Ceil straight off numerator/denominator: building the intermediate
+        `value_exact * 1000` Fraction (gcd + coprime normalization) was the
+        single hottest line of the whole commit loop at 4096-pod batches."""
         v = getattr(self, "_milli_int", None)
         if v is None:
-            ve = self.value_exact * 1000
-            v = -((-ve.numerator) // ve.denominator)
+            ve = self.value_exact
+            v = -((-ve.numerator * 1000) // ve.denominator)
             object.__setattr__(self, "_milli_int", v)
         return v
 

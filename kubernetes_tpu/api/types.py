@@ -285,12 +285,18 @@ class Pod:
 
     def host_ports(self) -> List[Tuple[str, str, int]]:
         """(protocol, hostIP, hostPort) triples with hostPort != 0
-        (nodeinfo usedPorts representation, node_info.go HostPortInfo)."""
+        (nodeinfo usedPorts representation, node_info.go HostPortInfo).
+        Memoized (read per commit-loop recheck decision); treat the
+        returned list as read-only. with_node clones carry the memo."""
+        memo = self.__dict__.get("_host_ports_memo")
+        if memo is not None:
+            return memo
         out = []
         for c in self.containers:
             for p in c.ports:
                 if p.host_port:
                     out.append((p.protocol or "TCP", p.host_ip or "0.0.0.0", p.host_port))
+        self.__dict__["_host_ports_memo"] = out
         return out
 
 

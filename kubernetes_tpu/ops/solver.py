@@ -37,8 +37,12 @@ import jax.numpy as jnp
 Arrays = Dict[str, jnp.ndarray]
 
 # chunk width of the prefix-acceptance commit loop — shared with the
-# sharded twin (parallel/sharded.py) so the two stay in lockstep
-DEFAULT_CHUNK = 64
+# sharded twin (parallel/sharded.py) so the two stay in lockstep.
+# 128 measured best on TPU at [1024, 10240]: the solve's cost is serial
+# scan steps (B/K of them), not FLOPs — K=128 halves the steps vs 64 and
+# the repair loop still converges in ~1-2 iterations/chunk
+# (scripts/microbench_solver_ab.py; sequential equivalence holds for any K)
+DEFAULT_CHUNK = 128
 
 
 def pop_order(priority: jnp.ndarray, enqueue_seq: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
@@ -49,13 +53,38 @@ def pop_order(priority: jnp.ndarray, enqueue_seq: jnp.ndarray, valid: jnp.ndarra
 
 
 def tie_noise(rng_key, b: int, n: int) -> jnp.ndarray:
-    """selectHost tie-break noise for a whole batch in ONE vectorized RNG
-    call (shared by the single-chip and sharded solvers so their streams
-    are identical). Explicit float32: under x64 mode uniform() would
-    default to float64, which the TPU emulates — the f64 threefry for a
-    [1024, 10k] noise block alone costs ~200ms/batch."""
-    keys = jax.random.split(rng_key, b)
-    return jax.vmap(lambda k: jax.random.uniform(k, (n,), dtype=jnp.float32))(keys)
+    """selectHost tie-break noise [b, n] — the ONE noise stream shared by
+    the single-chip solver, the sharded twin, and the host-side parity
+    walks, so their tie-breaks are identical by construction.
+
+    Counter-based bitmix (murmur3 fmix32 over (pod, node, key) lanes), not
+    threefry: the reference's contract is only "uniform among max-score
+    nodes" (reservoir sampling, core/generic_scheduler.go:278), which any
+    well-mixed keyed hash satisfies. The previous per-pod
+    split+vmap(uniform) lowered to B separate threefry programs — ~1.5s a
+    batch at [1024, 10240] on TPU vs ~0 for the elementwise mix. A shard
+    holding node columns [lo, hi) reproduces exactly its slice from the
+    global column index, so sharded solves need no noise transfer."""
+    kd = (
+        jax.random.key_data(rng_key)
+        if jnp.issubdtype(rng_key.dtype, jax.dtypes.prng_key)
+        else jnp.asarray(rng_key)
+    )
+    kd = kd.astype(jnp.uint32).reshape(-1)
+    # both key words enter BEFORE the avalanche (multiplied by odd
+    # constants so low-bit-only keys like PRNGKey(small) spread over all
+    # lanes), then a full fmix32 — every output bit depends on every input
+    seed = kd[0] * jnp.uint32(0x27220A95) ^ kd[-1] * jnp.uint32(0x01000193)
+    i = jnp.arange(b, dtype=jnp.uint32)[:, None]
+    j = jnp.arange(n, dtype=jnp.uint32)[None, :]
+    x = i * jnp.uint32(0x9E3779B1) + j * jnp.uint32(0x85EBCA77) + seed
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    # top 24 bits → [0, 1) exactly representable in f32
+    return (x >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
 
 @partial(jax.jit, static_argnames=("deterministic", "chunk", "return_carry"))

@@ -170,7 +170,14 @@ DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
 
 def accumulated_request(pod: Pod) -> Dict[str, int]:
     """calculateResource's `res` (node_info.go): container request sums +
-    overhead; init containers excluded (unlike GetResourceRequest)."""
+    overhead; init containers excluded (unlike GetResourceRequest).
+
+    Memoized on the pod object (computed once per pod; assume + forget +
+    every oracle pass re-read it). `with_node` clones carry the memo.
+    Callers must treat the returned dict as read-only."""
+    memo = pod.__dict__.get("_acc_req_memo")
+    if memo is not None:
+        return memo
     total: Dict[str, int] = {}
     for c in pod.containers:
         for name, q in c.requests.items():
@@ -179,11 +186,16 @@ def accumulated_request(pod: Pod) -> Dict[str, int]:
     for name, q in pod.overhead.items():
         v = q.milli_value() if name == RESOURCE_CPU else q.value()
         total[name] = total.get(name, 0) + v
+    pod.__dict__["_acc_req_memo"] = total
     return total
 
 
 def pod_non_zero_request(pod: Pod) -> Tuple[int, int]:
-    """(milliCPU, memBytes) with per-container defaulting of unset requests."""
+    """(milliCPU, memBytes) with per-container defaulting of unset requests.
+    Memoized like accumulated_request."""
+    memo = pod.__dict__.get("_nz_req_memo")
+    if memo is not None:
+        return memo
     cpu = 0
     mem = 0
     for c in pod.containers:
@@ -197,6 +209,7 @@ def pod_non_zero_request(pod: Pod) -> Tuple[int, int]:
     q = pod.overhead.get(RESOURCE_MEMORY)
     if q is not None:
         mem += q.value()
+    pod.__dict__["_nz_req_memo"] = (cpu, mem)
     return cpu, mem
 
 

@@ -125,6 +125,9 @@ class SolveOutput:
     # topology/affinity counts are one batch stale, so LIGHT re-checks
     # escalate to the full live-snapshot oracle check
     speculative: bool = False
+    # [len(pods)] RECHECK_* per pod, computed once per unique SPEC at
+    # dispatch (the level is a pure function of spec-key fields)
+    levels: Optional[np.ndarray] = None
 
 
 class ExtenderError(Exception):
@@ -286,6 +289,12 @@ def _spec_key(pod: Pod, selectors) -> str:
     ))
 
 
+def _no_nominations(node: str):
+    """Batch-constant stand-in for queue.nominated_pods_for_node when the
+    nominated index is empty: skips a lock round-trip per pod."""
+    return ()
+
+
 RECHECK_NONE = 0
 RECHECK_LIGHT = 1  # validate against THIS BATCH's commits only (cheap)
 RECHECK_FULL = 2  # full scalar oracle pass (O(cluster) metadata)
@@ -324,7 +333,9 @@ def _needs_oracle_recheck(pod: Pod) -> bool:
     return _recheck_level(pod) != RECHECK_NONE
 
 
-def _minus_one_could_fit(pod: Pod, index: "_BatchConflictIndex", preempted: bool) -> bool:
+def _minus_one_could_fit(
+    pod: Pod, index: "_BatchConflictIndex", preempted: bool, level: int
+) -> bool:
     """The device said NO node fits (against the batch-start state). Within
     the batch, feasibility can only IMPROVE through events this check
     detects — everything else (anti-affinity, ports, resource consumption)
@@ -335,7 +346,7 @@ def _minus_one_could_fit(pod: Pod, index: "_BatchConflictIndex", preempted: bool
         anchor case, predicates.go:1269 semantics);
       * a same-namespace commit matches a DoNotSchedule spread constraint's
         selector (raises the domain minimum, loosening the skew bound)."""
-    if _recheck_level(pod) != RECHECK_FULL:
+    if level != RECHECK_FULL:
         return False
     if preempted:
         return True
@@ -632,6 +643,7 @@ class Scheduler:
             pods=pods,
             batch=batch,
             aux=aux,
+            levels=np.array([_recheck_level(r) for r in reps], np.int8),
             sig_arr=np.asarray(sig_list, np.int32),
             assign_dev=assign,
             score_dev=score,
@@ -669,6 +681,7 @@ class Scheduler:
             node_fallback_any=bool((self.mirror.nodes.fallback & self.mirror.nodes.valid).any()),
             gang_ok=gang_ok_arr,
             speculative=disp["speculative"],
+            levels=disp["levels"][sig_arr],
         )
 
     def _pod_meta(self, pod: Pod):
@@ -850,16 +863,23 @@ class Scheduler:
 
     def _finalize_commit(
         self, info: PodInfo, assumed: Pod, node_name: str, cycle: int,
-        state: CycleState, defer: Optional[List] = None,
+        state: CycleState, defer: Optional[List] = None, lean: bool = False,
     ) -> None:
         """Second half: submit the async permit → prebind → bind → postbind
         pipeline (scheduler.go:631-743). With `defer`, the pipeline closure
         is appended there instead of submitted — the caller batches
         closures into chunked pool submissions (a ThreadPoolExecutor
         submit costs ~100µs of Future/Event bookkeeping; one per POD was
-        ~10%% of the whole commit loop)."""
+        ~10%% of the whole commit loop). `lean` (batch-constant, computed
+        by schedule_batch): no volume binder, no permit/prebind/bind/
+        postbind plugins, no bind extender — the pipeline reduces to
+        bind+finish, so defer a plain tuple and let _lean_bind_chunk run
+        the whole chunk without per-pod closures."""
         pod = info.pod
         t_decided = time.perf_counter()
+        if lean and defer is not None:
+            defer.append((info, assumed, node_name, state, t_decided))
+            return
 
         def bind_async():
             if self.volume_binder is not None:
@@ -917,9 +937,61 @@ class Scheduler:
         else:
             self._bind_pool.submit(bind_async)
 
+    def _lean_bind_chunk(self, items: List[Tuple], cycle: int) -> None:
+        """Plugin-free bind pipeline for a whole chunk: the per-pod
+        bind_async closure + four individually-locked histogram observes
+        were a measurable slice of commit wall at 4096-pod batches (and the
+        closures contend for the GIL with the NEXT batch's commit loop).
+        Semantics identical to bind_async when lean conditions hold: no
+        volume binder, permit/prebind success by vacuity, framework bind
+        SKIP → default binder."""
+        bind = self.binder.bind
+        finish = self.cache.finish_binding
+        age = self.queue.age
+        events = self.event_fn
+        binds: List[float] = []
+        e2es: List[float] = []
+        attempts: List[int] = []
+        ages: List[float] = []
+        for info, assumed, node_name, state, t_decided in items:
+            pod = info.pod
+            bound = False
+            try:
+                t_bind = time.perf_counter()
+                try:
+                    bind(pod, node_name)
+                except Exception as e:  # bind RPC failed → forget + requeue
+                    self._unbind(info, assumed, node_name, state, cycle, f"bind: {e}")
+                    continue
+                bound = True
+                now = time.perf_counter()
+                binds.append(now - t_bind)
+                e2es.append(now - t_decided)
+                attempts.append(info.attempts)
+                ages.append(max(age(info), 0.0))
+                finish(assumed)
+                events(pod, "Scheduled", f"bound to {node_name}")
+            except Exception:
+                # one pod's failure must not strand the rest of the chunk
+                # assumed-but-never-bound — the per-pod closures had this
+                # isolation. Post-bind bookkeeping failures leave the pod
+                # BOUND (never unbind a pod the apiserver accepted — the
+                # old bind_async swallowed those too); only a failure on
+                # the unbound side forgets + requeues.
+                if not bound:
+                    try:
+                        self._unbind(info, assumed, node_name, state, cycle, "bind pipeline error")
+                    except Exception:
+                        pass
+        M.binding_duration.observe_many(binds)
+        M.e2e_scheduling_duration.observe_many(e2es)
+        M.pod_scheduling_attempts.observe_many(attempts)
+        M.pod_scheduling_duration.observe_many(ages)
+
     def _commit(
         self, info: PodInfo, node_name: str, cycle: int,
         state: Optional[CycleState] = None, defer: Optional[List] = None,
+        lean: bool = False,
     ) -> bool:
         """reserve → assume → async(permit → prebind → bind → postbind).
         `state` is the pod's CycleState carried from PreFilter onward, so
@@ -929,7 +1001,7 @@ class Scheduler:
         assumed = self._prepare_commit(info, node_name, cycle, state)
         if assumed is None:
             return False
-        self._finalize_commit(info, assumed, node_name, cycle, state, defer=defer)
+        self._finalize_commit(info, assumed, node_name, cycle, state, defer=defer, lean=lean)
         return True
 
     def _unbind(self, info: PodInfo, assumed: Pod, node_name: str, state, cycle: int, msg: str) -> None:
@@ -1038,6 +1110,14 @@ class Scheduler:
             )
         except Exception:
             return entry  # encode trouble (e.g. overflow): solve fresh next cycle
+        # start the device→host copy NOW: on a remote-attached TPU the
+        # ~100ms result round-trip otherwise serializes after this batch's
+        # commit loop; enqueued behind the solve, it rides the tunnel while
+        # the host commits, so consume-time device_get finds the bytes local
+        try:
+            disp["assign_dev"].copy_to_host_async()
+        except AttributeError:
+            pass  # non-jax array (tests with stub arrays)
         entry["disp"] = disp
         return entry
 
@@ -1056,11 +1136,12 @@ class Scheduler:
         # gang completeness: every QUEUED member of any group present in the
         # batch joins it, so all-or-nothing is decided over the whole group
         # (a speculated batch never contains gang pods — gated at dispatch)
-        groups_in_batch = {
-            g for g in (pod_group_name(i.pod) for i in infos) if g
-        }
+        batch_groups = [pod_group_name(i.pod) for i in infos]
+        groups_in_batch = {g for g in batch_groups if g}
         if groups_in_batch:
-            infos.extend(self.queue.pop_all_in_groups(groups_in_batch, pod_group_name))
+            extra = self.queue.pop_all_in_groups(groups_in_batch, pod_group_name)
+            infos.extend(extra)
+            batch_groups.extend(pod_group_name(i.pod) for i in extra)
         cycle = self.queue.scheduling_cycle()
         self.stats["batches"] += 1
         M.batch_size.observe(len(infos))
@@ -1129,8 +1210,22 @@ class Scheduler:
                 # sentinel validity, i.e. solved fresh)
                 self._spec_pending = spec_next
 
-        nominated_fn = self.queue.nominated_pods_for_node
         fw = self.framework
+        # plugin-free bind pipeline? (batch-constant; see _lean_bind_chunk)
+        lean_bind = (
+            self.volume_binder is None
+            and not fw.has_plugins("permit")
+            and not fw.has_plugins("pre_bind")
+            and not fw.has_plugins("bind")
+            and not fw.has_plugins("post_bind")
+            and not any(e.supports_bind() for e in self.extenders)
+        )
+        # nominated-pods lookups take the queue lock per POD; skip them for
+        # the whole batch when the nominated index is empty (the common
+        # case) — preemption inside the loop re-arms the real lookup
+        nominated_fn = self.queue.nominated_pods_for_node
+        if not self.queue.has_nominations():
+            nominated_fn = _no_nominations
         # host framework plugins (framework.go): Filter narrows the mask,
         # PostFilter sees the feasible set, Score adds to the ranking — any
         # of them forces the host commit path (the device mask/score can't
@@ -1198,7 +1293,7 @@ class Scheduler:
             disposed = False
             try:
                 state = CycleState()
-                group = pod_group_name(pod)
+                group = batch_groups[i]
                 if group and group in gang_failed:
                     res.unschedulable += 1
                     disposed = True
@@ -1225,7 +1320,7 @@ class Scheduler:
                         disposed = True
                         self._fail(info, cycle, f"prefilter: {st.message}")
                         continue
-                level = _recheck_level(pod)
+                level = int(out.levels[i]) if out.levels is not None else _recheck_level(pod)
                 needs_full = (
                     out.fallback[i]
                     or out.existing_overflow
@@ -1319,7 +1414,7 @@ class Scheduler:
                             # the stale--1 counterpart.
                             or (out.speculative and level == RECHECK_FULL)
                             or _minus_one_could_fit(
-                                pod, conflict_index, res.preempted > 0
+                                pod, conflict_index, res.preempted > 0, level
                             )
                         )
                     ):
@@ -1363,6 +1458,9 @@ class Scheduler:
                         res.preempted += 1
                         # victim deletions changed the snapshot under the index
                         self._aff_index = None
+                        # the preempted pod is about to be re-queued with a
+                        # nomination: later pods must see the real index
+                        nominated_fn = self.queue.nominated_pods_for_node
                     res.unschedulable += 1
                     disposed = True
                     self._fail(info, cycle, "no fit")
@@ -1391,7 +1489,9 @@ class Scheduler:
                             conflict_index.add_anti(pod, c_node.node)
                     if node_name != device_choice:
                         residuals_diverged = True
-                elif self._commit(info, node_name, cycle, state, defer=bind_jobs):
+                elif self._commit(
+                    info, node_name, cycle, state, defer=bind_jobs, lean=lean_bind
+                ):
                     res.scheduled += 1
                     res.assignments[pod.key()] = node_name
                     disposed = True  # bind pipeline queued: never _fail past this
@@ -1439,7 +1539,8 @@ class Scheduler:
                 continue
             for s_info, s_assumed, s_node, s_state in members:
                 self._finalize_commit(
-                    s_info, s_assumed, s_node, cycle, s_state, defer=bind_jobs
+                    s_info, s_assumed, s_node, cycle, s_state, defer=bind_jobs,
+                    lean=lean_bind,
                 )
                 res.scheduled += 1
                 res.assignments[s_info.pod.key()] = s_node
@@ -1450,7 +1551,13 @@ class Scheduler:
         # allow() (framework/interface.py waiting pods) — sequentializing
         # those would deadlock a chunk, so they keep per-pod submission.
         if bind_jobs:
-            if self.framework.has_plugins("permit"):
+            if lean_bind:
+                step = max(1, -(-len(bind_jobs) // self._bind_workers))
+                for i in range(0, len(bind_jobs), step):
+                    self._bind_pool.submit(
+                        self._lean_bind_chunk, bind_jobs[i : i + step], cycle
+                    )
+            elif self.framework.has_plugins("permit"):
                 for f in bind_jobs:
                     self._bind_pool.submit(f)
             else:

@@ -292,6 +292,13 @@ class PriorityQueue:
             if info is not None:
                 info.pod.nominated_node_name = ""
 
+    def has_nominations(self) -> bool:
+        """True if ANY pod currently nominates a node (empty sets left by
+        discard don't count). Batch drivers use this to skip the per-pod
+        nominated lookup entirely when the index is empty."""
+        with self._lock:
+            return any(self._nominated_by_node.values())
+
     def nominated_pods_for_node(self, node: str) -> List[Pod]:
         with self._lock:
             return [
