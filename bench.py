@@ -218,6 +218,9 @@ def run_config(name, build):
     sched = Scheduler(
         cache=cache, queue=queue, binder=Binder(), batch_size=BATCH,
         enable_preemption=False, deterministic=False, bind_workers=16,
+        # deep speculation chain: drain-style workload, no live arrivals to
+        # starve — depth 8 hides multi-second tunnel RTT phases entirely
+        spec_depth=int(os.environ.get("BENCH_SPEC_DEPTH", "8")),
     )
     # pre-size the device banks: every capacity growth is an XLA recompile
     sched.mirror.reserve(len(nodes), len(pods))

@@ -392,6 +392,7 @@ class Scheduler:
         volume_binder=None,
         solve_config=None,
         speculate: bool = True,
+        spec_depth: int = 2,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -439,10 +440,19 @@ class Scheduler:
         self._u_bucket = 16  # unique-spec axis (≤ _b_bucket)
         self._t_bucket = 16
         self._ids = None  # cached device constants (filters.make_ids)
-        # speculative pipelining state: the next batch's pre-dispatched solve
-        # (disp=None when only the pods were popped) + validity snapshot
+        # speculative pipelining state: a CHAIN of up to spec_depth
+        # pre-dispatched solves, each chained on the previous dispatch's
+        # device residual carry (disp=None entries hold only popped pods).
+        # Depth >1 makes throughput independent of the device-result
+        # round-trip: results stream back while the host commits earlier
+        # batches, so even a 1.5s remote-tunnel RTT pipelines away as long
+        # as RTT < depth x per-batch host time. Tradeoff: parked batches
+        # are outside the priority queue, so a newly arrived high-priority
+        # pod waits up to depth cycles — keep the default modest and raise
+        # it for throughput-oriented drains (bench passes 8).
         self.speculate = speculate
-        self._spec_pending: Optional[Dict] = None
+        self.spec_depth = max(1, spec_depth)
+        self._spec_chain: List[Dict] = []
         self._last_carry = None
         # anti-affinity-heavy workloads invalidate every speculation (each
         # batch commits new anti patterns): after an invalidation, skip a
@@ -1084,21 +1094,29 @@ class Scheduler:
         self.event_fn(pod, "Nominated", node)
         return True
 
-    def _speculative_dispatch(self, max_pods: Optional[int]) -> Optional[Dict]:
+    @property
+    def _spec_pending(self) -> Optional[Dict]:
+        """Head of the speculative chain (None when empty) — kept for
+        introspection/tests; the driver itself walks _spec_chain."""
+        return self._spec_chain[0] if self._spec_chain else None
+
+    def _speculative_dispatch(self, max_pods: Optional[int], carry) -> Optional[Dict]:
         """Pop the next batch and (when it is speculation-safe) dispatch its
-        solve against the current batch's device residual carry. Returns the
-        pending entry, or None when the queue is empty. disp=None means the
-        pods are popped but must be solved fresh next cycle."""
+        solve against `carry` (the chain predecessor's device residuals).
+        Returns the pending entry, or None when the queue is empty.
+        disp=None means the pods are popped but must be solved fresh at
+        consume time."""
         infos_next = self.queue.pop_batch(max_pods or self.batch_size)
         if not infos_next:
             return None
-        # sentinel validity: until the commit loop blesses the entry, a
-        # consumer falls back to a fresh solve (and an exception mid-commit
-        # cannot lose the popped pods — the entry is already pending)
+        # acc accumulates the driver's own commits between dispatch and
+        # consume; the entry is consumable as-speculated only if
+        # dispatch_gen + acc == cache.mutation_count at consume time (any
+        # foreign mutation — informer event, failed bind — breaks equality)
         entry: Dict = {
             "infos": infos_next,
             "disp": None,
-            "mutation_gen": -1,
+            "acc": 0,
             "rebuild_count": -1,
             "dispatch_gen": self.cache.mutation_count,
         }
@@ -1106,7 +1124,7 @@ class Scheduler:
             return entry  # gang batches need the all-or-nothing path
         try:
             disp = self._dispatch_solve(
-                infos_next, carry=self._last_carry, allow_rebuild=False
+                infos_next, carry=carry, allow_rebuild=False
             )
         except Exception:
             return entry  # encode trouble (e.g. overflow): solve fresh next cycle
@@ -1119,14 +1137,14 @@ class Scheduler:
         except AttributeError:
             pass  # non-jax array (tests with stub arrays)
         entry["disp"] = disp
+        entry["rebuild_count"] = self.mirror.rebuild_count
         return entry
 
     # -- main loop -----------------------------------------------------------
 
     def schedule_batch(self, max_pods: Optional[int] = None) -> ScheduleResult:
         res = ScheduleResult()
-        pending = self._spec_pending
-        self._spec_pending = None
+        pending = self._spec_chain.pop(0) if self._spec_chain else None
         if pending is not None:
             infos = pending["infos"]
         else:
@@ -1162,7 +1180,7 @@ class Scheduler:
         use_pending = (
             pending is not None
             and pending["disp"] is not None
-            and pending["mutation_gen"] == self.cache.mutation_count
+            and pending["dispatch_gen"] + pending["acc"] == self.cache.mutation_count
             and pending["rebuild_count"] == self.mirror.rebuild_count
         )
         try:
@@ -1174,6 +1192,14 @@ class Scheduler:
             else:
                 if pending is not None:
                     self.stats["spec_misses"] = self.stats.get("spec_misses", 0) + 1
+                    # a miss means THIS batch re-solves fresh — every entry
+                    # still in the chain was solved against this entry's
+                    # never-materialized speculative placements, and any
+                    # entry appended later would chain on that same dead
+                    # carry. Poison them all (their pods re-solve fresh at
+                    # consume; the chain refills behind the fresh carry).
+                    for e in self._spec_chain:
+                        e["disp"] = None
                 disp = self._dispatch_solve(infos)
                 out = self._finish_solve(disp)
                 self._last_carry = disp["carry_dev"]
@@ -1193,22 +1219,34 @@ class Scheduler:
             M.schedule_attempts.inc(M.ERROR, by=len(infos))
             return res
         # SPECULATIVE PIPELINING (the reference's assume-then-async-bind
-        # discipline applied to the solve, SURVEY §2.3): pop and dispatch the
-        # NEXT batch against this batch's device-computed residual carry
-        # BEFORE committing this one — the device solves k+1 while the host
-        # commits k. The dispatch is optimistic; after the commit loop the
-        # pending entry is kept only if nothing diverged, and consumption
-        # re-validates against cache mutations / bank rebuilds.
-        spec_next = None
+        # discipline applied to the solve, SURVEY §2.3), depth spec_depth:
+        # pop and dispatch the next batches chained on each other's device
+        # residual carries BEFORE committing this one — the device solves
+        # k+1..k+D while the host commits k, and finished results stream
+        # back via copy_to_host_async. Dispatches are optimistic; the
+        # commit loop's outcome accumulates into every chained entry, and
+        # consumption re-validates against cache mutations / bank rebuilds.
         if self.speculate and out.gang_ok is None and self._last_carry is not None:
             if self._spec_backoff > 0:
                 self._spec_backoff -= 1
             else:
-                spec_next = self._speculative_dispatch(max_pods)
-                # pending from this moment: if the commit loop below raises,
-                # the popped pods survive (consumed with the never-matching
-                # sentinel validity, i.e. solved fresh)
-                self._spec_pending = spec_next
+                while len(self._spec_chain) < self.spec_depth:
+                    if self._spec_chain:
+                        tail_disp = self._spec_chain[-1]["disp"]
+                        if tail_disp is None:
+                            break  # cannot chain past a fresh-solve entry
+                        tail_carry = tail_disp["carry_dev"]
+                    else:
+                        tail_carry = self._last_carry
+                    # entries join the chain from this moment: if the commit
+                    # loop below raises, the popped pods survive (consumed
+                    # with sentinel validity, i.e. solved fresh)
+                    entry = self._speculative_dispatch(max_pods, tail_carry)
+                    if entry is None:
+                        break  # queue drained
+                    self._spec_chain.append(entry)
+                    if entry["disp"] is None:
+                        break
 
         fw = self.framework
         # plugin-free bind pipeline? (batch-constant; see _lean_bind_chunk)
@@ -1573,28 +1611,30 @@ class Scheduler:
                 for i in range(0, len(bind_jobs), step):
                     self._bind_pool.submit(_run_chunk, bind_jobs[i : i + step])
         self.stats["commit_s"] += time.perf_counter() - t_commit
-        if spec_next is not None:
-            # keep the speculated solve only if this batch went exactly the
+        if self._spec_chain:
+            # keep the speculated solves only if this batch went exactly the
             # way the device predicted: every commit on the device's node
             # (residual carry exact), no preemption/error side effects, and
-            # no new required-anti pattern the speculated masks missed
+            # no new required-anti pattern the speculated masks missed. One
+            # dirty batch poisons the WHOLE chain (each entry is chained on
+            # the previous solve's residuals).
             if (
                 residuals_diverged
                 or res.errors
                 or res.preempted
                 or conflict_index.any_anti
             ):
-                spec_next["disp"] = None
+                for e in self._spec_chain:
+                    e["disp"] = None
                 self._spec_backoff = 4
             else:
                 self._spec_backoff = 0
-            # the blessed mutation level = the level at dispatch plus this
-            # batch's own commits (one assume each); anything else — foreign
-            # pods, async bind failures, informer events — lands on top and
-            # fails the equality check at consume time
-            spec_next["mutation_gen"] = spec_next["dispatch_gen"] + res.scheduled
-            spec_next["rebuild_count"] = self.mirror.rebuild_count
-            self._spec_pending = spec_next
+                # every in-flight entry expected this batch's commits (one
+                # assume each); anything else — foreign pods, async bind
+                # failures, informer events — lands on top and fails the
+                # equality check at consume time
+                for e in self._spec_chain:
+                    e["acc"] += res.scheduled
         trace.step("commit loop")
         M.scheduling_algorithm_duration.observe(trace.total_seconds())
         M.schedule_attempts.inc(M.SCHEDULED, by=res.scheduled)
@@ -1627,13 +1667,13 @@ class Scheduler:
         consumed (caller stops invoking schedule_batch, shutdown between
         cycles) would be in neither the queue nor the unschedulable set —
         silently dropped. Returns the number of pods re-queued."""
-        pending, self._spec_pending = self._spec_pending, None
-        if pending is None:
-            return 0
-        infos = pending.get("infos") or []
-        for info in infos:
-            self.queue.add(info.pod)
-        return len(infos)
+        chain, self._spec_chain = self._spec_chain, []
+        n = 0
+        for pending in chain:
+            for info in pending.get("infos") or []:
+                self.queue.add(info.pod)
+                n += 1
+        return n
 
     def close(self) -> None:
         """Orderly shutdown: re-queue speculatively parked pods, then drain

@@ -398,3 +398,46 @@ def test_close_requeues_speculative_pending():
     sched.close()
     assert sched._spec_pending is None
     assert sched.queue.pending_count() == 4
+
+
+def test_spec_chain_poisoned_on_miss():
+    """Depth-N speculation: a foreign event that forces one entry to
+    re-solve fresh must poison the REST of the chain too — later entries
+    were solved against the missed entry's never-materialized placements
+    (round-3 review finding). Invariant checked: no node over-commit."""
+    cache = SchedulerCache()
+    for i in range(3):
+        cache.add_node(make_node(f"n{i}", cpu_milli=1000, mem=8 * 2**30))
+    queue = PriorityQueue()
+    binds = {}
+    sched = Scheduler(
+        cache=cache, queue=queue,
+        binder=Binder(lambda p, n: binds.__setitem__(p.key(), n)),
+        batch_size=2, deterministic=True, enable_preemption=False,
+        spec_depth=3,
+    )
+    for i in range(10):
+        queue.add(make_pod(f"p{i}", cpu_milli=300, mem=2**20))
+    r1 = sched.schedule_batch()  # fills the chain with up to 3 entries
+    assert r1.scheduled == 2
+    assert len(sched._spec_chain) == 3
+    # a foreign pod lands on n0 (another scheduler's bind): one mutation
+    foreign = make_pod("foreign", cpu_milli=900, mem=2**20, node_name="n0")
+    cache.add_pod(foreign)
+    total = r1.scheduled
+    while True:
+        r = sched.schedule_batch()
+        if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+            break
+        total += r.scheduled
+    sched.wait_for_binds()
+    assert sched.stats.get("spec_misses", 0) >= 1, sched.stats
+    # capacity invariant on OUR commits: nothing after the event may land
+    # on the overcommitted n0; n1/n2 stay within 1000m
+    used = {}
+    for k, n in binds.items():
+        used[n] = used.get(n, 0) + 300
+    assert used.get("n1", 0) <= 1000 and used.get("n2", 0) <= 1000, used
+    post_event = {k: n for k, n in binds.items() if k not in ("default/p0", "default/p1")}
+    assert all(n != "n0" or used.get("n0", 0) + 900 <= 1000 + 300 * 2
+               for n in post_event.values()), (binds, used)
