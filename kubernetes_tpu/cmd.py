@@ -41,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=10250, help="extender serving port")
     p.add_argument("--metrics-port", type=int, default=10251)
     p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument(
+        "--mesh", default="auto",
+        help="multi-chip: 'auto' shards the solve over all visible devices "
+             "when more than one is present, 'off' forces single-device, an "
+             "integer uses that many devices (parallel.node_mesh)",
+    )
     p.add_argument("--deterministic", action="store_true")
     p.add_argument(
         "--profile-dir",
@@ -83,11 +89,38 @@ def _configurator(args):
         with open(args.services_file) as f:
             services = [service_from_k8s(s) for s in json.load(f)]
         service_lister = lambda: services
+    mesh = None
+    mesh_arg = getattr(args, "mesh", "auto")
+    if mesh_arg != "off":
+        # multi-chip: route the device solve through the sharded pipeline
+        # over all (or --mesh N) visible chips; single chip → plain path
+        import jax
+
+        n_dev = len(jax.devices())
+        if mesh_arg == "auto":
+            # node-capacity buckets guarantee divisibility only for
+            # power-of-two shard counts (state/tensors._node_bucket): round
+            # an odd device count down rather than assert on every batch
+            want = 1 << (n_dev.bit_length() - 1)
+        else:
+            try:
+                want = int(mesh_arg)
+            except ValueError:
+                raise SystemExit(f"--mesh must be 'auto', 'off' or an integer, got {mesh_arg!r}")
+            if want & (want - 1):
+                raise SystemExit(f"--mesh {want}: shard count must be a power of two")
+        if want > 1:
+            from .parallel import node_mesh
+
+            # an explicit --mesh N larger than the device count must FAIL
+            # loudly (node_mesh raises), never fall back to single-device
+            mesh = node_mesh(want)
     cfgr = Configurator(
         feature_gates=fg,
         batch_size=args.batch_size,
         deterministic=args.deterministic,
         service_lister=service_lister,
+        mesh=mesh,
     )
     cc = None
     if args.config:

@@ -60,10 +60,12 @@ def _solver_body(
     req_any: jnp.ndarray,  # [U] replicated
     sig: jnp.ndarray,  # [B] pod → spec row, replicated
     pod_valid: jnp.ndarray,  # [B] replicated
+    nzacc: jnp.ndarray,  # [Nl, 2] shard-local non-zero scoring accumulators
+    scoring_req: jnp.ndarray,  # [U, 2] replicated
     *,
     deterministic: bool,
     n_local: int,
-) -> jnp.ndarray:
+):
     """shard_map body: chunked prefix-acceptance greedy (the multi-chip
     twin of ops.solver.solve_greedy, bit-identical results). Pods are
     processed in chunks; each repair iteration pays a handful of [K]-wide
@@ -83,7 +85,7 @@ def _solver_body(
     noise_c = jnp.reshape(noise, (n_chunks, K, noise.shape[-1]))
 
     def chunk_step(carry, inp):
-        free, count = carry
+        free, count, nza = carry
         idx, nz = inp  # [K] pod positions; [K, Nl] local noise columns
         sg = sig[idx]
         pv = pod_valid[idx]
@@ -91,12 +93,13 @@ def _solver_body(
         s_r = score[sg]
         r_q = req[sg]  # [K, R]
         r_any = req_any[sg]
+        s_q = scoring_req[sg]  # [K, 2]
 
         def not_done(st):
-            return ~jnp.all(st[2])
+            return ~jnp.all(st[3])
 
         def body(st):
-            free, count, decided, choice = st
+            free, count, nza, decided, choice = st
             res_ok = (~r_any[:, None]) | jnp.all(
                 r_q[:, None, :] <= free[None, :, :], axis=-1
             )
@@ -152,45 +155,57 @@ def _solver_body(
             target = jnp.where(mine, lidx, n_local)
             free = free.at[target].add(-(mine[:, None] * r_q), mode="drop")
             count = count.at[target].add(mine.astype(count.dtype), mode="drop")
+            nza = nza.at[target].add(mine[:, None] * s_q, mode="drop")
             choice = jnp.where(commit, cand, choice)
             decided = decided | commit | newly_none
-            return free, count, decided, choice
+            return free, count, nza, decided, choice
 
         decided0 = ~pv
         choice0 = jnp.full((K,), -1, jnp.int32)
-        free, count, _, choice = jax.lax.while_loop(
-            not_done, body, (free, count, decided0, choice0)
+        free, count, nza, _, choice = jax.lax.while_loop(
+            not_done, body, (free, count, nza, decided0, choice0)
         )
-        return (free, count), choice
+        return (free, count, nza), choice
 
-    (_, _), choices = jax.lax.scan(chunk_step, (free, count), (order_c, noise_c))
-    return jnp.reshape(choices, (B,)).astype(jnp.int32)
+    (free_f, count_f, nz_f), choices = jax.lax.scan(
+        chunk_step, (free, count, nzacc), (order_c, noise_c)
+    )
+    return jnp.reshape(choices, (B,)).astype(jnp.int32), free_f, count_f, nz_f
 
 
 def make_sharded_pipeline(mesh: Mesh):
     """Build the jitted multi-chip pipeline bound to `mesh`.
 
-    Same signature/result contract as ops.pipeline.solve_pipeline:
-    (na, pa, ea, ta, xa, au, ids, key, deterministic) → (assign [B],
-    score [B, N]).
-    """
+    Full signature/result parity with ops.pipeline.solve_pipeline —
+    (na, pa, ea, ta, xa, au, ids, key, pb=None, carry=None,
+    deterministic=False, config=None, term_kinds=None, n_buckets=None,
+    return_carry=False) → (assign [B], score [U, N]) or
+    (assign, score, carry_out) — so the production driver can route
+    _dispatch_solve through it unchanged, speculative carry included.
+    The carry's free/count/nz residuals stay node-SHARDED on device
+    between batches (they never cross to the host)."""
     n_shards = mesh.shape[AXIS_NODES]
 
     def _c(x: jnp.ndarray, *spec) -> jnp.ndarray:
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
-    @partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds"))
-    def pipeline(
-        na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays,
-        au: Arrays, ids: Arrays, key, pb: Arrays = None,
-        deterministic: bool = False,
-        config: "SolveConfig" = None, term_kinds=None,
-    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    def _prep(na, pa, ea, ta, xa, au, ids, key, pb, carry,
+              deterministic, config, term_kinds, n_buckets):
         N = na["valid"].shape[0]
         assert N % n_shards == 0, f"node capacity {N} not divisible by {n_shards} shards"
         n_local = N // n_shards
         # pin every per-node bank array's leading axis to the mesh
         na = {k: _c(v, AXIS_NODES) for k, v in na.items()}
+        if carry is not None:
+            # speculative pipelining: the previous batch's device residuals
+            # replace the pod-driven node columns (ops/pipeline.py contract)
+            free_in, count_in, nz_in = carry
+            na = {
+                **na,
+                "requested": na["alloc"] - _c(free_in, AXIS_NODES),
+                "pod_count": _c(count_in, AXIS_NODES),
+                "nonzero_req": _c(nz_in, AXIS_NODES),
+            }
         # the signature-count matrix is node-major [N, S]: shard its node
         # axis too (signature metadata stays replicated — it is tiny); the
         # [T,S]x[S,N] count matmuls then produce node-sharded outputs
@@ -198,7 +213,8 @@ def make_sharded_pipeline(mesh: Mesh):
             ea = {**ea, "counts": _c(ea["counts"], AXIS_NODES)}
         # mask/score compute (shared stage — identical math to the
         # single-device pipelines): nodes sharded, batch data-parallel
-        mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds)
+        mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config,
+                                     term_kinds, n_buckets)
         mask = _c(mask, AXIS_PODS, AXIS_NODES)
         score = _c(score, AXIS_PODS, AXIS_NODES)
         # the greedy commit is a strict sequential order over the whole
@@ -209,6 +225,7 @@ def make_sharded_pipeline(mesh: Mesh):
         free0 = na["alloc"] - na["requested"]
         count0 = na["pod_count"].astype(free0.dtype)
         allowed = na["allowed_pods"].astype(free0.dtype)
+        nz0 = na["nonzero_req"].astype(free0.dtype)
         sig, pvalid, prio, b = _pod_axis(pa, pb)
         if sig is None:
             sig = jnp.arange(b, dtype=jnp.int32)
@@ -216,8 +233,9 @@ def make_sharded_pipeline(mesh: Mesh):
         if deterministic:
             noise = jnp.zeros((b, n_shards))
         else:
-            # bit-identical to the single-device solve_greedy stream:
-            # per-step keys, full-width uniform rows, sliced per shard
+            # bit-identical to the single-device solve_greedy stream: the
+            # counter-based tie_noise is a pure function of (key, row,
+            # global column), so each shard holds exactly its columns
             noise = tie_noise(key, b, N)
         solver = jax.shard_map(
             partial(_solver_body, deterministic=deterministic, n_local=n_local),
@@ -234,14 +252,82 @@ def make_sharded_pipeline(mesh: Mesh):
                 P(),                  # req_any
                 P(),                  # sig
                 P(),                  # pod_valid
+                P(AXIS_NODES),        # nz0
+                P(),                  # scoring_req
             ),
-            out_specs=P(),
+            out_specs=(
+                P(),                  # choices (replicated)
+                P(AXIS_NODES),        # free residuals (stay sharded)
+                P(AXIS_NODES),        # count residuals
+                P(AXIS_NODES),        # nz residuals
+            ),
         )
-        choices = solver(
-            mask, score, pa["req"], free0, count0, allowed, order, noise,
-            pa["req_any"], sig, pvalid,
-        )
+        scoring_req = pa.get("scoring_req")
+        if scoring_req is None:
+            scoring_req = jnp.zeros((pa["req"].shape[0], 2), free0.dtype)
+        args = (mask, score, pa["req"], free0, count0, allowed, order, noise,
+                pa["req_any"], sig, pvalid, nz0, scoring_req)
+        return solver, args, score, order, b, pvalid
+
+    @partial(jax.jit, static_argnames=(
+        "deterministic", "config", "term_kinds", "n_buckets", "return_carry"
+    ))
+    def pipeline(
+        na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays,
+        au: Arrays, ids: Arrays, key, pb: Arrays = None, carry=None,
+        deterministic: bool = False,
+        config: "SolveConfig" = None, term_kinds=None, n_buckets=None,
+        return_carry: bool = False,
+    ):
+        solver, args, score, order, b, _ = _prep(
+            na, pa, ea, ta, xa, au, ids, key, pb, carry,
+            deterministic, config, term_kinds, n_buckets)
+        choices, free_f, count_f, nz_f = solver(*args)
         assign = jnp.full((b,), -1, jnp.int32).at[order].set(choices)
+        if return_carry:
+            return assign, score, (free_f, count_f, nz_f)
         return assign, score
 
+    @partial(jax.jit, static_argnames=(
+        "deterministic", "config", "term_kinds", "n_buckets"
+    ))
+    def pipeline_gang(
+        na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays,
+        au: Arrays, ids: Arrays, key, group: jnp.ndarray, pb: Arrays = None,
+        deterministic: bool = False,
+        config: "SolveConfig" = None, term_kinds=None, n_buckets=None,
+    ):
+        """All-or-nothing two-pass gang solve on the mesh (the multi-chip
+        twin of ops.pipeline.solve_pipeline_gang): pass 1 places everything;
+        groups with an unplaced member are dropped (replicated [B]
+        elementwise math) and pass 2 re-solves without them."""
+        k1, k2 = jax.random.split(key)
+        solver, args, score, order, b, pvalid = _prep(
+            na, pa, ea, ta, xa, au, ids, k1, pb, None,
+            deterministic, config, term_kinds, n_buckets)
+        choices, _, _, _ = solver(*args)
+        first = jnp.full((b,), -1, jnp.int32).at[order].set(choices)
+        grouped = group >= 0
+        failed_member = grouped & (first < 0)
+        fail_by_group = jnp.zeros(b, bool).at[
+            jnp.where(grouped, group, 0)
+        ].max(failed_member)
+        dropped = grouped & fail_by_group[jnp.where(grouped, group, 0)]
+        alive = pvalid & ~dropped
+        # pass 2 reuses pass 1's mask/score/solver (same bit-parity recipe
+        # as ops.solver.solve_gang) — only the tie-noise stream and the
+        # alive set change
+        args2 = list(args)
+        N = na["valid"].shape[0]
+        args2[7] = (
+            jnp.zeros((b, n_shards)) if deterministic
+            else _c(tie_noise(k2, b, N), None, AXIS_NODES)
+        )
+        args2[10] = alive
+        choices2, _, _, _ = solver(*args2)
+        second = jnp.full((b,), -1, jnp.int32).at[order].set(choices2)
+        gang_ok = ~dropped
+        return jnp.where(dropped, -1, second), score, gang_ok
+
+    pipeline.gang = pipeline_gang
     return pipeline

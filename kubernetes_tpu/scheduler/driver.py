@@ -393,6 +393,7 @@ class Scheduler:
         solve_config=None,
         speculate: bool = True,
         spec_depth: int = 2,
+        mesh=None,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -403,6 +404,17 @@ class Scheduler:
         if qs_less is not None:
             self.queue.set_queue_sort(qs_less)
         self.mirror = TensorMirror(self.cache)
+        # multi-chip: a jax.sharding.Mesh with a "nodes" axis routes every
+        # solve through parallel.sharded.make_sharded_pipeline (node columns
+        # + greedy residuals shard-local, SURVEY §2.4); the mirror keeps its
+        # device banks sharded-resident so per-batch patches never reshard
+        self.mesh = mesh
+        self._sharded = None
+        if mesh is not None:
+            from ..parallel.sharded import make_sharded_pipeline
+
+            self._sharded = make_sharded_pipeline(mesh)
+            self.mirror.set_mesh(mesh)
         self.batch_size = batch_size
         self.enable_preemption = enable_preemption
         self.deterministic = deterministic
@@ -629,7 +641,8 @@ class Scheduler:
             for i, gn in enumerate(group_names):
                 if gn:
                     garr[i] = gid_map.setdefault(gn, len(gid_map))
-            assign, score, gang_ok = solve_pipeline_gang(
+            gang_fn = self._sharded.gang if self._sharded is not None else solve_pipeline_gang
+            assign, score, gang_ok = gang_fn(
                 *args, garr, pb=pb, deterministic=self.deterministic,
                 config=self.solve_config, term_kinds=term_kinds,
                 n_buckets=n_buckets,
@@ -637,7 +650,8 @@ class Scheduler:
             gang_dev = gang_ok
         else:
             t_d = time.perf_counter()
-            assign, score, carry_out = solve_pipeline(
+            solve_fn = self._sharded if self._sharded is not None else solve_pipeline
+            assign, score, carry_out = solve_fn(
                 *args, pb=pb, carry=carry, deterministic=self.deterministic,
                 config=self.solve_config, term_kinds=term_kinds,
                 n_buckets=n_buckets, return_carry=True,

@@ -515,6 +515,27 @@ class TensorMirror:
             self.generation += 1
             return False
 
+    def set_mesh(self, mesh) -> None:
+        """Keep the node-major device banks SHARDED-resident on `mesh`
+        (leading axis split over the "nodes" mesh axis). Without this the
+        sharded pipeline would reshard replicated inputs on every dispatch.
+        Patches preserve the sharding (the jitted row-scatter's output
+        inherits its input's)."""
+        self._mesh = mesh
+        self._device_stale = True  # next device_arrays re-uploads sharded
+
+    def _to_dev(self, v, node_major: bool):
+        import jax
+        import jax.numpy as jnp
+
+        if node_major and getattr(self, "_mesh", None) is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(
+                jnp.asarray(v), NamedSharding(self._mesh, P("nodes"))
+            )
+        return jnp.asarray(v)
+
     def device_arrays(self):
         """(nodes, eps, pats) as DEVICE-resident dicts, patched with only
         the rows sync() touched since the last call. Full upload only after
@@ -526,9 +547,13 @@ class TensorMirror:
         host_e = self.eps.arrays()
         host_p = self.pats.arrays()
         if self._dev_nodes is None or self._device_stale:
-            self._dev_nodes = {k: jnp.asarray(v) for k, v in host_n.items()}
-            self._dev_eps = {k: jnp.asarray(v) for k, v in host_e.items()}
-            self._dev_pats = {k: jnp.asarray(v) for k, v in host_p.items()}
+            self._dev_nodes = {k: self._to_dev(v, True) for k, v in host_n.items()}
+            self._dev_eps = {
+                k: self._to_dev(v, k == "counts") for k, v in host_e.items()
+            }
+            self._dev_pats = {
+                k: self._to_dev(v, k == "counts") for k, v in host_p.items()
+            }
             self._device_stale = False
             self._image_stale = False
             self._pending_node_rows.clear()
@@ -558,7 +583,12 @@ class TensorMirror:
             }
             if changed:
                 dev = dict(dev)
-                dev.update({k: jnp.asarray(v) for k, v in changed.items()})
+                # node-major arrays: every nodes-bank array plus the banks'
+                # per-node count matrices (leading axis = node capacity)
+                dev.update({
+                    k: self._to_dev(v, host is host_n or k == "counts")
+                    for k, v in changed.items()
+                })
             if not rows:
                 return dev
             cap = next(iter(host.values())).shape[0]
