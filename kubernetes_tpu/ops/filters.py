@@ -98,18 +98,28 @@ def pod_fits_resources(nodes: Arrays, pods: Arrays) -> jnp.ndarray:
     return count_ok & (fits | ~pods["req_any"][:, None])
 
 
+def port_clash(num_a, proto_a, ip_a, num_b, proto_b, ip_b, wild) -> jnp.ndarray:
+    """HostPortInfo.CheckConflict core: same (protocol, port) conflicts
+    when either IP is the wildcard or they're equal. Inputs broadcast; the
+    port-list axes are reduced by the caller. The ONE definition shared by
+    the pod-vs-node mask (pod_fits_host_ports) and the pod-vs-pod in-batch
+    tracking matrix (pipeline._inbatch_tensors) so they can never
+    diverge."""
+    ip_clash = (ip_a == wild) | (ip_b == wild) | (ip_a == ip_b)
+    return (num_a > 0) & (num_b > 0) & (num_a == num_b) & (proto_a == proto_b) & ip_clash
+
+
 def pod_fits_host_ports(nodes: Arrays, pods: Arrays, ids: Arrays) -> jnp.ndarray:
-    """PodFitsHostPorts (predicates.go:1161) / HostPortInfo.CheckConflict:
-    same (protocol, port) conflicts when either IP is 0.0.0.0 or they're
-    equal."""
-    pp = pods["port_num"][:, None, :, None]  # [B, 1, PP, 1]
-    np_ = nodes["port_num"][None, :, None, :]  # [1, N, 1, P]
-    proto_eq = pods["port_proto"][:, None, :, None] == nodes["port_proto"][None, :, None, :]
-    pip = pods["port_ip"][:, None, :, None]
-    nip = nodes["port_ip"][None, :, None, :]
-    wild = ids["wildcard_ip"]
-    ip_clash = (pip == wild) | (nip == wild) | (pip == nip)
-    conflict = (pp > 0) & (np_ > 0) & (pp == np_) & proto_eq & ip_clash
+    """PodFitsHostPorts (predicates.go:1161)."""
+    conflict = port_clash(
+        pods["port_num"][:, None, :, None],  # [B, 1, PP, 1]
+        pods["port_proto"][:, None, :, None],
+        pods["port_ip"][:, None, :, None],
+        nodes["port_num"][None, :, None, :],  # [1, N, 1, P]
+        nodes["port_proto"][None, :, None, :],
+        nodes["port_ip"][None, :, None, :],
+        ids["wildcard_ip"],
+    )
     return ~jnp.any(conflict, axis=(2, 3))
 
 

@@ -159,8 +159,49 @@ def _pod_axis(pa: Arrays, pb: Optional[Arrays]):
     return sig, pb["valid"], pb["priority"], sig.shape[0]
 
 
+def _inbatch_tensors(na, pa, ta, ids, n_buckets):
+    """Build solve_greedy's `inb` dict: the device-side state that lets the
+    solver sequentialize required anti-affinity and host-port conflicts
+    WITHIN the batch (kills the commit loop's per-pod LIGHT rechecks)."""
+    from .topology import ANTI_REQ, _bucket_of, match_terms
+
+    N = na["valid"].shape[0]
+    U = pa["valid"].shape[0]
+    V = n_buckets or N
+    anti = ta["valid"] & (ta["kind"] == ANTI_REQ)
+    m_bb = match_terms(ta, pa["label_vals"], pa["ns_id"])  # [TT, U]
+    bucket_n, haskey_n = _bucket_of(na, ta["topo_slot"])  # [TT, N]
+    TT = anti.shape[0]
+    # pairwise spec port conflicts — same CheckConflict core as the
+    # pod-vs-node mask (filters.port_clash), reduced over both port lists
+    pconf = jnp.any(
+        F.port_clash(
+            pa["port_num"][:, None, :, None],
+            pa["port_proto"][:, None, :, None],
+            pa["port_ip"][:, None, :, None],
+            pa["port_num"][None, :, None, :],
+            pa["port_proto"][None, :, None, :],
+            pa["port_ip"][None, :, None, :],
+            ids["wildcard_ip"],
+        ),
+        axis=(2, 3),
+    )  # [U, U]
+    return {
+        "anti": anti,
+        "owner": ta["owner"].astype(jnp.int32),
+        "m_bb": m_bb,
+        "bucket_n": bucket_n,
+        "haskey_n": haskey_n,
+        "port_conflict": pconf,
+        "ca0": jnp.zeros((TT, V), jnp.float32),
+        "cb0": jnp.zeros((TT, V), jnp.float32),
+        "cs0": jnp.zeros((U, N), jnp.float32),
+    }
+
+
 @partial(jax.jit, static_argnames=(
-    "deterministic", "config", "term_kinds", "n_buckets", "return_carry"
+    "deterministic", "config", "term_kinds", "n_buckets", "return_carry",
+    "track_inbatch",
 ))
 def solve_pipeline(
     na: Arrays,  # NodeBank arrays
@@ -178,6 +219,7 @@ def solve_pipeline(
     term_kinds: Optional[frozenset] = None,
     n_buckets: Optional[int] = None,
     return_carry: bool = False,
+    track_inbatch: bool = False,
 ):
     """mask → score → greedy solve. Returns (assign [B], score [U, N])
     (+ the post-batch (free, count, nz) residual carry when return_carry).
@@ -218,6 +260,7 @@ def solve_pipeline(
         return_carry=return_carry,
         nz0=na["nonzero_req"].astype(free0.dtype) if return_carry else None,
         scoring_req=pa["scoring_req"] if return_carry else None,
+        inb=_inbatch_tensors(na, pa, ta, ids, n_buckets) if track_inbatch else None,
     )
     if return_carry:
         assign, carry_out = result

@@ -105,6 +105,7 @@ def solve_greedy(
     return_carry: bool = False,
     nz0: Optional[jnp.ndarray] = None,  # [N, 2] non-zero scoring accumulators
     scoring_req: Optional[jnp.ndarray] = None,  # [U, 2] per-spec scoring request
+    inb: Optional[Dict[str, jnp.ndarray]] = None,  # in-batch anti/port tracking
 ):
     """Greedy-by-priority batch assignment → node row per pod, -1 = no fit.
 
@@ -127,7 +128,25 @@ def solve_greedy(
     The mask/score/req rows are per unique pod SPEC (replica sets collapse
     to one row each; state/tensors dedup); `sig` maps each batch position to
     its spec row. With sig=None the mapping is the identity (one row per
-    pod) — the pre-dedup behavior, kept for tests and small callers."""
+    pod) — the pre-dedup behavior, kept for tests and small callers.
+
+    `inb` (optional) turns on IN-BATCH sequentialization of required
+    anti-affinity and host-port conflicts on device: the solver carries
+    per-(term, topology-value) commit counts (both directions — my term vs
+    committed matchers, committed owners vs my labels) plus a per-(spec,
+    node) commit table for port conflicts, masking later pods exactly the
+    way the sequential walk would (predicates.go:1284
+    satisfiesExistingPodsAntiAffinity applied within the batch). Without it
+    those conflicts are the host commit loop's LIGHT-recheck business.
+    Keys: anti [TT]b, owner [TT]i32, m_bb [TT,U]b (term matches spec
+    labels+ns), bucket_n [TT,N]i32, haskey_n [TT,N]b, port_conflict [U,U]b,
+    ca0/cb0 [TT,V]f32, cs0 [U,N]f32.
+
+    Sequential equivalence with tracking: commits stay a strict prefix of
+    the undecided order, truncated at the first SENSITIVE pod (one whose
+    feasibility other commits can change, or whose commit can change
+    others'), so every committed pod's anti/port mask reflects exactly the
+    commits sequentially before it."""
     U, N = mask.shape
     if req_any is None:
         req_any = jnp.any(req > 0, axis=-1)
@@ -152,9 +171,32 @@ def solve_greedy(
         nz0 = jnp.zeros((N, 2), free0.dtype)
     if scoring_req is None:
         scoring_req = jnp.zeros((U, 2), free0.dtype)
+    track = inb is not None
+    if track:
+        t_anti = inb["anti"]  # [TT] bool: valid required-anti term rows
+        t_owner = inb["owner"]  # [TT] int32 spec row owning the term
+        m_bb = inb["m_bb"] & t_anti[:, None]  # [TT, U]
+        bucket_n = inb["bucket_n"]  # [TT, N] topo value per node (term's key)
+        haskey_n = inb["haskey_n"]  # [TT, N] node carries the topo key
+        pconf = inb["port_conflict"]  # [U, U]
+        ca0, cb0, cs0 = inb["ca0"], inb["cb0"], inb["cs0"]
+        TT = t_anti.shape[0]
+        t_rows = jnp.arange(TT, dtype=jnp.int32)[:, None]
+        Vb = ca0.shape[1]
+        # a spec is SENSITIVE if commits can move its anti/port feasibility
+        # or its commit can move others': owns an anti term, is matched by
+        # one, or carries host ports (pconf diagonal: self-conflict)
+        own_any = (
+            jnp.zeros((U + 1,), bool)
+            .at[jnp.where(t_anti, t_owner, U)]
+            .max(t_anti, mode="drop")[:U]
+        )
+        sens_u = own_any | jnp.any(m_bb, axis=0) | jnp.diagonal(pconf)
+    else:
+        sens_u = None
 
     def chunk_step(carry, inp):
-        free, count, nzacc = carry
+        free, count, nzacc, ca, cb, cs = carry
         idx, nz = inp  # [K] pod positions in order; [K, N] noise rows
         sg = sig[idx]
         pv = pod_valid[idx]
@@ -163,12 +205,17 @@ def solve_greedy(
         r_q = req[sg]  # [K, R]
         r_any = req_any[sg]  # [K]
         s_q = scoring_req[sg]  # [K, 2]
+        if track:
+            sens_k = sens_u[sg]  # [K]
+            ownK = (t_owner[None, :] == sg[:, None]) & t_anti[None, :]  # [K, TT]
+            mbbK = m_bb[:, sg].T  # [K, TT]
+            pconfK = pconf[sg].astype(jnp.float32)  # [K, U]
 
         def not_done(st):
-            return ~jnp.all(st[3])
+            return ~jnp.all(st[6])
 
         def body(st):
-            free, count, nzacc, decided, choice = st
+            free, count, nzacc, ca, cb, cs, decided, choice = st
             # PodFitsResources (predicates.go:854): the pod-count check
             # always applies; the resource rows only when the pod requests
             # anything, so empty-request pods pass even on overcommitted
@@ -177,6 +224,23 @@ def solve_greedy(
                 r_q[:, None, :] <= free[None, :, :], axis=-1
             )  # [K, N]
             feas = m_r & res_ok & (count[None, :] + 1 <= allowed[None, :])
+            if track:
+                # in-batch anti/port exclusion from commits so far (exact:
+                # the commit barrier below guarantees these counts cover
+                # every sequentially-earlier sensitive commit)
+                hp = jax.lax.Precision.HIGHEST
+                ca_pos = ((jnp.take_along_axis(ca, bucket_n, axis=1) > 0) & haskey_n)
+                cb_pos = ((jnp.take_along_axis(cb, bucket_n, axis=1) > 0) & haskey_n)
+                blockA = jnp.matmul(
+                    ownK.astype(jnp.float32), ca_pos.astype(jnp.float32), precision=hp
+                ) > 0.5
+                blockB = jnp.matmul(
+                    mbbK.astype(jnp.float32), cb_pos.astype(jnp.float32), precision=hp
+                ) > 0.5
+                blockP = jnp.matmul(
+                    pconfK, (cs > 0).astype(jnp.float32), precision=hp
+                ) > 0.5
+                feas = feas & ~(blockA | blockB | blockP)
             feas = feas & ~decided[:, None]
             anyf = jnp.any(feas, axis=1)
             masked = jnp.where(feas, s_r, neg)
@@ -210,6 +274,13 @@ def solve_greedy(
             rejected = active & ~fits
             first_rej = jnp.min(jnp.where(rejected, jrange, K))
             commit = active & (jrange < first_rej)
+            if track:
+                # commit barrier: nothing past the first sensitive pod
+                # commits this round, so a committed pod's anti/port mask
+                # saw exactly the commits sequentially before it (the first
+                # active pod is always committable → progress holds)
+                first_sens = jnp.min(jnp.where(active & sens_k, jrange, K))
+                commit = commit & (jrange <= first_sens)
             # apply commits (duplicate indices accumulate; index N drops)
             target = jnp.where(commit, cand, N)
             free = free.at[target].add(
@@ -219,20 +290,40 @@ def solve_greedy(
                 commit.astype(count.dtype), mode="drop"
             )
             nzacc = nzacc.at[target].add(commit[:, None] * s_q, mode="drop")
+            if track:
+                # record the commits into the in-batch anti/port state
+                cidx2 = jnp.where(commit, cand, 0)
+                bcand = bucket_n[:, cidx2]  # [TT, K] topo value of each commit
+                hk = haskey_n[:, cidx2] & commit[None, :]
+                one = jnp.float32(1.0)
+                ca = ca.at[
+                    t_rows, jnp.where(m_bb[:, sg] & hk, bcand, Vb)
+                ].add(one, mode="drop")
+                cb = cb.at[
+                    t_rows, jnp.where(ownK.T & hk, bcand, Vb)
+                ].add(one, mode="drop")
+                cs = cs.at[
+                    jnp.where(commit, sg, U), jnp.where(commit, cand, 0)
+                ].add(one, mode="drop")
             choice = jnp.where(commit, cand, choice)
             decided = decided | commit | newly_none
-            return free, count, nzacc, decided, choice
+            return free, count, nzacc, ca, cb, cs, decided, choice
 
         decided0 = ~pv  # padding/invalid pods are decided at -1
         choice0 = jnp.full((K,), -1, jnp.int32)
-        free, count, nzacc, _, choice = jax.lax.while_loop(
-            not_done, body, (free, count, nzacc, decided0, choice0)
+        free, count, nzacc, ca, cb, cs, _, choice = jax.lax.while_loop(
+            not_done, body, (free, count, nzacc, ca, cb, cs, decided0, choice0)
         )
-        return (free, count, nzacc), choice
+        return (free, count, nzacc, ca, cb, cs), choice
 
+    if track:
+        carry0 = (free0, count0, nz0, ca0, cb0, cs0)
+    else:
+        _z = jnp.zeros((1, 1), jnp.float32)
+        carry0 = (free0, count0, nz0, _z, _z, _z)
     order_c = jnp.reshape(order, (n_chunks, K))
-    (free_f, count_f, nz_f), choices = jax.lax.scan(
-        chunk_step, (free0, count0, nz0), (order_c, noise)
+    (free_f, count_f, nz_f, _, _, _), choices = jax.lax.scan(
+        chunk_step, carry0, (order_c, noise)
     )
     # scatter back to original pod positions
     out = jnp.full((B,), -1, jnp.int32)

@@ -128,6 +128,10 @@ class SolveOutput:
     # [len(pods)] RECHECK_* per pod, computed once per unique SPEC at
     # dispatch (the level is a pure function of spec-key fields)
     levels: Optional[np.ndarray] = None
+    # the device solve sequentialized required anti-affinity + host ports
+    # WITHIN the batch (ops/solver.py inb): non-speculative batches can skip
+    # the host LIGHT rechecks while commits follow the device's choices
+    inbatch_tracked: bool = False
 
 
 class ExtenderError(Exception):
@@ -230,10 +234,13 @@ class _BatchConflictIndex:
         self._commits_by_kv: Dict[Tuple[str, str], List[Pod]] = {}
         self._rolled_back: set = set()
         self.any_anti = False
+        self.any_ports = False
         self.commits: List[Pod] = []  # flat, in commit order
 
     def add_commit(self, pod: Pod, node) -> None:
         self.commits.append(pod)
+        if pod.host_ports():
+            self.any_ports = True
         for kv in node.labels.items():
             self._commits_by_kv.setdefault(kv, []).append(pod)
 
@@ -410,10 +417,13 @@ class Scheduler:
         # device banks sharded-resident so per-batch patches never reshard
         self.mesh = mesh
         self._sharded = None
+        self._mesh_shards = 0
         if mesh is not None:
+            from ..parallel.mesh import AXIS_NODES
             from ..parallel.sharded import make_sharded_pipeline
 
             self._sharded = make_sharded_pipeline(mesh)
+            self._mesh_shards = mesh.shape[AXIS_NODES]
             self.mirror.set_mesh(mesh)
         self.batch_size = batch_size
         self.enable_preemption = enable_preemption
@@ -451,6 +461,10 @@ class Scheduler:
         self._b_bucket = 16
         self._u_bucket = 16  # unique-spec axis (≤ _b_bucket)
         self._t_bucket = 16
+        # monotone jit-static: once a batch carries required anti-affinity
+        # or host ports, compile the in-batch tracking variant and keep it
+        # (a superset program is exact on batches without those features)
+        self._track_inbatch = False
         self._ids = None  # cached device constants (filters.make_ids)
         # speculative pipelining state: a CHAIN of up to spec_depth
         # pre-dispatched solves, each chained on the previous dispatch's
@@ -616,6 +630,13 @@ class Scheduler:
         )
         n_buckets = self._v_bucket
         na_dev, ea_dev, xp_dev = self.mirror.device_arrays()
+        # tiny clusters on big meshes: capacity buckets guarantee shard
+        # divisibility only once capacity >= shard count — fall back to the
+        # single-device pipeline instead of asserting on every batch
+        use_sharded = (
+            self._sharded is not None
+            and int(na_dev["valid"].shape[0]) % self._mesh_shards == 0
+        )
         t_patch = time.perf_counter()
         self.stats["patch_s"] = self.stats.get("patch_s", 0.0) + (t_patch - t1)
         args = (
@@ -641,7 +662,7 @@ class Scheduler:
             for i, gn in enumerate(group_names):
                 if gn:
                     garr[i] = gid_map.setdefault(gn, len(gid_map))
-            gang_fn = self._sharded.gang if self._sharded is not None else solve_pipeline_gang
+            gang_fn = self._sharded.gang if use_sharded else solve_pipeline_gang
             assign, score, gang_ok = gang_fn(
                 *args, garr, pb=pb, deterministic=self.deterministic,
                 config=self.solve_config, term_kinds=term_kinds,
@@ -650,12 +671,30 @@ class Scheduler:
             gang_dev = gang_ok
         else:
             t_d = time.perf_counter()
-            solve_fn = self._sharded if self._sharded is not None else solve_pipeline
-            assign, score, carry_out = solve_fn(
-                *args, pb=pb, carry=carry, deterministic=self.deterministic,
-                config=self.solve_config, term_kinds=term_kinds,
-                n_buckets=n_buckets, return_carry=True,
-            )
+            if use_sharded:
+                # the sharded twin keeps the host LIGHT-recheck contract
+                # (in-batch tracking needs cross-shard bucket broadcasts —
+                # not implemented; semantics preserved via the commit loop)
+                assign, score, carry_out = self._sharded(
+                    *args, pb=pb, carry=carry, deterministic=self.deterministic,
+                    config=self.solve_config, term_kinds=term_kinds,
+                    n_buckets=n_buckets, return_carry=True,
+                )
+            else:
+                if self._sharded is None:
+                    # monotone only on the pure single-device path: a mesh
+                    # scheduler falling back for a tiny capacity must keep
+                    # the host LIGHT rechecks (its solves alternate paths)
+                    self._track_inbatch = self._track_inbatch or (
+                        "anti_req" in term_kinds
+                        or any(p.host_ports() for p in reps)
+                    )
+                assign, score, carry_out = solve_pipeline(
+                    *args, pb=pb, carry=carry, deterministic=self.deterministic,
+                    config=self.solve_config, term_kinds=term_kinds,
+                    n_buckets=n_buckets, return_carry=True,
+                    track_inbatch=self._track_inbatch and self._sharded is None,
+                )
             # dispatch_s = host upload + trace-cache lookup + enqueue (async)
             self.stats["dispatch_s"] = self.stats.get("dispatch_s", 0.0) + (
                 time.perf_counter() - t_d
@@ -675,6 +714,7 @@ class Scheduler:
             carry_dev=carry_out,
             existing_overflow=existing_overflow,
             speculative=carry is not None,
+            tracked=self._track_inbatch and self._sharded is None and gang_dev is None,
         )
 
     def _finish_solve(self, disp: Dict) -> SolveOutput:
@@ -706,6 +746,7 @@ class Scheduler:
             gang_ok=gang_ok_arr,
             speculative=disp["speculative"],
             levels=disp["levels"][sig_arr],
+            inbatch_tracked=disp.get("tracked", False),
         )
 
     def _pod_meta(self, pod: Pod):
@@ -737,6 +778,7 @@ class Scheduler:
         pod: Pod,
         node_name: str,
         index: "_BatchConflictIndex",
+        prior: Optional[List["_BatchConflictIndex"]] = None,
     ) -> bool:
         """Can an earlier commit of THIS batch invalidate pod→node_name?
         The cheap replacement for the full oracle pass (which is O(cluster)
@@ -754,7 +796,14 @@ class Scheduler:
             return True
         if pod.host_ports() and ni.host_port_conflict(pod):
             return True
-        return index.anti_conflict(pod, ni.node)
+        if index.anti_conflict(pod, ni.node):
+            return True
+        # prior batches' commit indices (consumed speculative entries carry
+        # them): the device solved this batch before those commits existed
+        for ix in prior or ():
+            if ix.anti_conflict(pod, ni.node):
+                return True
+        return False
 
     def _oracle_place(
         self, pod: Pod, score_row: np.ndarray, meta, state: Optional[CycleState] = None
@@ -1197,6 +1246,12 @@ class Scheduler:
             and pending["dispatch_gen"] + pending["acc"] == self.cache.mutation_count
             and pending["rebuild_count"] == self.mirror.rebuild_count
         )
+        # conflict indices of batches committed between this entry's
+        # dispatch and now (tracked chains survive anti/port commits; the
+        # stale device mask is patched by checking these host-side)
+        prior_ix: List[_BatchConflictIndex] = (
+            pending.get("prior") or []
+        ) if use_pending else []
         try:
             t_solve = time.perf_counter()
             if use_pending:
@@ -1378,15 +1433,28 @@ class Scheduler:
                     or out.existing_overflow
                     or host_filter
                     or level == RECHECK_FULL
-                    # speculative solve: topology/port counts are one batch
-                    # stale — LIGHT pods escalate to the live-snapshot check
-                    or (out.speculative and level == RECHECK_LIGHT)
+                    # speculative solve without device tracking: topology/
+                    # port counts are one batch stale — LIGHT pods escalate
+                    # to the live-snapshot check. With tracking, the prior
+                    # conflict indices + live-snapshot ports cover exactly
+                    # the staleness (needs_light below).
+                    or (out.speculative and level == RECHECK_LIGHT
+                        and not out.inbatch_tracked)
                     or (
                         self.volume_checker is not None
                         and bool(scheduling_relevant_volumes(pod))
                     )
                 )
-                needs_light = level == RECHECK_LIGHT or conflict_index.any_anti
+                # the device sequentialized anti/ports within this batch:
+                # LIGHT rechecks are redundant while commits follow the
+                # device's picks (divergence re-arms them) and the solve was
+                # not speculative (cross-batch staleness keeps the FULL
+                # escalation above)
+                tracked_ok = out.inbatch_tracked and not residuals_diverged
+                needs_light = (
+                    (level == RECHECK_LIGHT or conflict_index.any_anti)
+                    and not tracked_ok
+                ) or bool(prior_ix)
                 pod_host_rank = force_host_rank or (
                     bool(self.extenders)
                     and any(
@@ -1429,7 +1497,7 @@ class Scheduler:
                         # can invalidate a LIGHT pod's device placement
                         self.stats["light_rechecks"] += 1
                         ok = not self._intra_batch_conflict(
-                            pod, node_name, conflict_index
+                            pod, node_name, conflict_index, prior=prior_ix
                         )
                         if ok and residuals_diverged:
                             ni = self.cache.snapshot.get(node_name)
@@ -1636,7 +1704,10 @@ class Scheduler:
                 residuals_diverged
                 or res.errors
                 or res.preempted
-                or conflict_index.any_anti
+                # without device tracking, anti commits invalidate the
+                # speculated masks wholesale; with it, the carried conflict
+                # index patches them at consume time (needs_light)
+                or (conflict_index.any_anti and not out.inbatch_tracked)
             ):
                 for e in self._spec_chain:
                     e["disp"] = None
@@ -1649,6 +1720,9 @@ class Scheduler:
                 # equality check at consume time
                 for e in self._spec_chain:
                     e["acc"] += res.scheduled
+                if conflict_index.any_anti or conflict_index.any_ports:
+                    for e in self._spec_chain:
+                        e.setdefault("prior", []).append(conflict_index)
         trace.step("commit loop")
         M.scheduling_algorithm_duration.observe(trace.total_seconds())
         M.schedule_attempts.inc(M.SCHEDULED, by=res.scheduled)

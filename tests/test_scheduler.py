@@ -441,3 +441,26 @@ def test_spec_chain_poisoned_on_miss():
     post_event = {k: n for k, n in binds.items() if k not in ("default/p0", "default/p1")}
     assert all(n != "n0" or used.get("n0", 0) + 900 <= 1000 + 300 * 2
                for n in post_event.values()), (binds, used)
+
+
+def test_inbatch_tracking_skips_light_rechecks():
+    """With device-side in-batch anti tracking, a non-speculative batch of
+    mutually-anti pods must commit with ZERO host LIGHT rechecks and still
+    land one pod per hostname domain (round-2 VERDICT weak #3)."""
+    HOST = "kubernetes.io/hostname"
+    nodes = [make_node(f"n{i}", labels={HOST: f"n{i}"}) for i in range(4)]
+    sched, binds = _mk_scheduler(nodes, speculate=False)
+    term = PodAffinityTerm(
+        label_selector=LabelSelector(match_labels={"app": "x"}),
+        topology_key=HOST,
+    )
+    for i in range(5):
+        p = make_pod(f"p{i}", labels={"app": "x"})
+        p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[term]))
+        sched.queue.add(p)
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 4 and res.unschedulable == 1, res
+    assert len(set(res.assignments.values())) == 4
+    assert sched.stats.get("light_rechecks", 0) == 0, sched.stats
+    assert sched.stats.get("oracle_places", 0) == 0, sched.stats

@@ -272,3 +272,79 @@ def test_chunk_guard_non_divisible_batch():
     ))
     expect = _sequential(mask, score, req, free, count, allowed, order)
     assert (got == expect).all()
+
+
+def test_inbatch_anti_tracking_matches_sequential():
+    """solve_greedy with `inb`: required anti-affinity conflicts between
+    BATCH pods must resolve exactly like the sequential walk — the earlier
+    pod (in order) wins the topology domain, later conflicting pods move
+    or go -1 — with no host involvement."""
+    # 4 nodes in 2 zones (bucket 0/1); every pod mutually anti on zone
+    N, B = 4, 4
+    mask = np.ones((B, N), bool)
+    score = np.zeros((B, N), np.int64)
+    score[:, 0] = 5  # all prefer node 0 (zone 0)
+    req = np.ones((B, 1), np.int64)
+    free = np.full((N, 1), 100, np.int64)
+    count = np.zeros(N, np.int64)
+    allowed = np.full(N, 10, np.int64)
+    order = np.arange(B, dtype=np.int32)
+    TT, V = 4, 2
+    zone_of_node = np.array([0, 0, 1, 1], np.int32)
+    inb = {
+        # one anti term per pod, all selecting everyone (mutual anti)
+        "anti": jnp.asarray(np.array([True] * B)),
+        "owner": jnp.asarray(np.arange(B, dtype=np.int32)),
+        "m_bb": jnp.asarray(np.ones((TT, B), bool)),
+        "bucket_n": jnp.asarray(np.broadcast_to(zone_of_node, (TT, N)).copy()),
+        "haskey_n": jnp.asarray(np.ones((TT, N), bool)),
+        "port_conflict": jnp.asarray(np.zeros((B, B), bool)),
+        "ca0": jnp.zeros((TT, V), jnp.float32),
+        "cb0": jnp.zeros((TT, V), jnp.float32),
+        "cs0": jnp.zeros((B, N), jnp.float32),
+    }
+    got = np.asarray(solve_greedy(
+        jnp.asarray(mask), jnp.asarray(score), jnp.asarray(req),
+        jnp.asarray(free), jnp.asarray(count), jnp.asarray(allowed),
+        jnp.asarray(order), jax.random.PRNGKey(0), deterministic=True,
+        req_any=jnp.ones(B, bool), inb=inb,
+    ))
+    # sequential: pod0 -> node0 (zone0); pod1 blocked in zone0 -> first
+    # zone-1 node (2); pods 2,3: both zones occupied -> -1
+    assert got.tolist() == [0, 2, -1, -1], got
+
+
+def test_inbatch_port_tracking_matches_sequential():
+    """Host-port conflicts between batch pods: the spec x spec conflict
+    matrix + per-(spec, node) commit table must force later replicas of a
+    ported spec onto distinct nodes (hostname semantics)."""
+    N, B = 3, 4
+    mask = np.ones((B, N), bool)
+    score = np.zeros((B, N), np.int64)
+    score[:, 0] = 3
+    score[:, 1] = 2
+    req = np.ones((B, 1), np.int64)
+    free = np.full((N, 1), 100, np.int64)
+    order = np.arange(B, dtype=np.int32)
+    TT, V = 1, 1
+    pconf = np.ones((B, B), bool)  # every pod carries the same host port
+    inb = {
+        "anti": jnp.asarray(np.zeros(TT, bool)),
+        "owner": jnp.asarray(np.zeros(TT, np.int32)),
+        "m_bb": jnp.asarray(np.zeros((TT, B), bool)),
+        "bucket_n": jnp.asarray(np.zeros((TT, N), np.int32)),
+        "haskey_n": jnp.asarray(np.zeros((TT, N), bool)),
+        "port_conflict": jnp.asarray(pconf),
+        "ca0": jnp.zeros((TT, V), jnp.float32),
+        "cb0": jnp.zeros((TT, V), jnp.float32),
+        "cs0": jnp.zeros((B, N), jnp.float32),
+    }
+    got = np.asarray(solve_greedy(
+        jnp.asarray(mask), jnp.asarray(score), jnp.asarray(req),
+        jnp.asarray(free), jnp.asarray(np.zeros(N, np.int64)),
+        jnp.asarray(np.full(N, 10, np.int64)),
+        jnp.asarray(order), jax.random.PRNGKey(0), deterministic=True,
+        req_any=jnp.ones(B, bool), inb=inb,
+    ))
+    # one ported pod per node, in score order; the 4th has nowhere to go
+    assert got.tolist() == [0, 1, 2, -1], got
