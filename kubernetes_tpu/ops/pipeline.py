@@ -159,6 +159,22 @@ def _pod_axis(pa: Arrays, pb: Optional[Arrays]):
     return sig, pb["valid"], pb["priority"], sig.shape[0]
 
 
+def apply_carry(na: Arrays, carry: Optional[Tuple]) -> Arrays:
+    """Overlay a previous batch's device residual carry onto the node
+    bank's pod-driven columns (the speculative-pipelining contract). The
+    ONE definition shared by solve_pipeline, solve_pipeline_gang, and the
+    sharded _prep so the three paths can never desync."""
+    if carry is None:
+        return na
+    free_in, count_in, nz_in = carry
+    return {
+        **na,
+        "requested": na["alloc"] - free_in,
+        "pod_count": count_in,
+        "nonzero_req": nz_in,
+    }
+
+
 def _inbatch_tensors(na, pa, ta, ids, n_buckets):
     """Build solve_greedy's `inb` dict: the device-side state that lets the
     solver sequentialize required anti-affinity and host-port conflicts
@@ -232,14 +248,7 @@ def solve_pipeline(
     (labels/taints/...) are untouched by pod commits, and the driver
     re-solves from trued-up banks whenever a commit diverged from the
     device's choice."""
-    if carry is not None:
-        free_in, count_in, nz_in = carry
-        na = {
-            **na,
-            "requested": na["alloc"] - free_in,
-            "pod_count": count_in,
-            "nonzero_req": nz_in,
-        }
+    na = apply_carry(na, carry)
     mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds, n_buckets)
     free0 = na["alloc"] - na["requested"]
     sig, pvalid, prio, b = _pod_axis(pa, pb)
@@ -268,7 +277,9 @@ def solve_pipeline(
     return result, score
 
 
-@partial(jax.jit, static_argnames=("deterministic", "config", "term_kinds", "n_buckets"))
+@partial(jax.jit, static_argnames=(
+    "deterministic", "config", "term_kinds", "n_buckets", "return_carry"
+))
 def solve_pipeline_gang(
     na: Arrays,
     pa: Arrays,
@@ -280,20 +291,25 @@ def solve_pipeline_gang(
     key,
     group: jnp.ndarray,  # [B] group id, -1 = ungrouped (per batch position)
     pb: Optional[Arrays] = None,
+    carry: Optional[Tuple] = None,
     deterministic: bool = False,
     config: Optional[SolveConfig] = None,
     term_kinds: Optional[frozenset] = None,
     n_buckets: Optional[int] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    return_carry: bool = False,
+):
     """Gang variant: same fused mask/score, then the all-or-nothing
     two-pass solve (ops/solver.solve_gang). Returns (assign, score,
-    gang_ok) — members of dropped groups come back assign=-1, gang_ok
-    False, and their capacity is released to other pods in pass 2."""
+    gang_ok[, carry]) — members of dropped groups come back assign=-1,
+    gang_ok False, and their capacity is released to other pods in pass 2.
+    `carry`/`return_carry` follow the solve_pipeline contract so gang
+    batches participate in speculative pipelining."""
+    na = apply_carry(na, carry)
     mask, score = mask_and_score(na, pa, ea, ta, xa, au, ids, config, term_kinds, n_buckets)
     free0 = na["alloc"] - na["requested"]
     sig, pvalid, prio, b = _pod_axis(pa, pb)
     order = pop_order(prio, jnp.arange(b, dtype=jnp.int32), pvalid)
-    assign, gang_ok = solve_gang(
+    result = solve_gang(
         mask,
         score,
         pa["req"],
@@ -307,7 +323,14 @@ def solve_pipeline_gang(
         req_any=pa["req_any"],
         sig=sig,
         pod_valid=pvalid,
+        return_carry=return_carry,
+        nz0=na["nonzero_req"].astype(free0.dtype) if return_carry else None,
+        scoring_req=pa["scoring_req"] if return_carry else None,
     )
+    if return_carry:
+        assign, gang_ok, carry_out = result
+        return assign, score, gang_ok, carry_out
+    assign, gang_ok = result
     return assign, score, gang_ok
 
 

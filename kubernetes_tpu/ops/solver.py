@@ -333,7 +333,7 @@ def solve_greedy(
     return out
 
 
-@partial(jax.jit, static_argnames=("deterministic",))
+@partial(jax.jit, static_argnames=("deterministic", "return_carry"))
 def solve_gang(
     mask: jnp.ndarray,
     score: jnp.ndarray,
@@ -348,12 +348,17 @@ def solve_gang(
     req_any: Optional[jnp.ndarray] = None,
     sig: Optional[jnp.ndarray] = None,
     pod_valid: Optional[jnp.ndarray] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    return_carry: bool = False,
+    nz0: Optional[jnp.ndarray] = None,
+    scoring_req: Optional[jnp.ndarray] = None,
+):
     """All-or-nothing gang assignment: two-pass greedy. Pass 1 places
     everything; groups with any unplaced member are dropped and pass 2
     re-solves without them (their capacity is released for other pods).
-    Returns (assignment [B], gang_ok [B]). `group` is per POD (batch
-    position), like `sig`/`pod_valid`."""
+    Returns (assignment [B], gang_ok [B]) — plus pass 2's residual carry
+    with return_carry, which reflects exactly the surviving members'
+    consumption, so the NEXT batch's speculative solve can chain on a
+    gang batch like on any other."""
     B = order.shape[0]
     k1, k2 = jax.random.split(rng_key)
     first = solve_greedy(mask, score, req, free0, count0, allowed, order, k1,
@@ -370,8 +375,13 @@ def solve_gang(
     alive = (
         ~dropped if pod_valid is None else (pod_valid & ~dropped)
     )
-    second = solve_greedy(mask, score, req, free0, count0, allowed, order, k2,
+    result = solve_greedy(mask, score, req, free0, count0, allowed, order, k2,
                           deterministic=deterministic, req_any=req_any,
-                          sig=sig, pod_valid=alive)
+                          sig=sig, pod_valid=alive,
+                          return_carry=return_carry, nz0=nz0,
+                          scoring_req=scoring_req)
     gang_ok = ~dropped
-    return jnp.where(dropped, -1, second), gang_ok
+    if return_carry:
+        second, carry = result
+        return jnp.where(dropped, -1, second), gang_ok, carry
+    return jnp.where(dropped, -1, result), gang_ok

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.pipeline import SolveConfig, _pod_axis, mask_and_score
+from ..ops.pipeline import SolveConfig, _pod_axis, apply_carry, mask_and_score
 from ..ops.solver import DEFAULT_CHUNK, pop_order, tie_noise
 from .mesh import AXIS_NODES, AXIS_PODS
 
@@ -197,15 +197,10 @@ def make_sharded_pipeline(mesh: Mesh):
         # pin every per-node bank array's leading axis to the mesh
         na = {k: _c(v, AXIS_NODES) for k, v in na.items()}
         if carry is not None:
-            # speculative pipelining: the previous batch's device residuals
-            # replace the pod-driven node columns (ops/pipeline.py contract)
-            free_in, count_in, nz_in = carry
-            na = {
-                **na,
-                "requested": na["alloc"] - _c(free_in, AXIS_NODES),
-                "pod_count": _c(count_in, AXIS_NODES),
-                "nonzero_req": _c(nz_in, AXIS_NODES),
-            }
+            # speculative pipelining (ops/pipeline.apply_carry contract,
+            # with the residuals pinned to their node shards)
+            carry = tuple(_c(x, AXIS_NODES) for x in carry)
+            na = apply_carry(na, carry)
         # the signature-count matrix is node-major [N, S]: shard its node
         # axis too (signature metadata stays replicated — it is tiny); the
         # [T,S]x[S,N] count matmuls then produce node-sharded outputs
@@ -289,21 +284,24 @@ def make_sharded_pipeline(mesh: Mesh):
         return assign, score
 
     @partial(jax.jit, static_argnames=(
-        "deterministic", "config", "term_kinds", "n_buckets"
+        "deterministic", "config", "term_kinds", "n_buckets", "return_carry"
     ))
     def pipeline_gang(
         na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays,
         au: Arrays, ids: Arrays, key, group: jnp.ndarray, pb: Arrays = None,
-        deterministic: bool = False,
+        carry=None, deterministic: bool = False,
         config: "SolveConfig" = None, term_kinds=None, n_buckets=None,
+        return_carry: bool = False,
     ):
         """All-or-nothing two-pass gang solve on the mesh (the multi-chip
         twin of ops.pipeline.solve_pipeline_gang): pass 1 places everything;
         groups with an unplaced member are dropped (replicated [B]
-        elementwise math) and pass 2 re-solves without them."""
+        elementwise math) and pass 2 re-solves without them. Pass 2's
+        node-sharded residuals come back with return_carry so the chain
+        can speculate past gang batches."""
         k1, k2 = jax.random.split(key)
         solver, args, score, order, b, pvalid = _prep(
-            na, pa, ea, ta, xa, au, ids, k1, pb, None,
+            na, pa, ea, ta, xa, au, ids, k1, pb, carry,
             deterministic, config, term_kinds, n_buckets)
         choices, _, _, _ = solver(*args)
         first = jnp.full((b,), -1, jnp.int32).at[order].set(choices)
@@ -324,10 +322,13 @@ def make_sharded_pipeline(mesh: Mesh):
             else _c(tie_noise(k2, b, N), None, AXIS_NODES)
         )
         args2[10] = alive
-        choices2, _, _, _ = solver(*args2)
+        choices2, free_f, count_f, nz_f = solver(*args2)
         second = jnp.full((b,), -1, jnp.int32).at[order].set(choices2)
         gang_ok = ~dropped
-        return jnp.where(dropped, -1, second), score, gang_ok
+        assign = jnp.where(dropped, -1, second)
+        if return_carry:
+            return assign, score, gang_ok, (free_f, count_f, nz_f)
+        return assign, score, gang_ok
 
     pipeline.gang = pipeline_gang
     return pipeline

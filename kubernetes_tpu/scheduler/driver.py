@@ -663,10 +663,11 @@ class Scheduler:
                 if gn:
                     garr[i] = gid_map.setdefault(gn, len(gid_map))
             gang_fn = self._sharded.gang if use_sharded else solve_pipeline_gang
-            assign, score, gang_ok = gang_fn(
-                *args, garr, pb=pb, deterministic=self.deterministic,
+            assign, score, gang_ok, carry_out = gang_fn(
+                *args, garr, pb=pb, carry=carry,
+                deterministic=self.deterministic,
                 config=self.solve_config, term_kinds=term_kinds,
-                n_buckets=n_buckets,
+                n_buckets=n_buckets, return_carry=True,
             )
             gang_dev = gang_ok
         else:
@@ -1176,6 +1177,15 @@ class Scheduler:
         # consume; the entry is consumable as-speculated only if
         # dispatch_gen + acc == cache.mutation_count at consume time (any
         # foreign mutation — informer event, failed bind — breaks equality)
+        # gang completeness at DISPATCH time: queued members of any group
+        # present join the speculated batch (pop_all_in_groups), exactly as
+        # the fresh path does at batch assembly — members created later are
+        # protected by the min-available guard at commit
+        groups = {g for g in (pod_group_name(i.pod) for i in infos_next) if g}
+        if groups:
+            infos_next.extend(
+                self.queue.pop_all_in_groups(groups, pod_group_name)
+            )
         entry: Dict = {
             "infos": infos_next,
             "disp": None,
@@ -1183,8 +1193,6 @@ class Scheduler:
             "rebuild_count": -1,
             "dispatch_gen": self.cache.mutation_count,
         }
-        if any(pod_group_name(i.pod) for i in infos_next):
-            return entry  # gang batches need the all-or-nothing path
         try:
             disp = self._dispatch_solve(
                 infos_next, carry=carry, allow_rebuild=False
@@ -1197,6 +1205,8 @@ class Scheduler:
         # the host commits, so consume-time device_get finds the bytes local
         try:
             disp["assign_dev"].copy_to_host_async()
+            if disp["gang_dev"] is not None:
+                disp["gang_dev"].copy_to_host_async()
         except AttributeError:
             pass  # non-jax array (tests with stub arrays)
         entry["disp"] = disp
@@ -1216,10 +1226,14 @@ class Scheduler:
             return res
         # gang completeness: every QUEUED member of any group present in the
         # batch joins it, so all-or-nothing is decided over the whole group
-        # (a speculated batch never contains gang pods — gated at dispatch)
+        # (speculated entries did this at dispatch time; see below)
         batch_groups = [pod_group_name(i.pod) for i in infos]
         groups_in_batch = {g for g in batch_groups if g}
-        if groups_in_batch:
+        if groups_in_batch and (pending is None or pending["disp"] is None):
+            # entries whose dispatched solve will be CONSUMED completed
+            # their groups at dispatch time — extending those would add
+            # pods the device never solved. Entries re-solving fresh
+            # (failed dispatch, poisoned chain) reunify like any batch.
             extra = self.queue.pop_all_in_groups(groups_in_batch, pod_group_name)
             infos.extend(extra)
             batch_groups.extend(pod_group_name(i.pod) for i in extra)
@@ -1295,7 +1309,7 @@ class Scheduler:
         # back via copy_to_host_async. Dispatches are optimistic; the
         # commit loop's outcome accumulates into every chained entry, and
         # consumption re-validates against cache mutations / bank rebuilds.
-        if self.speculate and out.gang_ok is None and self._last_carry is not None:
+        if self.speculate and self._last_carry is not None:
             if self._spec_backoff > 0:
                 self._spec_backoff -= 1
             else:
