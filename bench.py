@@ -199,52 +199,249 @@ def cfg5_gang():
     return nodes, pods
 
 
+def cfg6_preemption():
+    """Preemption-enabled run (the only config exercising the eviction
+    path under load): 2k nodes pre-filled with low-priority pods consuming
+    ~90% of CPU, then 10k high-priority pods that can only land by
+    evicting victims (pkg/scheduler/core preempt path)."""
+    n = _n(2000)
+    nodes = [mk_node(i) for i in range(n)]
+    existing = []
+    for i in range(n * 7):  # 7 x 4000m = 28 of 32 cores per node
+        p = mk_pod(1_000_000 + i, cpu="4000m", mem="1Gi",
+                   labels={"app": f"lowprio-{i % 20}"})
+        p.priority = 0
+        p.node_name = f"node-{i % n}"
+        existing.append(p)
+    pending = []
+    for i in range(_n(10000)):
+        p = mk_pod(i, cpu="6000m", mem="2Gi", labels={"app": f"hiprio-{i % 20}"})
+        p.priority = 1000
+        pending.append(p)
+    return nodes, pending, existing
+
+
 CONFIGS = {
     "1": ("5k_pods_500_nodes_resources", cfg1_resources),
     "2": ("50k_pods_5k_nodes_taint_nodeaffinity", cfg2_taint_affinity),
     "3": ("100k_pods_10k_nodes_topology_spread", cfg3_spread),
     "4": ("20k_pods_2k_nodes_interpod_affinity", cfg4_interpod),
     "5": ("64k_pods_1k_gangs_2k_nodes", cfg5_gang),
+    "6": ("10k_hi_pods_2k_full_nodes_preemption", cfg6_preemption),
+}
+# per-config scheduler options (CONFIGS keeps its (name, build) shape for
+# the microbench scripts that import it)
+CONFIG_OPTS = {
+    "6": {"enable_preemption": True},
 }
 
 
-def run_config(name, build):
+def _hist_counts(h):
+    with h._lock:
+        return list(h._counts.get((), [0] * (len(h.buckets) + 1)))
+
+
+def _hist_pct_from_diff(h, before, q):
+    """Quantile (bucket upper bound) of ONLY the samples observed since
+    `before` — isolates one config's pod latencies from the process-global
+    histogram."""
+    now = _hist_counts(h)
+    diff = [b - a for a, b in zip(before, now)]
+    total = sum(diff)
+    if total == 0:
+        return None
+    target = q * total
+    acc = 0
+    for i, b in enumerate(h.buckets):
+        acc += diff[i]
+        if acc >= target:
+            return b
+    return float("inf")
+
+
+def audit_placement(nodes, commits, existing=(), sample=1000, seed=0):
+    """Post-run correctness audit of the FINAL placement + a sampled
+    feasibility-at-commit-time replay (round-2 VERDICT weak #6: counters
+    are not evidence).
+
+    * full sweep (every node): capacity (cpu/mem/pod count vs allocatable
+      minus pre-existing), host-port collisions, required anti-affinity in
+      both directions, DoNotSchedule skew bound at final state.
+    * sampled replay: commits re-applied IN COMMIT ORDER to a fresh
+      Snapshot; for `sample` random pods the full oracle predicate chain
+      (pod_fits_on_node) must accept the chosen node at its commit time.
+    Returns a dict of violation counts (all zero = pass).
+    """
+    import random
+
+    from kubernetes_tpu.oracle import Snapshot
+    from kubernetes_tpu.oracle.predicates import (
+        compute_predicate_metadata,
+        get_pod_anti_affinity_terms,
+        pod_fits_on_node,
+        pod_matches_term,
+    )
+
+    rng = random.Random(seed)
+    picked = set(
+        rng.sample(range(len(commits)), min(sample, len(commits)))
+    ) if commits else set()
+    snap = Snapshot(list(nodes), list(existing))
+    replay_violations = 0
+    for i, (pod, node_name) in enumerate(commits):
+        ni = snap.get(node_name)
+        if ni is None:
+            replay_violations += 1
+            continue
+        if i in picked:
+            meta = compute_predicate_metadata(pod, snap)
+            ok, _ = pod_fits_on_node(pod, ni, meta=meta, snapshot=snap)
+            if not ok:
+                replay_violations += 1
+        bound = pod.with_node(node_name)
+        ni.add_pod(bound)
+
+    # final-state sweeps
+    cap_violations = port_violations = anti_violations = skew_violations = 0
+    for name, ni in snap.node_infos.items():
+        alloc = {k: q.value() if k != RESOURCE_CPU else q.milli_value()
+                 for k, q in ni.node.allocatable.items()}
+        used = ni.requested()
+        for rname, v in used.items():
+            cap = alloc.get(rname)
+            if cap is not None and v > cap:
+                cap_violations += 1
+        pods_cap = alloc.get(RESOURCE_PODS)
+        if pods_cap is not None and len(ni.pods) > pods_cap:
+            cap_violations += 1
+        seen_ports = {}
+        for p in ni.pods:
+            for t in p.host_ports():
+                proto, ip, port = t
+                for (pr2, ip2, po2) in seen_ports:
+                    if pr2 == proto and po2 == port and (
+                        ip == "0.0.0.0" or ip2 == "0.0.0.0" or ip == ip2
+                    ):
+                        port_violations += 1
+                seen_ports[t] = True
+    # anti-affinity: every pod's required anti terms vs all OTHER pods in
+    # the term's topology domain
+    domain_pods = {}  # (key, value) -> [pods]
+    node_of = {}
+    for name, ni in snap.node_infos.items():
+        for p in ni.pods:
+            node_of[id(p)] = ni.node
+            for kv in ni.node.labels.items():
+                domain_pods.setdefault(kv, []).append(p)
+    for name, ni in snap.node_infos.items():
+        for p in ni.pods:
+            for term in get_pod_anti_affinity_terms(p.affinity):
+                k = term.topology_key
+                v = ni.node.labels.get(k) if k else None
+                if v is None:
+                    continue
+                for q in domain_pods.get((k, v), ()):
+                    if q is not p and pod_matches_term(q, p, term):
+                        anti_violations += 1
+    # DoNotSchedule skew at final state
+    from kubernetes_tpu.oracle.predicates import get_hard_spread_constraints
+    from kubernetes_tpu.api.selectors import match_label_selector
+
+    hard_pods = [
+        (p, node_of[id(p)])
+        for ni in snap.node_infos.values()
+        for p in ni.pods
+        if get_hard_spread_constraints(p)
+    ]
+    for p, node in hard_pods:
+        for c in get_hard_spread_constraints(p):
+            counts = {}
+            for name2, ni2 in snap.node_infos.items():
+                v = ni2.node.labels.get(c.topology_key)
+                if v is None:
+                    continue
+                counts[v] = counts.get(v, 0) + sum(
+                    1 for q in ni2.pods
+                    if q.namespace == p.namespace
+                    and match_label_selector(c.label_selector, q.labels)
+                )
+            my_v = node.labels.get(c.topology_key)
+            if counts and my_v in counts:
+                if counts[my_v] - min(counts.values()) > c.max_skew:
+                    skew_violations += 1
+    return {
+        "commits": len(commits),
+        "replay_sampled": len(picked),
+        "replay_violations": replay_violations,
+        "capacity_violations": cap_violations,
+        "port_violations": port_violations,
+        "anti_affinity_violations": anti_violations,
+        "hard_spread_skew_violations": skew_violations,
+    }
+
+
+def run_config(name, build, opts=None):
+    from kubernetes_tpu.metrics import metrics as M
+
     t_setup = time.perf_counter()
-    nodes, pods = build()
+    built = build()
+    nodes, pods = built[0], built[1]
+    existing = built[2] if len(built) > 2 else []
     cache = SchedulerCache()
     for node in nodes:
         cache.add_node(node)
+    for p in existing:
+        cache.add_pod(p)
     queue = PriorityQueue()
     sched = Scheduler(
         cache=cache, queue=queue, binder=Binder(), batch_size=BATCH,
-        enable_preemption=False, deterministic=False, bind_workers=16,
+        deterministic=False, bind_workers=16,
         # deep speculation chain: drain-style workload, no live arrivals to
         # starve — depth 8 hides multi-second tunnel RTT phases entirely
         spec_depth=int(os.environ.get("BENCH_SPEC_DEPTH", "8")),
+        **{"enable_preemption": False, **(opts or {})},
     )
     # pre-size the device banks: every capacity growth is an XLA recompile
     sched.mirror.reserve(len(nodes), len(pods))
     for p in pods:
         queue.add(p)
     setup_s = time.perf_counter() - t_setup
+    pod_hist_before = _hist_counts(M.pod_scheduling_duration)
 
     batch_times = []
     batch_sched = []
+    commits = []  # [(pod, node_name)] in COMMIT order, for the audit
+    pod_by_key = {p.key(): p for p in pods}
     t0 = time.perf_counter()
     first_batch_s = None
-    scheduled = unsched = 0
+    scheduled = unsched = preempted = 0
+    idle_rounds = 0
     while True:
         tb = time.perf_counter()
         r = sched.schedule_batch()
         dt = time.perf_counter() - tb
         if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+            # preemption requeues its beneficiaries with backoff: give them
+            # bounded retry rounds instead of declaring the drain done the
+            # first time the active queue runs dry
+            active, backoff, unsched_q = queue.counts()
+            if preempted and idle_rounds < 20 and (active + backoff + unsched_q):
+                idle_rounds += 1
+                time.sleep(0.05)
+                queue.move_all_to_active()
+                continue
             break
+        idle_rounds = 0
         if first_batch_s is None:
             first_batch_s = dt
         batch_times.append(dt)
         batch_sched.append(r.scheduled)
         scheduled += r.scheduled
-        unsched += r.unschedulable
+        unsched += r.unschedulable  # attempts; see unschedulable_pods below
+        preempted += r.preempted
+        commits.extend(
+            (pod_by_key[k], n) for k, n in r.assignments.items() if k in pod_by_key
+        )
     sched.wait_for_binds()
     elapsed = time.perf_counter() - t0
     steady = sum(batch_times[1:]) or 1e-9
@@ -261,12 +458,33 @@ def run_config(name, build):
     # >5x the median latency (recompiles or tunnel stalls the median hides)
     tail_med = float(np.median(batch_times[half:])) if batch_times[half:] else 0.0
     stall_batches = sum(1 for t in batch_times[half:] if tail_med > 0 and t > 5 * tail_med)
+    # per-pod queue-add → bound latency (PodSchedulingDuration histogram,
+    # this config's samples only) — the BASELINE.json headline latency
+    pod_p50 = _hist_pct_from_diff(M.pod_scheduling_duration, pod_hist_before, 0.5)
+    pod_p99 = _hist_pct_from_diff(M.pod_scheduling_duration, pod_hist_before, 0.99)
+    # audit: on preemption runs victims vanished mid-run, so the fresh
+    # replay would see stale occupancy — audit only the final sweep there
+    t_a = time.perf_counter()
+    audit = audit_placement(
+        nodes, commits, existing=existing,
+        sample=int(os.environ.get("BENCH_AUDIT_SAMPLE", "1000")),
+    ) if not preempted else {"skipped": "preemption run (victims deleted mid-run)"}
+    audit_s = time.perf_counter() - t_a
+
     detail = {
         "config": name,
         "nodes": len(nodes),
         "pods": len(pods),
         "scheduled": scheduled,
-        "unschedulable": unsched,
+        # attempt-counted (a preemption-retried pod counts once per retry
+        # round); pods actually left unplaced:
+        "unschedulable_attempts": unsched,
+        "unschedulable_pods": max(len(pods) - scheduled, 0),
+        "preempted": preempted,
+        "pod_sched_p50_s": pod_p50,
+        "pod_sched_p99_s": pod_p99,
+        "audit": audit,
+        "audit_s": round(audit_s, 3),
         "elapsed_s": round(elapsed, 3),
         "pods_per_sec": round(scheduled / elapsed, 1) if elapsed > 0 else 0.0,
         "pods_per_sec_steady": round(
@@ -285,7 +503,7 @@ def run_config(name, build):
 
 
 def main():
-    which = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
+    which = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6").split(",")
     details = []
     for key in which:
         key = key.strip()
@@ -293,7 +511,7 @@ def main():
             continue
         name, build = CONFIGS[key]
         print(f"[bench] running config {key}: {name} ...", file=sys.stderr, flush=True)
-        d = run_config(name, build)
+        d = run_config(name, build, CONFIG_OPTS.get(key))
         details.append(d)
         print(f"[bench] {json.dumps(d)}", file=sys.stderr, flush=True)
 

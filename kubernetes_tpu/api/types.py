@@ -356,6 +356,25 @@ class Service:
     selector: Dict[str, str] = field(default_factory=dict)
 
 
+@dataclass
+class ReplicaSet:
+    """apps/v1 ReplicaSet — the controller-manager subset: desired replica
+    count + selector + pod template (pkg/apis/apps/types.go ReplicaSetSpec;
+    reconciled by pkg/controller/replicaset/replica_set.go syncReplicaSet).
+    The template is a Pod whose name/uid are ignored (each replica gets a
+    generated name and fresh uid)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Optional[Pod] = None
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
 def service_from_k8s(obj: dict) -> Service:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
@@ -750,6 +769,45 @@ def pod_to_k8s(pod: Pod) -> dict:
         },
         "spec": spec,
         "status": status,
+    }
+
+
+def replicaset_from_k8s(obj: dict) -> ReplicaSet:
+    """apps/v1 ReplicaSet JSON → ReplicaSet (the controller subset)."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    tmpl = spec.get("template")
+    template = None
+    if tmpl is not None:
+        tmeta = dict(tmpl.get("metadata") or {})
+        tmeta.setdefault("namespace", meta.get("namespace", "default"))
+        tmeta.setdefault("name", meta.get("name", "") + "-template")
+        template = pod_from_k8s({"metadata": tmeta, "spec": tmpl.get("spec") or {}})
+    return ReplicaSet(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid") or _new_uid(),
+        replicas=int(spec.get("replicas") if spec.get("replicas") is not None else 1),
+        selector=_label_selector_from(spec.get("selector")),
+        template=template,
+    )
+
+
+def replicaset_to_k8s(rs: ReplicaSet) -> dict:
+    spec: Dict[str, Any] = {"replicas": rs.replicas}
+    if rs.selector is not None:
+        spec["selector"] = _label_selector_to(rs.selector)
+    if rs.template is not None:
+        t = pod_to_k8s(rs.template)
+        spec["template"] = {
+            "metadata": {"labels": t["metadata"].get("labels", {})},
+            "spec": t["spec"],
+        }
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "ReplicaSet",
+        "metadata": {"name": rs.name, "namespace": rs.namespace, "uid": rs.uid},
+        "spec": spec,
     }
 
 

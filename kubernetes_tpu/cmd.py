@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
     # sim mode
     p.add_argument("--nodes", type=int, default=100)
     p.add_argument("--pods", type=int, default=500)
+    p.add_argument(
+        "--controllers", action="store_true",
+        help="sim: run the controller-manager (ReplicaSet + nodelifecycle); "
+             "pods are created BY ReplicaSets, one node is killed mid-run, "
+             "evicted replicas are recreated and re-scheduled",
+    )
+    p.add_argument("--replicas-per-set", type=int, default=50)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--pod-cpu", default="100m", help="sim pod cpu request")
     p.add_argument(
@@ -203,26 +210,62 @@ def run_sim(args) -> int:
     informers = start_scheduler_informers(api, handlers)
     for inf in informers.values():
         inf.wait_for_sync()
-    from .api.types import Container, Pod, Quantity, RESOURCE_CPU, RESOURCE_MEMORY
+    from .api.types import (
+        Container,
+        LabelSelector,
+        Pod,
+        Quantity,
+        RESOURCE_CPU,
+        RESOURCE_MEMORY,
+        ReplicaSet,
+    )
 
-    for i in range(args.pods):
-        if args.feature_rate > 0:
-            p = g.pod(10_000 + i, feature_rate=args.feature_rate)
-        else:
-            p = Pod(
-                name=f"sim-{i}", namespace="sim",
+    cm = None
+    if args.controllers:
+        # controller-driven churn: pods are created by ReplicaSets through
+        # the apiserver, not pre-filled into the queue
+        from .controllers import ControllerManager
+
+        cm = ControllerManager(api).start()
+        n_sets = max(1, args.pods // args.replicas_per_set)
+        for s in range(n_sets):
+            replicas = args.replicas_per_set if s < n_sets - 1 else (
+                args.pods - args.replicas_per_set * (n_sets - 1)
+            )
+            tmpl = Pod(
+                name="t", namespace="sim", labels={"app": f"rs-{s}"},
                 containers=[Container(name="c", requests={
                     RESOURCE_CPU: Quantity.parse(args.pod_cpu),
                     RESOURCE_MEMORY: Quantity.parse("128Mi"),
                 })],
             )
-        # pods must name THIS scheduler or the handlers drop them
-        # (eventhandlers.go responsibleForPod)
-        p.scheduler_name = args.scheduler_name
-        api.create("pods", p)
+            tmpl.scheduler_name = args.scheduler_name
+            api.create("replicasets", ReplicaSet(
+                name=f"rs-{s}", namespace="sim", replicas=replicas,
+                selector=LabelSelector(match_labels={"app": f"rs-{s}"}),
+                template=tmpl,
+            ))
+    else:
+        for i in range(args.pods):
+            if args.feature_rate > 0:
+                p = g.pod(10_000 + i, feature_rate=args.feature_rate)
+            else:
+                p = Pod(
+                    name=f"sim-{i}", namespace="sim",
+                    containers=[Container(name="c", requests={
+                        RESOURCE_CPU: Quantity.parse(args.pod_cpu),
+                        RESOURCE_MEMORY: Quantity.parse("128Mi"),
+                    })],
+                )
+            # pods must name THIS scheduler or the handlers drop them
+            # (eventhandlers.go responsibleForPod)
+            p.scheduler_name = args.scheduler_name
+            api.create("pods", p)
     t0 = time.perf_counter()
     deadline = time.time() + 300
     idle = 0
+    killed = None
+    evicted_at_kill = 0
     renew_by = None
     while time.time() < deadline:
         if elector is not None:
@@ -240,12 +283,37 @@ def run_sim(args) -> int:
         sched.queue.flush()
         r = sched.schedule_batch()
         pods, _ = api.list("pods")
-        if len(pods) >= args.pods and all(p.node_name for p in pods):
+        live = [p for p in pods if p.phase != "Failed"]
+        clear_of_killed = killed is None or not any(
+            p.node_name == killed for p in live
+        )
+        if (len(live) >= args.pods and all(p.node_name for p in live)
+                and clear_of_killed):
+            if cm is not None and not killed:
+                # kill one node that hosts pods: the lifecycle controller
+                # taints + evicts, the ReplicaSets refill, the scheduler
+                # re-places on the survivors — the full control loop
+                cm.wait_idle()
+                victims = {p.node_name for p in live}
+                target = sorted(victims)[0]
+                node = api.get("nodes", target)
+                node.conditions = [{"type": "Ready", "status": "False"}]
+                api.update("nodes", node)
+                killed = target
+                evicted_at_kill = sum(1 for p in live if p.node_name == target)
+                continue
             break
         # quiescence: nothing scheduled AND nothing left to try — pods stuck
         # in unschedulableQ wait for cluster events that a static sim never
         # produces, so stop instead of spinning out the deadline
-        if r.scheduled == 0 and r.errors == 0 and r.preempted == 0 and len(pods) >= args.pods:
+        converged = len(live) >= args.pods
+        if cm is not None and killed is not None:
+            # controller runs only converge when the refill landed clear of
+            # the dead node (lifecycle evictions + RS refills still racing)
+            converged = converged and clear_of_killed and all(
+                p.node_name for p in live
+            )
+        if r.scheduled == 0 and r.errors == 0 and r.preempted == 0 and converged:
             idle += 1
             active, backoff, _ = sched.queue.counts()
             if idle >= 3 and active == 0 and backoff == 0:
@@ -256,24 +324,31 @@ def run_sim(args) -> int:
     sched.wait_for_binds()
     elapsed = time.perf_counter() - t0
     pods, _ = api.list("pods")
-    bound = sum(1 for p in pods if p.node_name)
-    print(
-        json.dumps(
-            {
-                "mode": "sim",
-                "nodes": args.nodes,
-                "pods": len(pods),
-                "bound": bound,
-                "elapsed_s": round(elapsed, 3),
-                "pods_per_sec": round(bound / elapsed, 1) if elapsed > 0 else 0,
-                "stats": {k: round(v, 4) if isinstance(v, float) else v
-                          for k, v in sched.stats.items()},
-            }
-        )
-    )
+    live = [p for p in pods if p.phase != "Failed"]
+    bound = sum(1 for p in live if p.node_name)
+    out = {
+        "mode": "sim",
+        "nodes": args.nodes,
+        "pods": len(live),
+        "bound": bound,
+        "elapsed_s": round(elapsed, 3),
+        "pods_per_sec": round(bound / elapsed, 1) if elapsed > 0 else 0,
+        "stats": {k: round(v, 4) if isinstance(v, float) else v
+                  for k, v in sched.stats.items()},
+    }
+    if cm is not None:
+        out["controllers"] = {
+            "replicaset_syncs": cm.replicaset.sync_count,
+            "killed_node": killed,
+            "evicted": cm.nodelifecycle.evictions,
+            "recreated_and_rebound": evicted_at_kill,
+            "bound_on_killed_node": sum(1 for p in live if p.node_name == killed),
+        }
+        cm.stop()
+    print(json.dumps(out))
     for inf in informers.values():
         inf.stop()
-    return 0 if bound == len(pods) else 1
+    return 0 if bound == len(live) else 1
 
 
 def main(argv: Optional[list] = None) -> int:

@@ -1,0 +1,34 @@
+"""Control-plane controllers: the kube-controller-manager subset.
+
+The reference starts ~35 reconcile loops from one binary
+(cmd/kube-controller-manager/app/controllermanager.go:373
+NewControllerInitializers). This package rebuilds the two that close the
+scheduling loop — workload replication and node health — as informer-driven
+reconcilers over the fake apiserver:
+
+  * ReplicaSetController (pkg/controller/replicaset/replica_set.go):
+    selector/owner-matched live pods vs .spec.replicas; creates missing
+    replicas from the template, deletes surplus (pending-first victim
+    order), replaces Failed pods.
+  * NodeLifecycleController (pkg/controller/nodelifecycle/): node Ready
+    condition → not-ready/unreachable taints (NoSchedule + NoExecute), and
+    NoExecute eviction of pods without a matching toleration — which is
+    what makes a "node death" flow end-to-end: evict → ReplicaSet refill →
+    scheduler re-place.
+
+Controllers share one informer set and drain per-controller workqueues
+(client-go util/workqueue semantics: dedup-while-pending, re-add-after-get).
+"""
+
+from .manager import ControllerManager
+from .nodelifecycle import NodeLifecycleController, TAINT_NOT_READY
+from .replicaset import ReplicaSetController
+from .workqueue import WorkQueue
+
+__all__ = [
+    "ControllerManager",
+    "NodeLifecycleController",
+    "ReplicaSetController",
+    "TAINT_NOT_READY",
+    "WorkQueue",
+]

@@ -1,0 +1,103 @@
+"""Node lifecycle controller (pkg/controller/nodelifecycle/).
+
+The reference's monitorNodeHealth watches each node's Ready condition and
+manages the not-ready/unreachable taints
+(node_lifecycle_controller.go:~770 processTaintBaseEviction +
+nodetree taint helpers); its NoExecute taint manager
+(scheduler/taint_manager.go) evicts pods lacking a matching toleration.
+Condensed here into one reconcile per node:
+
+  Ready != "True"  → ensure node.kubernetes.io/not-ready {NoSchedule,
+                     NoExecute} taints, then evict (delete) every bound pod
+                     without a toleration for them — the ReplicaSet
+                     controller replaces the evicted replicas elsewhere.
+  Ready == "True"  → remove both taints (the scheduler's eventhandlers see
+                     the node update and flush unschedulable pods back to
+                     the active queue — MoveAllToActiveQueue semantics).
+
+Grace periods (node-monitor-grace-period etc.) collapse to immediate
+reaction: the fake apiserver's conditions ARE the health signal (no
+heartbeat staleness to debounce).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api.types import (
+    Node,
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    Taint,
+    tolerations_tolerate_taint,
+)
+
+logger = logging.getLogger("kubernetes_tpu.controllers.nodelifecycle")
+
+TAINT_NOT_READY = "node.kubernetes.io/not-ready"
+
+
+def _ready(node: Node) -> bool:
+    for c in node.conditions:
+        if c.get("type") == "Ready":
+            return c.get("status") == "True"
+    return True  # no conditions reported: treat as healthy (fresh sim node)
+
+
+class NodeLifecycleController:
+    def __init__(self, api, node_informer, pod_informer, queue):
+        self.api = api
+        self.node_informer = node_informer
+        self.pod_informer = pod_informer
+        self.queue = queue
+        self.evictions = 0  # observability for tests
+
+    def register(self) -> None:
+        self.node_informer.add_event_handler(
+            on_add=lambda n: self.queue.add(n.name),
+            on_update=lambda old, new: self.queue.add(new.name),
+        )
+        # a pod BINDING to a node that is already unready must be evicted
+        # too (the reference's NoExecute taint manager watches pod events,
+        # taint_manager.go PodUpdated) — re-sync the hosting node
+        self.pod_informer.add_event_handler(
+            on_add=lambda p: self._pod_event(p),
+            on_update=lambda old, new: self._pod_event(new),
+        )
+
+    def _pod_event(self, pod) -> None:
+        if not pod.node_name:
+            return
+        node = self.node_informer.get(pod.node_name)
+        if node is not None and not _ready(node):
+            self.queue.add(node.name)
+
+    def sync(self, name: str) -> None:
+        node: Optional[Node] = self.node_informer.get(name)
+        if node is None:
+            return
+        tainted = any(t.key == TAINT_NOT_READY for t in node.taints)
+        if _ready(node):
+            if tainted:
+                node.taints = [t for t in node.taints if t.key != TAINT_NOT_READY]
+                self.api.update("nodes", node)
+            return
+        if not tainted:
+            node.taints = list(node.taints) + [
+                Taint(key=TAINT_NOT_READY, effect=TAINT_NO_SCHEDULE),
+                Taint(key=TAINT_NOT_READY, effect=TAINT_NO_EXECUTE),
+            ]
+            self.api.update("nodes", node)
+        # NoExecute eviction: every pod bound here without a toleration
+        no_exec = Taint(key=TAINT_NOT_READY, effect=TAINT_NO_EXECUTE)
+        for p in self.pod_informer.list():
+            if p.node_name != name:
+                continue
+            if tolerations_tolerate_taint(p.tolerations, no_exec):
+                continue
+            try:
+                self.api.delete("pods", p.key())
+                self.evictions += 1
+            except KeyError:
+                pass

@@ -1,0 +1,126 @@
+"""ReplicaSet controller (pkg/controller/replicaset/replica_set.go).
+
+Reconcile contract (syncReplicaSet → manageReplicas, replica_set.go:560):
+live pods owned by the RS (ownerReference.controller uid match) or adopted
+by selector (orphans — simplified adoption: counted, not patched) are
+compared against .spec.replicas; the diff is closed by creating replicas
+from the template (generated names, fresh uids, owner reference stamped)
+or deleting surplus — unscheduled/pending pods first, mirroring
+getPodsToDelete's rank (controller_utils.go ActivePods ordering). Failed
+pods never count as live, so an evicted/failed replica is replaced on the
+next sync — the loop the nodelifecycle controller's NoExecute eviction
+feeds into.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from typing import List, Optional
+
+from ..api.selectors import match_label_selector
+from ..api.types import Pod, ReplicaSet
+
+logger = logging.getLogger("kubernetes_tpu.controllers.replicaset")
+
+# manageReplicas burst ceiling (replica_set.go burstReplicas)
+BURST_REPLICAS = 500
+
+_suffix = itertools.count(1)
+
+
+def _owned_by(pod: Pod, rs: ReplicaSet) -> bool:
+    for ref in pod.owner_references:
+        if ref.get("controller") and ref.get("uid") == rs.uid:
+            return True
+    return False
+
+
+def _adoptable(pod: Pod, rs: ReplicaSet) -> bool:
+    """Orphan matched by the RS selector (ClaimPods semantics, counted
+    without patching the owner ref)."""
+    if any(r.get("controller") for r in pod.owner_references):
+        return False
+    return pod.namespace == rs.namespace and match_label_selector(rs.selector, pod.labels)
+
+
+class ReplicaSetController:
+    """One reconcile loop: replicasets + pods informers → workqueue →
+    manageReplicas through the (fake) apiserver."""
+
+    def __init__(self, api, rs_informer, pod_informer, queue):
+        self.api = api
+        self.rs_informer = rs_informer
+        self.pod_informer = pod_informer
+        self.queue = queue
+        self.sync_count = 0  # observability for tests
+
+    # -- event handlers (replica_set.go addPod/updatePod/deletePod) ---------
+
+    def register(self) -> None:
+        self.rs_informer.add_event_handler(
+            on_add=lambda rs: self.queue.add(rs.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+            on_delete=lambda rs: self.queue.add(rs.key()),
+        )
+        self.pod_informer.add_event_handler(
+            on_add=lambda p: self._enqueue_owner(p),
+            on_update=lambda old, new: self._enqueue_owner(new),
+            on_delete=lambda p: self._enqueue_owner(p),
+        )
+
+    def _enqueue_owner(self, pod: Pod) -> None:
+        for ref in pod.owner_references:
+            if ref.get("controller") and ref.get("kind") == "ReplicaSet":
+                self.queue.add(f"{pod.namespace}/{ref.get('name')}")
+                return
+        # orphan: any RS whose selector matches may want it
+        for rs in self.rs_informer.list():
+            if _adoptable(pod, rs):
+                self.queue.add(rs.key())
+
+    # -- reconcile -----------------------------------------------------------
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        rs: Optional[ReplicaSet] = self.rs_informer.get(key)
+        if rs is None:
+            return  # deleted; orphaned pods keep running (no GC here)
+        live: List[Pod] = []
+        for p in self.pod_informer.list():
+            if p.phase in ("Failed", "Succeeded"):
+                continue
+            if _owned_by(p, rs) or _adoptable(p, rs):
+                live.append(p)
+        diff = rs.replicas - len(live)
+        if diff > 0:
+            for _ in range(min(diff, BURST_REPLICAS)):
+                self.api.create("pods", self._new_replica(rs))
+        elif diff < 0:
+            # deletion order: pending (unscheduled) before running
+            # (controller_utils.go ActivePods: unassigned < assigned)
+            victims = sorted(live, key=lambda p: (p.node_name != "", p.creation_timestamp))
+            for p in victims[: min(-diff, BURST_REPLICAS)]:
+                try:
+                    self.api.delete("pods", p.key())
+                except KeyError:
+                    pass
+
+    def _new_replica(self, rs: ReplicaSet) -> Pod:
+        import time
+
+        from ..api.types import _new_uid
+
+        t = rs.template or Pod()
+        pod = t.with_node("")  # clone (request memos stay valid: same containers)
+        pod.name = f"{rs.name}-{next(_suffix):05d}"
+        pod.namespace = rs.namespace
+        pod.uid = _new_uid()
+        pod.phase = "Pending"
+        pod.creation_timestamp = time.time()
+        pod.labels = dict(t.labels)
+        pod.owner_references = [
+            {"uid": rs.uid, "controller": True, "kind": "ReplicaSet", "name": rs.name}
+        ]
+        return pod

@@ -1,0 +1,74 @@
+"""client-go util/workqueue (Type) semantics, the subset controllers use:
+
+  * add(item) — enqueue; a key already queued is deduped; a key currently
+    being PROCESSED is marked dirty and re-queued when done() is called
+    (workqueue/queue.go Add/Get/Done).
+  * get() — block for the next key (None after shutdown).
+  * done(item) — processing finished; re-queue if it went dirty meanwhile.
+
+Rate limiting is reduced to a bounded retry counter the caller manages
+(controllers here re-add on reconcile error up to a few times); the
+reference's token-bucket delays exist to protect a remote apiserver that
+this in-process store does not need.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Hashable, Optional
+
+
+class WorkQueue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._queued: set = set()
+        self._processing: set = set()
+        self._dirty: set = set()
+        self._shutdown = False
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            if item in self._processing:
+                self._dirty.add(item)
+                return
+            if item in self._queued:
+                return
+            self._queued.add(item)
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
+        with self._cond:
+            while not self._queue and not self._shutdown:
+                if not self._cond.wait(timeout=timeout):
+                    return None
+            if self._shutdown and not self._queue:
+                return None
+            item = self._queue.popleft()
+            self._queued.discard(item)
+            self._processing.add(item)
+            return item
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._dirty.discard(item)
+                if item not in self._queued:
+                    self._queued.add(item)
+                    self._queue.append(item)
+                    self._cond.notify()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)

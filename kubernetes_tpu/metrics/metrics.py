@@ -74,7 +74,11 @@ pending_pods = registry.register(Gauge(
 pod_scheduling_duration = registry.register(Histogram(
     "scheduler_pod_scheduling_duration_seconds",
     "Time from first attempt to successful scheduling per pod",
-    buckets=_DURATION_BUCKETS,
+    # queue-add → bound can span a whole queue drain (100k pods enqueued at
+    # once wait tens of seconds for their batch): extend the tail so p99 is
+    # a number, not +Inf (metrics.go PodSchedulingDuration uses exponential
+    # buckets to 512s for the same reason)
+    buckets=_DURATION_BUCKETS + (20.0, 40.0, 80.0, 160.0, 320.0, 640.0),
 ))
 pod_scheduling_attempts = registry.register(Histogram(
     "scheduler_pod_scheduling_attempts",

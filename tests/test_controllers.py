@@ -1,0 +1,223 @@
+"""Control-plane controllers: ReplicaSet + NodeLifecycle reconcile loops
+over the fake apiserver, and the full control loop with the scheduler in
+the middle (create → schedule → node death → evict → recreate →
+re-schedule). Reference anchors: replica_set.go syncReplicaSet,
+node_lifecycle_controller.go, controllermanager.go:373."""
+
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Container,
+    LabelSelector,
+    Pod,
+    Quantity,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    ReplicaSet,
+    Toleration,
+    replicaset_from_k8s,
+)
+from kubernetes_tpu.apiserver import FakeAPIServer
+from kubernetes_tpu.client import APIBinder, start_scheduler_informers
+from kubernetes_tpu.controllers import ControllerManager, TAINT_NOT_READY
+from kubernetes_tpu.models.generators import make_node
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.scheduler.eventhandlers import EventHandlers
+
+
+def _template(app: str, cpu="100m") -> Pod:
+    return Pod(
+        name="template", labels={"app": app},
+        containers=[Container(name="c", requests={
+            RESOURCE_CPU: Quantity.parse(cpu),
+            RESOURCE_MEMORY: Quantity.parse("64Mi"),
+        })],
+    )
+
+
+def _rs(name: str, replicas: int, app: str) -> ReplicaSet:
+    return ReplicaSet(
+        name=name, replicas=replicas,
+        selector=LabelSelector(match_labels={"app": app}),
+        template=_template(app),
+    )
+
+
+def _pods(api, app=None):
+    pods, _ = api.list("pods")
+    if app is None:
+        return pods
+    return [p for p in pods if p.labels.get("app") == app]
+
+
+def test_replicaset_scales_up_and_down():
+    api = FakeAPIServer()
+    cm = ControllerManager(api).start()
+    try:
+        rs = _rs("web", 5, "web")
+        api.create("replicasets", rs)
+        assert cm.wait_idle()
+        assert len(_pods(api, "web")) == 5
+        # every replica is owned and Pending
+        for p in _pods(api, "web"):
+            assert p.owner_references[0]["uid"] == rs.uid
+            assert p.phase == "Pending"
+        # scale down → surplus deleted
+        rs.replicas = 2
+        api.update("replicasets", rs)
+        assert cm.wait_idle()
+        assert len(_pods(api, "web")) == 2
+        # scale back up
+        rs.replicas = 4
+        api.update("replicasets", rs)
+        assert cm.wait_idle()
+        assert len(_pods(api, "web")) == 4
+    finally:
+        cm.stop()
+
+
+def test_replicaset_replaces_deleted_and_failed_pods():
+    api = FakeAPIServer()
+    cm = ControllerManager(api).start()
+    try:
+        api.create("replicasets", _rs("job", 3, "job"))
+        assert cm.wait_idle()
+        pods = _pods(api, "job")
+        assert len(pods) == 3
+        # external deletion → replacement
+        api.delete("pods", pods[0].key())
+        assert cm.wait_idle()
+        assert len(_pods(api, "job")) == 3
+        # a pod failing (phase) no longer counts as live → replaced
+        victim = _pods(api, "job")[0]
+        victim.phase = "Failed"
+        api.update("pods", victim)
+        assert cm.wait_idle()
+        live = [p for p in _pods(api, "job") if p.phase != "Failed"]
+        assert len(live) == 3
+    finally:
+        cm.stop()
+
+
+def test_replicaset_json_round_trip():
+    rs = replicaset_from_k8s({
+        "metadata": {"name": "api", "namespace": "prod", "uid": "u-1"},
+        "spec": {
+            "replicas": 3,
+            "selector": {"matchLabels": {"app": "api"}},
+            "template": {
+                "metadata": {"labels": {"app": "api"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "250m", "memory": "1Gi"}}}]},
+            },
+        },
+    })
+    assert rs.replicas == 3 and rs.namespace == "prod"
+    assert rs.template.containers[0].requests["cpu"].milli_value() == 250
+    assert rs.selector.match_labels == {"app": "api"}
+
+
+def test_nodelifecycle_taints_and_untaints():
+    api = FakeAPIServer()
+    n = make_node("n0", cpu_milli=4000, mem=8 * 2**30)
+    api.create("nodes", n)
+    cm = ControllerManager(api).start()
+    try:
+        n.conditions = [{"type": "Ready", "status": "False"}]
+        api.update("nodes", n)
+        assert cm.wait_idle()
+        node = api.get("nodes", "n0")
+        assert {t.effect for t in node.taints if t.key == TAINT_NOT_READY} == {
+            "NoSchedule", "NoExecute"}
+        node.conditions = [{"type": "Ready", "status": "True"}]
+        api.update("nodes", node)
+        assert cm.wait_idle()
+        node = api.get("nodes", "n0")
+        assert not any(t.key == TAINT_NOT_READY for t in node.taints)
+    finally:
+        cm.stop()
+
+
+def test_nodelifecycle_evicts_without_toleration():
+    api = FakeAPIServer()
+    n = make_node("n0", cpu_milli=4000, mem=8 * 2**30)
+    api.create("nodes", n)
+    bound = Pod(name="victim", node_name="n0")
+    tolerant = Pod(name="survivor", node_name="n0", tolerations=[
+        Toleration(key=TAINT_NOT_READY, operator="Exists")])
+    api.create("pods", bound)
+    api.create("pods", tolerant)
+    cm = ControllerManager(api).start()
+    try:
+        n.conditions = [{"type": "Ready", "status": "False"}]
+        api.update("nodes", n)
+        assert cm.wait_idle()
+        keys = {p.key() for p in _pods(api)}
+        assert "default/victim" not in keys
+        assert "default/survivor" in keys
+        assert cm.nodelifecycle.evictions == 1
+    finally:
+        cm.stop()
+
+
+def test_full_control_loop_with_scheduler():
+    """The VERDICT's end-to-end bar: pods are CREATED by the controller,
+    scheduled by the driver, 'fail' when their node dies (lifecycle taints
+    + evicts), get recreated by the ReplicaSet, and are re-scheduled onto
+    surviving nodes — with the queue flush observed via re-binds."""
+    api = FakeAPIServer()
+    for i in range(3):
+        api.create("nodes", make_node(f"n{i}", cpu_milli=2000, mem=8 * 2**30))
+
+    sched = Scheduler(batch_size=16, deterministic=True, enable_preemption=False)
+    sched.binder = Binder(APIBinder(api).bind)
+    handlers = EventHandlers(sched.cache, sched.queue, "default-scheduler")
+    informers = start_scheduler_informers(api, handlers)
+    for inf in informers.values():
+        inf.wait_for_sync()
+    cm = ControllerManager(api).start()
+    try:
+        api.create("replicasets", _rs("svc", 6, "svc"))
+        assert cm.wait_idle()
+
+        def drain(deadline=20.0):
+            end = time.monotonic() + deadline
+            while time.monotonic() < end:
+                r = sched.schedule_batch()
+                sched.wait_for_binds()
+                if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+                    bound = [p for p in _pods(api, "svc")
+                             if p.node_name and p.phase != "Failed"]
+                    if len(bound) >= 6 and cm.wait_idle(timeout=1.0):
+                        return bound
+                time.sleep(0.05)
+            raise AssertionError(
+                f"drain timed out; pods={[ (p.key(), p.node_name) for p in _pods(api) ]}"
+            )
+
+        bound = drain()
+        assert len(bound) == 6
+
+        # node death: some replicas lived on n0
+        on_n0 = [p for p in bound if p.node_name == "n0"]
+        assert on_n0, "expected replicas on n0"
+        n0 = api.get("nodes", "n0")
+        n0.conditions = [{"type": "Ready", "status": "False"}]
+        api.update("nodes", n0)
+        assert cm.wait_idle()
+        # lifecycle evicted them; RS recreated; scheduler must re-place on
+        # n1/n2 only (n0 carries the NoSchedule taint now)
+        bound2 = drain()
+        assert len(bound2) == 6
+        assert all(p.node_name in ("n1", "n2") for p in bound2), [
+            (p.key(), p.node_name) for p in bound2]
+        # the evicted generation is gone from the apiserver
+        assert cm.nodelifecycle.evictions >= len(on_n0)
+    finally:
+        cm.stop()
+        for inf in informers.values():
+            inf.stop()
