@@ -367,6 +367,7 @@ class ReplicaSet:
     name: str = ""
     namespace: str = "default"
     uid: str = field(default_factory=_new_uid)
+    resource_version: str = ""
     replicas: int = 1
     selector: Optional[LabelSelector] = None
     template: Optional[Pod] = None
@@ -787,6 +788,7 @@ def replicaset_from_k8s(obj: dict) -> ReplicaSet:
         name=meta.get("name", ""),
         namespace=meta.get("namespace", "default"),
         uid=meta.get("uid") or _new_uid(),
+        resource_version=str(meta.get("resourceVersion", "")),
         replicas=int(spec.get("replicas") if spec.get("replicas") is not None else 1),
         selector=_label_selector_from(spec.get("selector")),
         template=template,
@@ -803,10 +805,13 @@ def replicaset_to_k8s(rs: ReplicaSet) -> dict:
             "metadata": {"labels": t["metadata"].get("labels", {})},
             "spec": t["spec"],
         }
+    meta: Dict[str, Any] = {"name": rs.name, "namespace": rs.namespace, "uid": rs.uid}
+    if rs.resource_version:
+        meta["resourceVersion"] = rs.resource_version
     return {
         "apiVersion": "apps/v1",
         "kind": "ReplicaSet",
-        "metadata": {"name": rs.name, "namespace": rs.namespace, "uid": rs.uid},
+        "metadata": meta,
         "spec": spec,
     }
 

@@ -1,6 +1,7 @@
 """Simulated API server: ObjectTracker-style store, resourceVersion watch
 streams with 410-compaction, pods/binding subresource."""
 
+from .http import APIServerHTTP
 from .store import (
     ADDED,
     DELETED,
@@ -15,6 +16,7 @@ from .store import (
 
 __all__ = [
     "ADDED",
+    "APIServerHTTP",
     "DELETED",
     "MODIFIED",
     "ConflictError",

@@ -1,0 +1,307 @@
+"""HTTP transport for the fake apiserver: REST list+watch on k8s wire JSON.
+
+The reference's integration seam is exactly this protocol — reflectors
+LIST then WATCH a resource over HTTP (tools/cache/reflector.go:184), the
+server streaming chunked watch events from its cacher
+(storage/cacher/cacher.go:234), with 410 Gone forcing a relist after
+compaction. Serving it makes the in-process store reachable by
+out-of-process clients: a second scheduler replica, the debug CLI, or a
+real kubectl-style tool.
+
+Routes (apiVersion collapsed — kinds are top-level):
+  GET    /api/v1/{kind}                          list → {"kind": "...List",
+         "items": [...], "metadata": {"resourceVersion": "N"}}
+  GET    /api/v1/{kind}?watch=1&resourceVersion=N   chunked watch stream of
+         {"type": "ADDED|MODIFIED|DELETED", "object": {...}} lines
+         (Transfer-Encoding: chunked, one JSON object per chunk — the k8s
+         watch framing); HTTP 410 when N is compacted
+  POST   /api/v1/{kind}                          create (JSON body)
+  GET    /api/v1/{kind}/{ns}/{name}              get (cluster-scoped kinds
+         — nodes — take /{name} alone)
+  PUT    /api/v1/{kind}/{ns}/{name}              update (409 on stale
+         resourceVersion when the body carries one)
+  DELETE /api/v1/{kind}/{ns}/{name}              delete
+  POST   /api/v1/pods/{ns}/{name}/binding        bind subresource
+         ({"target": {"name": node}}, registry BindingREST semantics)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api.types import (
+    node_from_k8s,
+    node_to_k8s,
+    pod_from_k8s,
+    pod_to_k8s,
+    replicaset_from_k8s,
+    replicaset_to_k8s,
+)
+from .store import ConflictError, FakeAPIServer, GoneError, NotFoundError
+
+
+def _lease_to_k8s(rec) -> dict:
+    """coordination/v1 Lease wire shape for LeaderElectionRecord — enough
+    for an out-of-process replica to contend for the lock over HTTP."""
+    return {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": rec.name, "resourceVersion": rec.resource_version or ""},
+        "spec": {
+            "holderIdentity": rec.holder_identity,
+            "leaseDurationSeconds": rec.lease_duration_s,
+            "acquireTime": rec.acquire_time,
+            "renewTime": rec.renew_time,
+            "leaseTransitions": rec.leader_transitions,
+        },
+    }
+
+
+def _lease_from_k8s(d: dict):
+    from ..utils.leaderelection import LeaderElectionRecord
+
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    return LeaderElectionRecord(
+        holder_identity=spec.get("holderIdentity", ""),
+        lease_duration_s=float(spec.get("leaseDurationSeconds", 15.0)),
+        acquire_time=float(spec.get("acquireTime", 0.0)),
+        renew_time=float(spec.get("renewTime", 0.0)),
+        leader_transitions=int(spec.get("leaseTransitions", 0)),
+        name=meta.get("name", "kube-scheduler"),
+        resource_version=str(meta.get("resourceVersion", "")),
+    )
+
+
+# kind → (to_k8s, from_k8s, ListKind)
+_CODECS: Dict[str, Tuple[Callable, Callable, str]] = {
+    "pods": (pod_to_k8s, pod_from_k8s, "PodList"),
+    "nodes": (node_to_k8s, node_from_k8s, "NodeList"),
+    "replicasets": (replicaset_to_k8s, replicaset_from_k8s, "ReplicaSetList"),
+    "leases": (_lease_to_k8s, _lease_from_k8s, "LeaseList"),
+}
+
+
+def _status(code: int, reason: str, message: str) -> bytes:
+    return json.dumps({
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "reason": reason, "message": message, "code": code,
+    }).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    store: FakeAPIServer = None  # type: ignore  # set per-server subclass
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    # -- helpers -------------------------------------------------------------
+
+    def _send_json(self, code: int, payload: Any) -> None:
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    @staticmethod
+    def _obj_key(kind: str, rest) -> Optional[str]:
+        """nodes/leases are cluster-scoped (key = name); everything else
+        is namespace/name — mirroring store._key_of."""
+        if kind in ("nodes", "leases"):
+            return rest[0] if len(rest) == 1 else None
+        return f"{rest[0]}/{rest[1]}" if len(rest) == 2 else None
+
+    def _route(self):
+        u = urlparse(self.path)
+        parts = [p for p in u.path.split("/") if p]
+        # ["api", "v1", kind, ns?, name?, subresource?]
+        if len(parts) < 3 or parts[0] != "api" or parts[1] != "v1":
+            return None
+        kind = parts[2]
+        rest = parts[3:]
+        return kind, rest, parse_qs(u.query)
+
+    # -- verbs ---------------------------------------------------------------
+
+    def do_GET(self):
+        r = self._route()
+        if r is None:
+            return self._send_json(404, _status(404, "NotFound", self.path))
+        kind, rest, q = r
+        codec = _CODECS.get(kind)
+        if codec is None:
+            return self._send_json(404, _status(404, "NotFound", f"unknown kind {kind}"))
+        to_k8s, _, list_kind = codec
+        if rest:
+            key = self._obj_key(kind, rest)
+            if key is None:
+                return self._send_json(404, _status(404, "NotFound", self.path))
+            obj = None
+            try:
+                obj = self.store.get(kind, key)
+            except KeyError:
+                pass
+            if obj is None:
+                return self._send_json(404, _status(404, "NotFound", self.path))
+            return self._send_json(200, to_k8s(obj))
+        if q.get("watch", ["0"])[0] in ("1", "true"):
+            return self._serve_watch(kind, to_k8s, q)
+        items, rv = self.store.list(kind)
+        return self._send_json(200, {
+            "kind": list_kind,
+            "apiVersion": "v1",
+            "metadata": {"resourceVersion": str(rv)},
+            "items": [to_k8s(o) for o in items],
+        })
+
+    def _serve_watch(self, kind: str, to_k8s, q) -> None:
+        try:
+            since = int((q.get("resourceVersion") or ["0"])[0] or 0)
+            timeout = float((q.get("timeoutSeconds") or ["300"])[0])
+        except ValueError as e:
+            return self._send_json(400, _status(400, "BadRequest", str(e)))
+        try:
+            watcher = self.store.watch(kind, since)
+        except GoneError as e:
+            return self._send_json(410, _status(410, "Expired", str(e)))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        last_write = _time.monotonic()
+        try:
+            while _time.monotonic() < deadline:
+                ev = watcher.next(timeout=0.5)
+                if ev is None:
+                    if watcher.closed:
+                        break  # store closed the stream (restart simulation)
+                    if _time.monotonic() - last_write > 2.0:
+                        # blank-line heartbeat (clients skip empty lines):
+                        # detects a dropped client during idle stretches
+                        # instead of pinning this thread + Watcher for the
+                        # full timeoutSeconds
+                        chunk(b"\n")
+                        last_write = _time.monotonic()
+                    continue
+                d = to_k8s(ev.obj)
+                d["metadata"] = {**d.get("metadata", {}), "resourceVersion": str(ev.rv)}
+                chunk(json.dumps({"type": ev.type, "object": d}).encode() + b"\n")
+                last_write = _time.monotonic()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            watcher.close()
+            try:
+                chunk(b"")  # terminating chunk
+            except Exception:
+                pass
+
+    def do_POST(self):
+        r = self._route()
+        if r is None:
+            return self._send_json(404, _status(404, "NotFound", self.path))
+        kind, rest, _ = r
+        # bind subresource
+        if kind == "pods" and len(rest) == 3 and rest[2] == "binding":
+            body = self._read_body()
+            node = ((body.get("target") or {}).get("name")) or ""
+            try:
+                self.store.bind(rest[0], rest[1], node)
+            except NotFoundError as e:
+                return self._send_json(404, _status(404, "NotFound", str(e)))
+            except ConflictError as e:
+                return self._send_json(409, _status(409, "Conflict", str(e)))
+            return self._send_json(201, {"kind": "Status", "status": "Success"})
+        codec = _CODECS.get(kind)
+        if codec is None or rest:
+            return self._send_json(404, _status(404, "NotFound", self.path))
+        _, from_k8s, _ = codec
+        try:
+            obj = from_k8s(self._read_body())
+        except Exception as e:  # malformed JSON/object → 400, not a dropped conn
+            return self._send_json(400, _status(400, "BadRequest", str(e)))
+        try:
+            created = self.store.create(kind, obj)
+        except ConflictError as e:
+            return self._send_json(409, _status(409, "AlreadyExists", str(e)))
+        return self._send_json(201, codec[0](created))
+
+    def do_PUT(self):
+        r = self._route()
+        if r is None:
+            return self._send_json(404, _status(404, "NotFound", self.path))
+        kind, rest, _ = r
+        codec = _CODECS.get(kind)
+        if codec is None or self._obj_key(kind, rest) is None:
+            return self._send_json(404, _status(404, "NotFound", self.path))
+        to_k8s, from_k8s, _ = codec
+        body = self._read_body()
+        obj = from_k8s(body)
+        check_rv = bool(((body.get("metadata") or {}).get("resourceVersion")))
+        try:
+            updated = self.store.update(kind, obj, check_rv=check_rv)
+        except ConflictError as e:
+            return self._send_json(409, _status(409, "Conflict", str(e)))
+        except KeyError:
+            return self._send_json(404, _status(404, "NotFound", self.path))
+        return self._send_json(200, to_k8s(updated))
+
+    def do_DELETE(self):
+        r = self._route()
+        if r is None:
+            return self._send_json(404, _status(404, "NotFound", self.path))
+        kind, rest, _ = r
+        key = self._obj_key(kind, rest)
+        if key is None:
+            return self._send_json(404, _status(404, "NotFound", self.path))
+        try:
+            self.store.delete(kind, key)
+        except KeyError:
+            return self._send_json(404, _status(404, "NotFound", self.path))
+        return self._send_json(200, {"kind": "Status", "status": "Success"})
+
+
+class APIServerHTTP:
+    """Serve a FakeAPIServer store over HTTP (daemon threads)."""
+
+    def __init__(self, store: FakeAPIServer, host: str = "127.0.0.1", port: int = 0):
+        self.store = store
+        handler = type("BoundHandler", (_Handler,), {"store": store})
+        self._srv = ThreadingHTTPServer((host, port), handler)
+        self._srv.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        h, p = self._srv.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def start(self) -> "APIServerHTTP":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="apiserver-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

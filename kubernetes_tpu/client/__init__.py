@@ -1,5 +1,6 @@
 """client-go equivalent: reflector/informer machinery + the API binder."""
 
 from .informer import APIBinder, Informer, start_scheduler_informers
+from .remote import RemoteAPIServer
 
-__all__ = ["APIBinder", "Informer", "start_scheduler_informers"]
+__all__ = ["APIBinder", "Informer", "RemoteAPIServer", "start_scheduler_informers"]

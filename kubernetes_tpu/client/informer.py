@@ -77,7 +77,13 @@ class Informer:
 
     def stop(self) -> None:
         self._stop.set()
-        self.api.close_watchers(self.kind)
+        # in-process store: hurry the reflector loop out of its blocking
+        # next() by dropping the server-side streams. A remote apiserver
+        # has no such admin hook — the loop exits on its 0.2s poll and the
+        # client-side watcher is closed in _run's finally.
+        close = getattr(self.api, "close_watchers", None)
+        if close is not None:
+            close(self.kind)
         if self._thread:
             self._thread.join(timeout=5)
 

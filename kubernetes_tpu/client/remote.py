@@ -1,0 +1,173 @@
+"""HTTP client for the apiserver transport: FakeAPIServer's interface over
+the wire, so Informer (and anything else written against the in-process
+store) consumes a REMOTE apiserver unchanged — the client-go RESTClient +
+watch.Interface analogue (tools/cache/reflector.go list+watch protocol).
+
+RemoteAPIServer(base_url) implements list/watch/create/update/delete/get/
+bind; watch() returns a Watcher-compatible object fed by a daemon thread
+reading the chunked stream. GoneError maps from HTTP 410 (the informer's
+relist trigger), ConflictError from 409.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import queue
+import threading
+from typing import Any, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from ..api.types import (
+    node_from_k8s,
+    node_to_k8s,
+    pod_from_k8s,
+    pod_to_k8s,
+    replicaset_from_k8s,
+    replicaset_to_k8s,
+)
+from ..apiserver.http import _lease_from_k8s, _lease_to_k8s
+from ..apiserver.store import ConflictError, GoneError, NotFoundError, WatchEvent, _key_of
+
+_CODECS = {
+    "pods": (pod_to_k8s, pod_from_k8s),
+    "nodes": (node_to_k8s, node_from_k8s),
+    "replicasets": (replicaset_to_k8s, replicaset_from_k8s),
+    "leases": (_lease_to_k8s, _lease_from_k8s),
+}
+
+
+class _RemoteWatcher:
+    """Watcher-compatible stream over a chunked HTTP watch response."""
+
+    def __init__(self, conn: http.client.HTTPConnection, resp, from_k8s):
+        self._conn = conn
+        self._resp = resp
+        self._from = from_k8s
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self.closed = False
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        try:
+            buf = b""
+            while True:
+                data = self._resp.read1(65536)
+                if not data:
+                    break
+                buf += data
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    d = json.loads(line)
+                    obj = self._from(d["object"])
+                    rv = int(d["object"].get("metadata", {}).get("resourceVersion", 0))
+                    self._q.put(WatchEvent(d["type"], obj, rv))
+        except Exception:
+            pass  # connection dropped: informer treats close as relist
+        finally:
+            self.close()
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._q.put(None)
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+
+
+class RemoteAPIServer:
+    """FakeAPIServer's surface, HTTP-backed. Drop-in for Informer."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        u = urlparse(base_url)
+        self._host = u.hostname
+        self._port = u.port or 80
+        self._timeout = timeout
+
+    def _conn(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=timeout or self._timeout
+        )
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None):
+        conn = self._conn()
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            conn.request(method, path, body=payload,
+                         headers={"Content-Type": "application/json"} if payload else {})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status == 410:
+                raise GoneError(data.decode())
+            if resp.status == 409:
+                raise ConflictError(data.decode())
+            if resp.status == 404:
+                raise NotFoundError(path)
+            if resp.status >= 400:
+                raise RuntimeError(f"{method} {path}: {resp.status} {data[:200]!r}")
+            return json.loads(data) if data else {}
+        finally:
+            conn.close()
+
+    # -- FakeAPIServer surface ------------------------------------------------
+
+    def list(self, kind: str) -> Tuple[List[Any], int]:
+        d = self._req("GET", f"/api/v1/{kind}")
+        _, from_k8s = _CODECS[kind]
+        rv = int(d.get("metadata", {}).get("resourceVersion", 0))
+        return [from_k8s(o) for o in d.get("items", [])], rv
+
+    def watch(self, kind: str, since_rv: int) -> _RemoteWatcher:
+        _, from_k8s = _CODECS[kind]
+        conn = self._conn(timeout=None)  # streams block until events arrive
+        conn.request(
+            "GET", f"/api/v1/{kind}?watch=1&resourceVersion={since_rv}"
+        )
+        resp = conn.getresponse()
+        if resp.status == 410:
+            data = resp.read()
+            conn.close()
+            raise GoneError(data.decode())
+        if resp.status != 200:
+            data = resp.read()
+            conn.close()
+            raise RuntimeError(f"watch {kind}: {resp.status} {data[:200]!r}")
+        return _RemoteWatcher(conn, resp, from_k8s)
+
+    def create(self, kind: str, obj: Any) -> Any:
+        to_k8s, from_k8s = _CODECS[kind]
+        return from_k8s(self._req("POST", f"/api/v1/{kind}", to_k8s(obj)))
+
+    def update(self, kind: str, obj: Any, check_rv: bool = False) -> Any:
+        to_k8s, from_k8s = _CODECS[kind]
+        body = to_k8s(obj)
+        if not check_rv:
+            body.get("metadata", {}).pop("resourceVersion", None)
+        return from_k8s(
+            self._req("PUT", f"/api/v1/{kind}/{_key_of(obj)}", body)
+        )
+
+    def delete(self, kind: str, key: str) -> None:
+        self._req("DELETE", f"/api/v1/{kind}/{key}")
+
+    def get(self, kind: str, key: str) -> Any:
+        _, from_k8s = _CODECS[kind]
+        return from_k8s(self._req("GET", f"/api/v1/{kind}/{key}"))
+
+    def bind(self, namespace: str, name: str, node_name: str) -> None:
+        self._req(
+            "POST",
+            f"/api/v1/pods/{namespace}/{name}/binding",
+            {"target": {"kind": "Node", "name": node_name}},
+        )

@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--address", default="127.0.0.1")
     p.add_argument("--port", type=int, default=10250, help="extender serving port")
     p.add_argument("--metrics-port", type=int, default=10251)
+    p.add_argument(
+        "--serve-api", type=int, default=0, metavar="PORT",
+        help="sim: also serve the apiserver over HTTP (REST list+watch) on "
+             "this port so out-of-process clients/replicas can integrate",
+    )
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument(
         "--mesh", default="auto",
@@ -182,6 +187,12 @@ def run_sim(args) -> int:
 
     cfgr, sched, cc = _configurator(args)
     api = FakeAPIServer()
+    api_http = None
+    if args.serve_api:
+        from .apiserver import APIServerHTTP
+
+        api_http = APIServerHTTP(api, port=args.serve_api).start()
+        print(f"apiserver HTTP on {api_http.url} (list/watch/create/bind)")
     sched.binder = Binder(APIBinder(api).bind)
     # leaderElection.leaderElect (server.go:157 → leaderelection.RunOrDie):
     # acquire the lease before scheduling; renew each cycle, stand down on
@@ -348,6 +359,8 @@ def run_sim(args) -> int:
     print(json.dumps(out))
     for inf in informers.values():
         inf.stop()
+    if api_http is not None:
+        api_http.stop()
     return 0 if bound == len(live) else 1
 
 
