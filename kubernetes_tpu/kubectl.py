@@ -1,0 +1,209 @@
+"""kubectl-subset ops CLI over the apiserver HTTP transport.
+
+The honest minimum of the reference's ops tooling
+(staging/src/k8s.io/kubectl): get / describe / cordon / uncordon / drain /
+delete against any server speaking the list+watch transport
+(apiserver/http.py — e.g. `--mode sim --serve-api PORT`). Being a separate
+process talking wire JSON is the point: it proves the control plane is
+reachable the way the reference's is.
+
+  python -m kubernetes_tpu.kubectl --server http://127.0.0.1:18080 get pods
+  python -m kubernetes_tpu.kubectl ... get nodes
+  python -m kubernetes_tpu.kubectl ... describe pod default/web-1
+  python -m kubernetes_tpu.kubectl ... describe node node-3
+  python -m kubernetes_tpu.kubectl ... cordon node-3
+  python -m kubernetes_tpu.kubectl ... drain node-3
+  python -m kubernetes_tpu.kubectl ... delete pod default/web-1
+
+Reference behaviors mirrored: cordon sets spec.unschedulable
+(kubectl/pkg/drain), drain = cordon + evict every pod bound to the node
+(pods with a controller owner are deleted and re-created elsewhere by
+their ReplicaSet — the same flow `kubectl drain` relies on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .client.remote import RemoteAPIServer
+
+
+def _fmt_table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [len(h) for h in headers]
+    for r in rows:
+        for i, c in enumerate(r):
+            widths[i] = max(widths[i], len(c))
+    out = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def cmd_get(api: RemoteAPIServer, kind: str) -> int:
+    kind = {"pod": "pods", "node": "nodes", "rs": "replicasets",
+            "replicaset": "replicasets"}.get(kind, kind)
+    if kind not in ("pods", "nodes", "replicasets"):
+        print(f"unknown kind {kind}", file=sys.stderr)
+        return 1
+    items, _ = api.list(kind)
+    if kind == "pods":
+        rows = [[p.key(), p.phase, p.node_name or "<none>",
+                 str(p.get_priority())] for p in items]
+        print(_fmt_table(["NAME", "STATUS", "NODE", "PRIORITY"], rows))
+    elif kind == "nodes":
+        rows = []
+        for n in items:
+            status = "SchedulingDisabled" if n.unschedulable else "Ready"
+            for c in n.conditions:
+                if c.get("type") == "Ready" and c.get("status") != "True":
+                    status = "NotReady"
+            taints = ",".join(f"{t.key}:{t.effect}" for t in n.taints) or "<none>"
+            rows.append([n.name, status, taints])
+        print(_fmt_table(["NAME", "STATUS", "TAINTS"], rows))
+    elif kind == "replicasets":
+        rows = [[rs.key(), str(rs.replicas)] for rs in items]
+        print(_fmt_table(["NAME", "DESIRED"], rows))
+    else:
+        print(f"unknown kind {kind}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_describe(api: RemoteAPIServer, kind: str, name: str) -> int:
+    if kind in ("pod", "pods"):
+        p = api.get("pods", name if "/" in name else f"default/{name}")
+        print(f"Name:         {p.name}")
+        print(f"Namespace:    {p.namespace}")
+        print(f"Node:         {p.node_name or '<none>'}")
+        print(f"Status:       {p.phase}")
+        print(f"Priority:     {p.get_priority()}")
+        print(f"Labels:       {p.labels}")
+        if p.nominated_node_name:
+            print(f"NominatedNodeName: {p.nominated_node_name}")
+        for c in p.containers:
+            reqs = {k: str(q.value_exact) for k, q in c.requests.items()}
+            print(f"Container {c.name}: requests={reqs}")
+        if p.tolerations:
+            print("Tolerations: " + "; ".join(
+                f"{t.key} {t.operator} {t.value} {t.effect}".strip()
+                for t in p.tolerations))
+        if p.owner_references:
+            print(f"Controlled By: " + ", ".join(
+                f"{r.get('kind')}/{r.get('name')}" for r in p.owner_references))
+        return 0
+    if kind in ("node", "nodes"):
+        n = api.get("nodes", name)
+        print(f"Name:          {n.name}")
+        print(f"Labels:        {n.labels}")
+        print(f"Unschedulable: {n.unschedulable}")
+        print("Taints:        " + (", ".join(
+            f"{t.key}={t.value}:{t.effect}" for t in n.taints) or "<none>"))
+        alloc = {k: str(q.value_exact) for k, q in n.allocatable.items()}
+        print(f"Allocatable:   {alloc}")
+        pods, _ = api.list("pods")
+        mine = [p for p in pods if p.node_name == n.name]
+        print(f"Non-terminated Pods: ({len(mine)} in total)")
+        for p in mine:
+            print(f"  {p.key()}")
+        return 0
+    print(f"unknown kind {kind}", file=sys.stderr)
+    return 1
+
+
+def _set_unschedulable(api: RemoteAPIServer, name: str, value: bool) -> int:
+    """CAS loop on resourceVersion: a blind PUT would clobber concurrent
+    controller writes (taints, conditions) — real kubectl cordon PATCHes
+    spec.unschedulable for the same reason."""
+    from .apiserver.store import ConflictError
+
+    for _ in range(10):
+        n = api.get("nodes", name)
+        n.unschedulable = value
+        try:
+            api.update("nodes", n, check_rv=True)
+        except ConflictError:
+            continue  # re-read and retry against the newer version
+        print(f"node/{name} {'cordoned' if value else 'uncordoned'}")
+        return 0
+    print(f"node/{name}: too many conflicting writers", file=sys.stderr)
+    return 1
+
+
+def cmd_drain(api: RemoteAPIServer, name: str) -> int:
+    """cordon + evict everything bound to the node (kubectl drain's core:
+    pkg/drain — controller-owned pods are re-created elsewhere)."""
+    _set_unschedulable(api, name, True)
+    pods, _ = api.list("pods")
+    evicted = 0
+    for p in pods:
+        if p.node_name != name:
+            continue
+        api.delete("pods", p.key())
+        evicted += 1
+        print(f"evicting pod {p.key()}")
+    print(f"node/{name} drained ({evicted} pods evicted)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="kubectl", description=__doc__)
+    p.add_argument("--server", required=True, help="apiserver base URL")
+    sub = p.add_subparsers(dest="verb", required=True)
+    g = sub.add_parser("get")
+    g.add_argument("kind")
+    d = sub.add_parser("describe")
+    d.add_argument("kind")
+    d.add_argument("name")
+    for verb in ("cordon", "uncordon", "drain"):
+        s = sub.add_parser(verb)
+        s.add_argument("node")
+    dl = sub.add_parser("delete")
+    dl.add_argument("kind")
+    dl.add_argument("name")
+    args = p.parse_args(argv)
+    api = RemoteAPIServer(args.server)
+    if args.verb == "get":
+        return cmd_get(api, args.kind)
+    if args.verb == "describe":
+        return cmd_describe(api, args.kind, args.name)
+    if args.verb == "cordon":
+        return _set_unschedulable(api, args.node, True)
+    if args.verb == "uncordon":
+        return _set_unschedulable(api, args.node, False)
+    if args.verb == "drain":
+        return cmd_drain(api, args.node)
+    if args.verb == "delete":
+        kind = {"pod": "pods", "node": "nodes", "rs": "replicasets",
+                "replicaset": "replicasets"}.get(args.kind, args.kind)
+        if kind not in ("pods", "nodes", "replicasets"):
+            print(f"unknown kind {args.kind}", file=sys.stderr)
+            return 1
+        key = args.name if "/" in args.name or kind == "nodes" else f"default/{args.name}"
+        api.delete(kind, key)
+        print(f"{kind}/{args.name} deleted")
+        return 0
+    return 1
+
+
+def run() -> int:
+    """CLI entry with expected-failure mapping: missing objects and an
+    unreachable server print one-line errors (exit 1), not tracebacks."""
+    from .apiserver.store import ConflictError, NotFoundError
+
+    try:
+        return main()
+    except NotFoundError as e:
+        print(f"Error: not found: {e}", file=sys.stderr)
+        return 1
+    except ConflictError as e:
+        print(f"Error: conflict: {e}", file=sys.stderr)
+        return 1
+    except (ConnectionError, OSError) as e:
+        print(f"Error: cannot reach server: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -189,3 +189,40 @@ def test_leader_election_over_http(served):
         won = b.try_acquire_or_renew()
         time.sleep(0.1)
     assert won, "b never took over after a stopped renewing"
+
+
+def test_kubectl_cli_over_http(served):
+    """The debug CLI (kubectl subset) drives the control plane as a
+    separate process over the wire: get/describe/cordon/drain."""
+    store, srv = served
+    store.create("nodes", make_node("n0"))
+    store.create("nodes", make_node("n1"))
+    p = make_pod("w1", cpu_milli=100, mem=2**20)
+    p.node_name = "n0"
+    store.create("pods", p)
+
+    def kubectl(*args):
+        out = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.kubectl",
+             "--server", srv.url, *args],
+            capture_output=True, text=True, timeout=30,
+            cwd="/root/repo",
+        )
+        assert out.returncode == 0, (args, out.stdout, out.stderr)
+        return out.stdout
+
+    assert "w1" in kubectl("get", "pods")
+    assert "n0" in kubectl("get", "nodes")
+    desc = kubectl("describe", "node", "n0")
+    assert "default/w1" in desc and "Unschedulable: False" in desc
+    desc = kubectl("describe", "pod", "default/w1")
+    assert "Node:         n0" in desc
+    kubectl("cordon", "n1")
+    assert store.get("nodes", "n1").unschedulable is True
+    kubectl("uncordon", "n1")
+    assert store.get("nodes", "n1").unschedulable is False
+    out = kubectl("drain", "n0")
+    assert "evicting pod default/w1" in out
+    assert store.get("nodes", "n0").unschedulable is True
+    pods, _ = store.list("pods")
+    assert not pods
