@@ -63,6 +63,7 @@ class NodeInfo:
         self._recount()
 
     def _recount(self) -> None:
+        self._aff_pods: List[Pod] = []
         self._req: Dict[str, int] = {}
         self._nz_cpu = 0
         self._nz_mem = 0
@@ -71,6 +72,17 @@ class NodeInfo:
             self._account(p, 1)
 
     def _account(self, pod: Pod, sign: int) -> None:
+        # podsWithAffinity maintained INCREMENTALLY (node_info.go AddPod/
+        # RemovePod do the same): preemption's reprieve loop re-reads it
+        # once per candidate node per victim — recomputing over every pod
+        # made preempt() O(cluster x pods) in pure list filtering
+        if pod_has_affinity_constraints(pod):
+            if sign > 0:
+                self._aff_pods.append(pod)
+            else:
+                # every removal path (remove_pod / remove_pod_key) passes
+                # the stored object, matching the pods-list semantics
+                self._aff_pods.remove(pod)
         req = self._req
         for name, v in accumulated_request(pod).items():
             nv = req.get(name, 0) + sign * v
@@ -115,7 +127,10 @@ class NodeInfo:
     # -- aggregates ----------------------------------------------------------
 
     def pods_with_affinity(self) -> List[Pod]:
-        return [p for p in self.pods if pod_has_affinity_constraints(p)]
+        """READ-ONLY view (the incrementally-maintained list itself —
+        mutating it desyncs the affinity bookkeeping that feeds the
+        mirror's pattern encoding and preemption's fast-path guard)."""
+        return self._aff_pods
 
     def requested(self) -> Dict[str, int]:
         """RequestedResource per calculateResource (node_info.go): sum of

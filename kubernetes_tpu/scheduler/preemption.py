@@ -22,6 +22,8 @@ from ..oracle.nodeinfo import NodeInfo, Snapshot
 from ..oracle.predicates import (
     check_node_unschedulable,
     compute_predicate_metadata,
+    get_pod_affinity_terms,
+    get_pod_anti_affinity_terms,
     pod_fits_host,
     pod_fits_on_node,
     pod_match_node_selector,
@@ -174,6 +176,7 @@ def select_victims_on_node(
     can_disrupt: Optional[Callable[[Pod], bool]] = None,
     extra_fit: Optional[Callable[[Pod, object], bool]] = None,
     enabled: Optional[frozenset] = None,
+    static_meta=None,
 ) -> Optional[Victims]:
     """selectVictimsOnNode (:1104): remove ALL lower-priority pods; if the
     pod then fits, reprieve candidates most-important-first — PDB-protected
@@ -201,7 +204,9 @@ def select_victims_on_node(
     victims_set = {id(p) for p in potential}
     sni.set_pods([p for p in sni.pods if id(p) not in victims_set])
 
-    meta = compute_predicate_metadata(pod, shadow, enabled=enabled)
+    meta = static_meta if static_meta is not None else compute_predicate_metadata(
+        pod, shadow, enabled=enabled
+    )
     fits, _ = pod_fits_on_node(pod, sni, meta=meta)
     if fits and extra_fit is not None:
         # volume predicates etc.: evicting pods cannot cure a zone/volume
@@ -216,7 +221,9 @@ def select_victims_on_node(
 
     def reprieve(p: Pod) -> bool:
         sni.add_pod(p)
-        meta = compute_predicate_metadata(pod, shadow, enabled=enabled)
+        meta = static_meta if static_meta is not None else compute_predicate_metadata(
+            pod, shadow, enabled=enabled
+        )
         still_fits, _ = pod_fits_on_node(pod, sni, meta=meta)
         if still_fits and extra_fit is not None:
             still_fits = extra_fit(pod, sni)
@@ -289,11 +296,28 @@ def preempt(
     if not pod_eligible_to_preempt_others(pod, snapshot):
         return None, [], []
     potential = nodes_where_preemption_might_help(pod, snapshot)
+    # AFFINITY-FREE FAST PATH: when the preemptor carries no (anti-)affinity
+    # terms and no spread constraints, AND no existing pod carries affinity
+    # constraints, the predicate metadata is identical for every candidate
+    # shadow (victim removal cannot change empty pair maps) — compute it
+    # once instead of once per node per reprieve. This is what makes
+    # preemption O(candidates x victims) instead of O(candidates x victims
+    # x cluster) on plain-resource workloads.
+    static_meta = None
+    if (
+        not get_pod_affinity_terms(pod.affinity)
+        and not get_pod_anti_affinity_terms(pod.affinity)
+        and not pod.topology_spread_constraints
+        and not any(
+            ni.pods_with_affinity() for ni in snapshot.node_infos.values()
+        )
+    ):
+        static_meta = compute_predicate_metadata(pod, snapshot, enabled=enabled)
     candidates: Dict[str, Victims] = {}
     for name in potential:
         v = select_victims_on_node(
             pod, name, snapshot, pdbs=pdbs, can_disrupt=can_disrupt,
-            extra_fit=extra_fit, enabled=enabled,
+            extra_fit=extra_fit, enabled=enabled, static_meta=static_meta,
         )
         if v is not None:
             candidates[name] = v
