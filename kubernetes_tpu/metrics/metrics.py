@@ -78,7 +78,7 @@ pod_scheduling_duration = registry.register(Histogram(
     # once wait tens of seconds for their batch): extend the tail so p99 is
     # a number, not +Inf (metrics.go PodSchedulingDuration uses exponential
     # buckets to 512s for the same reason)
-    buckets=_DURATION_BUCKETS + (20.0, 40.0, 80.0, 160.0, 320.0, 640.0),
+    buckets=_DURATION_BUCKETS + (20.0, 40.0, 80.0, 160.0, 320.0, 640.0, 1280.0, 2560.0),
 ))
 pod_scheduling_attempts = registry.register(Histogram(
     "scheduler_pod_scheduling_attempts",

@@ -296,20 +296,26 @@ def preempt(
     if not pod_eligible_to_preempt_others(pod, snapshot):
         return None, [], []
     potential = nodes_where_preemption_might_help(pod, snapshot)
+    if not potential:
+        return None, [], []
     # AFFINITY-FREE FAST PATH: when the preemptor carries no (anti-)affinity
-    # terms and no spread constraints, AND no existing pod carries affinity
-    # constraints, the predicate metadata is identical for every candidate
-    # shadow (victim removal cannot change empty pair maps) — compute it
-    # once instead of once per node per reprieve. This is what makes
-    # preemption O(candidates x victims) instead of O(candidates x victims
-    # x cluster) on plain-resource workloads.
+    # terms and no spread constraints, AND no existing pod carries a
+    # REQUIRED ANTI-affinity term (the only existing-pod terms the
+    # predicate metadata reads — preferred/positive terms never enter the
+    # pair maps), the metadata is identical for every candidate shadow
+    # (victim removal cannot change empty pair maps) — compute it once
+    # instead of once per node per reprieve. This is what makes preemption
+    # O(candidates x victims) instead of O(candidates x victims x cluster)
+    # on plain-resource and preferred-only workloads.
     static_meta = None
     if (
         not get_pod_affinity_terms(pod.affinity)
         and not get_pod_anti_affinity_terms(pod.affinity)
         and not pod.topology_spread_constraints
         and not any(
-            ni.pods_with_affinity() for ni in snapshot.node_infos.values()
+            get_pod_anti_affinity_terms(ep.affinity)
+            for ni in snapshot.node_infos.values()
+            for ep in ni.pods_with_affinity()
         )
     ):
         static_meta = compute_predicate_metadata(pod, snapshot, enabled=enabled)
