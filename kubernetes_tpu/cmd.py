@@ -71,6 +71,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--nodes", type=int, default=100)
     p.add_argument("--pods", type=int, default=500)
     p.add_argument(
+        "--hollow-nodes", action="store_true",
+        help="sim: run a hollow kubelet per node (kubemark) — pods are "
+             "acked Running from the node side, node health is heartbeat-"
+             "driven, and --controllers' node kill becomes a kubelet crash",
+    )
+    p.add_argument(
         "--controllers", action="store_true",
         help="sim: run the controller-manager (ReplicaSet + nodelifecycle); "
              "pods are created BY ReplicaSets, one node is killed mid-run, "
@@ -215,8 +221,15 @@ def run_sim(args) -> int:
             time.sleep(elector.retry_period_s)
     g = ClusterGen(args.seed)
     nodes, existing = g.cluster(args.nodes, 0, feature_rate=0.3)
-    for n in nodes:
-        api.create("nodes", n)
+    hollow = None
+    if args.hollow_nodes:
+        from .kubemark import HollowCluster
+
+        # the kubelets register their own Node objects
+        hollow = HollowCluster(api, nodes, heartbeat_s=0.5).start()
+    else:
+        for n in nodes:
+            api.create("nodes", n)
     handlers = EventHandlers(sched.cache, sched.queue, args.scheduler_name)
     informers = start_scheduler_informers(api, handlers)
     for inf in informers.values():
@@ -237,7 +250,10 @@ def run_sim(args) -> int:
         # the apiserver, not pre-filled into the queue
         from .controllers import ControllerManager
 
-        cm = ControllerManager(api).start()
+        cm = ControllerManager(
+            api,
+            node_monitor_grace_s=2.0 if args.hollow_nodes else None,
+        ).start()
         n_sets = max(1, args.pods // args.replicas_per_set)
         for s in range(n_sets):
             replicas = args.replicas_per_set if s < n_sets - 1 else (
@@ -303,13 +319,18 @@ def run_sim(args) -> int:
             if cm is not None and not killed:
                 # kill one node that hosts pods: the lifecycle controller
                 # taints + evicts, the ReplicaSets refill, the scheduler
-                # re-places on the survivors — the full control loop
+                # re-places on the survivors — the full control loop. With
+                # hollow nodes the kill is a kubelet CRASH (heartbeats
+                # stop); otherwise the Ready condition is set directly.
                 cm.wait_idle()
                 victims = {p.node_name for p in live}
                 target = sorted(victims)[0]
-                node = api.get("nodes", target)
-                node.conditions = [{"type": "Ready", "status": "False"}]
-                api.update("nodes", node)
+                if hollow is not None:
+                    hollow.kill(target)
+                else:
+                    node = api.get("nodes", target)
+                    node.conditions = [{"type": "Ready", "status": "False"}]
+                    api.update("nodes", node)
                 killed = target
                 evicted_at_kill = sum(1 for p in live if p.node_name == target)
                 continue
@@ -359,6 +380,8 @@ def run_sim(args) -> int:
     print(json.dumps(out))
     for inf in informers.values():
         inf.stop()
+    if hollow is not None:
+        hollow.stop()
     if api_http is not None:
         api_http.stop()
     return 0 if bound == len(live) else 1

@@ -24,7 +24,8 @@ logger = logging.getLogger("kubernetes_tpu.controllers.manager")
 
 
 class ControllerManager:
-    def __init__(self, api, controllers=("replicaset", "nodelifecycle")):
+    def __init__(self, api, controllers=("replicaset", "nodelifecycle"),
+                 node_monitor_grace_s=None):
         self.api = api
         self.informers: Dict[str, Informer] = {
             "pods": Informer(api, "pods"),
@@ -45,14 +46,24 @@ class ControllerManager:
         if "nodelifecycle" in controllers:
             q = WorkQueue()
             self.nodelifecycle = NodeLifecycleController(
-                api, self.informers["nodes"], self.informers["pods"], q
+                api, self.informers["nodes"], self.informers["pods"], q,
+                monitor_grace_s=node_monitor_grace_s,
             )
             self.controllers.append(self.nodelifecycle)
             self._queues.append(q)
+            if node_monitor_grace_s:
+                t = threading.Thread(
+                    target=self._monitor_loop,
+                    args=(self.nodelifecycle, node_monitor_grace_s / 4.0),
+                    name="node-monitor", daemon=True,
+                )
+                self._monitor_thread = t
 
     def start(self) -> "ControllerManager":
         for c in self.controllers:
             c.register()
+        if getattr(self, "_monitor_thread", None) is not None:
+            self._monitor_thread.start()
         for inf in self.informers.values():
             inf.start()
         for inf in self.informers.values():
@@ -65,6 +76,15 @@ class ControllerManager:
             t.start()
             self._threads.append(t)
         return self
+
+    def _monitor_loop(self, controller, period_s: float) -> None:
+        """monitorNodeHealth's clock: staleness has no apiserver event,
+        so every period each node re-syncs."""
+        while not self._stop.wait(period_s):
+            try:
+                controller.resync_all()
+            except Exception:
+                logger.exception("node monitor tick failed")
 
     def _worker(self, controller, queue: WorkQueue) -> None:
         while not self._stop.is_set():

@@ -23,6 +23,7 @@ heartbeat staleness to debounce).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 from ..api.types import (
@@ -32,13 +33,14 @@ from ..api.types import (
     Taint,
     tolerations_tolerate_taint,
 )
+from ..apiserver.store import ConflictError, NotFoundError
 
 logger = logging.getLogger("kubernetes_tpu.controllers.nodelifecycle")
 
 TAINT_NOT_READY = "node.kubernetes.io/not-ready"
 
 
-def _ready(node: Node) -> bool:
+def _ready_condition(node: Node) -> bool:
     for c in node.conditions:
         if c.get("type") == "Ready":
             return c.get("status") == "True"
@@ -46,12 +48,79 @@ def _ready(node: Node) -> bool:
 
 
 class NodeLifecycleController:
-    def __init__(self, api, node_informer, pod_informer, queue):
+    def __init__(self, api, node_informer, pod_informer, queue,
+                 monitor_grace_s: Optional[float] = None):
         self.api = api
         self.node_informer = node_informer
         self.pod_informer = pod_informer
         self.queue = queue
+        # node-lease staleness threshold (node-monitor-grace-period);
+        # falsy disables the monitor (purely condition-driven, the
+        # pre-kubemark behavior)
+        self.monitor_grace_s = monitor_grace_s or None
         self.evictions = 0  # observability for tests
+
+    def _heartbeat_stale(self, name: str) -> bool:
+        """monitorNodeHealth's grace-period half over NodeLease objects: a
+        node whose kubelet renews `node-<name>` in the leases kind goes
+        unready once the renew time is older than the grace period. Nodes
+        without a lease are status-driven only (static sim nodes exempt)."""
+        if self.monitor_grace_s is None:
+            return False
+        try:
+            rec = self.api.get("leases", f"node-{name}")
+        except (KeyError, NotFoundError):
+            return False
+        return time.time() - rec.renew_time > self.monitor_grace_s
+
+    def _ready(self, node: Node) -> bool:
+        if self._heartbeat_stale(node.name):
+            return False
+        return _ready_condition(node)
+
+    @staticmethod
+    def _untaint(node: Node) -> None:
+        node.taints = [t for t in node.taints if t.key != TAINT_NOT_READY]
+
+    def _taint_mutator(self, stale: bool):
+        def mutate(node: Node) -> None:
+            if any(t.key == TAINT_NOT_READY for t in node.taints):
+                return
+            node.taints = list(node.taints) + [
+                Taint(key=TAINT_NOT_READY, effect=TAINT_NO_SCHEDULE),
+                Taint(key=TAINT_NOT_READY, effect=TAINT_NO_EXECUTE),
+            ]
+            if stale:
+                # record WHY (monitorNodeHealth writes Unknown when the
+                # kubelet stops reporting)
+                node.conditions = [
+                    c for c in node.conditions if c.get("type") != "Ready"
+                ] + [{"type": "Ready", "status": "Unknown",
+                      "reason": "NodeStatusUnknown"}]
+        return mutate
+
+    def _cas_node(self, name: str, mutate) -> None:
+        """Read-modify-write against the AUTHORITATIVE store copy with a
+        resourceVersion precondition: writing the informer's (possibly
+        stale) object back blind would clobber concurrent writers'
+        fields."""
+        for _ in range(5):
+            try:
+                node = self.api.get("nodes", name)
+            except (KeyError, NotFoundError):
+                return
+            mutate(node)
+            try:
+                self.api.update("nodes", node, check_rv=True)
+                return
+            except ConflictError:
+                continue
+
+    def resync_all(self) -> None:
+        """Periodic monitor tick (monitorNodeHealth): re-enqueue every
+        node so staleness is noticed without an apiserver event."""
+        for n in self.node_informer.list():
+            self.queue.add(n.name)
 
     def register(self) -> None:
         self.node_informer.add_event_handler(
@@ -70,7 +139,7 @@ class NodeLifecycleController:
         if not pod.node_name:
             return
         node = self.node_informer.get(pod.node_name)
-        if node is not None and not _ready(node):
+        if node is not None and not self._ready(node):
             self.queue.add(node.name)
 
     def sync(self, name: str) -> None:
@@ -78,17 +147,12 @@ class NodeLifecycleController:
         if node is None:
             return
         tainted = any(t.key == TAINT_NOT_READY for t in node.taints)
-        if _ready(node):
+        if self._ready(node):
             if tainted:
-                node.taints = [t for t in node.taints if t.key != TAINT_NOT_READY]
-                self.api.update("nodes", node)
+                self._cas_node(name, self._untaint)
             return
         if not tainted:
-            node.taints = list(node.taints) + [
-                Taint(key=TAINT_NOT_READY, effect=TAINT_NO_SCHEDULE),
-                Taint(key=TAINT_NOT_READY, effect=TAINT_NO_EXECUTE),
-            ]
-            self.api.update("nodes", node)
+            self._cas_node(name, self._taint_mutator(self._heartbeat_stale(name)))
         # NoExecute eviction: every pod bound here without a toleration
         no_exec = Taint(key=TAINT_NOT_READY, effect=TAINT_NO_EXECUTE)
         for p in self.pod_informer.list():

@@ -1,0 +1,25 @@
+"""Hollow-node runtime: the kubemark analogue.
+
+The reference's hollow kubelet (pkg/kubemark/hollow_kubelet.go:64) runs a
+real kubelet against fake container/volume managers: it watches for pods
+bound to its node, "runs" them (status → Running), and heartbeats node
+status — so scheduler-side binds get confirmed from the NODE side and node
+health is a live signal, not a test fixture. This package is that loop
+over the fake apiserver:
+
+  * HollowKubelet — one node agent: registers (or adopts) its Node object,
+    acks pods bound to it (phase Pending → Running, Ready condition),
+    marks them Failed on stop if configured, and heartbeats the node Ready
+    condition on an interval.
+  * HollowCluster — N hollow kubelets sharing one informer set (the
+    kubemark controller shape, pkg/kubemark/controller.go).
+
+With the nodelifecycle controller's heartbeat-staleness monitor, killing a
+HollowKubelet makes the whole failure path autonomous: heartbeats stop →
+Ready goes Unknown → taints → NoExecute eviction → ReplicaSet refill →
+scheduler re-place. No test reaches into a node's conditions by hand.
+"""
+
+from .hollow import HollowCluster, HollowKubelet
+
+__all__ = ["HollowCluster", "HollowKubelet"]

@@ -1,0 +1,137 @@
+"""Hollow-node runtime (kubemark): binds confirmed from the NODE side and
+node death detected from heartbeat staleness — the fully autonomous loop
+create → schedule → kubelet-ack → kubelet crash → staleness → taint →
+evict → ReplicaSet refill → re-place → ack on survivors. Reference
+anchors: pkg/kubemark/hollow_kubelet.go:64, nodelifecycle
+monitorNodeHealth grace-period semantics."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import Container, LabelSelector, Pod, Quantity, RESOURCE_CPU, RESOURCE_MEMORY, ReplicaSet
+from kubernetes_tpu.apiserver import FakeAPIServer
+from kubernetes_tpu.client import APIBinder, start_scheduler_informers
+from kubernetes_tpu.controllers import ControllerManager, TAINT_NOT_READY
+from kubernetes_tpu.kubemark import HollowCluster
+
+# make_node pulls generators (no jax); the Scheduler-driven test below
+# does its own importorskip so the pure control-plane tests run everywhere
+from kubernetes_tpu.models.generators import make_node
+
+
+def _wait(cond, timeout=15.0, msg=""):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return True
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def test_hollow_kubelet_acks_bound_pods():
+    api = FakeAPIServer()
+    hollow = HollowCluster(api, [make_node("n0", cpu_milli=4000, mem=8 * 2**30)],
+                           heartbeat_s=0.2).start()
+    try:
+        p = Pod(name="w", containers=[Container(name="c", requests={
+            RESOURCE_CPU: Quantity.parse("100m")})])
+        api.create("pods", p)
+        api.bind("default", "w", "n0")
+        _wait(lambda: api.get("pods", "default/w").phase == "Running",
+              msg="kubelet never acked the bind")
+        # heartbeats flow on the node LEASE (NodeLease), not the Node —
+        # the node watch stays quiet while the lease renew time advances
+        rv0 = api.get("nodes", "n0").resource_version
+        b0 = api.get("leases", "node-n0").renew_time
+        _wait(lambda: api.get("leases", "node-n0").renew_time > b0,
+              msg="no lease renewal")
+        assert api.get("nodes", "n0").resource_version == rv0
+    finally:
+        hollow.stop()
+
+
+def test_heartbeat_staleness_marks_node_unready():
+    api = FakeAPIServer()
+    hollow = HollowCluster(api, [make_node("n0", cpu_milli=4000, mem=8 * 2**30)],
+                           heartbeat_s=0.2).start()
+    cm = ControllerManager(api, node_monitor_grace_s=1.0).start()
+    try:
+        # healthy: no taints appear
+        time.sleep(1.2)
+        assert not any(t.key == TAINT_NOT_READY
+                       for t in api.get("nodes", "n0").taints)
+        hollow.kill("n0")  # crash: heartbeats stop
+        _wait(lambda: any(t.key == TAINT_NOT_READY
+                          for t in api.get("nodes", "n0").taints),
+              msg="stale heartbeat never tainted the node")
+        ready = [c for c in api.get("nodes", "n0").conditions
+                 if c.get("type") == "Ready"]
+        assert ready and ready[0]["status"] == "Unknown"
+    finally:
+        cm.stop()
+        hollow.stop()
+
+
+def test_full_autonomous_node_failure_loop():
+    """Nobody sets a condition by hand: the kubelet crash alone drives
+    taint → evict → refill → re-place → ack on the survivors."""
+    pytest.importorskip("jax")
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.scheduler.eventhandlers import EventHandlers
+
+    api = FakeAPIServer()
+    nodes = [make_node(f"n{i}", cpu_milli=2000, mem=8 * 2**30) for i in range(3)]
+    hollow = HollowCluster(api, nodes, heartbeat_s=0.2).start()
+    cm = ControllerManager(api, node_monitor_grace_s=1.0).start()
+    sched = Scheduler(batch_size=16, deterministic=True, enable_preemption=False)
+    sched.binder = Binder(APIBinder(api).bind)
+    handlers = EventHandlers(sched.cache, sched.queue, "default-scheduler")
+    informers = start_scheduler_informers(api, handlers)
+    for inf in informers.values():
+        inf.wait_for_sync()
+
+    stop_pump = False
+
+    def pump():
+        while not stop_pump:
+            sched.queue.flush()
+            sched.schedule_batch()
+            sched.wait_for_binds()
+            time.sleep(0.05)
+
+    import threading
+
+    pump_t = threading.Thread(target=pump, daemon=True)
+    pump_t.start()
+    try:
+        tmpl = Pod(name="t", labels={"app": "svc"}, containers=[
+            Container(name="c", requests={
+                RESOURCE_CPU: Quantity.parse("100m"),
+                RESOURCE_MEMORY: Quantity.parse("16Mi")})])
+        api.create("replicasets", ReplicaSet(
+            name="svc", replicas=6,
+            selector=LabelSelector(match_labels={"app": "svc"}), template=tmpl))
+
+        def running():
+            pods, _ = api.list("pods")
+            return [p for p in pods if p.phase == "Running" and p.node_name]
+
+        _wait(lambda: len(running()) == 6, timeout=30,
+              msg="initial replicas never all Running")
+        victim_node = running()[0].node_name
+        hollow.kill(victim_node)
+        # the ONLY intervention above is killing the kubelet process
+        def settled():
+            live = running()
+            return (len(live) == 6
+                    and all(p.node_name != victim_node for p in live))
+        _wait(settled, timeout=30, msg="cluster never re-converged off the dead node")
+        assert cm.nodelifecycle.evictions >= 1
+    finally:
+        stop_pump = True
+        pump_t.join(timeout=3)
+        cm.stop()
+        hollow.stop()
+        for inf in informers.values():
+            inf.stop()
