@@ -62,6 +62,7 @@ def _solver_body(
     pod_valid: jnp.ndarray,  # [B] replicated
     nzacc: jnp.ndarray,  # [Nl, 2] shard-local non-zero scoring accumulators
     scoring_req: jnp.ndarray,  # [U, 2] replicated
+    inb: "Optional[dict]" = None,  # in-batch anti/port tracking (see below)
     *,
     deterministic: bool,
     n_local: int,
@@ -83,9 +84,38 @@ def _solver_body(
     jrange = jnp.arange(K)
     order_c = jnp.reshape(order, (n_chunks, K))
     noise_c = jnp.reshape(noise, (n_chunks, K, noise.shape[-1]))
+    track = inb is not None
+    if track:
+        # in-batch anti/port sequentialization on the mesh (the multi-chip
+        # twin of ops.solver.solve_greedy's inb contract). The commit-count
+        # tables ca/cb [TT, V] are REPLICATED (updates are pure functions
+        # of replicated commit data once the winning node's topology bucket
+        # is broadcast from its owner shard); cs [U, Nl] and the per-node
+        # bucket/haskey columns are shard-local.
+        t_anti = inb["anti"]
+        t_owner = inb["owner"]
+        m_bb = inb["m_bb"] & t_anti[:, None]  # [TT, U] replicated
+        bucket_nl = inb["bucket_n"]  # [TT, Nl] local columns
+        haskey_nl = inb["haskey_n"]  # [TT, Nl]
+        pconf = inb["port_conflict"]  # [U, U] replicated
+        ca0, cb0, cs0 = inb["ca0"], inb["cb0"], inb["cs0"]
+        U = mask.shape[0]
+        TT = t_anti.shape[0]
+        t_rows = jnp.arange(TT, dtype=jnp.int32)[:, None]
+        Vb = ca0.shape[1]
+        own_any = (
+            jnp.zeros((U + 1,), bool)
+            .at[jnp.where(t_anti, t_owner, U)]
+            .max(t_anti, mode="drop")[:U]
+        )
+        sens_u = own_any | jnp.any(m_bb, axis=0) | jnp.diagonal(pconf)
+    else:
+        _z = jnp.zeros((1, 1), jnp.float32)
+        ca0 = cb0 = _z
+        cs0 = jnp.zeros((1, n_local), jnp.float32)
 
     def chunk_step(carry, inp):
-        free, count, nza = carry
+        free, count, nza, ca, cb, cs = carry
         idx, nz = inp  # [K] pod positions; [K, Nl] local noise columns
         sg = sig[idx]
         pv = pod_valid[idx]
@@ -94,16 +124,35 @@ def _solver_body(
         r_q = req[sg]  # [K, R]
         r_any = req_any[sg]
         s_q = scoring_req[sg]  # [K, 2]
+        if track:
+            sens_k = sens_u[sg]
+            ownK = (t_owner[None, :] == sg[:, None]) & t_anti[None, :]  # [K, TT]
+            mbbK = m_bb[:, sg].T  # [K, TT]
+            pconfK = pconf[sg].astype(jnp.float32)  # [K, U]
 
         def not_done(st):
-            return ~jnp.all(st[3])
+            return ~jnp.all(st[6])
 
         def body(st):
-            free, count, nza, decided, choice = st
+            free, count, nza, ca, cb, cs, decided, choice = st
             res_ok = (~r_any[:, None]) | jnp.all(
                 r_q[:, None, :] <= free[None, :, :], axis=-1
             )
             feas = m_r & res_ok & (count[None, :] + 1 <= allowed[None, :])
+            if track:
+                hp = jax.lax.Precision.HIGHEST
+                ca_pos = ((jnp.take_along_axis(ca, bucket_nl, axis=1) > 0) & haskey_nl)
+                cb_pos = ((jnp.take_along_axis(cb, bucket_nl, axis=1) > 0) & haskey_nl)
+                blockA = jnp.matmul(
+                    ownK.astype(jnp.float32), ca_pos.astype(jnp.float32), precision=hp
+                ) > 0.5
+                blockB = jnp.matmul(
+                    mbbK.astype(jnp.float32), cb_pos.astype(jnp.float32), precision=hp
+                ) > 0.5
+                blockP = jnp.matmul(
+                    pconfK, (cs > 0).astype(jnp.float32), precision=hp
+                ) > 0.5
+                feas = feas & ~(blockA | blockB | blockP)
             feas = feas & ~decided[:, None]
             anyf = jax.lax.pmax(jnp.any(feas, axis=1), AXIS_NODES)  # [K]
             masked = jnp.where(feas, s_r, neg)
@@ -151,24 +200,50 @@ def _solver_body(
                 jnp.min(jnp.where(rejected, jrange, K)), AXIS_NODES
             )
             commit = active & (jrange < first_rej)
+            if track:
+                # commit barrier (ops/solver.py contract): nothing past the
+                # first sensitive pod commits this round, so a committed
+                # pod's anti/port mask saw exactly the prior commits
+                first_sens = jnp.min(jnp.where(active & sens_k, jrange, K))
+                commit = commit & (jrange <= first_sens)
             mine = commit & local
             target = jnp.where(mine, lidx, n_local)
             free = free.at[target].add(-(mine[:, None] * r_q), mode="drop")
             count = count.at[target].add(mine.astype(count.dtype), mode="drop")
             nza = nza.at[target].add(mine[:, None] * s_q, mode="drop")
+            if track:
+                # broadcast each committed pod's topology bucket (and the
+                # haskey bit) from the shard that owns its node, then apply
+                # the replicated ca/cb updates identically on every shard
+                bc_local = jnp.where(
+                    mine[None, :], bucket_nl[:, jnp.where(mine, lidx, 0)], -1
+                )  # [TT, K]
+                bc_g = jax.lax.pmax(bc_local, AXIS_NODES)
+                hk_local = haskey_nl[:, jnp.where(mine, lidx, 0)] & mine[None, :]
+                hk_g = jax.lax.pmax(hk_local, AXIS_NODES)
+                one = jnp.float32(1.0)
+                ca = ca.at[
+                    t_rows, jnp.where(m_bb[:, sg] & hk_g & commit[None, :], bc_g, Vb)
+                ].add(one, mode="drop")
+                cb = cb.at[
+                    t_rows, jnp.where(ownK.T & hk_g & commit[None, :], bc_g, Vb)
+                ].add(one, mode="drop")
+                cs = cs.at[
+                    jnp.where(mine, sg, mask.shape[0]), jnp.where(mine, lidx, 0)
+                ].add(one, mode="drop")
             choice = jnp.where(commit, cand, choice)
             decided = decided | commit | newly_none
-            return free, count, nza, decided, choice
+            return free, count, nza, ca, cb, cs, decided, choice
 
         decided0 = ~pv
         choice0 = jnp.full((K,), -1, jnp.int32)
-        free, count, nza, _, choice = jax.lax.while_loop(
-            not_done, body, (free, count, nza, decided0, choice0)
+        free, count, nza, ca, cb, cs, _, choice = jax.lax.while_loop(
+            not_done, body, (free, count, nza, ca, cb, cs, decided0, choice0)
         )
-        return (free, count, nza), choice
+        return (free, count, nza, ca, cb, cs), choice
 
-    (free_f, count_f, nz_f), choices = jax.lax.scan(
-        chunk_step, (free, count, nzacc), (order_c, noise_c)
+    (free_f, count_f, nz_f, _, _, _), choices = jax.lax.scan(
+        chunk_step, (free, count, nzacc, ca0, cb0, cs0), (order_c, noise_c)
     )
     return jnp.reshape(choices, (B,)).astype(jnp.int32), free_f, count_f, nz_f
 
@@ -190,7 +265,8 @@ def make_sharded_pipeline(mesh: Mesh):
         return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
     def _prep(na, pa, ea, ta, xa, au, ids, key, pb, carry,
-              deterministic, config, term_kinds, n_buckets):
+              deterministic, config, term_kinds, n_buckets,
+              track_inbatch=False):
         N = na["valid"].shape[0]
         assert N % n_shards == 0, f"node capacity {N} not divisible by {n_shards} shards"
         n_local = N // n_shards
@@ -232,24 +308,40 @@ def make_sharded_pipeline(mesh: Mesh):
             # counter-based tie_noise is a pure function of (key, row,
             # global column), so each shard holds exactly its columns
             noise = tie_noise(key, b, N)
+        base_specs = (
+            P(None, AXIS_NODES),  # mask
+            P(None, AXIS_NODES),  # score
+            P(),                  # req
+            P(AXIS_NODES),        # free0
+            P(AXIS_NODES),        # count0
+            P(AXIS_NODES),        # allowed
+            P(),                  # order
+            P(None, AXIS_NODES),  # noise
+            P(),                  # req_any
+            P(),                  # sig
+            P(),                  # pod_valid
+            P(AXIS_NODES),        # nz0
+            P(),                  # scoring_req
+        )
+        if track_inbatch:
+            from ..ops.pipeline import _inbatch_tensors
+
+            inb = _inbatch_tensors(na, pa, ta, ids, n_buckets)
+            inb_specs = {
+                "anti": P(), "owner": P(), "m_bb": P(),
+                "bucket_n": P(None, AXIS_NODES),
+                "haskey_n": P(None, AXIS_NODES),
+                "port_conflict": P(), "ca0": P(), "cb0": P(),
+                "cs0": P(None, AXIS_NODES),
+            }
+            in_specs = base_specs + (inb_specs,)
+        else:
+            inb = None
+            in_specs = base_specs
         solver = jax.shard_map(
             partial(_solver_body, deterministic=deterministic, n_local=n_local),
             mesh=mesh,
-            in_specs=(
-                P(None, AXIS_NODES),  # mask
-                P(None, AXIS_NODES),  # score
-                P(),                  # req
-                P(AXIS_NODES),        # free0
-                P(AXIS_NODES),        # count0
-                P(AXIS_NODES),        # allowed
-                P(),                  # order
-                P(None, AXIS_NODES),  # noise
-                P(),                  # req_any
-                P(),                  # sig
-                P(),                  # pod_valid
-                P(AXIS_NODES),        # nz0
-                P(),                  # scoring_req
-            ),
+            in_specs=in_specs,
             out_specs=(
                 P(),                  # choices (replicated)
                 P(AXIS_NODES),        # free residuals (stay sharded)
@@ -262,21 +354,25 @@ def make_sharded_pipeline(mesh: Mesh):
             scoring_req = jnp.zeros((pa["req"].shape[0], 2), free0.dtype)
         args = (mask, score, pa["req"], free0, count0, allowed, order, noise,
                 pa["req_any"], sig, pvalid, nz0, scoring_req)
+        if track_inbatch:
+            args = args + (inb,)
         return solver, args, score, order, b, pvalid
 
     @partial(jax.jit, static_argnames=(
-        "deterministic", "config", "term_kinds", "n_buckets", "return_carry"
+        "deterministic", "config", "term_kinds", "n_buckets", "return_carry",
+        "track_inbatch",
     ))
     def pipeline(
         na: Arrays, pa: Arrays, ea: Arrays, ta: Arrays, xa: Arrays,
         au: Arrays, ids: Arrays, key, pb: Arrays = None, carry=None,
         deterministic: bool = False,
         config: "SolveConfig" = None, term_kinds=None, n_buckets=None,
-        return_carry: bool = False,
+        return_carry: bool = False, track_inbatch: bool = False,
     ):
         solver, args, score, order, b, _ = _prep(
             na, pa, ea, ta, xa, au, ids, key, pb, carry,
-            deterministic, config, term_kinds, n_buckets)
+            deterministic, config, term_kinds, n_buckets,
+            track_inbatch=track_inbatch)
         choices, free_f, count_f, nz_f = solver(*args)
         assign = jnp.full((b,), -1, jnp.int32).at[order].set(choices)
         if return_carry:

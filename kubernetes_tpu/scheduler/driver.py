@@ -672,29 +672,26 @@ class Scheduler:
             gang_dev = gang_ok
         else:
             t_d = time.perf_counter()
+            self._track_inbatch = self._track_inbatch or (
+                "anti_req" in term_kinds
+                or any(p.host_ports() for p in reps)
+            )
             if use_sharded:
-                # the sharded twin keeps the host LIGHT-recheck contract
-                # (in-batch tracking needs cross-shard bucket broadcasts —
-                # not implemented; semantics preserved via the commit loop)
+                # same in-batch anti/port sequentialization as the
+                # single-device path: commit counts replicate, the winning
+                # node's topology bucket is broadcast from its owner shard
                 assign, score, carry_out = self._sharded(
                     *args, pb=pb, carry=carry, deterministic=self.deterministic,
                     config=self.solve_config, term_kinds=term_kinds,
                     n_buckets=n_buckets, return_carry=True,
+                    track_inbatch=self._track_inbatch,
                 )
             else:
-                if self._sharded is None:
-                    # monotone only on the pure single-device path: a mesh
-                    # scheduler falling back for a tiny capacity must keep
-                    # the host LIGHT rechecks (its solves alternate paths)
-                    self._track_inbatch = self._track_inbatch or (
-                        "anti_req" in term_kinds
-                        or any(p.host_ports() for p in reps)
-                    )
                 assign, score, carry_out = solve_pipeline(
                     *args, pb=pb, carry=carry, deterministic=self.deterministic,
                     config=self.solve_config, term_kinds=term_kinds,
                     n_buckets=n_buckets, return_carry=True,
-                    track_inbatch=self._track_inbatch and self._sharded is None,
+                    track_inbatch=self._track_inbatch,
                 )
             # dispatch_s = host upload + trace-cache lookup + enqueue (async)
             self.stats["dispatch_s"] = self.stats.get("dispatch_s", 0.0) + (
@@ -715,7 +712,7 @@ class Scheduler:
             carry_dev=carry_out,
             existing_overflow=existing_overflow,
             speculative=carry is not None,
-            tracked=self._track_inbatch and self._sharded is None and gang_dev is None,
+            tracked=self._track_inbatch and gang_dev is None,
         )
 
     def _finish_solve(self, disp: Dict) -> SolveOutput:

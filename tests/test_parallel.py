@@ -258,3 +258,71 @@ def test_driver_over_mesh_gang():
     assert binds_mesh == binds_one, (binds_mesh, binds_one)
     assert r_mesh.scheduled == r_one.scheduled
     assert set(binds_mesh) == {f"default/a{m}" for m in range(4)}
+
+
+@pytest.mark.parametrize("deterministic", [True, False])
+def test_driver_over_mesh_inbatch_anti_and_ports(deterministic):
+    """The SHARDED solve also sequentializes required anti-affinity and
+    host ports in-batch (commit counts replicated, winning bucket broadcast
+    from the owner shard): bit-identical placements to the single-device
+    driver with ZERO host LIGHT rechecks on both paths — including under
+    the selectHost noise tie-break."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        Container,
+        ContainerPort,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+    from kubernetes_tpu.models.generators import make_node, make_pod
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    HOST = "kubernetes.io/hostname"
+    ZONE = "topology.kubernetes.io/zone"
+
+    def run(mesh_arg):
+        cache = SchedulerCache()
+        for i in range(8):
+            cache.add_node(make_node(
+                f"n{i}",
+                cpu_milli=8000, mem=16 * 2**30,
+                labels={HOST: f"n{i}", ZONE: f"z{i % 4}"},
+            ))
+        binds = {}
+        sched = Scheduler(
+            cache=cache, queue=PriorityQueue(),
+            binder=Binder(lambda p, n: binds.__setitem__(p.key(), n)),
+            batch_size=32, deterministic=deterministic,
+            enable_preemption=False, seed=5, mesh=mesh_arg, speculate=False,
+        )
+        term = PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"grp": "a"}),
+            topology_key=ZONE,
+        )
+        for i in range(6):  # 6 zone-anti pods over 4 zones: 4 fit
+            p = make_pod(f"anti{i}", cpu_milli=100, mem=2**20,
+                         labels={"grp": "a"})
+            p.priority = 20
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[term]))
+            sched.queue.add(p)
+        for i in range(10):  # 10 ported pods over 8 hosts: 8 fit
+            p = make_pod(f"port{i}", cpu_milli=100, mem=2**20)
+            p.priority = 10
+            p.containers[0].ports = [ContainerPort(host_port=9090, container_port=80)]
+            sched.queue.add(p)
+        r = sched.schedule_batch()
+        sched.wait_for_binds()
+        return binds, r, dict(sched.stats)
+
+    mesh = node_mesh(8)
+    b_mesh, r_mesh, s_mesh = run(mesh)
+    b_one, r_one, s_one = run(None)
+    assert b_mesh == b_one, (b_mesh, b_one)
+    assert r_mesh.scheduled == r_one.scheduled == 12
+    assert r_mesh.unschedulable == 4  # 2 anti + 2 port leftovers
+    for s in (s_mesh, s_one):
+        assert s.get("light_rechecks", 0) == 0, s
+        assert s.get("oracle_places", 0) == 0, s
