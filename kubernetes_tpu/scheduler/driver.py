@@ -162,7 +162,13 @@ POD_GROUP_MIN_AVAILABLE = "pod-group.scheduling.sigs.k8s.io/min-available"
 
 
 def pod_group_name(pod: Pod) -> str:
-    return pod.labels.get(POD_GROUP_LABEL, "") or pod.annotations.get(POD_GROUP_LABEL, "")
+    """Memoized (labels/annotations are spec-stable; read 3x per pod per
+    batch across assembly, dispatch, and the commit loop)."""
+    g = pod.__dict__.get("_grp_memo")
+    if g is None:
+        g = pod.labels.get(POD_GROUP_LABEL, "") or pod.annotations.get(POD_GROUP_LABEL, "")
+        pod.__dict__["_grp_memo"] = g
+    return g
 
 
 def pod_group_min_available(pod: Pod) -> int:
@@ -1364,6 +1370,18 @@ class Scheduler:
         # (reference: the sequential loop sees it via
         # satisfiesExistingPodsAntiAffinity, predicates.go:1284)
         conflict_index = _BatchConflictIndex()
+        # maintaining the commit index costs ~10us/pod in label-dict walks;
+        # a batch of pure RECHECK_NONE pods (no gang, no host plugins, no
+        # extenders) never reads it — neither the LIGHT/_minus_one paths
+        # (no such pods) nor the oracle metadata extras (commits carry no
+        # affinity terms, so their contribution is empty)
+        index_needed = (
+            out.gang_ok is not None
+            or host_filter
+            or bool(self.extenders)
+            or out.levels is None
+            or bool((out.levels[: len(infos)] != RECHECK_NONE).any())
+        )
         # once ANY pod commits to a different node than the solver chose (an
         # oracle re-placement), the scan carry's residuals are stale for the
         # rest of the batch — later device picks need a resource validation
@@ -1612,7 +1630,7 @@ class Scheduler:
                     # the guard's rollback_group fails staged members
                     gang_staged.setdefault(group, []).append((info, assumed, node_name, state))
                     disposed = True
-                    c_node = self.cache.snapshot.get(node_name)
+                    c_node = self.cache.snapshot.get(node_name) if index_needed else None
                     if c_node is not None:
                         conflict_index.add_commit(pod, c_node.node)
                         self._aff_extra.append((assumed, c_node.node.labels))
@@ -1626,7 +1644,7 @@ class Scheduler:
                     res.scheduled += 1
                     res.assignments[pod.key()] = node_name
                     disposed = True  # bind pipeline queued: never _fail past this
-                    c_node = self.cache.snapshot.get(node_name)
+                    c_node = self.cache.snapshot.get(node_name) if index_needed else None
                     if c_node is not None:
                         conflict_index.add_commit(pod, c_node.node)
                         self._aff_extra.append((pod.with_node(node_name), c_node.node.labels))

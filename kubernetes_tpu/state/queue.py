@@ -108,8 +108,20 @@ class PriorityQueue:
 
     # -- public API (scheduling_queue.go) -----------------------------------
 
+    @staticmethod
+    def _warm_memos(pod: Pod) -> None:
+        """Warm the pod's resource-request memos off the critical path
+        (enqueue runs on the informer thread or at setup) so the commit
+        loop's assume path finds them hot; with_node clones carry them."""
+        from ..oracle.nodeinfo import accumulated_request, pod_non_zero_request
+
+        accumulated_request(pod)
+        pod_non_zero_request(pod)
+        pod.host_ports()
+
     def add(self, pod: Pod) -> None:
         """Add: new pending pod → activeQ."""
+        self._warm_memos(pod)
         with self._lock:
             info = PodInfo(pod=pod, timestamp=self._now(), seq=next(self._seq))
             self._unschedulable.pop(pod.key(), None)
@@ -250,6 +262,7 @@ class PriorityQueue:
                 heapq.heapify(self._backoff)
 
     def update(self, old: Pod, new: Pod) -> None:
+        self._warm_memos(new)  # fresh object: same critical-path concern as add
         with self._lock:
             key = new.key()
             if key in self._unschedulable:
