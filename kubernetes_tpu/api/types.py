@@ -371,6 +371,8 @@ class ReplicaSet:
     replicas: int = 1
     selector: Optional[LabelSelector] = None
     template: Optional[Pod] = None
+    # controller ownership (a Deployment's uid), like Pod.owner_references
+    owner_references: List[Dict[str, Any]] = field(default_factory=list)
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
@@ -773,6 +775,43 @@ def pod_to_k8s(pod: Pod) -> dict:
     }
 
 
+@dataclass
+class Deployment:
+    """apps/v1 Deployment — the controller subset: desired replicas +
+    selector + pod template (reconciled to template-hash ReplicaSets by
+    pkg/controller/deployment)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    resource_version: str = ""
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Optional[Pod] = None
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def deployment_from_k8s(obj: dict) -> Deployment:
+    rs = replicaset_from_k8s(obj)
+    return Deployment(
+        name=rs.name, namespace=rs.namespace, uid=rs.uid,
+        resource_version=rs.resource_version, replicas=rs.replicas,
+        selector=rs.selector, template=rs.template,
+    )
+
+
+def deployment_to_k8s(dep: Deployment) -> dict:
+    d = replicaset_to_k8s(ReplicaSet(
+        name=dep.name, namespace=dep.namespace, uid=dep.uid,
+        resource_version=dep.resource_version, replicas=dep.replicas,
+        selector=dep.selector, template=dep.template,
+    ))
+    d["kind"] = "Deployment"
+    return d
+
+
 def replicaset_from_k8s(obj: dict) -> ReplicaSet:
     """apps/v1 ReplicaSet JSON → ReplicaSet (the controller subset)."""
     meta = obj.get("metadata") or {}
@@ -792,6 +831,7 @@ def replicaset_from_k8s(obj: dict) -> ReplicaSet:
         replicas=int(spec.get("replicas") if spec.get("replicas") is not None else 1),
         selector=_label_selector_from(spec.get("selector")),
         template=template,
+        owner_references=list(meta.get("ownerReferences") or []),
     )
 
 
@@ -808,6 +848,8 @@ def replicaset_to_k8s(rs: ReplicaSet) -> dict:
     meta: Dict[str, Any] = {"name": rs.name, "namespace": rs.namespace, "uid": rs.uid}
     if rs.resource_version:
         meta["resourceVersion"] = rs.resource_version
+    if rs.owner_references:
+        meta["ownerReferences"] = list(rs.owner_references)
     return {
         "apiVersion": "apps/v1",
         "kind": "ReplicaSet",

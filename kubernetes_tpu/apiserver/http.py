@@ -34,6 +34,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..api.types import (
+    deployment_from_k8s,
+    deployment_to_k8s,
     node_from_k8s,
     node_to_k8s,
     pod_from_k8s,
@@ -82,6 +84,7 @@ _CODECS: Dict[str, Tuple[Callable, Callable, str]] = {
     "pods": (pod_to_k8s, pod_from_k8s, "PodList"),
     "nodes": (node_to_k8s, node_from_k8s, "NodeList"),
     "replicasets": (replicaset_to_k8s, replicaset_from_k8s, "ReplicaSetList"),
+    "deployments": (deployment_to_k8s, deployment_from_k8s, "DeploymentList"),
     "leases": (_lease_to_k8s, _lease_from_k8s, "LeaseList"),
 }
 

@@ -20,6 +20,7 @@ Controllers share one informer set and drain per-controller workqueues
 (client-go util/workqueue semantics: dedup-while-pending, re-add-after-get).
 """
 
+from .deployment import DeploymentController
 from .manager import ControllerManager
 from .nodelifecycle import NodeLifecycleController, TAINT_NOT_READY
 from .replicaset import ReplicaSetController
@@ -27,6 +28,7 @@ from .workqueue import WorkQueue
 
 __all__ = [
     "ControllerManager",
+    "DeploymentController",
     "NodeLifecycleController",
     "ReplicaSetController",
     "TAINT_NOT_READY",

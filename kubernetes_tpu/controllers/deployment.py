@@ -1,0 +1,129 @@
+"""Deployment controller (pkg/controller/deployment/deployment_controller.go).
+
+The reconcile subset that closes the workload-management story on top of
+the ReplicaSet controller: a Deployment owns ReplicaSets keyed by a
+TEMPLATE HASH (getNewReplicaSet / rsutil.GetPodTemplateSpecHash); the
+active RS is scaled to .spec.replicas and every RS with a different
+template hash is scaled to zero — the "Recreate"-shaped rollout (the
+reference's default RollingUpdate maxSurge/maxUnavailable scheduling is
+a progressive version of the same two scale operations; surge windows
+are out of scope here and documented as such).
+
+So: edit the Deployment's template → a new hash → a new RS appears and
+the old one drains; the ReplicaSet controller + scheduler + (hollow)
+kubelets do the rest.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from dataclasses import replace
+from typing import Optional
+
+from ..api.types import Deployment, ReplicaSet
+
+logger = logging.getLogger("kubernetes_tpu.controllers.deployment")
+
+
+def template_hash(dep: Deployment) -> str:
+    """Stable hash of the pod template (rsutil.ComputeHash analogue): EVERY
+    spec-shaping field (an edit to any of them must produce a new hash and
+    therefore a new generation), via value-based dataclass reprs."""
+    t = dep.template
+    if t is None:
+        return "empty"
+    basis = repr((
+        sorted(t.labels.items()),
+        sorted(t.annotations.items()),
+        t.containers,
+        t.init_containers,
+        t.overhead,
+        t.tolerations,
+        sorted(t.node_selector.items()),
+        t.affinity,
+        t.topology_spread_constraints,
+        t.priority,
+        t.priority_class_name,
+        t.host_network,
+        t.volumes,
+        t.scheduler_name,
+    ))
+    return hashlib.sha1(basis.encode()).hexdigest()[:10]
+
+
+def _owned(rs: ReplicaSet, dep: Deployment) -> bool:
+    """ownerReference (controller uid) match — NOT name prefixes, which
+    collide between deployments like `web` and `web-api`."""
+    return any(
+        ref.get("controller") and ref.get("uid") == dep.uid
+        for ref in rs.owner_references
+    )
+
+
+class DeploymentController:
+    def __init__(self, api, dep_informer, rs_informer, queue):
+        self.api = api
+        self.dep_informer = dep_informer
+        self.rs_informer = rs_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.dep_informer.add_event_handler(
+            on_add=lambda d: self.queue.add(d.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+            on_delete=lambda d: self.queue.add(d.key()),
+        )
+        # RS churn re-syncs the owning deployment (getDeploymentsForReplicaSet)
+        self.rs_informer.add_event_handler(
+            on_add=lambda rs: self._enqueue_owner(rs),
+            on_update=lambda old, new: self._enqueue_owner(new),
+            on_delete=lambda rs: self._enqueue_owner(rs),
+        )
+
+    def _enqueue_owner(self, rs: ReplicaSet) -> None:
+        uids = {
+            ref.get("uid")
+            for ref in rs.owner_references
+            if ref.get("controller") and ref.get("kind") == "Deployment"
+        }
+        if not uids:
+            return
+        for d in self.dep_informer.list():
+            if d.uid in uids:
+                self.queue.add(d.key())
+                return
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        dep: Optional[Deployment] = self.dep_informer.get(key)
+        if dep is None:
+            return  # deleted: owned RSs keep running (no GC, as with RS→pods)
+        want = f"{dep.name}-{template_hash(dep)}"
+        active: Optional[ReplicaSet] = None
+        for rs in self.rs_informer.list():
+            if not _owned(rs, dep):
+                continue
+            if rs.name == want:
+                active = rs
+            elif rs.replicas != 0:
+                # old template generation: drain it (the RS controller
+                # deletes its surplus pods, pending-first). Update a COPY:
+                # informer store objects are shared with the RS controller
+                # and must only change when the apiserver accepts the write
+                self.api.update("replicasets", replace(rs, replicas=0))
+        if active is None:
+            self.api.create("replicasets", ReplicaSet(
+                name=want,
+                namespace=dep.namespace,
+                replicas=dep.replicas,
+                selector=dep.selector,
+                template=dep.template,
+                owner_references=[{
+                    "uid": dep.uid, "controller": True,
+                    "kind": "Deployment", "name": dep.name,
+                }],
+            ))
+        elif active.replicas != dep.replicas:
+            self.api.update("replicasets", replace(active, replicas=dep.replicas))

@@ -16,6 +16,7 @@ import threading
 from typing import Dict, List, Optional
 
 from ..client.informer import Informer
+from .deployment import DeploymentController
 from .nodelifecycle import NodeLifecycleController
 from .replicaset import ReplicaSetController
 from .workqueue import WorkQueue
@@ -24,13 +25,15 @@ logger = logging.getLogger("kubernetes_tpu.controllers.manager")
 
 
 class ControllerManager:
-    def __init__(self, api, controllers=("replicaset", "nodelifecycle"),
+    def __init__(self, api,
+                 controllers=("deployment", "replicaset", "nodelifecycle"),
                  node_monitor_grace_s=None):
         self.api = api
         self.informers: Dict[str, Informer] = {
             "pods": Informer(api, "pods"),
             "nodes": Informer(api, "nodes"),
             "replicasets": Informer(api, "replicasets"),
+            "deployments": Informer(api, "deployments"),
         }
         self.controllers = []
         self._queues: List[WorkQueue] = []
@@ -42,6 +45,14 @@ class ControllerManager:
                 api, self.informers["replicasets"], self.informers["pods"], q
             )
             self.controllers.append(self.replicaset)
+            self._queues.append(q)
+        if "deployment" in controllers:
+            q = WorkQueue()
+            self.deployment = DeploymentController(
+                api, self.informers["deployments"],
+                self.informers["replicasets"], q,
+            )
+            self.controllers.append(self.deployment)
             self._queues.append(q)
         if "nodelifecycle" in controllers:
             q = WorkQueue()

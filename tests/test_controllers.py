@@ -221,3 +221,50 @@ def test_full_control_loop_with_scheduler():
         cm.stop()
         for inf in informers.values():
             inf.stop()
+
+
+def test_deployment_creates_scales_and_rolls():
+    """Deployment → template-hash ReplicaSet: create, scale, and a
+    template edit rolls to a NEW RS while the old one drains to zero
+    (deployment_controller.go reconcile, Recreate-shaped)."""
+    from kubernetes_tpu.api.types import Deployment, Quantity as Q
+
+    api = FakeAPIServer()
+    cm = ControllerManager(api).start()
+    try:
+        dep = Deployment(
+            name="web", replicas=4,
+            selector=LabelSelector(match_labels={"app": "web"}),
+            template=_template("web"),
+        )
+        api.create("deployments", dep)
+        assert cm.wait_idle()
+        rss, _ = api.list("replicasets")
+        assert len(rss) == 1 and rss[0].replicas == 4
+        assert rss[0].name.startswith("web-")
+        assert len(_pods(api, "web")) == 4
+        gen1 = rss[0].name
+
+        # scale
+        dep.replicas = 2
+        api.update("deployments", dep)
+        assert cm.wait_idle()
+        assert api.get("replicasets", f"default/{gen1}").replicas == 2
+        assert len(_pods(api, "web")) == 2
+
+        # template edit → new hash → new RS; old drains
+        dep.template.containers[0].requests[RESOURCE_CPU] = Q.parse("200m")
+        api.update("deployments", dep)
+        assert cm.wait_idle()
+        rss, _ = api.list("replicasets")
+        by_name = {rs.name: rs for rs in rss}
+        assert len(by_name) == 2
+        assert by_name[gen1].replicas == 0
+        gen2 = next(n for n in by_name if n != gen1)
+        assert by_name[gen2].replicas == 2
+        live = [p for p in _pods(api, "web") if p.phase != "Failed"]
+        assert len(live) == 2
+        # the survivors are the NEW generation (owned by gen2's RS)
+        assert all(r["name"] == gen2 for p in live for r in p.owner_references)
+    finally:
+        cm.stop()
