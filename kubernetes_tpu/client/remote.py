@@ -19,6 +19,8 @@ from typing import Any, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from ..api.types import (
+    deployment_from_k8s,
+    deployment_to_k8s,
     node_from_k8s,
     node_to_k8s,
     pod_from_k8s,
@@ -33,6 +35,7 @@ _CODECS = {
     "pods": (pod_to_k8s, pod_from_k8s),
     "nodes": (node_to_k8s, node_from_k8s),
     "replicasets": (replicaset_to_k8s, replicaset_from_k8s),
+    "deployments": (deployment_to_k8s, deployment_from_k8s),
     "leases": (_lease_to_k8s, _lease_from_k8s),
 }
 

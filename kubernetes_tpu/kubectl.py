@@ -41,10 +41,17 @@ def _fmt_table(headers: List[str], rows: List[List[str]]) -> str:
     return "\n".join(out)
 
 
+_KIND_ALIASES = {
+    "pod": "pods", "node": "nodes", "rs": "replicasets",
+    "replicaset": "replicasets", "deploy": "deployments",
+    "deployment": "deployments",
+}
+_KINDS = ("pods", "nodes", "replicasets", "deployments")
+
+
 def cmd_get(api: RemoteAPIServer, kind: str) -> int:
-    kind = {"pod": "pods", "node": "nodes", "rs": "replicasets",
-            "replicaset": "replicasets"}.get(kind, kind)
-    if kind not in ("pods", "nodes", "replicasets"):
+    kind = _KIND_ALIASES.get(kind, kind)
+    if kind not in _KINDS:
         print(f"unknown kind {kind}", file=sys.stderr)
         return 1
     items, _ = api.list(kind)
@@ -62,7 +69,7 @@ def cmd_get(api: RemoteAPIServer, kind: str) -> int:
             taints = ",".join(f"{t.key}:{t.effect}" for t in n.taints) or "<none>"
             rows.append([n.name, status, taints])
         print(_fmt_table(["NAME", "STATUS", "TAINTS"], rows))
-    elif kind == "replicasets":
+    elif kind in ("replicasets", "deployments"):
         rows = [[rs.key(), str(rs.replicas)] for rs in items]
         print(_fmt_table(["NAME", "DESIRED"], rows))
     else:
@@ -175,9 +182,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.verb == "drain":
         return cmd_drain(api, args.node)
     if args.verb == "delete":
-        kind = {"pod": "pods", "node": "nodes", "rs": "replicasets",
-                "replicaset": "replicasets"}.get(args.kind, args.kind)
-        if kind not in ("pods", "nodes", "replicasets"):
+        kind = _KIND_ALIASES.get(args.kind, args.kind)
+        if kind not in _KINDS:
             print(f"unknown kind {args.kind}", file=sys.stderr)
             return 1
         key = args.name if "/" in args.name or kind == "nodes" else f"default/{args.name}"
