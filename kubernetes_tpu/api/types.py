@@ -793,6 +793,64 @@ class Deployment:
         return f"{self.namespace}/{self.name}"
 
 
+@dataclass
+class Job:
+    """batch/v1 Job — the controller subset: parallelism + completions +
+    template (pkg/apis/batch/types.go JobSpec; reconciled by
+    pkg/controller/job)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    resource_version: str = ""
+    parallelism: int = 1
+    completions: int = 1
+    template: Optional[Pod] = None
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def job_from_k8s(obj: dict) -> Job:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    tmpl = spec.get("template")
+    template = None
+    if tmpl is not None:
+        tmeta = dict(tmpl.get("metadata") or {})
+        tmeta.setdefault("namespace", meta.get("namespace", "default"))
+        tmeta.setdefault("name", meta.get("name", "") + "-template")
+        template = pod_from_k8s({"metadata": tmeta, "spec": tmpl.get("spec") or {}})
+    return Job(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid") or _new_uid(),
+        resource_version=str(meta.get("resourceVersion", "")),
+        # explicit 0 is the standard way to SUSPEND a Job — distinct from
+        # absent (defaults to 1); None also means absent
+        parallelism=int(spec.get("parallelism") if spec.get("parallelism") is not None else 1),
+        completions=int(spec.get("completions") if spec.get("completions") is not None else 1),
+        template=template,
+    )
+
+
+def job_to_k8s(job: Job) -> dict:
+    spec: Dict[str, Any] = {
+        "parallelism": job.parallelism,
+        "completions": job.completions,
+    }
+    if job.template is not None:
+        t = pod_to_k8s(job.template)
+        spec["template"] = {
+            "metadata": {"labels": t["metadata"].get("labels", {})},
+            "spec": t["spec"],
+        }
+    meta: Dict[str, Any] = {"name": job.name, "namespace": job.namespace, "uid": job.uid}
+    if job.resource_version:
+        meta["resourceVersion"] = job.resource_version
+    return {"apiVersion": "batch/v1", "kind": "Job", "metadata": meta, "spec": spec}
+
+
 def deployment_from_k8s(obj: dict) -> Deployment:
     rs = replicaset_from_k8s(obj)
     return Deployment(

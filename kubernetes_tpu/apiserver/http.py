@@ -36,6 +36,8 @@ from urllib.parse import parse_qs, urlparse
 from ..api.types import (
     deployment_from_k8s,
     deployment_to_k8s,
+    job_from_k8s,
+    job_to_k8s,
     node_from_k8s,
     node_to_k8s,
     pod_from_k8s,
@@ -85,6 +87,7 @@ _CODECS: Dict[str, Tuple[Callable, Callable, str]] = {
     "nodes": (node_to_k8s, node_from_k8s, "NodeList"),
     "replicasets": (replicaset_to_k8s, replicaset_from_k8s, "ReplicaSetList"),
     "deployments": (deployment_to_k8s, deployment_from_k8s, "DeploymentList"),
+    "jobs": (job_to_k8s, job_from_k8s, "JobList"),
     "leases": (_lease_to_k8s, _lease_from_k8s, "LeaseList"),
 }
 

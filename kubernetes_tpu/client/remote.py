@@ -21,6 +21,8 @@ from urllib.parse import urlparse
 from ..api.types import (
     deployment_from_k8s,
     deployment_to_k8s,
+    job_from_k8s,
+    job_to_k8s,
     node_from_k8s,
     node_to_k8s,
     pod_from_k8s,
@@ -36,6 +38,7 @@ _CODECS = {
     "nodes": (node_to_k8s, node_from_k8s),
     "replicasets": (replicaset_to_k8s, replicaset_from_k8s),
     "deployments": (deployment_to_k8s, deployment_from_k8s),
+    "jobs": (job_to_k8s, job_from_k8s),
     "leases": (_lease_to_k8s, _lease_from_k8s),
 }
 

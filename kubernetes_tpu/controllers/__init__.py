@@ -21,6 +21,7 @@ Controllers share one informer set and drain per-controller workqueues
 """
 
 from .deployment import DeploymentController
+from .job import JobController
 from .manager import ControllerManager
 from .nodelifecycle import NodeLifecycleController, TAINT_NOT_READY
 from .replicaset import ReplicaSetController
@@ -29,6 +30,7 @@ from .workqueue import WorkQueue
 __all__ = [
     "ControllerManager",
     "DeploymentController",
+    "JobController",
     "NodeLifecycleController",
     "ReplicaSetController",
     "TAINT_NOT_READY",

@@ -1,0 +1,84 @@
+"""Job controller (pkg/controller/job/job_controller.go).
+
+Run-to-completion workloads: a Job keeps `parallelism` pods running until
+`completions` pods have Succeeded (manageJob/syncJob semantics). Failed
+pods are replaced (backoffLimit collapses to "always retry" — the
+reference's exponential job backoff protects a real apiserver this
+in-process store doesn't need); Succeeded pods count toward completion
+and are never replaced. When completions are reached, remaining active
+pods are left to finish (no active deletion — matching the reference's
+non-indexed default where success is counted, not truncated).
+
+The sim's hollow kubelets mark pods Running; tests drive Succeeded/Failed
+transitions the way a real workload would report them.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ..api.types import Job, Pod
+from .podowner import deletion_rank, new_child_pod, owned_by
+
+logger = logging.getLogger("kubernetes_tpu.controllers.job")
+
+
+class JobController:
+    def __init__(self, api, job_informer, pod_informer, queue):
+        self.api = api
+        self.job_informer = job_informer
+        self.pod_informer = pod_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.job_informer.add_event_handler(
+            on_add=lambda j: self.queue.add(j.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+        )
+        self.pod_informer.add_event_handler(
+            on_add=lambda p: self._enqueue_owner(p),
+            on_update=lambda old, new: self._enqueue_owner(new),
+            on_delete=lambda p: self._enqueue_owner(p),
+        )
+
+    def _enqueue_owner(self, pod: Pod) -> None:
+        for ref in pod.owner_references:
+            if ref.get("controller") and ref.get("kind") == "Job":
+                self.queue.add(f"{pod.namespace}/{ref.get('name')}")
+                return
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        job: Optional[Job] = self.job_informer.get(key)
+        if job is None:
+            return
+        active: List[Pod] = []
+        succeeded = 0
+        for p in self.pod_informer.list():
+            if not owned_by(p, job.uid):
+                continue
+            if p.phase == "Succeeded":
+                succeeded += 1
+            elif p.phase != "Failed":
+                active.append(p)
+        if succeeded >= job.completions:
+            return  # done; stragglers run to their own completion
+        # keep `parallelism` active, bounded by the completions still needed
+        want_active = min(job.parallelism, job.completions - succeeded)
+        diff = want_active - len(active)
+        if diff > 0:
+            for _ in range(diff):
+                self.api.create("pods", self._new_pod(job))
+        elif diff < 0:
+            # parallelism was lowered: trim pending pods first
+            victims = sorted(active, key=deletion_rank)
+            for p in victims[:-diff]:
+                try:
+                    self.api.delete("pods", p.key())
+                except KeyError:
+                    pass
+
+    def _new_pod(self, job: Job) -> Pod:
+        return new_child_pod(job.template, "Job", job.name, job.uid, job.namespace)

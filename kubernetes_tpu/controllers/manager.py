@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 
 from ..client.informer import Informer
 from .deployment import DeploymentController
+from .job import JobController
 from .nodelifecycle import NodeLifecycleController
 from .replicaset import ReplicaSetController
 from .workqueue import WorkQueue
@@ -26,7 +27,7 @@ logger = logging.getLogger("kubernetes_tpu.controllers.manager")
 
 class ControllerManager:
     def __init__(self, api,
-                 controllers=("deployment", "replicaset", "nodelifecycle"),
+                 controllers=("deployment", "replicaset", "job", "nodelifecycle"),
                  node_monitor_grace_s=None):
         self.api = api
         self.informers: Dict[str, Informer] = {
@@ -34,6 +35,7 @@ class ControllerManager:
             "nodes": Informer(api, "nodes"),
             "replicasets": Informer(api, "replicasets"),
             "deployments": Informer(api, "deployments"),
+            "jobs": Informer(api, "jobs"),
         }
         self.controllers = []
         self._queues: List[WorkQueue] = []
@@ -53,6 +55,13 @@ class ControllerManager:
                 self.informers["replicasets"], q,
             )
             self.controllers.append(self.deployment)
+            self._queues.append(q)
+        if "job" in controllers:
+            q = WorkQueue()
+            self.job = JobController(
+                api, self.informers["jobs"], self.informers["pods"], q
+            )
+            self.controllers.append(self.job)
             self._queues.append(q)
         if "nodelifecycle" in controllers:
             q = WorkQueue()

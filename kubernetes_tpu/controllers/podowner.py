@@ -1,0 +1,44 @@
+"""Shared helpers for pod-owning controllers (ReplicaSet, Job): child
+ownership tests and child-pod construction, so the owner-ref shape, the
+generated-name scheme, and the deletion rank evolve in ONE place
+(controller_utils.go's ActivePods ordering + NewControllerRef)."""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import List
+
+from ..api.types import Pod, _new_uid
+
+_suffix = itertools.count(1)
+
+
+def owned_by(pod: Pod, owner_uid: str) -> bool:
+    return any(
+        ref.get("controller") and ref.get("uid") == owner_uid
+        for ref in pod.owner_references
+    )
+
+
+def deletion_rank(pod: Pod):
+    """getPodsToDelete's order: unassigned (pending) victims first, then
+    oldest-first among assigned (controller_utils.go ActivePods)."""
+    return (pod.node_name != "", pod.creation_timestamp)
+
+
+def new_child_pod(template, owner_kind: str, owner_name: str, owner_uid: str,
+                  namespace: str) -> Pod:
+    t = template or Pod()
+    pod = t.with_node("")  # clone (request memos stay valid: same containers)
+    pod.name = f"{owner_name}-{next(_suffix):05d}"
+    pod.namespace = namespace
+    pod.uid = _new_uid()
+    pod.phase = "Pending"
+    pod.creation_timestamp = time.time()
+    pod.labels = dict(t.labels)
+    pod.owner_references = [
+        {"uid": owner_uid, "controller": True, "kind": owner_kind,
+         "name": owner_name}
+    ]
+    return pod

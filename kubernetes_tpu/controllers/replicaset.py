@@ -14,27 +14,17 @@ feeds into.
 
 from __future__ import annotations
 
-import itertools
 import logging
-import threading
 from typing import List, Optional
 
 from ..api.selectors import match_label_selector
 from ..api.types import Pod, ReplicaSet
+from .podowner import deletion_rank, new_child_pod, owned_by
 
 logger = logging.getLogger("kubernetes_tpu.controllers.replicaset")
 
 # manageReplicas burst ceiling (replica_set.go burstReplicas)
 BURST_REPLICAS = 500
-
-_suffix = itertools.count(1)
-
-
-def _owned_by(pod: Pod, rs: ReplicaSet) -> bool:
-    for ref in pod.owner_references:
-        if ref.get("controller") and ref.get("uid") == rs.uid:
-            return True
-    return False
 
 
 def _adoptable(pod: Pod, rs: ReplicaSet) -> bool:
@@ -91,7 +81,7 @@ class ReplicaSetController:
         for p in self.pod_informer.list():
             if p.phase in ("Failed", "Succeeded"):
                 continue
-            if _owned_by(p, rs) or _adoptable(p, rs):
+            if owned_by(p, rs.uid) or _adoptable(p, rs):
                 live.append(p)
         diff = rs.replicas - len(live)
         if diff > 0:
@@ -100,7 +90,7 @@ class ReplicaSetController:
         elif diff < 0:
             # deletion order: pending (unscheduled) before running
             # (controller_utils.go ActivePods: unassigned < assigned)
-            victims = sorted(live, key=lambda p: (p.node_name != "", p.creation_timestamp))
+            victims = sorted(live, key=deletion_rank)
             for p in victims[: min(-diff, BURST_REPLICAS)]:
                 try:
                     self.api.delete("pods", p.key())
@@ -108,19 +98,4 @@ class ReplicaSetController:
                     pass
 
     def _new_replica(self, rs: ReplicaSet) -> Pod:
-        import time
-
-        from ..api.types import _new_uid
-
-        t = rs.template or Pod()
-        pod = t.with_node("")  # clone (request memos stay valid: same containers)
-        pod.name = f"{rs.name}-{next(_suffix):05d}"
-        pod.namespace = rs.namespace
-        pod.uid = _new_uid()
-        pod.phase = "Pending"
-        pod.creation_timestamp = time.time()
-        pod.labels = dict(t.labels)
-        pod.owner_references = [
-            {"uid": rs.uid, "controller": True, "kind": "ReplicaSet", "name": rs.name}
-        ]
-        return pod
+        return new_child_pod(rs.template, "ReplicaSet", rs.name, rs.uid, rs.namespace)

@@ -44,9 +44,9 @@ def _fmt_table(headers: List[str], rows: List[List[str]]) -> str:
 _KIND_ALIASES = {
     "pod": "pods", "node": "nodes", "rs": "replicasets",
     "replicaset": "replicasets", "deploy": "deployments",
-    "deployment": "deployments",
+    "deployment": "deployments", "job": "jobs",
 }
-_KINDS = ("pods", "nodes", "replicasets", "deployments")
+_KINDS = ("pods", "nodes", "replicasets", "deployments", "jobs")
 
 
 def cmd_get(api: RemoteAPIServer, kind: str) -> int:
@@ -72,6 +72,9 @@ def cmd_get(api: RemoteAPIServer, kind: str) -> int:
     elif kind in ("replicasets", "deployments"):
         rows = [[rs.key(), str(rs.replicas)] for rs in items]
         print(_fmt_table(["NAME", "DESIRED"], rows))
+    elif kind == "jobs":
+        rows = [[j.key(), str(j.parallelism), str(j.completions)] for j in items]
+        print(_fmt_table(["NAME", "PARALLELISM", "COMPLETIONS"], rows))
     else:
         print(f"unknown kind {kind}", file=sys.stderr)
         return 1

@@ -268,3 +268,51 @@ def test_deployment_creates_scales_and_rolls():
         assert all(r["name"] == gen2 for p in live for r in p.owner_references)
     finally:
         cm.stop()
+
+
+def test_job_runs_to_completion_and_replaces_failures():
+    """Job keeps `parallelism` pods active until `completions` Succeeded
+    (job_controller.go syncJob): failures are replaced, successes counted
+    and never replaced, and a finished job stops creating pods."""
+    from kubernetes_tpu.api.types import Job
+
+    api = FakeAPIServer()
+    cm = ControllerManager(api).start()
+    try:
+        api.create("jobs", Job(name="batch", parallelism=2, completions=3,
+                               template=_template("batch")))
+        assert cm.wait_idle()
+        active = [p for p in _pods(api, "batch") if p.phase not in ("Succeeded", "Failed")]
+        assert len(active) == 2
+
+        # one completes → a replacement is created (2 active, 1 done)
+        done = active[0]
+        done.phase = "Succeeded"
+        api.update("pods", done)
+        assert cm.wait_idle()
+        pods = _pods(api, "batch")
+        assert sum(1 for p in pods if p.phase == "Succeeded") == 1
+        assert sum(1 for p in pods if p.phase not in ("Succeeded", "Failed")) == 2
+
+        # one fails → replaced, count unchanged
+        victim = next(p for p in _pods(api, "batch") if p.phase not in ("Succeeded", "Failed"))
+        victim.phase = "Failed"
+        api.update("pods", victim)
+        assert cm.wait_idle()
+        pods = _pods(api, "batch")
+        assert sum(1 for p in pods if p.phase not in ("Succeeded", "Failed")) == 2
+
+        # two more succeed → 3 completions reached; only the needed pods
+        # were kept active near the end (min(parallelism, remaining))
+        for p in [p for p in _pods(api, "batch") if p.phase not in ("Succeeded", "Failed")]:
+            p.phase = "Succeeded"
+            api.update("pods", p)
+        assert cm.wait_idle()
+        pods = _pods(api, "batch")
+        assert sum(1 for p in pods if p.phase == "Succeeded") == 3
+        # done: nothing new is created
+        assert cm.wait_idle()
+        assert sum(1 for p in _pods(api, "batch")
+                   if p.phase not in ("Succeeded", "Failed")) == 0
+    finally:
+        cm.stop()
