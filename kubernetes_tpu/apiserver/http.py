@@ -45,6 +45,7 @@ from ..api.types import (
     replicaset_from_k8s,
     replicaset_to_k8s,
 )
+from ..utils.events import event_from_k8s, event_to_k8s
 from .store import ConflictError, FakeAPIServer, GoneError, NotFoundError
 
 
@@ -88,6 +89,7 @@ _CODECS: Dict[str, Tuple[Callable, Callable, str]] = {
     "replicasets": (replicaset_to_k8s, replicaset_from_k8s, "ReplicaSetList"),
     "deployments": (deployment_to_k8s, deployment_from_k8s, "DeploymentList"),
     "jobs": (job_to_k8s, job_from_k8s, "JobList"),
+    "events": (event_to_k8s, event_from_k8s, "EventList"),
     "leases": (_lease_to_k8s, _lease_from_k8s, "LeaseList"),
 }
 

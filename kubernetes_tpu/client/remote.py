@@ -31,6 +31,7 @@ from ..api.types import (
     replicaset_to_k8s,
 )
 from ..apiserver.http import _lease_from_k8s, _lease_to_k8s
+from ..utils.events import event_from_k8s, event_to_k8s
 from ..apiserver.store import ConflictError, GoneError, NotFoundError, WatchEvent, _key_of
 
 _CODECS = {
@@ -39,6 +40,7 @@ _CODECS = {
     "replicasets": (replicaset_to_k8s, replicaset_from_k8s),
     "deployments": (deployment_to_k8s, deployment_from_k8s),
     "jobs": (job_to_k8s, job_from_k8s),
+    "events": (event_to_k8s, event_from_k8s),
     "leases": (_lease_to_k8s, _lease_from_k8s),
 }
 

@@ -200,6 +200,12 @@ def run_sim(args) -> int:
         api_http = APIServerHTTP(api, port=args.serve_api).start()
         print(f"apiserver HTTP on {api_http.url} (list/watch/create/bind)")
     sched.binder = Binder(APIBinder(api).bind)
+    # scheduler events land in the apiserver's events kind (kubectl get
+    # events shows Scheduled/FailedScheduling/Preempted series)
+    from .utils.events import Recorder, api_sink
+
+    recorder = Recorder(sink=api_sink(api))
+    sched.event_fn = recorder.pod_event_fn()
     # leaderElection.leaderElect (server.go:157 → leaderelection.RunOrDie):
     # acquire the lease before scheduling; renew each cycle, stand down on
     # loss (active-passive replicas, SURVEY §2.3)

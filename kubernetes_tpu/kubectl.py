@@ -44,9 +44,9 @@ def _fmt_table(headers: List[str], rows: List[List[str]]) -> str:
 _KIND_ALIASES = {
     "pod": "pods", "node": "nodes", "rs": "replicasets",
     "replicaset": "replicasets", "deploy": "deployments",
-    "deployment": "deployments", "job": "jobs",
+    "deployment": "deployments", "job": "jobs", "event": "events", "ev": "events",
 }
-_KINDS = ("pods", "nodes", "replicasets", "deployments", "jobs")
+_KINDS = ("pods", "nodes", "replicasets", "deployments", "jobs", "events")
 
 
 def cmd_get(api: RemoteAPIServer, kind: str) -> int:
@@ -75,6 +75,14 @@ def cmd_get(api: RemoteAPIServer, kind: str) -> int:
     elif kind == "jobs":
         rows = [[j.key(), str(j.parallelism), str(j.completions)] for j in items]
         print(_fmt_table(["NAME", "PARALLELISM", "COMPLETIONS"], rows))
+    elif kind == "events":
+        import time as _t
+
+        items.sort(key=lambda e: e.last_timestamp)
+        rows = [[f"{max(_t.time() - e.last_timestamp, 0):.0f}s", e.type,
+                 e.reason, e.object_key, str(e.count), e.message[:60]]
+                for e in items]
+        print(_fmt_table(["LAST SEEN", "TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE"], rows))
     else:
         print(f"unknown kind {kind}", file=sys.stderr)
         return 1

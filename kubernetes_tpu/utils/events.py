@@ -29,6 +29,13 @@ class Event:
     first_timestamp: float = field(default_factory=time.time)
     last_timestamp: float = field(default_factory=time.time)
 
+    def key(self) -> str:
+        """Store key: one object per (involved object, reason) series —
+        stable across count bumps (the apiserver's _key_of hook). Shares
+        the series-name scheme with the wire metadata (_event_name) so
+        remote updates always match in-process objects."""
+        return f"{_event_ns(self)}/{_event_name(self)}"
+
 
 class Recorder:
     def __init__(self, capacity: int = 4096, sink: Optional[Callable[[Event], None]] = None):
@@ -76,3 +83,85 @@ class Recorder:
     def events(self, object_key: Optional[str] = None) -> List[Event]:
         with self._lock:
             return [e for e in self._events if object_key is None or e.object_key == object_key]
+
+
+def _event_ns(ev: Event) -> str:
+    """Involved object's namespace; cluster-scoped objects (no slash, e.g.
+    a node name) land in "default" — consistently across key(), the wire
+    codec, and round-trips."""
+    return ev.object_key.split("/", 1)[0] if "/" in ev.object_key else "default"
+
+
+def _event_name(ev: Event) -> str:
+    """Stable per-series name (the events API names series objects)."""
+    obj = ev.object_key.replace("/", ".")
+    return f"{obj}.{ev.reason.lower()}"
+
+
+def event_to_k8s(ev: Event) -> dict:
+    ns = _event_ns(ev)
+    name = ev.object_key.split("/", 1)[1] if "/" in ev.object_key else ev.object_key
+    return {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "name": _event_name(ev),
+            "namespace": ns,
+            "resourceVersion": getattr(ev, "resource_version", ""),
+        },
+        "type": ev.type,
+        "reason": ev.reason,
+        "message": ev.message,
+        "count": ev.count,
+        "firstTimestamp": ev.first_timestamp,
+        "lastTimestamp": ev.last_timestamp,
+        "involvedObject": {"namespace": ns, "name": name},
+    }
+
+
+def event_from_k8s(d: dict) -> Event:
+    meta = d.get("metadata") or {}
+    inv = d.get("involvedObject") or {}
+    ev = Event(
+        reason=d.get("reason", ""),
+        message=d.get("message", ""),
+        type=d.get("type", EVENT_TYPE_NORMAL),
+        object_key=f"{inv.get('namespace', 'default')}/{inv.get('name', '')}",
+        count=int(d.get("count", 1)),
+        first_timestamp=float(d.get("firstTimestamp", 0.0)),
+        last_timestamp=float(d.get("lastTimestamp", 0.0)),
+    )
+    ev.resource_version = str(meta.get("resourceVersion", ""))
+    return ev
+
+
+def node_event_key(node_name: str) -> str:
+    """Involved-object key for cluster-scoped nodes: namespaced into
+    "default" so key()/codec/round-trips agree."""
+    return f"default/{node_name}"
+
+
+def api_sink(api) -> Callable[[Event], None]:
+    """Sink writing event series to the apiserver's "events" kind (the
+    recordToSink half of client-go's event broadcaster): one object per
+    (involved object, reason) series, updated in place on count bumps."""
+
+    def sink(ev: Event) -> None:
+        # event recording must NEVER break scheduling: any transport or
+        # store failure drops the event (the reference's broadcaster has
+        # the same best-effort contract)
+        try:
+            obj = Event(
+                reason=ev.reason, message=ev.message, type=ev.type,
+                object_key=ev.object_key, count=ev.count,
+                first_timestamp=ev.first_timestamp,
+                last_timestamp=ev.last_timestamp,
+            )
+            try:
+                api.update("events", obj)
+            except KeyError:  # incl. NotFoundError: first write of a series
+                api.create("events", obj)
+        except Exception:
+            pass
+
+    return sink

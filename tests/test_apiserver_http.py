@@ -253,3 +253,29 @@ def test_informer_over_http_survives_stream_drop(served):
     assert inf.get("default/b") is not None, "relist never caught up"
     assert inf.relist_count > relists0
     inf.stop()
+
+
+def test_events_over_http_and_kubectl(served):
+    """Scheduler events flow recorder → apiserver "events" kind → wire →
+    kubectl get events (series-aggregated: one object per pod+reason)."""
+    from kubernetes_tpu.utils.events import Recorder, api_sink
+
+    store, srv = served
+    rec = Recorder(sink=api_sink(store))
+    fn = rec.pod_event_fn()
+    p = make_pod("w1")
+    fn(p, "FailedScheduling", "0/3 nodes available")
+    fn(p, "FailedScheduling", "0/3 nodes available")  # series bump
+    fn(p, "Scheduled", "bound to n1")
+    evs, _ = RemoteAPIServer(srv.url).list("events")
+    by_reason = {e.reason: e for e in evs}
+    assert by_reason["FailedScheduling"].count == 2
+    assert by_reason["FailedScheduling"].type == "Warning"
+    assert by_reason["Scheduled"].message == "bound to n1"
+    out = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.kubectl", "--server", srv.url,
+         "get", "events"],
+        capture_output=True, text=True, timeout=30, cwd="/root/repo",
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "FailedScheduling" in out.stdout and "default/w1" in out.stdout
