@@ -363,6 +363,23 @@ class NodeBank:
         # would force the driver's O(nodes) oracle fallback forever
         self.fallback[i] = False
 
+    def apply_pod_delta(self, i: int, pod, sign: int) -> None:
+        """Increment the pod-driven usage columns by one pod's request
+        vector (the mirror's delta path) — numerically identical to the
+        snapshot refresh because NodeInfo's own accounting added the exact
+        same memoized values. Ports are NOT handled here (list-shaped —
+        the caller snapshot-refreshes ported nodes)."""
+        for rname, v in accumulated_request(pod).items():
+            if rname != RESOURCE_PODS:
+                s = self.vocab.slot_of_resource(rname)
+                if s >= self.requested.shape[1]:
+                    raise KeySlotOverflow()
+                self.requested[i, s] += sign * v
+        c, m = pod_non_zero_request(pod)
+        self.nonzero_req[i, 0] += sign * c
+        self.nonzero_req[i, 1] += sign * m
+        self.pod_count[i] += sign
+
     def update_usage(self, i: int, ni: NodeInfo) -> bool:
         """Refresh ONLY the pod-driven columns (requested/non-zero/pod
         count/used ports) — the single-pod delta path. Node identity
