@@ -900,11 +900,25 @@ class SigBank:
         self.counts = np.zeros((self.node_capacity, s), np.int16)
         self._sig_of: Dict[bytes, int] = {}
         self._key_of_row: Dict[int, bytes] = {}
+        self._encode_cache: Dict[tuple, Tuple[bytes, np.ndarray, int, bool]] = {}
         self._refs = np.zeros(s, np.int64)
         self._free = list(range(s - 1, -1, -1))
         self.dirty_sig_rows: Set[int] = set()
 
     def _encode_key(self, pod: Pod) -> Tuple[bytes, np.ndarray, int, bool]:
+        # memoized by label CONTENT: replicas share label sets, so a
+        # 4096-pod batch needs ~#specs encodes instead of one numpy row
+        # build per pod. Safety rests on Vocab ids/slots being GROW-ONLY
+        # and process-stable (rebuilds reuse the vocab), so cached ids can
+        # never go stale; the cache dies with this bank. Bounded against
+        # label-churn pathologies (the win is ~#distinct specs, so a small
+        # bound keeps the hit rate while capping worst-case memory at high
+        # key_slots counts).
+        lk = (tuple(sorted(pod.labels.items())), pod.namespace,
+              pod.deletion_timestamp is not None)
+        hit = self._encode_cache.get(lk)
+        if hit is not None:
+            return hit
         v = self.vocab
         row = np.zeros(self.key_capacity, np.int32)
         row[:] = ABSENT
@@ -916,7 +930,11 @@ class SigBank:
         ns = v.id(pod.namespace)
         deleting = pod.deletion_timestamp is not None
         key = row.tobytes() + ns.to_bytes(4, "little") + bytes([deleting])
-        return key, row, ns, deleting
+        if len(self._encode_cache) > 8192:
+            self._encode_cache.clear()
+        out = (key, row, ns, deleting)
+        self._encode_cache[lk] = out
+        return out
 
     def _intern(self, pod: Pod) -> int:
         key, row, ns, deleting = self._encode_key(pod)
