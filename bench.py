@@ -414,6 +414,14 @@ def run_config(name, build, opts=None):
     for p in pods:
         queue.add(p)
     setup_s = time.perf_counter() - t_setup
+    # pre-pay compile (or persistent-cache load) + full bank upload at the
+    # real shapes so the drain measures scheduling, not XLA (the production
+    # analogue: a scheduler warms its executables at boot before Run()).
+    # Timed OUTSIDE setup_s — the two fields must not overlap.
+    t_w = time.perf_counter()
+    warmed = sched.warmup()
+    warmup_s = time.perf_counter() - t_w
+    print(f"[bench] warmup: {warmed} pods, {warmup_s:.1f}s", file=sys.stderr, flush=True)
     pod_hist_before = _hist_counts(M.pod_scheduling_duration)
     # the cluster model is millions of long-lived objects; generational GC
     # walking them mid-batch shows up as ~1s commit-loop outliers. Freeze
@@ -433,37 +441,41 @@ def run_config(name, build, opts=None):
     first_batch_s = None
     scheduled = unsched = preempted = 0
     idle_rounds = 0
-    while True:
-        tb = time.perf_counter()
-        r = sched.schedule_batch()
-        dt = time.perf_counter() - tb
-        if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
-            # preemption requeues its beneficiaries with backoff: give them
-            # bounded retry rounds instead of declaring the drain done the
-            # first time the active queue runs dry
-            active, backoff, unsched_q = queue.counts()
-            if preempted and idle_rounds < 20 and (active + backoff + unsched_q):
-                idle_rounds += 1
-                time.sleep(0.05)
-                queue.move_all_to_active()
-                continue
-            break
-        idle_rounds = 0
-        if first_batch_s is None:
-            first_batch_s = dt
-        batch_times.append(dt)
-        batch_sched.append(r.scheduled)
-        scheduled += r.scheduled
-        unsched += r.unschedulable  # attempts; see unschedulable_pods below
-        preempted += r.preempted
-        commits.extend(
-            (pod_by_key[k], n) for k, n in r.assignments.items() if k in pod_by_key
-        )
-    sched.wait_for_binds()
-    elapsed = time.perf_counter() - t0
-    gc.enable()
-    gc.unfreeze()
-    gc.collect()
+    try:
+        while True:
+            tb = time.perf_counter()
+            r = sched.schedule_batch()
+            dt = time.perf_counter() - tb
+            if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+                # preemption requeues its beneficiaries with backoff: give
+                # them bounded retry rounds instead of declaring the drain
+                # done the first time the active queue runs dry
+                active, backoff, unsched_q = queue.counts()
+                if preempted and idle_rounds < 20 and (active + backoff + unsched_q):
+                    idle_rounds += 1
+                    time.sleep(0.05)
+                    queue.move_all_to_active()
+                    continue
+                break
+            idle_rounds = 0
+            if first_batch_s is None:
+                first_batch_s = dt
+            batch_times.append(dt)
+            batch_sched.append(r.scheduled)
+            scheduled += r.scheduled
+            unsched += r.unschedulable  # attempts; see unschedulable_pods below
+            preempted += r.preempted
+            commits.extend(
+                (pod_by_key[k], n) for k, n in r.assignments.items() if k in pod_by_key
+            )
+        sched.wait_for_binds()
+        elapsed = time.perf_counter() - t0
+    finally:
+        # a scheduler error mid-drain must not leave GC disabled+frozen for
+        # every remaining config in this same-process run
+        gc.enable()
+        gc.unfreeze()
+        gc.collect()
     steady = sum(batch_times[1:]) or 1e-9
     bt = np.array(batch_times) if batch_times else np.array([0.0])
     # warm throughput: MEDIAN per-batch rate (actual scheduled / latency)
@@ -515,6 +527,7 @@ def run_config(name, build, opts=None):
         "batch_p50_s": round(float(np.percentile(bt, 50)), 4),
         "batch_p99_s": round(float(np.percentile(bt, 99)), 4),
         "setup_s": round(setup_s, 3),
+        "warmup_s": round(warmup_s, 3),
         "phase_split_s": {k: round(v, 3) if isinstance(v, float) else v
                           for k, v in sched.stats.items()},
         "mirror_rebuilds": sched.mirror.rebuild_count,

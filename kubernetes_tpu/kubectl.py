@@ -152,7 +152,11 @@ def _set_unschedulable(api: RemoteAPIServer, name: str, value: bool) -> int:
 def cmd_drain(api: RemoteAPIServer, name: str) -> int:
     """cordon + evict everything bound to the node (kubectl drain's core:
     pkg/drain — controller-owned pods are re-created elsewhere)."""
-    _set_unschedulable(api, name, True)
+    if _set_unschedulable(api, name, True) != 0:
+        # real kubectl drain aborts when the cordon fails — evicting from an
+        # uncordoned node just lets the scheduler re-place replicas onto it
+        print(f"node/{name}: cordon failed, aborting drain", file=sys.stderr)
+        return 1
     pods, _ = api.list("pods")
     evicted = 0
     for p in pods:

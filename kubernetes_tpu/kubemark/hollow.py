@@ -131,12 +131,17 @@ class HollowKubelet:
         for p in self._pods():
             if p.node_name != self.node_name or p.phase != "Pending":
                 continue
-            p.phase = "Running"
-            p.conditions = [
+            # never mutate the informer-cached object: a failed/raced update
+            # would leave the shared cache marked Running with no server-side
+            # transition, and the write would race the informer thread. The
+            # clone is what we send; the cache changes only via MODIFIED.
+            running = p.with_node(p.node_name)
+            running.phase = "Running"
+            running.conditions = [
                 c for c in p.conditions if c.get("type") != "Ready"
             ] + [{"type": "Ready", "status": "True"}]
             try:
-                self.api.update("pods", p)
+                self.api.update("pods", running)
                 self.acked += 1
             except (KeyError, NotFoundError, ConflictError):
                 pass  # deleted or raced: next tick reconverges

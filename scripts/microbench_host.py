@@ -1,0 +1,165 @@
+"""Host-path microbenchmark: attribute the per-pod microseconds of a
+schedule_batch cycle WITHOUT any device work (the device solve is ~10ms and
+is not the wall — PERF.md round 3). Run on the bench host:
+
+    python scripts/microbench_host.py
+
+Phases measured on the 100k/10k headline shape (config 3):
+  pop        — PriorityQueue.pop_batch(4096) from a ~100k heap
+  spec_key   — _spec_key over the batch (dedup map)
+  encode     — PodBatch.set_pod + compile_batch_terms over unique specs
+  assume     — per-pod cache.assume_pod (with_node + NodeInfo accounting)
+  sync       — TensorMirror.sync consuming the 4096 assume deltas
+  commitmisc — CycleState + bookkeeping shell around assume
+  bindchunk  — _lean_bind_chunk equivalent (finish_binding + histograms)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler, _spec_key
+from kubernetes_tpu.state.cache import SchedulerCache, TensorMirror
+from kubernetes_tpu.state.queue import PriorityQueue
+from kubernetes_tpu.state.tensors import PodBatch, _bucket
+from kubernetes_tpu.state.terms import compile_batch_terms
+
+N_NODES = int(os.environ.get("MB_NODES", "10000"))
+N_PODS = int(os.environ.get("MB_PODS", "100000"))
+BATCH = int(os.environ.get("MB_BATCH", "4096"))
+SPECS = int(os.environ.get("MB_SPECS", "100"))  # distinct controllers
+
+
+def build():
+    nodes = [
+        make_node(
+            f"n{i}",
+            cpu_milli=64000,
+            mem=256 * 2**30,
+            labels={
+                "zone": f"z{i % 16}",
+                "kubernetes.io/hostname": f"n{i}",
+            },
+        )
+        for i in range(N_NODES)
+    ]
+    pods = []
+    for i in range(N_PODS):
+        spec = i % SPECS
+        p = make_pod(
+            f"p{i}",
+            cpu_milli=100,
+            mem=200 * 2**20,
+            labels={"app": f"a{spec}"},
+        )
+        pods.append(p)
+    return nodes, pods
+
+
+def t(label, fn, n=1, per=None):
+    t0 = time.perf_counter()
+    out = fn()
+    dt = time.perf_counter() - t0
+    unit = f"  ({dt / per * 1e6:.2f}us/pod)" if per else ""
+    print(f"{label:12s} {dt * 1e3:9.2f} ms{unit}", flush=True)
+    return out, dt
+
+
+def main():
+    print(f"nodes={N_NODES} pods={N_PODS} batch={BATCH} specs={SPECS}")
+    nodes, pods = build()
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    queue = PriorityQueue()
+    t("q.add all", lambda: [queue.add(p) for p in pods], per=N_PODS)
+
+    mirror = TensorMirror(cache)
+    mirror.reserve(N_NODES, N_PODS)
+    mirror.sync()
+
+    # the bench freezes+disables GC for the measured drain (bench.py) —
+    # without this, generational walks over the ~1M-object cluster model
+    # dominate every allocation-heavy phase below
+    import gc
+
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+
+    # -- pop ------------------------------------------------------------------
+    infos, _ = t("pop_batch", lambda: queue.pop_batch(BATCH), per=BATCH)
+    batch_pods = [i.pod for i in infos]
+
+    # -- spec keys ------------------------------------------------------------
+    def specs():
+        sig_list = []
+        reps = []
+        idx = {}
+        for p in batch_pods:
+            k = _spec_key(p, None)
+            u = idx.get(k)
+            if u is None:
+                u = len(reps)
+                idx[k] = u
+                reps.append(p)
+            sig_list.append(u)
+        return sig_list, reps
+
+    (sig_list, reps), _ = t("spec_key", specs, per=BATCH)
+    t("spec_key2", specs, per=BATCH)  # memo warm?
+
+    # -- encode ---------------------------------------------------------------
+    def encode():
+        b = PodBatch(mirror.vocab, _bucket(len(reps)))
+        for i, p in enumerate(reps):
+            b.set_pod(i, p)
+        tb, aux = compile_batch_terms(mirror.vocab, reps, b_capacity=b.capacity)
+        return b, tb, aux
+
+    t("encode", encode, per=BATCH)
+
+    # -- assume (the commit loop's cache write) -------------------------------
+    # round-robin placement; realistic: each node gets ~B/N pods
+    names = [nodes[i % N_NODES].name for i in range(len(batch_pods))]
+
+    def assume():
+        cache.assume_pods([p.with_node(nm) for p, nm in zip(batch_pods, names)])
+
+    t("assume_bulk", assume, per=BATCH)
+
+    # -- sync (mirror consumes the deltas) ------------------------------------
+    t("sync", mirror.sync, per=BATCH)
+
+    # second round, warm
+    infos2 = queue.pop_batch(BATCH)
+    batch2 = [i.pod for i in infos2]
+    names2 = [nodes[(7 * i) % N_NODES].name for i in range(len(batch2))]
+
+    def assume2():
+        cache.assume_pods([p.with_node(nm) for p, nm in zip(batch2, names2)])
+
+    t("assume2_bulk", assume2, per=BATCH)
+    t("sync2", mirror.sync, per=BATCH)
+
+    def clone_only():
+        return [p.with_node(nm) for p, nm in zip(batch2, names2)]
+
+    t("with_node", clone_only, per=BATCH)
+
+    # -- finish_binding + queue.age (the lean bind chunk) --------------------
+    def finish():
+        for p, info in zip(batch2, infos2):
+            cache.finish_binding(p)
+            queue.age(info)
+
+    t("bind_finish", finish, per=BATCH)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ from kubernetes_tpu.apiserver import (
     ConflictError,
     FakeAPIServer,
     GoneError,
+    NotFoundError,
 )
 from kubernetes_tpu.client import APIBinder, Informer, start_scheduler_informers
 from kubernetes_tpu.models.generators import make_node, make_pod
@@ -176,7 +177,10 @@ def test_end_to_end_churn_while_scheduling():
             if i % 10 == 5:
                 api.create("nodes", make_node(f"extra{i}", cpu_milli=8000, mem=16 * 2**30))
             if i % 15 == 7:
-                api.delete("nodes", f"n{i % 6}")
+                try:
+                    api.delete("nodes", f"n{i % 6}")
+                except NotFoundError:
+                    pass  # a prior churn round already deleted this node
             time.sleep(0.005)
         stop.set()
 

@@ -464,3 +464,76 @@ def test_inbatch_tracking_skips_light_rechecks():
     assert len(set(res.assignments.values())) == 4
     assert sched.stats.get("light_rechecks", 0) == 0, sched.stats
     assert sched.stats.get("oracle_places", 0) == 0, sched.stats
+
+
+def test_warmup_compiles_without_consuming_queue():
+    """warmup() peeks — it must compile/upload but pop, commit, and mutate
+    nothing; the following schedule_batch sees the full queue."""
+    nodes = [make_node(f"n{i}", cpu_milli=2000, mem=4 * 2**30) for i in range(4)]
+    sched, binds = _mk_scheduler(nodes)
+    for i in range(8):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=200, mem=2**20))
+    mut0 = sched.cache.mutation_count
+    warmed = sched.warmup()
+    assert warmed == 8
+    assert sched.queue.pending_count() == 8
+    assert sched.cache.mutation_count == mut0
+    assert sched.cache.assumed_count() == 0
+    res = sched.schedule_batch()
+    assert res.scheduled == 8
+    sched.wait_for_binds()
+    assert len(binds) == 8
+
+
+def test_bulk_commit_matches_scalar_shell():
+    """The homogeneous-batch bulk commit path must place identically to the
+    per-pod scalar shell given the same device solve (deterministic ties).
+    An uninterested extender forces the scalar loop without changing any
+    per-pod decision."""
+
+    class _Uninterested:
+        def is_interested(self, pod):
+            return False
+
+        def supports_filter(self):
+            return False
+
+        def supports_prioritize(self):
+            return False
+
+        def supports_bind(self):
+            return False
+
+        def supports_preemption(self):
+            return False
+
+        def is_ignorable(self):
+            return True
+
+    def build():
+        nodes = [
+            make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30,
+                      labels={"zone": f"z{i % 3}"})
+            for i in range(6)
+        ]
+        pods = [make_pod(f"p{i}", cpu_milli=300, mem=2**24) for i in range(24)]
+        return nodes, pods
+
+    nodes, pods = build()
+    fast, fast_binds = _mk_scheduler(nodes, speculate=False)
+    for p in pods:
+        fast.queue.add(p)
+    r1 = fast.schedule_batch()
+    fast.wait_for_binds()
+
+    nodes2, pods2 = build()
+    slow, slow_binds = _mk_scheduler(nodes2, speculate=False,
+                                     extenders=[_Uninterested()])
+    for p in pods2:
+        slow.queue.add(p)
+    r2 = slow.schedule_batch()
+    slow.wait_for_binds()
+
+    assert r1.scheduled == r2.scheduled == 24
+    assert r1.assignments == r2.assignments
+    assert dict(fast_binds) == dict(slow_binds)
