@@ -77,6 +77,10 @@ class Toleration:
     operator: str = "Equal"  # Equal | Exists
     value: str = ""
     effect: str = ""  # empty matches all effects
+    # NoExecute grace: how long the pod stays on a tainted node before
+    # eviction (None = forever). Set to 300 by the DefaultTolerationSeconds
+    # admission plugin; honored by the nodelifecycle controller.
+    toleration_seconds: Optional[int] = None
 
     def tolerates(self, taint: Taint) -> bool:
         """v1helper.TolerationsTolerateTaint semantics
@@ -325,10 +329,17 @@ class Node:
     conditions: List[Dict[str, Any]] = field(default_factory=list)
 
     def allocatable_int(self) -> Dict[str, int]:
-        """Allocatable in scheduler units (cpu -> millicores, rest -> value)."""
+        """Allocatable in scheduler units (cpu -> millicores, rest -> value).
+        Memoized — the oracle reads it once per feasibility check and node
+        allocatable is status the informer replaces wholesale (new Node
+        object), never mutates. Treat the returned dict as read-only."""
+        memo = self.__dict__.get("_alloc_int_memo")
+        if memo is not None:
+            return memo
         out = {}
         for name, q in self.allocatable.items():
             out[name] = _request_value(name, q)
+        self.__dict__["_alloc_int_memo"] = out
         return out
 
 
@@ -342,6 +353,60 @@ class PodDisruptionBudget:
     namespace: str = "default"
     selector: Optional[LabelSelector] = None
     disruptions_allowed: int = 0
+
+
+@dataclass
+class PriorityClass:
+    """scheduling.k8s.io/v1 PriorityClass (pkg/apis/scheduling/types.go):
+    name → integer priority, resolved into pod.spec.priority by the
+    Priority admission plugin (plugin/pkg/admission/priority/admission.go)
+    at pod-create time. Cluster-scoped."""
+
+    name: str = ""
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+    resource_version: str = ""
+
+    def key(self) -> str:
+        return self.name
+
+
+# scheduling/types.go system classes (created by the apiserver's
+# PostStartHook in the reference; seeded by install_system_priority_classes)
+SYSTEM_CLUSTER_CRITICAL = "system-cluster-critical"
+SYSTEM_NODE_CRITICAL = "system-node-critical"
+SYSTEM_CRITICAL_PRIORITY = 2_000_000_000
+SYSTEM_PRIORITY_CLASSES = {
+    SYSTEM_CLUSTER_CRITICAL: SYSTEM_CRITICAL_PRIORITY,
+    SYSTEM_NODE_CRITICAL: SYSTEM_CRITICAL_PRIORITY + 1000,
+}
+
+
+def priorityclass_from_k8s(obj: dict) -> PriorityClass:
+    meta = obj.get("metadata") or {}
+    return PriorityClass(
+        name=meta.get("name", ""),
+        value=int(obj.get("value", 0)),
+        global_default=bool(obj.get("globalDefault", False)),
+        description=obj.get("description", ""),
+        resource_version=str(meta.get("resourceVersion", "")),
+    )
+
+
+def priorityclass_to_k8s(pc: PriorityClass) -> dict:
+    out = {
+        "apiVersion": "scheduling.k8s.io/v1",
+        "kind": "PriorityClass",
+        "metadata": {"name": pc.name},
+        "value": pc.value,
+        "globalDefault": pc.global_default,
+    }
+    if pc.description:
+        out["description"] = pc.description
+    if pc.resource_version:
+        out["metadata"]["resourceVersion"] = pc.resource_version
+    return out
 
 
 @dataclass
@@ -626,6 +691,7 @@ def pod_from_k8s(obj: dict) -> Pod:
                 operator=t.get("operator", "Equal"),
                 value=t.get("value", ""),
                 effect=t.get("effect", ""),
+                toleration_seconds=t.get("tolerationSeconds"),
             )
             for t in spec.get("tolerations") or []
         ],
@@ -726,7 +792,15 @@ def pod_to_k8s(pod: Pod) -> dict:
         spec["hostNetwork"] = True
     if pod.tolerations:
         spec["tolerations"] = [
-            {"key": t.key, "operator": t.operator, "value": t.value, "effect": t.effect}
+            {
+                "key": t.key, "operator": t.operator, "value": t.value,
+                "effect": t.effect,
+                **(
+                    {"tolerationSeconds": t.toleration_seconds}
+                    if t.toleration_seconds is not None
+                    else {}
+                ),
+            }
             for t in pod.tolerations
         ]
     if pod.topology_spread_constraints:

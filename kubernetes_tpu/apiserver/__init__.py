@@ -1,6 +1,15 @@
 """Simulated API server: ObjectTracker-style store, resourceVersion watch
 streams with 410-compaction, pods/binding subresource."""
 
+from .admission import (
+    AdmissionChain,
+    AdmissionError,
+    Authorizer,
+    DefaultTolerationSeconds,
+    PriorityAdmission,
+    default_admission_chain,
+    install_system_priority_classes,
+)
 from .http import APIServerHTTP
 from .store import (
     ADDED,
@@ -16,6 +25,13 @@ from .store import (
 
 __all__ = [
     "ADDED",
+    "AdmissionChain",
+    "AdmissionError",
+    "Authorizer",
+    "DefaultTolerationSeconds",
+    "PriorityAdmission",
+    "default_admission_chain",
+    "install_system_priority_classes",
     "APIServerHTTP",
     "DELETED",
     "MODIFIED",

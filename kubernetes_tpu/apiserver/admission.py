@@ -1,0 +1,177 @@
+"""Admission chain: mutating/validating hooks on apiserver writes.
+
+The reference routes every write through authn → authz → admission
+(staging/src/k8s.io/apiserver/pkg/server/config.go handler chain; ~25
+plugins under /root/reference/plugin/pkg/admission/). This is the
+scheduling-relevant core of that chain:
+
+* `PriorityAdmission` — plugin/pkg/admission/priority/admission.go:137:
+  resolves pod.spec.priorityClassName → spec.priority at CREATE (empty
+  name → the globalDefault class if one exists, else 0; unknown name →
+  reject), and protects the `system-` PriorityClass name prefix
+  (admission.go:105-134 — only the two built-in system classes may use
+  it).
+* `DefaultTolerationSeconds` —
+  plugin/pkg/admission/defaulttolerationseconds/admission.go:76: every
+  created/updated pod gets NoExecute tolerations for node.kubernetes.io/
+  not-ready and /unreachable with tolerationSeconds=300, unless the pod
+  already tolerates that taint (this is what gives evictions their 5min
+  grace by default; the nodelifecycle controller honors it).
+
+Plugins run in order; each may MUTATE (return a replacement object) or
+REJECT (raise AdmissionError → HTTP 422). Authn/authz are modeled as an
+always-allow seam (`Authorizer`) — the chain position exists; deployments
+needing real policy plug in there.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from ..api.types import (
+    Pod,
+    PriorityClass,
+    SYSTEM_PRIORITY_CLASSES,
+    Toleration,
+)
+
+DEFAULT_NOT_READY_TOLERATION_SECONDS = 300
+TAINT_NODE_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_NODE_UNREACHABLE = "node.kubernetes.io/unreachable"
+
+
+class AdmissionError(Exception):
+    """Write rejected by an admission plugin (HTTP 422 on the wire)."""
+
+
+class Authorizer:
+    """authn/authz seam (always-allow): the chain position of the
+    reference's authentication/authorization filters. Replace `allow` to
+    enforce policy."""
+
+    def allow(self, kind: str, op: str, obj: Any) -> bool:
+        return True
+
+
+class AdmissionChain:
+    def __init__(self, plugins: Optional[List] = None, authorizer: Optional[Authorizer] = None):
+        self.plugins = list(plugins or [])
+        self.authorizer = authorizer or Authorizer()
+
+    def admit(self, store, kind: str, op: str, obj: Any) -> Any:
+        """Run the chain for one write; returns the (possibly mutated)
+        object or raises AdmissionError. `store` gives plugins read access
+        (PriorityClass lookups)."""
+        if not self.authorizer.allow(kind, op, obj):
+            raise AdmissionError(f"{op} {kind} forbidden")
+        for p in self.plugins:
+            out = p.admit(store, kind, op, obj)
+            if out is not None:
+                obj = out
+        return obj
+
+
+class PriorityAdmission:
+    """priorityClassName → pod.priority resolution + system- protection."""
+
+    def admit(self, store, kind: str, op: str, obj: Any):
+        if kind == "priorityclasses":
+            pc: PriorityClass = obj
+            if pc.name.startswith("system-") and pc.name not in SYSTEM_PRIORITY_CLASSES:
+                raise AdmissionError(
+                    f"priority class name {pc.name}: the system- prefix is reserved"
+                )
+            return None
+        if kind != "pods" or op != "CREATE":
+            return None
+        pod: Pod = obj
+        name = pod.priority_class_name
+        if not name:
+            # no class named: use the global default if one exists
+            # (admission.go:160-176), else priority 0 — never override an
+            # explicitly-set priority
+            if pod.priority is None:
+                default = self._global_default(store)
+                pod.priority = default.value if default is not None else 0
+            return pod
+        value = SYSTEM_PRIORITY_CLASSES.get(name)
+        if value is None:
+            try:
+                pc = store.get("priorityclasses", name)
+                value = pc.value
+            except KeyError:
+                raise AdmissionError(f"no PriorityClass with name {name} was found")
+        pod.priority = value
+        return pod
+
+    @staticmethod
+    def _global_default(store) -> Optional[PriorityClass]:
+        try:
+            items, _ = store.list("priorityclasses")
+        except Exception:
+            return None
+        for pc in items:
+            if pc.global_default:
+                return pc
+        return None
+
+
+class DefaultTolerationSeconds:
+    """Add the default NoExecute not-ready/unreachable tolerations."""
+
+    def __init__(self, seconds: int = DEFAULT_NOT_READY_TOLERATION_SECONDS):
+        self.seconds = seconds
+
+    def admit(self, store, kind: str, op: str, obj: Any):
+        if kind != "pods" or op not in ("CREATE", "UPDATE"):
+            return None
+        pod: Pod = obj
+        has_not_ready = has_unreachable = False
+        for t in pod.tolerations:
+            # only a toleration that covers the NoExecute effect counts
+            # (admission.go:87-99 checks effect NoExecute or empty) — a
+            # NoSchedule-only toleration must not suppress the default
+            if t.effect not in ("", "NoExecute"):
+                continue
+            if t.operator == "Exists" and not t.key:
+                has_not_ready = has_unreachable = True  # tolerates everything
+            if t.key == TAINT_NODE_NOT_READY:
+                has_not_ready = True
+            if t.key == TAINT_NODE_UNREACHABLE:
+                has_unreachable = True
+        for key, present in (
+            (TAINT_NODE_NOT_READY, has_not_ready),
+            (TAINT_NODE_UNREACHABLE, has_unreachable),
+        ):
+            if not present:
+                pod.tolerations = pod.tolerations + [
+                    Toleration(
+                        key=key,
+                        operator="Exists",
+                        effect="NoExecute",
+                        toleration_seconds=self.seconds,
+                    )
+                ]
+        return pod
+
+
+def default_admission_chain() -> AdmissionChain:
+    """The default-on scheduling-relevant plugin set (the reference enables
+    Priority and DefaultTolerationSeconds in its recommended plugins,
+    kubeapiserver/options/plugins.go)."""
+    return AdmissionChain([PriorityAdmission(), DefaultTolerationSeconds()])
+
+
+def install_system_priority_classes(store) -> None:
+    """Seed the built-in system classes (the reference's scheduling REST
+    PostStartHook creates them at startup)."""
+    from ..apiserver.store import ConflictError
+
+    for name, value in SYSTEM_PRIORITY_CLASSES.items():
+        try:
+            store.create(
+                "priorityclasses",
+                PriorityClass(name=name, value=value, description="built-in"),
+            )
+        except ConflictError:
+            pass

@@ -42,10 +42,13 @@ from ..api.types import (
     node_to_k8s,
     pod_from_k8s,
     pod_to_k8s,
+    priorityclass_from_k8s,
+    priorityclass_to_k8s,
     replicaset_from_k8s,
     replicaset_to_k8s,
 )
 from ..utils.events import event_from_k8s, event_to_k8s
+from .admission import AdmissionError
 from .store import ConflictError, FakeAPIServer, GoneError, NotFoundError
 
 
@@ -91,6 +94,7 @@ _CODECS: Dict[str, Tuple[Callable, Callable, str]] = {
     "jobs": (job_to_k8s, job_from_k8s, "JobList"),
     "events": (event_to_k8s, event_from_k8s, "EventList"),
     "leases": (_lease_to_k8s, _lease_from_k8s, "LeaseList"),
+    "priorityclasses": (priorityclass_to_k8s, priorityclass_from_k8s, "PriorityClassList"),
 }
 
 
@@ -124,9 +128,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     @staticmethod
     def _obj_key(kind: str, rest) -> Optional[str]:
-        """nodes/leases are cluster-scoped (key = name); everything else
-        is namespace/name — mirroring store._key_of."""
-        if kind in ("nodes", "leases"):
+        """nodes/leases/priorityclasses are cluster-scoped (key = name);
+        everything else is namespace/name — mirroring store._key_of."""
+        if kind in ("nodes", "leases", "priorityclasses"):
             return rest[0] if len(rest) == 1 else None
         return f"{rest[0]}/{rest[1]}" if len(rest) == 2 else None
 
@@ -251,6 +255,8 @@ class _Handler(BaseHTTPRequestHandler):
             created = self.store.create(kind, obj)
         except ConflictError as e:
             return self._send_json(409, _status(409, "AlreadyExists", str(e)))
+        except AdmissionError as e:
+            return self._send_json(422, _status(422, "Invalid", str(e)))
         return self._send_json(201, codec[0](created))
 
     def do_PUT(self):
@@ -269,6 +275,8 @@ class _Handler(BaseHTTPRequestHandler):
             updated = self.store.update(kind, obj, check_rv=check_rv)
         except ConflictError as e:
             return self._send_json(409, _status(409, "Conflict", str(e)))
+        except AdmissionError as e:
+            return self._send_json(422, _status(422, "Invalid", str(e)))
         except KeyError:
             return self._send_json(404, _status(404, "NotFound", self.path))
         return self._send_json(200, to_k8s(updated))

@@ -87,7 +87,7 @@ class Watcher:
 
 
 class FakeAPIServer:
-    def __init__(self, history_window: int = HISTORY_WINDOW):
+    def __init__(self, history_window: int = HISTORY_WINDOW, admission=None):
         self._lock = threading.Lock()
         self._rv = itertools.count(1)
         self._objects: Dict[str, Dict[str, Any]] = {}
@@ -95,6 +95,10 @@ class FakeAPIServer:
         self._watchers: Dict[str, List[Watcher]] = {}
         self._history_window = history_window
         self._current_rv = 0
+        # admission chain (apiserver/admission.py): runs on create/update
+        # BEFORE the store lock (plugins read the store — PriorityClass
+        # lookups); raises AdmissionError to reject, may mutate the object
+        self._admission = admission
 
     # -- internals -----------------------------------------------------------
 
@@ -116,6 +120,8 @@ class FakeAPIServer:
     # -- REST surface ---------------------------------------------------------
 
     def create(self, kind: str, obj: Any) -> Any:
+        if self._admission is not None:
+            obj = self._admission.admit(self, kind, "CREATE", copy.deepcopy(obj))
         with self._lock:
             objs = self._objects.setdefault(kind, {})
             key = _key_of(obj)
@@ -128,6 +134,8 @@ class FakeAPIServer:
             return copy.deepcopy(stored)
 
     def update(self, kind: str, obj: Any, check_rv: bool = False) -> Any:
+        if self._admission is not None:
+            obj = self._admission.admit(self, kind, "UPDATE", copy.deepcopy(obj))
         with self._lock:
             objs = self._objects.setdefault(kind, {})
             key = _key_of(obj)

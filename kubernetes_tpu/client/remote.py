@@ -27,9 +27,12 @@ from ..api.types import (
     node_to_k8s,
     pod_from_k8s,
     pod_to_k8s,
+    priorityclass_from_k8s,
+    priorityclass_to_k8s,
     replicaset_from_k8s,
     replicaset_to_k8s,
 )
+from ..apiserver.admission import AdmissionError
 from ..apiserver.http import _lease_from_k8s, _lease_to_k8s
 from ..utils.events import event_from_k8s, event_to_k8s
 from ..apiserver.store import ConflictError, GoneError, NotFoundError, WatchEvent, _key_of
@@ -42,6 +45,7 @@ _CODECS = {
     "jobs": (job_to_k8s, job_from_k8s),
     "events": (event_to_k8s, event_from_k8s),
     "leases": (_lease_to_k8s, _lease_from_k8s),
+    "priorityclasses": (priorityclass_to_k8s, priorityclass_from_k8s),
 }
 
 
@@ -122,6 +126,8 @@ class RemoteAPIServer:
                 raise ConflictError(data.decode())
             if resp.status == 404:
                 raise NotFoundError(path)
+            if resp.status == 422:
+                raise AdmissionError(data.decode())
             if resp.status >= 400:
                 raise RuntimeError(f"{method} {path}: {resp.status} {data[:200]!r}")
             return json.loads(data) if data else {}

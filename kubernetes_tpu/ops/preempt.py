@@ -1,0 +1,174 @@
+"""Vectorized victim search: the device formulation of preemption.
+
+Reference semantics (core/generic_scheduler.go): selectNodesForPreemption
+(:1007) evaluates selectVictimsOnNode (:1104) on every candidate node —
+remove ALL lower-priority pods, check the preemptor fits, then reprieve
+candidates most-important-first (PDB-violating pods reprieved first, :1055)
+— and pickOneNodeForPreemption (:878) tie-breaks across nodes. The
+reference parallelizes the node loop with 16 goroutines; here the node axis
+is a vector lane: one `lax.scan` step per PREEMPTOR (sequential semantics
+between preemptors — earlier victims vanish, earlier nominees charge their
+node) with the per-node victim search inside as an inner scan over
+importance-ordered victim slots, all nodes at once.
+
+What the kernel models exactly (the affinity-free static case — the same
+preconditions as the host fast path `preemption._select_victims_fast`):
+PodFitsResources (predicates.go:854 compare rules incl. the
+always-check-cpu/mem/ephemeral + scalars-when-requested split and the pod
+count), candidate-node pruning by the four unresolvable predicates
+(nodesWherePreemptionMightHelp :1218 — the caller passes that mask, built
+from the same filter kernels the solver uses), PDB-violation counting, and
+the full 6-criteria pick. Host ports and (anti-)affinity interactions are
+OUTSIDE this kernel — the driver routes pods/clusters carrying those
+through the scalar oracle path.
+
+Inter-preemptor state carried on device: per-node free resources and
+pod-count slack (victim removals add them back), victim aliveness, and
+NOMINEE charges — the reference's victim-search fit check is
+nominee-aware (selectVictimsOnNode :1160 calls podFitsOnNode with the
+scheduling queue, whose pass 1 counts nominated pods, :620-630), and
+without it a batch of preemptors thrashes: the first eviction's freed
+capacity makes every later preemptor "fit", so nobody else evicts and the
+batch converges one pod per round. Charges are tracked as one aggregated
+[N, R] overlay (initial out-of-batch nominations + each chosen
+preemptor's request); the reference filters nominees by priority >= the
+incoming pod's — the aggregate counts ALL of them, a deliberate
+conservative divergence (a per-preemptor filter would need a [P, N, R]
+overlay), mirrored by the host fast path so the two stay bit-identical.
+
+Tie-break note: criterion 6 ("first") resolves by node ROW order here; the
+host oracle resolves by snapshot insertion order. These coincide on a
+freshly-encoded cluster; after node churn the rows may differ — both are
+conformant (the reference iterates a Go map, whose order is random).
+
+Victim slots are pre-sorted HOST-side per node: PDB-violating pods first,
+then by util.MoreImportantPod order (priority desc, start-time asc) — the
+reprieve order is preemptor-independent, so one sort serves every step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Arrays = Dict[str, jnp.ndarray]
+
+_BIG = jnp.int64(2**62)
+_TS_MIN = jnp.int64(-(2**62))
+
+
+@jax.jit
+def preempt_batch(
+    cand: jnp.ndarray,  # [P, N] bool — candidate nodes (unresolvable preds pass)
+    p_req: jnp.ndarray,  # [P, R] int64 — preemptor GetResourceRequest
+    p_req_any: jnp.ndarray,  # [P] bool — requests anything at all
+    p_prio: jnp.ndarray,  # [P] int32
+    p_valid: jnp.ndarray,  # [P] bool
+    vict_req: jnp.ndarray,  # [N, V, R] int64 — accumulated_request per victim
+    vict_prio: jnp.ndarray,  # [N, V] int32
+    vict_ts: jnp.ndarray,  # [N, V] int64 — creation ts (µs) for tie-break 5
+    vict_pdb: jnp.ndarray,  # [N, V] bool — PDB-violating flag
+    vict_valid: jnp.ndarray,  # [N, V] bool — slot holds a disruptable pod
+    free0: jnp.ndarray,  # [N, R] int64 — allocatable - requested
+    count_free0: jnp.ndarray,  # [N] int32 — allowed_pods - pod_count
+    node_valid: jnp.ndarray,  # [N] bool
+    nom_extra0: jnp.ndarray,  # [N, R] int64 — out-of-batch nominee requests
+    nom_cnt0: jnp.ndarray,  # [N] int32 — out-of-batch nominee pod counts
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (chosen [P] int32 node row or -1, victims [P, V] bool —
+    victim slots of the chosen node, fits_free [P] bool — the pod fits a
+    candidate node WITHOUT evicting anyone at its step's live state, so no
+    preemption happens and the caller should simply retry the pod)."""
+    n, v_cap, r = vict_req.shape
+    always = (jnp.arange(r) < 3)[None, :]  # cpu/mem/ephemeral slots
+
+    def step(carry, k):
+        free, count_free, alive, nom_extra, nom_cnt = carry
+        req = p_req[k]  # [R]
+        checked = always | (req[None, :] > 0)  # [1->N, R]
+        # nominee-adjusted view: what findNodesThatFit/podFitsOnNode pass-1
+        # would see — free minus outstanding nominee reservations
+        nfree = free - nom_extra
+        ncount_free = count_free - nom_cnt
+        # preemption only when the pod truly fits NOWHERE as-is
+        # (Preempt runs after findNodesThatFit came back empty — a stale
+        # speculative -1 must not evict anyone when live state fits)
+        free_ok = jnp.all((nfree - req[None, :] >= 0) | ~checked, axis=1) | ~p_req_any[k]
+        fits_free = jnp.any(cand[k] & node_valid & free_ok & (ncount_free >= 1))
+        lower = alive & vict_valid & (vict_prio < p_prio[k])  # [N, V]
+        freed = jnp.sum(jnp.where(lower[..., None], vict_req, 0), axis=1)  # [N, R]
+        nfreed = jnp.sum(lower, axis=1).astype(jnp.int32)  # [N]
+        head0 = nfree + freed - req[None, :]  # [N, R]
+        res_ok = jnp.all((head0 >= 0) | ~checked, axis=1) | ~p_req_any[k]
+        cslack0 = ncount_free + nfreed - 1  # [N]
+        fits = cand[k] & node_valid & res_ok & (cslack0 >= 0) & (nfreed > 0)
+
+        # greedy reprieve in slot order (host pre-sorted: violating first,
+        # then importance) — selectVictimsOnNode's re-add loop, every node
+        # in parallel
+        def rep(c2, vi):
+            head, cslack = c2
+            is_l = lower[:, vi]
+            r_v = vict_req[:, vi]  # [N, R]
+            keep_res = jnp.all((head - r_v >= 0) | ~checked, axis=1) | ~p_req_any[k]
+            can_keep = is_l & keep_res & (cslack >= 1)
+            head = head - jnp.where(can_keep[:, None], r_v, 0)
+            cslack = cslack - can_keep.astype(jnp.int32)
+            return (head, cslack), is_l & ~can_keep
+
+        (_, _), victim_cols = jax.lax.scan(
+            rep, (head0, cslack0), jnp.arange(v_cap)
+        )
+        victims = victim_cols.T  # [N, V]
+        cnt = jnp.sum(victims, axis=1).astype(jnp.int32)
+        feasible = fits & (cnt > 0)
+
+        # pickOneNodeForPreemption's lexicographic chain, vectorized as
+        # successive keep-min filters
+        viol = jnp.sum(victims & vict_pdb, axis=1).astype(jnp.int64)
+        vp = jnp.where(victims, vict_prio, jnp.iinfo(jnp.int32).min)
+        maxprio = jnp.max(vp, axis=1).astype(jnp.int64)
+        # sum in int64: 3+ victims at ~2e9 priority overflow an int32 sum,
+        # which would corrupt the tie-break vs the host's exact Python ints
+        psum = jnp.sum(
+            jnp.where(victims, vict_prio.astype(jnp.int64), 0), axis=1
+        )
+        is_top = victims & (vict_prio.astype(jnp.int64) == maxprio[:, None])
+        maxts = jnp.max(jnp.where(is_top, vict_ts, _TS_MIN), axis=1)
+
+        sel = feasible
+        for key in (viol, maxprio, psum, cnt.astype(jnp.int64), -maxts):
+            masked = jnp.where(sel, key, _BIG)
+            sel = sel & (masked == jnp.min(masked))
+        found = jnp.any(sel) & p_valid[k] & ~fits_free
+        chosen = jnp.argmax(sel)  # lowest row among survivors
+        onehot = (jnp.arange(n) == chosen) & found
+
+        # earlier victims vanish for later preemptors, and the chosen
+        # preemptor's request becomes a NOMINEE charge on its node (the
+        # queue's nominated index, which pass-1 fit checks count)
+        freed_sel = jnp.sum(jnp.where(victims[..., None], vict_req, 0), axis=1)
+        free = free + jnp.where(onehot[:, None], freed_sel, 0)
+        count_free = count_free + jnp.where(onehot, cnt, 0)
+        nom_extra = nom_extra + jnp.where(onehot[:, None], req[None, :], 0)
+        nom_cnt = nom_cnt + onehot.astype(nom_cnt.dtype)
+        alive = alive & ~(onehot[:, None] & victims)
+        out_node = jnp.where(found, chosen, -1).astype(jnp.int32)
+        out_victims = victims[chosen] & found
+        return (free, count_free, alive, nom_extra, nom_cnt), (
+            out_node, out_victims, fits_free,
+        )
+
+    init = (
+        free0,
+        count_free0.astype(jnp.int32),
+        jnp.ones(vict_valid.shape, bool),
+        nom_extra0,
+        nom_cnt0.astype(jnp.int32),
+    )
+    _, (nodes_out, victims_out, fits_free_out) = jax.lax.scan(
+        step, init, jnp.arange(p_prio.shape[0])
+    )
+    return nodes_out, victims_out, fits_free_out

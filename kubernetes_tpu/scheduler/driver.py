@@ -133,6 +133,11 @@ class SolveOutput:
     # WITHIN the batch (ops/solver.py inb): non-speculative batches can skip
     # the host LIGHT rechecks while commits follow the device's choices
     inbatch_tracked: bool = False
+    # queue.nomination_adds at dispatch: outstanding out-of-batch
+    # nominations were folded into this solve's mask; equality with the
+    # queue's current counter means no nomination appeared since (clears
+    # only make the mask conservative)
+    nom_adds: int = -1
 
 
 class ExtenderError(Exception):
@@ -284,6 +289,32 @@ class _BatchConflictIndex:
 # the queue's memo warming must not import the scheduler layer); re-exported
 # here for the driver's own call sites and existing imports
 _spec_key = spec_key
+
+_NOM_FOLD = None
+
+
+def _nominee_fold_fn():
+    """Jitted overlay of out-of-batch nominees' requests onto the node
+    bank's usage columns — podFitsOnNode's pass-1 nominee accounting
+    (generic_scheduler.go:620-630) done ONCE per dispatch on device instead
+    of per pod x node on the host. Conservative vs the reference in one
+    way: all nominees count, not only those with priority >= the incoming
+    pod's (a per-pod filter would need a [B, N, R] overlay); pass 2
+    (without nominees) is vacuous for resource-only pods, and pods with
+    topology terms keep the full host recheck path."""
+    global _NOM_FOLD
+    if _NOM_FOLD is None:
+        import jax
+
+        @jax.jit
+        def fold(na, rows, vecs, cnt):
+            out = dict(na)
+            out["requested"] = na["requested"].at[rows].add(vecs)
+            out["pod_count"] = na["pod_count"].at[rows].add(cnt)
+            return out
+
+        _NOM_FOLD = fold
+    return _NOM_FOLD
 
 
 def _no_nominations(node: str):
@@ -620,6 +651,42 @@ class Scheduler:
         )
         n_buckets = self._v_bucket
         na_dev, ea_dev, xp_dev = self.mirror.device_arrays()
+        # fold OUT-OF-BATCH nominations into the mask's usage columns
+        # (in-batch nominees are sequentialized by the solver's own carry;
+        # chained speculative solves inherit the fold through their free
+        # residuals). nomination_adds is recorded so consumers can tell
+        # whether new nominations appeared after this dispatch.
+        nom_adds = self.queue.nomination_adds
+        if self.queue.has_nominations():
+            from ..state.tensors import _req_slot_pairs
+
+            extras = self.queue.nomination_extras({p.key() for p in pods})
+            width = int(na_dev["requested"].shape[1])
+            rows: List[int] = []
+            vecs: List[np.ndarray] = []
+            for node, npod in extras:
+                row = self.mirror.row_of.get(node)
+                if row is None:
+                    continue
+                vec = np.zeros(width, np.int64)
+                ok = True
+                for s, v in _req_slot_pairs(self.mirror.vocab, npod):
+                    if s >= width:
+                        ok = False  # exotic-slot overflow: skip (rare; the
+                        break  # pod itself routes via fallback when popped)
+                    vec[s] = v
+                if ok:
+                    rows.append(row)
+                    vecs.append(vec)
+            if rows:
+                nb = _bucket(len(rows))
+                pad = nb - len(rows)
+                na_dev = _nominee_fold_fn()(
+                    na_dev,
+                    np.asarray(rows + [rows[0]] * pad, np.int32),
+                    np.asarray(vecs + [np.zeros(width, np.int64)] * pad),
+                    np.asarray([1] * len(rows) + [0] * pad, np.int32),
+                )
         # tiny clusters on big meshes: capacity buckets guarantee shard
         # divisibility only once capacity >= shard count — fall back to the
         # single-device pipeline instead of asserting on every batch
@@ -703,6 +770,7 @@ class Scheduler:
             existing_overflow=existing_overflow,
             speculative=carry is not None,
             tracked=self._track_inbatch and gang_dev is None,
+            nom_adds=nom_adds,
         )
 
     def _finish_solve(self, disp: Dict) -> SolveOutput:
@@ -735,6 +803,7 @@ class Scheduler:
             speculative=disp["speculative"],
             levels=disp["levels"][sig_arr],
             inbatch_tracked=disp.get("tracked", False),
+            nom_adds=disp.get("nom_adds", -1),
         )
 
     def warmup(self, max_pods: Optional[int] = None) -> int:
@@ -1149,7 +1218,6 @@ class Scheduler:
         M.preemption_evaluation_duration.observe(time.perf_counter() - t0)
         if node is None:
             return False
-        M.preemption_victims.observe(len(victims))
         # extenders with a preemption verb get to veto/trim the victim set
         # (processPreemptionWithExtenders, core/generic_scheduler.go:323-345;
         # simplification: consulted on the chosen candidate rather than the
@@ -1176,6 +1244,14 @@ class Scheduler:
                     return False  # extender vetoed the candidate node
                 keep = set(mv.pod_uids)
                 victims = [v for v in victims if v.uid in keep]
+        self._apply_preemption(pod, node, victims, clear)
+        return True
+
+    def _apply_preemption(self, pod: Pod, node: str, victims: List[Pod], clear) -> None:
+        """Victim deletes + nomination bookkeeping (the API-write tail of
+        Preempt, scheduler.go:436-470) — shared by the per-pod scalar path
+        and the device-batched path."""
+        M.preemption_victims.observe(len(victims))
         for v in victims:
             if self.delete_fn is not None:
                 # API delete: the informer's delete event removes it from the
@@ -1188,7 +1264,107 @@ class Scheduler:
             self.queue.clear_nomination(key)
         pod.nominated_node_name = node
         self.event_fn(pod, "Nominated", node)
-        return True
+
+    def _preempt_deferred(self, fails: List[PodInfo], cycle: int, res: ScheduleResult) -> None:
+        """Batched preemption for the bulk-commit fast path's -1 pods: ONE
+        device dispatch evaluates every preemptor x every candidate node
+        (ops/preempt.preempt_batch — the vectorized selectNodesForPreemption,
+        SURVEY §7 stage 7), with pop order preserved by the kernel's
+        sequential carry. Evaluated at end-of-batch state (this batch's
+        commits already assumed) — the batched analogue of preempt-after-
+        failed-cycle. Every device plan is re-VERIFIED against the live
+        snapshot on its chosen node before applying (exactness gate:
+        bit-equal victim set or the pod falls back to the scalar oracle);
+        ineligible batches (affinity/ports/volume seams, extender preemption
+        verbs, restricted predicate sets) take the scalar path wholesale."""
+        t0 = time.perf_counter()
+
+        def can_disrupt(p: Pod) -> bool:
+            return not self.cache.is_assumed(p.key())
+
+        pdbs = self.pdb_lister()
+        plans = None
+        if (
+            self.volume_checker is None
+            and self._enabled_preds is None
+            and not any(e.supports_preemption() for e in self.extenders)
+        ):
+            try:
+                plans = preemption_mod.batch_preempt_device(
+                    [i.pod for i in fails],
+                    self.cache.snapshot,
+                    pdbs=pdbs,
+                    can_disrupt=can_disrupt,
+                    # outstanding nominations reserve their nodes in the
+                    # kernel's fit checks (podFitsOnNode pass-1 semantics)
+                    nominated=self.queue.nomination_extras(
+                        {i.pod.key() for i in fails}
+                    ),
+                )
+            except Exception:
+                plans = None  # kernel trouble: scalar path answers instead
+        M.preemption_evaluation_duration.observe(time.perf_counter() - t0)
+        any_preempted = False
+        any_fits_free = False
+        for k, info in enumerate(fails):
+            pod = info.pod
+            applied = False
+            # _try_preempt counts its own attempt; only the pure device
+            # paths (applied plan / fits_free / no-candidates) count here
+            if plans is None:
+                applied = self._try_preempt(info)
+            else:
+                node_name, victims, fits_free = plans[k]
+                if fits_free:
+                    # a stale speculative -1: the pod fits somewhere live
+                    # without eviction — requeue, never evict for it
+                    any_fits_free = True
+                if node_name is None:
+                    M.preemption_attempts.inc()
+                if node_name is not None:
+                    from ..oracle.nodeinfo import accumulated_request
+
+                    noms = [
+                        p
+                        for p in self.queue.nominated_pods_for_node(node_name)
+                        if p.key() != pod.key()
+                    ]
+                    charge = None
+                    if noms:
+                        total: Dict[str, int] = {}
+                        for npod in noms:
+                            for rn, v in accumulated_request(npod).items():
+                                if rn != "pods":
+                                    total[rn] = total.get(rn, 0) + v
+                        charge = (total, len(noms))
+                    live = preemption_mod._select_victims_fast(
+                        pod, self.cache.snapshot.get(node_name), pdbs, can_disrupt,
+                        nominee_charge=charge,
+                    )
+                    if live is not None and [p.key() for p in live.pods] == [
+                        p.key() for p in victims
+                    ]:
+                        clear = [
+                            p.key()
+                            for p in self.queue.nominated_pods_for_node(node_name)
+                            if p.get_priority() < pod.get_priority()
+                        ]
+                        M.preemption_attempts.inc()
+                        self._apply_preemption(pod, node_name, victims, clear)
+                        applied = True
+                    else:
+                        applied = self._try_preempt(info)
+            if applied:
+                res.preempted += 1
+                any_preempted = True
+                self._aff_index = None
+            res.unschedulable += 1
+            self._fail(info, cycle, "no fit")
+        if any_preempted or any_fits_free:
+            # victim deletions are cluster events — and fits_free pods must
+            # retry promptly rather than age out of unschedulableQ
+            # (eventhandlers.go:127 -> MoveAllToActiveQueue)
+            self.queue.move_all_to_active()
 
     @property
     def _spec_pending(self) -> Optional[Dict]:
@@ -1456,7 +1632,15 @@ class Scheduler:
             and not index_needed
             and not host_pre_filter
             and not force_host_rank
-            and nominated_fn is _no_nominations
+            # nominations either don't exist, or every outstanding one was
+            # folded into this solve's mask at dispatch and none appeared
+            # since — the pass-1 accounting is already in the device pick,
+            # and pass 2 (without nominees) is vacuous for RECHECK_NONE
+            # pods (resources only)
+            and (
+                nominated_fn is _no_nominations
+                or out.nom_adds == self.queue.nomination_adds
+            )
             and self.volume_binder is None
             and self.volume_checker is None
             and not fw.has_plugins("reserve")
@@ -1466,12 +1650,9 @@ class Scheduler:
         )
         if fast_bulk:
             assign_l = out.assign[: len(infos)].tolist()
-            if self.enable_preemption and any(r < 0 for r in assign_l):
-                fast_bulk = False  # -1s must preempt in pop order: scalar loop
-            elif any(r < 0 for r in assign_l) and (
-                out.node_fallback_any or out.speculative
-            ):
+            if any(r < 0 for r in assign_l) and out.node_fallback_any:
                 fast_bulk = False  # -1s need the oracle fallback: scalar loop
+        preempt_fails: List[PodInfo] = []
         if fast_bulk:
             name_of = self.mirror.name_of_row
             assumed_meta: List[Tuple[PodInfo, Pod, str]] = []
@@ -1481,6 +1662,11 @@ class Scheduler:
                 info = infos[i]
                 node_name = name_of[row] if row >= 0 else None
                 if node_name is None:
+                    if row < 0 and self.enable_preemption:
+                        # deferred: one device-batched preemption round after
+                        # the commits (pop order preserved by the kernel)
+                        preempt_fails.append(info)
+                        continue
                     res.unschedulable += 1
                     if row >= 0:
                         residuals_diverged = True  # charged a vanished node
@@ -1490,6 +1676,13 @@ class Scheduler:
             rejected = set(
                 self.cache.assume_pods([m[1] for m in assumed_meta])
             )
+            if self.queue.has_nominations():
+                # DeleteNominatedPodIfExists at assume time (scheduler.go:
+                # 529), batched — committed pods stop reserving their
+                # nominated nodes
+                self.queue.clear_nominations(
+                    [m[0].pod.key() for j, m in enumerate(assumed_meta) if j not in rejected]
+                )
             state = CycleState()  # shared: the lean pipeline never reads it
             append = bind_jobs.append
             assignments = res.assignments
@@ -1502,6 +1695,8 @@ class Scheduler:
                 append((info, assumed, node_name, state, perf()))
                 assignments[info.pod.key()] = node_name
             res.scheduled += len(assumed_meta) - len(rejected)
+            if preempt_fails:
+                self._preempt_deferred(preempt_fails, cycle, res)
             infos = []  # the scalar loop below sees an empty batch
 
         # commit in pop order so oracle re-checks see earlier assumes,

@@ -88,6 +88,12 @@ class PriorityQueue:
         self._scheduling_cycle = 0
         self.nominated: Dict[str, str] = {}  # pod key → nominated node
         self._nominated_by_node: Dict[str, Set[str]] = {}
+        # bumped whenever a NOMINATION IS ADDED (never on clears): the
+        # driver folds outstanding nominations into the device mask at
+        # dispatch, and a speculated solve is consumable only if no
+        # nomination appeared since (clears only make the mask
+        # conservative — safe)
+        self.nomination_adds = 0
         self.closed = False
 
     # -- internals -----------------------------------------------------------
@@ -328,6 +334,7 @@ class PriorityQueue:
         if node:
             self.nominated[key] = node
             self._nominated_by_node.setdefault(node, set()).add(key)
+            self.nomination_adds += 1
 
     def _remove_nominated(self, key: str) -> None:
         node = self.nominated.pop(key, None)
@@ -343,6 +350,29 @@ class PriorityQueue:
             info = self._infos.get(key)
             if info is not None:
                 info.pod.nominated_node_name = ""
+
+    def nomination_extras(self, exclude_keys) -> List[Tuple[str, Pod]]:
+        """Outstanding (node, pod) nominations EXCLUDING the given keys —
+        the driver folds these into the device mask at dispatch (the
+        podFitsOnNode pass-1 nominee accounting, generic_scheduler.go:
+        620-630, batched: in-batch nominees are covered by the solver's
+        own sequential carry, so only out-of-batch ones are listed)."""
+        with self._lock:
+            return [
+                (node, self._infos[k].pod)
+                for k, node in self.nominated.items()
+                if k not in exclude_keys and k in self._infos
+            ]
+
+    def clear_nominations(self, keys) -> None:
+        """Bulk clear_nomination under one lock (the bulk-commit fast
+        path's per-batch nomination drop)."""
+        with self._lock:
+            for key in keys:
+                self._remove_nominated(key)
+                info = self._infos.get(key)
+                if info is not None:
+                    info.pod.nominated_node_name = ""
 
     def has_nominations(self) -> bool:
         """True if ANY pod currently nominates a node (empty sets left by

@@ -1,0 +1,114 @@
+"""Admission chain: PriorityClass resolution + defaultTolerationSeconds on
+apiserver writes, end-to-end over HTTP into the scheduler's priority view.
+Reference: plugin/pkg/admission/priority/admission.go:137,
+plugin/pkg/admission/defaulttolerationseconds/admission.go:76."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    PriorityClass,
+    SYSTEM_CLUSTER_CRITICAL,
+    SYSTEM_CRITICAL_PRIORITY,
+)
+from kubernetes_tpu.apiserver import (
+    AdmissionError,
+    APIServerHTTP,
+    FakeAPIServer,
+    default_admission_chain,
+    install_system_priority_classes,
+)
+from kubernetes_tpu.client import RemoteAPIServer
+from kubernetes_tpu.models.generators import make_pod
+
+
+@pytest.fixture()
+def api():
+    store = FakeAPIServer(admission=default_admission_chain())
+    install_system_priority_classes(store)
+    return store
+
+
+def test_priority_class_resolution(api):
+    api.create("priorityclasses", PriorityClass(name="high", value=1000))
+    p = make_pod("a", cpu_milli=100, mem=2**20)
+    p.priority_class_name = "high"
+    created = api.create("pods", p)
+    assert created.priority == 1000
+    assert created.get_priority() == 1000
+
+
+def test_priority_unknown_class_rejected(api):
+    p = make_pod("b", cpu_milli=100, mem=2**20)
+    p.priority_class_name = "nope"
+    with pytest.raises(AdmissionError):
+        api.create("pods", p)
+
+
+def test_priority_global_default_applies(api):
+    api.create(
+        "priorityclasses",
+        PriorityClass(name="default-tier", value=7, global_default=True),
+    )
+    created = api.create("pods", make_pod("c", cpu_milli=100, mem=2**20))
+    assert created.priority == 7
+
+
+def test_priority_system_classes_builtin(api):
+    p = make_pod("d", cpu_milli=100, mem=2**20)
+    p.priority_class_name = SYSTEM_CLUSTER_CRITICAL
+    created = api.create("pods", p)
+    assert created.priority == SYSTEM_CRITICAL_PRIORITY
+
+
+def test_system_prefix_protected(api):
+    with pytest.raises(AdmissionError):
+        api.create("priorityclasses", PriorityClass(name="system-mine", value=5))
+
+
+def test_default_toleration_seconds(api):
+    created = api.create("pods", make_pod("e", cpu_milli=100, mem=2**20))
+    tols = {t.key: t for t in created.tolerations}
+    for key in ("node.kubernetes.io/not-ready", "node.kubernetes.io/unreachable"):
+        assert key in tols
+        assert tols[key].effect == "NoExecute"
+        assert tols[key].toleration_seconds == 300
+
+
+def test_priority_resolution_over_http_to_scheduler_view(api):
+    """A pod POSTed over the wire with priorityClassName comes back with the
+    resolved priority — what the scheduler's informer then sees."""
+    srv = APIServerHTTP(api).start()
+    try:
+        remote = RemoteAPIServer(srv.url)
+        remote.create("priorityclasses", PriorityClass(name="web-tier", value=500))
+        got = remote.get("priorityclasses", "web-tier")
+        assert got.value == 500
+        p = make_pod("w", cpu_milli=100, mem=2**20)
+        p.priority_class_name = "web-tier"
+        created = remote.create("pods", p)
+        assert created.priority == 500
+        # rejection surfaces as AdmissionError over the wire too
+        bad = make_pod("x", cpu_milli=100, mem=2**20)
+        bad.priority_class_name = "missing"
+        with pytest.raises(AdmissionError):
+            remote.create("pods", bad)
+    finally:
+        srv.stop()
+
+
+def test_default_toleration_ignores_noschedule_only(api):
+    """A NoSchedule-only toleration for not-ready must NOT suppress the
+    default NoExecute toleration (admission.go:87-99 checks the effect)."""
+    from kubernetes_tpu.api.types import Toleration
+
+    p = make_pod("f", cpu_milli=100, mem=2**20)
+    p.tolerations = [
+        Toleration(key="node.kubernetes.io/not-ready", operator="Exists",
+                   effect="NoSchedule")
+    ]
+    created = api.create("pods", p)
+    ne = [t for t in created.tolerations
+          if t.key == "node.kubernetes.io/not-ready" and t.effect == "NoExecute"]
+    assert len(ne) == 1 and ne[0].toleration_seconds == 300
