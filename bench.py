@@ -458,6 +458,11 @@ def run_config(name, build, opts=None):
     warmup_s = time.perf_counter() - t_w
     print(f"[bench] warmup: {warmed} pods, {warmup_s:.1f}s", file=sys.stderr, flush=True)
     pod_hist_before = _hist_counts(M.pod_scheduling_duration)
+    # EXACT per-pod queue-add → bound latency from raw samples, this config
+    # only (round-3 VERDICT weak #8: bucket upper bounds are not
+    # percentiles)
+    M.pod_scheduling_duration.enable_sampling()
+    M.pod_scheduling_duration.reset_samples()
     # the cluster model is millions of long-lived objects; generational GC
     # walking them mid-batch shows up as ~1s commit-loop outliers. Freeze
     # the setup heap out of the collector and keep GC off during the
@@ -529,8 +534,15 @@ def run_config(name, build, opts=None):
     stall_batches = sum(1 for t in batch_times[half:] if tail_med > 0 and t > 5 * tail_med)
     # per-pod queue-add → bound latency (PodSchedulingDuration histogram,
     # this config's samples only) — the BASELINE.json headline latency
-    pod_p50 = _hist_pct_from_diff(M.pod_scheduling_duration, pod_hist_before, 0.5)
-    pod_p99 = _hist_pct_from_diff(M.pod_scheduling_duration, pod_hist_before, 0.99)
+    # exact percentiles from raw samples; the bucket-bound estimate stays
+    # as a cross-check field (they must bracket each other)
+    pod_p50 = M.pod_scheduling_duration.exact_percentile(0.5)
+    pod_p99 = M.pod_scheduling_duration.exact_percentile(0.99)
+    pod_p99_bucket = _hist_pct_from_diff(M.pod_scheduling_duration, pod_hist_before, 0.99)
+    if pod_p50 is not None:
+        pod_p50 = round(pod_p50, 4)
+    if pod_p99 is not None:
+        pod_p99 = round(pod_p99, 4)
     # audit: preemption runs sweep the FINAL state (victim deletions
     # tracked via delete_fn) with the commit-time replay disabled — a
     # commit may have been legal only after a mid-run deletion the replay
@@ -555,6 +567,7 @@ def run_config(name, build, opts=None):
         "preempted": preempted,
         "pod_sched_p50_s": pod_p50,
         "pod_sched_p99_s": pod_p99,
+        "pod_sched_p99_bucket_s": pod_p99_bucket,
         "audit": audit,
         "audit_s": round(audit_s, 3),
         "elapsed_s": round(elapsed, 3),

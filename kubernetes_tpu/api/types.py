@@ -419,6 +419,11 @@ class Service:
     name: str = ""
     namespace: str = "default"
     selector: Dict[str, str] = field(default_factory=dict)
+    uid: str = field(default_factory=_new_uid)
+    resource_version: str = ""
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
 
 
 @dataclass
@@ -459,6 +464,133 @@ def service_to_k8s(svc: Service) -> dict:
         "kind": "Service",
         "metadata": {"name": svc.name, "namespace": svc.namespace},
         "spec": {"selector": dict(svc.selector)},
+    }
+
+
+@dataclass
+class Endpoints:
+    """core/v1 Endpoints — the Service's live backend set, reconciled from
+    the Service selector by the endpoints controller
+    (pkg/controller/endpoint/endpoints_controller.go syncService).
+    Addresses here are pod identities (pod IPs are not modeled; the
+    scheduling-visible contract is membership)."""
+
+    name: str = ""
+    namespace: str = "default"
+    addresses: List[str] = field(default_factory=list)  # pod keys, sorted
+    resource_version: str = ""
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def endpoints_from_k8s(obj: dict) -> Endpoints:
+    meta = obj.get("metadata") or {}
+    subsets = obj.get("subsets") or []
+    addrs = []
+    for s in subsets:
+        for a in s.get("addresses") or []:
+            ref = a.get("targetRef") or {}
+            if ref.get("name"):
+                addrs.append(f"{ref.get('namespace', 'default')}/{ref['name']}")
+    return Endpoints(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        addresses=addrs,
+        resource_version=str(meta.get("resourceVersion", "")),
+    )
+
+
+def endpoints_to_k8s(ep: Endpoints) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Endpoints",
+        "metadata": {"name": ep.name, "namespace": ep.namespace},
+        "subsets": [
+            {
+                "addresses": [
+                    {
+                        "targetRef": {
+                            "kind": "Pod",
+                            "namespace": a.split("/", 1)[0],
+                            "name": a.split("/", 1)[1],
+                        }
+                    }
+                    for a in ep.addresses
+                ]
+            }
+        ] if ep.addresses else [],
+    }
+
+
+@dataclass
+class StatefulSet:
+    """apps/v1 StatefulSet — the controller subset: stable ordinal
+    identities name-0..name-(replicas-1), OrderedReady rollout
+    (pkg/apis/apps/types.go StatefulSetSpec; reconciled by
+    pkg/controller/statefulset/stateful_set.go)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    resource_version: str = ""
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None
+    template: Optional[Pod] = None
+    service_name: str = ""
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class DaemonSet:
+    """apps/v1 DaemonSet — one pod per eligible node, placed through the
+    DEFAULT scheduler via a per-node matchFields node-affinity pin
+    (ScheduleDaemonSetPods, pkg/controller/daemon/daemon_controller.go
+    nodeShouldRunDaemonPod + util.ReplaceDaemonSetPodNodeNameNodeAffinity)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    resource_version: str = ""
+    selector: Optional[LabelSelector] = None
+    template: Optional[Pod] = None
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Namespace:
+    """core/v1 Namespace — lifecycle subset: Active → Terminating drains
+    every namespaced object, then the namespace object goes away
+    (pkg/controller/namespace/deletion/namespaced_resources_deleter.go)."""
+
+    name: str = ""
+    phase: str = "Active"  # Active | Terminating
+    resource_version: str = ""
+
+    def key(self) -> str:
+        return self.name
+
+
+def namespace_from_k8s(obj: dict) -> Namespace:
+    meta = obj.get("metadata") or {}
+    status = obj.get("status") or {}
+    return Namespace(
+        name=meta.get("name", ""),
+        phase=status.get("phase", "Active"),
+        resource_version=str(meta.get("resourceVersion", "")),
+    )
+
+
+def namespace_to_k8s(ns: Namespace) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": ns.name},
+        "status": {"phase": ns.phase},
     }
 
 
@@ -965,6 +1097,75 @@ def replicaset_from_k8s(obj: dict) -> ReplicaSet:
         template=template,
         owner_references=list(meta.get("ownerReferences") or []),
     )
+
+
+def _workload_from_k8s(cls, api_kind: str, obj: dict, extra=None):
+    """Shared apps/v1 workload decode (StatefulSet/DaemonSet: metadata +
+    selector + pod template [+ replicas where present])."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    tmpl = spec.get("template")
+    template = None
+    if tmpl is not None:
+        tmeta = dict(tmpl.get("metadata") or {})
+        tmeta.setdefault("namespace", meta.get("namespace", "default"))
+        tmeta.setdefault("name", meta.get("name", "") + "-template")
+        template = pod_from_k8s({"metadata": tmeta, "spec": tmpl.get("spec") or {}})
+    kw = dict(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid") or _new_uid(),
+        resource_version=str(meta.get("resourceVersion", "")),
+        selector=_label_selector_from(spec.get("selector")),
+        template=template,
+    )
+    if extra:
+        kw.update(extra(spec))
+    return cls(**kw)
+
+
+def _workload_to_k8s(obj, api_kind: str, extra_spec=None) -> dict:
+    spec: Dict[str, Any] = {}
+    if getattr(obj, "replicas", None) is not None and hasattr(obj, "replicas"):
+        spec["replicas"] = obj.replicas
+    if obj.selector is not None:
+        spec["selector"] = _label_selector_to(obj.selector)
+    if obj.template is not None:
+        t = pod_to_k8s(obj.template)
+        spec["template"] = {
+            "metadata": {"labels": t["metadata"].get("labels", {})},
+            "spec": t["spec"],
+        }
+    if extra_spec:
+        spec.update(extra_spec)
+    meta: Dict[str, Any] = {"name": obj.name, "namespace": obj.namespace, "uid": obj.uid}
+    if obj.resource_version:
+        meta["resourceVersion"] = obj.resource_version
+    return {"apiVersion": "apps/v1", "kind": api_kind, "metadata": meta, "spec": spec}
+
+
+def statefulset_from_k8s(obj: dict) -> StatefulSet:
+    return _workload_from_k8s(
+        StatefulSet, "StatefulSet", obj,
+        extra=lambda spec: {
+            "replicas": int(spec.get("replicas") if spec.get("replicas") is not None else 1),
+            "service_name": spec.get("serviceName", ""),
+        },
+    )
+
+
+def statefulset_to_k8s(ss: StatefulSet) -> dict:
+    return _workload_to_k8s(ss, "StatefulSet", {"serviceName": ss.service_name})
+
+
+def daemonset_from_k8s(obj: dict) -> DaemonSet:
+    return _workload_from_k8s(DaemonSet, "DaemonSet", obj)
+
+
+def daemonset_to_k8s(ds: DaemonSet) -> dict:
+    d = _workload_to_k8s(ds, "DaemonSet")
+    d["spec"].pop("replicas", None)
+    return d
 
 
 def replicaset_to_k8s(rs: ReplicaSet) -> dict:

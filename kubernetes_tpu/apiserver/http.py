@@ -34,18 +34,28 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..api.types import (
+    daemonset_from_k8s,
+    daemonset_to_k8s,
     deployment_from_k8s,
     deployment_to_k8s,
+    endpoints_from_k8s,
+    endpoints_to_k8s,
     job_from_k8s,
     job_to_k8s,
     node_from_k8s,
     node_to_k8s,
+    namespace_from_k8s,
+    namespace_to_k8s,
     pod_from_k8s,
     pod_to_k8s,
     priorityclass_from_k8s,
     priorityclass_to_k8s,
     replicaset_from_k8s,
     replicaset_to_k8s,
+    service_from_k8s,
+    service_to_k8s,
+    statefulset_from_k8s,
+    statefulset_to_k8s,
 )
 from ..utils.events import event_from_k8s, event_to_k8s
 from .admission import AdmissionError
@@ -95,7 +105,24 @@ _CODECS: Dict[str, Tuple[Callable, Callable, str]] = {
     "events": (event_to_k8s, event_from_k8s, "EventList"),
     "leases": (_lease_to_k8s, _lease_from_k8s, "LeaseList"),
     "priorityclasses": (priorityclass_to_k8s, priorityclass_from_k8s, "PriorityClassList"),
+    "statefulsets": (statefulset_to_k8s, statefulset_from_k8s, "StatefulSetList"),
+    "daemonsets": (daemonset_to_k8s, daemonset_from_k8s, "DaemonSetList"),
+    "services": (service_to_k8s, service_from_k8s, "ServiceList"),
+    "endpoints": (endpoints_to_k8s, endpoints_from_k8s, "EndpointsList"),
+    "namespaces": (namespace_to_k8s, namespace_from_k8s, "NamespaceList"),
 }
+
+
+def _parse_selector(vals) -> Optional[Dict[str, str]]:
+    """k8s wire selector syntax: "k1=v1,k2=v2" (equality only)."""
+    if not vals or not vals[0]:
+        return None
+    out: Dict[str, str] = {}
+    for part in vals[0].split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            out[k.strip()] = v.strip()
+    return out or None
 
 
 def _status(code: int, reason: str, message: str) -> bytes:
@@ -130,7 +157,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _obj_key(kind: str, rest) -> Optional[str]:
         """nodes/leases/priorityclasses are cluster-scoped (key = name);
         everything else is namespace/name — mirroring store._key_of."""
-        if kind in ("nodes", "leases", "priorityclasses"):
+        if kind in ("nodes", "leases", "priorityclasses", "namespaces"):
             return rest[0] if len(rest) == 1 else None
         return f"{rest[0]}/{rest[1]}" if len(rest) == 2 else None
 
@@ -169,7 +196,11 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(200, to_k8s(obj))
         if q.get("watch", ["0"])[0] in ("1", "true"):
             return self._serve_watch(kind, to_k8s, q)
-        items, rv = self.store.list(kind)
+        items, rv = self.store.list(
+            kind,
+            label_selector=_parse_selector(q.get("labelSelector")),
+            field_selector=_parse_selector(q.get("fieldSelector")),
+        )
         return self._send_json(200, {
             "kind": list_kind,
             "apiVersion": "v1",
@@ -184,7 +215,11 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._send_json(400, _status(400, "BadRequest", str(e)))
         try:
-            watcher = self.store.watch(kind, since)
+            watcher = self.store.watch(
+                kind, since,
+                label_selector=_parse_selector(q.get("labelSelector")),
+                field_selector=_parse_selector(q.get("fieldSelector")),
+            )
         except GoneError as e:
             return self._send_json(410, _status(410, "Expired", str(e)))
         self.send_response(200)

@@ -64,10 +64,15 @@ def _key_of(obj: Any) -> str:
 
 
 class Watcher:
-    """One watch stream: a queue of WatchEvents; close() ends it."""
+    """One watch stream: a queue of WatchEvents; close() ends it. An
+    attached (label, field) selector pair filters server-side — the store
+    only pushes matching events (per-node pod watches don't fan the whole
+    cluster)."""
 
-    def __init__(self):
+    def __init__(self, label_selector=None, field_selector=None):
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self.label_selector = label_selector
+        self.field_selector = field_selector
         self.closed = False
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
@@ -86,10 +91,42 @@ class Watcher:
             self._q.put(None)
 
 
+def _field_of(obj: Any, path: str) -> str:
+    """Resolve a field-selector path against the typed objects
+    (apimachinery/pkg/fields: the supported paths are per-kind; these
+    cover the scheduling-relevant set — notably pods-by-nodeName, which is
+    how kubelets watch only their own pods)."""
+    if path == "metadata.name":
+        return getattr(obj, "name", "")
+    if path == "metadata.namespace":
+        return getattr(obj, "namespace", "")
+    if path == "spec.nodeName":
+        return getattr(obj, "node_name", "")
+    if path == "status.phase":
+        return getattr(obj, "phase", "")
+    return ""
+
+
+def _matches(obj: Any, label_selector: Optional[Dict[str, str]],
+             field_selector: Optional[Dict[str, str]]) -> bool:
+    """labels.Set.AsSelector + fields.Set matching (equality only — the
+    reference's field selectors are equality-based too)."""
+    if label_selector:
+        labels = getattr(obj, "labels", None) or {}
+        for k, v in label_selector.items():
+            if labels.get(k) != v:
+                return False
+    if field_selector:
+        for path, v in field_selector.items():
+            if _field_of(obj, path) != v:
+                return False
+    return True
+
+
 class FakeAPIServer:
-    def __init__(self, history_window: int = HISTORY_WINDOW, admission=None):
+    def __init__(self, history_window: int = HISTORY_WINDOW, admission=None,
+                 wal=None):
         self._lock = threading.Lock()
-        self._rv = itertools.count(1)
         self._objects: Dict[str, Dict[str, Any]] = {}
         self._history: Dict[str, Deque[WatchEvent]] = {}
         self._watchers: Dict[str, List[Watcher]] = {}
@@ -99,6 +136,21 @@ class FakeAPIServer:
         # BEFORE the store lock (plugins read the store — PriorityClass
         # lookups); raises AdmissionError to reject, may mutate the object
         self._admission = admission
+        # durable persistence (apiserver/persist.WAL or a path): every
+        # accepted write is logged before it returns; on startup the store
+        # replays snapshot+log and resourceVersion CONTINUES from the
+        # highest persisted revision ("etcd IS the checkpoint", SURVEY §5).
+        # Watch history is not persisted — reconnecting watchers relist.
+        if isinstance(wal, str):
+            from .persist import WAL
+
+            wal = WAL(wal)
+        self._wal = wal
+        start_rv = 0
+        if wal is not None:
+            self._objects, start_rv = wal.replay()
+            self._current_rv = start_rv
+        self._rv = itertools.count(start_rv + 1)
 
     # -- internals -----------------------------------------------------------
 
@@ -106,7 +158,7 @@ class FakeAPIServer:
         self._current_rv = next(self._rv)
         return self._current_rv
 
-    def _emit(self, kind: str, type_: str, obj: Any, rv: int) -> None:
+    def _emit(self, kind: str, type_: str, obj: Any, rv: int, old: Any = None) -> None:
         ev = WatchEvent(type_, obj, rv)
         hist = self._history.setdefault(kind, deque(maxlen=self._history_window))
         hist.append(ev)
@@ -115,7 +167,14 @@ class FakeAPIServer:
         live = [w for w in self._watchers.get(kind, []) if not w.closed]
         self._watchers[kind] = live
         for w in live:
-            w._push(WatchEvent(type_, copy.deepcopy(obj), rv))
+            if _matches(obj, w.label_selector, w.field_selector):
+                w._push(WatchEvent(type_, copy.deepcopy(obj), rv))
+            elif old is not None and _matches(old, w.label_selector, w.field_selector):
+                # the object LEFT this watcher's selector: synthesize
+                # DELETED so filtered informer caches don't go stale (the
+                # reference watch cache does the same, cacher.go
+                # sendWatchCacheEvent's match-transition handling)
+                w._push(WatchEvent(DELETED, copy.deepcopy(obj), rv))
 
     # -- REST surface ---------------------------------------------------------
 
@@ -130,6 +189,9 @@ class FakeAPIServer:
             stored = copy.deepcopy(obj)
             stored.resource_version = str(self._bump())
             objs[key] = stored
+            if self._wal is not None:
+                self._wal.append("PUT", kind, key, self._current_rv, stored)
+                self._wal.maybe_compact(self._objects, self._current_rv)
             self._emit(kind, ADDED, copy.deepcopy(stored), self._current_rv)
             return copy.deepcopy(stored)
 
@@ -143,10 +205,14 @@ class FakeAPIServer:
                 raise NotFoundError(key)
             if check_rv and obj.resource_version != objs[key].resource_version:
                 raise ConflictError(f"{kind} {key}: resourceVersion mismatch")
+            prev = objs[key]
             stored = copy.deepcopy(obj)
             stored.resource_version = str(self._bump())
             objs[key] = stored
-            self._emit(kind, MODIFIED, copy.deepcopy(stored), self._current_rv)
+            if self._wal is not None:
+                self._wal.append("PUT", kind, key, self._current_rv, stored)
+                self._wal.maybe_compact(self._objects, self._current_rv)
+            self._emit(kind, MODIFIED, copy.deepcopy(stored), self._current_rv, old=prev)
             return copy.deepcopy(stored)
 
     def delete(self, kind: str, key: str) -> None:
@@ -155,7 +221,11 @@ class FakeAPIServer:
             if key not in objs:
                 raise NotFoundError(key)
             obj = objs.pop(key)
-            self._emit(kind, DELETED, copy.deepcopy(obj), self._bump())
+            rv = self._bump()
+            if self._wal is not None:
+                self._wal.append("DELETE", kind, key, rv)
+                self._wal.maybe_compact(self._objects, self._current_rv)
+            self._emit(kind, DELETED, copy.deepcopy(obj), rv)
 
     def get(self, kind: str, key: str) -> Any:
         with self._lock:
@@ -164,21 +234,30 @@ class FakeAPIServer:
                 raise NotFoundError(key)
             return copy.deepcopy(obj)
 
-    def list(self, kind: str) -> Tuple[List[Any], int]:
-        """→ (deep-copied items, list resourceVersion)."""
+    def list(self, kind: str, label_selector: Optional[Dict[str, str]] = None,
+             field_selector: Optional[Dict[str, str]] = None) -> Tuple[List[Any], int]:
+        """→ (deep-copied items, list resourceVersion); selectors filter
+        server-side (labels.Selector / fields.Selector on the list verb)."""
         with self._lock:
-            items = [copy.deepcopy(o) for o in self._objects.get(kind, {}).values()]
+            items = [
+                copy.deepcopy(o)
+                for o in self._objects.get(kind, {}).values()
+                if _matches(o, label_selector, field_selector)
+            ]
             return items, self._current_rv
 
-    def watch(self, kind: str, since_rv: int) -> Watcher:
-        """Watch from since_rv (exclusive). 410 when compacted below it."""
+    def watch(self, kind: str, since_rv: int,
+              label_selector: Optional[Dict[str, str]] = None,
+              field_selector: Optional[Dict[str, str]] = None) -> Watcher:
+        """Watch from since_rv (exclusive). 410 when compacted below it.
+        Selectors filter events server-side."""
         with self._lock:
             hist = self._history.setdefault(kind, deque(maxlen=self._history_window))
             if hist and since_rv < hist[0].rv - 1 and since_rv < self._oldest_live_rv(kind):
                 raise GoneError(f"resourceVersion {since_rv} compacted")
-            w = Watcher()
+            w = Watcher(label_selector, field_selector)
             for ev in hist:
-                if ev.rv > since_rv:
+                if ev.rv > since_rv and _matches(ev.obj, label_selector, field_selector):
                     w._push(WatchEvent(ev.type, copy.deepcopy(ev.obj), ev.rv))
             self._watchers.setdefault(kind, []).append(w)
             return w
@@ -211,8 +290,12 @@ class FakeAPIServer:
                 raise NotFoundError(key)
             if pod.node_name and pod.node_name != node_name:
                 raise ConflictError(f"pod {key} already bound to {pod.node_name}")
+            prev = pod
             pod = copy.deepcopy(pod)
             pod.node_name = node_name
             pod.resource_version = str(self._bump())
             pods[key] = pod
-            self._emit("pods", MODIFIED, copy.deepcopy(pod), self._current_rv)
+            if self._wal is not None:
+                self._wal.append("PUT", "pods", key, self._current_rv, pod)
+                self._wal.maybe_compact(self._objects, self._current_rv)
+            self._emit("pods", MODIFIED, copy.deepcopy(pod), self._current_rv, old=prev)

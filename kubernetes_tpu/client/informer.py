@@ -26,9 +26,16 @@ logger = logging.getLogger("kubernetes_tpu.informer")
 class Informer:
     """One resource kind's reflector loop + local store + handlers."""
 
-    def __init__(self, api: FakeAPIServer, kind: str):
+    def __init__(self, api: FakeAPIServer, kind: str,
+                 label_selector: Optional[Dict[str, str]] = None,
+                 field_selector: Optional[Dict[str, str]] = None):
         self.api = api
         self.kind = kind
+        # server-side filtering (labels/fields on list+watch): a kubelet's
+        # pod informer passes {"spec.nodeName": <node>} so the apiserver
+        # never fans it the whole cluster's pod events
+        self.label_selector = label_selector
+        self.field_selector = field_selector
         self._store: Dict[str, Any] = {}
         self._lock = threading.Lock()
         self._handlers: List[Dict[str, Callable]] = []
@@ -99,7 +106,11 @@ class Informer:
                 continue
             self._synced.set()
             try:
-                watcher = self.api.watch(self.kind, rv)
+                watcher = self.api.watch(
+                    self.kind, rv,
+                    label_selector=self.label_selector,
+                    field_selector=self.field_selector,
+                )
             except GoneError:
                 continue  # immediately relist
             try:
@@ -128,7 +139,11 @@ class Informer:
         add/update/delete diffs against the previous contents (DeltaFIFO
         Replace/Sync semantics)."""
         self.relist_count += 1
-        items, rv = self.api.list(self.kind)
+        items, rv = self.api.list(
+            self.kind,
+            label_selector=self.label_selector,
+            field_selector=self.field_selector,
+        )
         fresh = {_key_of(o): o for o in items}
         with self._lock:
             old = self._store

@@ -19,8 +19,14 @@ from typing import Any, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from ..api.types import (
+    daemonset_from_k8s,
+    daemonset_to_k8s,
     deployment_from_k8s,
     deployment_to_k8s,
+    endpoints_from_k8s,
+    endpoints_to_k8s,
+    namespace_from_k8s,
+    namespace_to_k8s,
     job_from_k8s,
     job_to_k8s,
     node_from_k8s,
@@ -31,6 +37,10 @@ from ..api.types import (
     priorityclass_to_k8s,
     replicaset_from_k8s,
     replicaset_to_k8s,
+    service_from_k8s,
+    service_to_k8s,
+    statefulset_from_k8s,
+    statefulset_to_k8s,
 )
 from ..apiserver.admission import AdmissionError
 from ..apiserver.http import _lease_from_k8s, _lease_to_k8s
@@ -46,6 +56,11 @@ _CODECS = {
     "events": (event_to_k8s, event_from_k8s),
     "leases": (_lease_to_k8s, _lease_from_k8s),
     "priorityclasses": (priorityclass_to_k8s, priorityclass_from_k8s),
+    "statefulsets": (statefulset_to_k8s, statefulset_from_k8s),
+    "daemonsets": (daemonset_to_k8s, daemonset_from_k8s),
+    "services": (service_to_k8s, service_from_k8s),
+    "endpoints": (endpoints_to_k8s, endpoints_from_k8s),
+    "namespaces": (namespace_to_k8s, namespace_from_k8s),
 }
 
 
@@ -136,17 +151,33 @@ class RemoteAPIServer:
 
     # -- FakeAPIServer surface ------------------------------------------------
 
-    def list(self, kind: str) -> Tuple[List[Any], int]:
-        d = self._req("GET", f"/api/v1/{kind}")
+    @staticmethod
+    def _sel_params(label_selector, field_selector) -> str:
+        from urllib.parse import quote
+
+        parts = []
+        for name, sel in (("labelSelector", label_selector),
+                          ("fieldSelector", field_selector)):
+            if sel:
+                parts.append(
+                    f"{name}=" + quote(",".join(f"{k}={v}" for k, v in sel.items()))
+                )
+        return ("&" + "&".join(parts)) if parts else ""
+
+    def list(self, kind: str, label_selector=None, field_selector=None) -> Tuple[List[Any], int]:
+        qs = self._sel_params(label_selector, field_selector)
+        d = self._req("GET", f"/api/v1/{kind}?l=1{qs}")
         _, from_k8s = _CODECS[kind]
         rv = int(d.get("metadata", {}).get("resourceVersion", 0))
         return [from_k8s(o) for o in d.get("items", [])], rv
 
-    def watch(self, kind: str, since_rv: int) -> _RemoteWatcher:
+    def watch(self, kind: str, since_rv: int, label_selector=None,
+              field_selector=None) -> _RemoteWatcher:
         _, from_k8s = _CODECS[kind]
+        qs = self._sel_params(label_selector, field_selector)
         conn = self._conn(timeout=None)  # streams block until events arrive
         conn.request(
-            "GET", f"/api/v1/{kind}?watch=1&resourceVersion={since_rv}"
+            "GET", f"/api/v1/{kind}?watch=1&resourceVersion={since_rv}{qs}"
         )
         resp = conn.getresponse()
         if resp.status == 410:

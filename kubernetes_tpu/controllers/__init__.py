@@ -2,14 +2,30 @@
 
 The reference starts ~35 reconcile loops from one binary
 (cmd/kube-controller-manager/app/controllermanager.go:373
-NewControllerInitializers). This package rebuilds the two that close the
-scheduling loop — workload replication and node health — as informer-driven
-reconcilers over the fake apiserver:
+NewControllerInitializers). This package rebuilds the nine that close the
+scheduling loop — workload replication, node health, ownership, service
+membership, and namespace lifecycle — as informer-driven reconcilers over
+the (fake or HTTP) apiserver:
 
   * ReplicaSetController (pkg/controller/replicaset/replica_set.go):
     selector/owner-matched live pods vs .spec.replicas; creates missing
     replicas from the template, deletes surplus (pending-first victim
     order), replaces Failed pods.
+  * DeploymentController (pkg/controller/deployment/): template-hash
+    ReplicaSet generations.
+  * JobController (pkg/controller/job/): parallelism/completions.
+  * StatefulSetController (pkg/controller/statefulset/): stable ordinal
+    identities, OrderedReady rollout, reverse-order scale-down.
+  * DaemonSetController (pkg/controller/daemon/): one pod per eligible
+    node, placed by the DEFAULT scheduler through a matchFields
+    metadata.name affinity pin (ScheduleDaemonSetPods semantics).
+  * EndpointsController (pkg/controller/endpoint/): Service selector →
+    live backend membership.
+  * GarbageCollectorController (pkg/controller/garbagecollector/):
+    ownerReference cascade — deleting a Deployment deletes its
+    ReplicaSets, whose deletes delete their pods.
+  * NamespaceController (pkg/controller/namespace/): Terminating
+    namespaces drain every namespaced object, then finalize.
   * NodeLifecycleController (pkg/controller/nodelifecycle/): node Ready
     condition → not-ready/unreachable taints (NoSchedule + NoExecute), and
     NoExecute eviction of pods without a matching toleration — which is
@@ -20,19 +36,30 @@ Controllers share one informer set and drain per-controller workqueues
 (client-go util/workqueue semantics: dedup-while-pending, re-add-after-get).
 """
 
+from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .endpoints import EndpointsController
+from .garbagecollector import GarbageCollectorController
 from .job import JobController
-from .manager import ControllerManager
+from .manager import DEFAULT_CONTROLLERS, ControllerManager
+from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController, TAINT_NOT_READY
 from .replicaset import ReplicaSetController
+from .statefulset import StatefulSetController
 from .workqueue import WorkQueue
 
 __all__ = [
     "ControllerManager",
+    "DEFAULT_CONTROLLERS",
+    "DaemonSetController",
     "DeploymentController",
+    "EndpointsController",
+    "GarbageCollectorController",
     "JobController",
+    "NamespaceController",
     "NodeLifecycleController",
     "ReplicaSetController",
+    "StatefulSetController",
     "TAINT_NOT_READY",
     "WorkQueue",
 ]

@@ -1,0 +1,150 @@
+"""DaemonSet controller: one pod per eligible node, scheduled by the
+DEFAULT scheduler.
+
+Reference: pkg/controller/daemon/daemon_controller.go. In this reference
+era (ScheduleDaemonSetPods on by default) the controller does NOT bind
+pods itself: each daemon pod carries a node-affinity pin
+(util.ReplaceDaemonSetPodNodeNameNodeAffinity — a required matchFields
+metadata.name In [node] term) plus the standard daemon tolerations
+(util.AddOrUpdateDaemonPodTolerations: not-ready/unreachable NoExecute,
+unschedulable/disk-pressure/memory-pressure NoSchedule), and the default
+scheduler places it — taints, resources, and the pin all flow through the
+normal Filter path (our device mask's OP_NAME_IN handles the pin).
+
+Eligibility (nodeShouldRunDaemonPod, simplified to the scheduling-visible
+parts): the template's nodeSelector must match the node's labels; taint
+tolerance is the SCHEDULER's job (the added tolerations express the
+daemon contract).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ..api.types import (
+    Affinity,
+    DaemonSet,
+    Node,
+    NodeAffinity,
+    NodeSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    Toleration,
+)
+from .podowner import new_child_pod, owned_by
+
+logger = logging.getLogger("kubernetes_tpu.controllers.daemonset")
+
+DAEMON_TOLERATIONS = [
+    Toleration(key="node.kubernetes.io/not-ready", operator="Exists", effect="NoExecute"),
+    Toleration(key="node.kubernetes.io/unreachable", operator="Exists", effect="NoExecute"),
+    Toleration(key="node.kubernetes.io/unschedulable", operator="Exists", effect="NoSchedule"),
+    Toleration(key="node.kubernetes.io/disk-pressure", operator="Exists", effect="NoSchedule"),
+    Toleration(key="node.kubernetes.io/memory-pressure", operator="Exists", effect="NoSchedule"),
+]
+
+
+def _node_pin(node_name: str) -> Affinity:
+    """ReplaceDaemonSetPodNodeNameNodeAffinity: required matchFields
+    metadata.name In [node]."""
+    return Affinity(
+        node_affinity=NodeAffinity(
+            required=NodeSelector(
+                node_selector_terms=[
+                    NodeSelectorTerm(
+                        match_fields=[
+                            NodeSelectorRequirement(
+                                key="metadata.name", operator="In", values=[node_name]
+                            )
+                        ]
+                    )
+                ]
+            )
+        )
+    )
+
+
+class DaemonSetController:
+    def __init__(self, api, ds_informer, node_informer, pod_informer, queue):
+        self.api = api
+        self.ds_informer = ds_informer
+        self.node_informer = node_informer
+        self.pod_informer = pod_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.ds_informer.add_event_handler(
+            on_add=lambda ds: self.queue.add(ds.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+            on_delete=lambda ds: self.queue.add(ds.key()),
+        )
+        # node membership changes re-reconcile every daemonset
+        self.node_informer.add_event_handler(
+            on_add=lambda n: self._enqueue_all(),
+            on_delete=lambda n: self._enqueue_all(),
+        )
+        self.pod_informer.add_event_handler(
+            on_delete=lambda p: self._enqueue_owner(p),
+        )
+
+    def _enqueue_all(self) -> None:
+        for ds in self.ds_informer.list():
+            self.queue.add(ds.key())
+
+    def _enqueue_owner(self, pod: Pod) -> None:
+        for ref in pod.owner_references:
+            if ref.get("controller") and ref.get("kind") == "DaemonSet":
+                self.queue.add(f"{pod.namespace}/{ref.get('name')}")
+                return
+
+    def _eligible(self, ds: DaemonSet, node: Node) -> bool:
+        tmpl = ds.template or Pod()
+        return all(node.labels.get(k) == v for k, v in tmpl.node_selector.items())
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        ds: Optional[DaemonSet] = self.ds_informer.get(key)
+        if ds is None:
+            return  # deletion cascade is the GC's job
+        nodes = {n.name: n for n in self.node_informer.list()}
+        want = {nm for nm, n in nodes.items() if self._eligible(ds, n)}
+        have: dict = {}
+        for p in self.pod_informer.list():
+            if not owned_by(p, ds.uid) or p.phase in ("Failed", "Succeeded"):
+                continue
+            target = p.node_name or _pinned_node(p)
+            have.setdefault(target, []).append(p)
+        for nm in sorted(want):
+            if nm not in have:
+                self.api.create("pods", self._daemon_pod(ds, nm))
+        for nm, pods in have.items():
+            surplus: List[Pod] = pods[1:] if nm in want else pods
+            for p in surplus:
+                try:
+                    self.api.delete("pods", p.key())
+                except KeyError:
+                    pass
+
+    def _daemon_pod(self, ds: DaemonSet, node_name: str) -> Pod:
+        pod = new_child_pod(ds.template, "DaemonSet", ds.name, ds.uid, ds.namespace)
+        pod.name = f"{ds.name}-{node_name}"
+        pod.affinity = _node_pin(node_name)
+        pod.tolerations = list((ds.template.tolerations if ds.template else [])) + [
+            t for t in DAEMON_TOLERATIONS
+        ]
+        return pod
+
+
+def _pinned_node(pod: Pod) -> str:
+    a = pod.affinity
+    try:
+        for term in a.node_affinity.required.node_selector_terms:
+            for req in term.match_fields:
+                if req.key == "metadata.name" and req.operator == "In" and req.values:
+                    return req.values[0]
+    except AttributeError:
+        pass
+    return ""

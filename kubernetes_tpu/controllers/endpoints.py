@@ -1,0 +1,80 @@
+"""Endpoints controller: Service selector → live backend membership.
+
+Reference: pkg/controller/endpoint/endpoints_controller.go syncService —
+for each Service, the Endpoints object of the same name lists the READY
+pods matched by the selector. Pod IPs are not modeled; membership is
+recorded as pod keys (the scheduling-visible contract the SelectorSpread/
+ServiceAntiAffinity priorities and the service listers consume)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api.types import Endpoints, Pod, Service
+from ..apiserver.store import ConflictError, NotFoundError
+
+logger = logging.getLogger("kubernetes_tpu.controllers.endpoints")
+
+
+def _selects(svc: Service, labels) -> bool:
+    """Service.spec.selector is a plain map: every pair must match; an
+    empty selector selects nothing (endpoints_controller.go skips
+    selector-less services)."""
+    return bool(svc.selector) and all(labels.get(k) == v for k, v in svc.selector.items())
+
+
+class EndpointsController:
+    def __init__(self, api, svc_informer, pod_informer, queue):
+        self.api = api
+        self.svc_informer = svc_informer
+        self.pod_informer = pod_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.svc_informer.add_event_handler(
+            on_add=lambda s: self.queue.add(s.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+            on_delete=lambda s: self.queue.add(s.key()),
+        )
+        self.pod_informer.add_event_handler(
+            on_add=lambda p: self._enqueue_matching(p),
+            on_update=lambda old, new: self._enqueue_matching(new),
+            on_delete=lambda p: self._enqueue_matching(p),
+        )
+
+    def _enqueue_matching(self, pod: Pod) -> None:
+        for svc in self.svc_informer.list():
+            if svc.namespace == pod.namespace and _selects(svc, pod.labels):
+                self.queue.add(svc.key())
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        svc: Optional[Service] = self.svc_informer.get(key)
+        if svc is None:
+            # service gone → endpoints follow (syncService's delete branch)
+            try:
+                self.api.delete("endpoints", key)
+            except KeyError:
+                pass
+            return
+        addrs = sorted(
+            p.key()
+            for p in self.pod_informer.list()
+            if p.namespace == svc.namespace
+            and _selects(svc, p.labels)
+            and p.node_name  # scheduled (ready-gate proxy)
+            and p.phase not in ("Failed", "Succeeded")
+        )
+        ep = Endpoints(name=svc.name, namespace=svc.namespace, addresses=addrs)
+        try:
+            current = self.api.get("endpoints", ep.key())
+            if current.addresses == addrs:
+                return  # no-op update suppression (the controller's courtesy)
+            self.api.update("endpoints", ep)
+        except (KeyError, NotFoundError):
+            try:
+                self.api.create("endpoints", ep)
+            except ConflictError:
+                self.api.update("endpoints", ep)

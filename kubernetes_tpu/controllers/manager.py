@@ -16,18 +16,28 @@ import threading
 from typing import Dict, List, Optional
 
 from ..client.informer import Informer
+from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .endpoints import EndpointsController
+from .garbagecollector import GarbageCollectorController
 from .job import JobController
+from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
 from .replicaset import ReplicaSetController
+from .statefulset import StatefulSetController
 from .workqueue import WorkQueue
 
 logger = logging.getLogger("kubernetes_tpu.controllers.manager")
 
+DEFAULT_CONTROLLERS = (
+    "deployment", "replicaset", "job", "nodelifecycle",
+    "garbagecollector", "daemonset", "endpoints", "statefulset", "namespace",
+)
+
 
 class ControllerManager:
     def __init__(self, api,
-                 controllers=("deployment", "replicaset", "job", "nodelifecycle"),
+                 controllers=DEFAULT_CONTROLLERS,
                  node_monitor_grace_s=None):
         self.api = api
         self.informers: Dict[str, Informer] = {
@@ -36,6 +46,11 @@ class ControllerManager:
             "replicasets": Informer(api, "replicasets"),
             "deployments": Informer(api, "deployments"),
             "jobs": Informer(api, "jobs"),
+            "statefulsets": Informer(api, "statefulsets"),
+            "daemonsets": Informer(api, "daemonsets"),
+            "services": Informer(api, "services"),
+            "endpoints": Informer(api, "endpoints"),
+            "namespaces": Informer(api, "namespaces"),
         }
         self.controllers = []
         self._queues: List[WorkQueue] = []
@@ -62,6 +77,42 @@ class ControllerManager:
                 api, self.informers["jobs"], self.informers["pods"], q
             )
             self.controllers.append(self.job)
+            self._queues.append(q)
+        if "statefulset" in controllers:
+            q = WorkQueue()
+            self.statefulset = StatefulSetController(
+                api, self.informers["statefulsets"], self.informers["pods"], q
+            )
+            self.controllers.append(self.statefulset)
+            self._queues.append(q)
+        if "daemonset" in controllers:
+            q = WorkQueue()
+            self.daemonset = DaemonSetController(
+                api, self.informers["daemonsets"], self.informers["nodes"],
+                self.informers["pods"], q,
+            )
+            self.controllers.append(self.daemonset)
+            self._queues.append(q)
+        if "endpoints" in controllers:
+            q = WorkQueue()
+            self.endpoints = EndpointsController(
+                api, self.informers["services"], self.informers["pods"], q
+            )
+            self.controllers.append(self.endpoints)
+            self._queues.append(q)
+        if "garbagecollector" in controllers:
+            q = WorkQueue()
+            self.garbagecollector = GarbageCollectorController(
+                api, self.informers, q
+            )
+            self.controllers.append(self.garbagecollector)
+            self._queues.append(q)
+        if "namespace" in controllers:
+            q = WorkQueue()
+            self.namespace = NamespaceController(
+                api, self.informers["namespaces"], q
+            )
+            self.controllers.append(self.namespace)
             self._queues.append(q)
         if "nodelifecycle" in controllers:
             q = WorkQueue()

@@ -1,0 +1,71 @@
+"""Namespace lifecycle controller: Terminating namespaces drain.
+
+Reference: pkg/controller/namespace/deletion/
+namespaced_resources_deleter.go — a namespace marked Terminating has
+every namespaced resource deleted, then the namespace itself is removed
+(finalization). The store has no finalizers; the observable contract is
+the same: set phase=Terminating (or delete the Namespace object) and the
+namespace's contents go away."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api.types import Namespace
+
+logger = logging.getLogger("kubernetes_tpu.controllers.namespace")
+
+# every namespaced kind the store may hold
+NAMESPACED_KINDS = (
+    "pods", "replicasets", "deployments", "jobs", "statefulsets",
+    "daemonsets", "services", "endpoints", "events",
+)
+
+
+class NamespaceController:
+    def __init__(self, api, ns_informer, queue):
+        self.api = api
+        self.ns_informer = ns_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.ns_informer.add_event_handler(
+            on_add=lambda ns: self.queue.add(ns.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+            on_delete=lambda ns: self.queue.add(ns.key()),
+        )
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        ns: Optional[Namespace] = self.ns_informer.get(key)
+        if ns is not None and ns.phase != "Terminating":
+            return
+        # Terminating OR deleted outright: drain the namespace's contents
+        self._drain(key)
+        if ns is not None:
+            # finalize: contents gone → the namespace object goes away
+            try:
+                self.api.delete("namespaces", key)
+            except KeyError:
+                pass
+
+    def _drain(self, namespace: str) -> int:
+        removed = 0
+        for kind in NAMESPACED_KINDS:
+            try:
+                items, _ = self.api.list(kind)
+            except Exception:
+                continue
+            for obj in items:
+                if getattr(obj, "namespace", None) != namespace:
+                    continue
+                try:
+                    self.api.delete(kind, obj.key())
+                    removed += 1
+                except KeyError:
+                    pass
+        if removed:
+            logger.info("namespace %s: drained %d objects", namespace, removed)
+        return removed

@@ -1,0 +1,103 @@
+"""StatefulSet controller: stable ordinal identities, ordered rollout.
+
+Reference: pkg/controller/statefulset/stateful_set.go +
+stateful_set_control.go UpdateStatefulSet: pods are named
+<set>-<ordinal>; scale-up creates ordinal i only once 0..i-1 are created
+and Running (OrderedReady pod management), scale-down deletes the highest
+ordinal first, one at a time. Identity is stable: a failed/evicted
+ordinal is re-created with the SAME name (the re-created pod flows
+through the scheduler again)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from ..api.types import Pod, StatefulSet, _new_uid
+from .podowner import owned_by
+
+logger = logging.getLogger("kubernetes_tpu.controllers.statefulset")
+
+
+class StatefulSetController:
+    def __init__(self, api, ss_informer, pod_informer, queue):
+        self.api = api
+        self.ss_informer = ss_informer
+        self.pod_informer = pod_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.ss_informer.add_event_handler(
+            on_add=lambda s: self.queue.add(s.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+            on_delete=lambda s: self.queue.add(s.key()),
+        )
+        self.pod_informer.add_event_handler(
+            on_add=lambda p: self._enqueue_owner(p),
+            on_update=lambda old, new: self._enqueue_owner(new),
+            on_delete=lambda p: self._enqueue_owner(p),
+        )
+
+    def _enqueue_owner(self, pod: Pod) -> None:
+        for ref in pod.owner_references:
+            if ref.get("controller") and ref.get("kind") == "StatefulSet":
+                self.queue.add(f"{pod.namespace}/{ref.get('name')}")
+                return
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        ss: Optional[StatefulSet] = self.ss_informer.get(key)
+        if ss is None:
+            return  # cascade is the GC's job
+        by_ordinal: Dict[int, Pod] = {}
+        for p in self.pod_informer.list():
+            if not owned_by(p, ss.uid):
+                continue
+            ordinal = _ordinal_of(ss.name, p.name)
+            if ordinal is not None and p.phase not in ("Failed", "Succeeded"):
+                by_ordinal[ordinal] = p
+        # scale-down first: highest ordinal, one per sync (OrderedReady)
+        surplus = sorted((o for o in by_ordinal if o >= ss.replicas), reverse=True)
+        if surplus:
+            victim = by_ordinal[surplus[0]]
+            try:
+                self.api.delete("pods", victim.key())
+            except KeyError:
+                pass
+            return
+        # scale-up: the lowest missing ordinal, only if every lower ordinal
+        # is Running (the ordered-readiness gate)
+        for i in range(ss.replicas):
+            p = by_ordinal.get(i)
+            if p is None:
+                self.api.create("pods", self._ordinal_pod(ss, i))
+                return
+            if p.phase != "Running":
+                return  # wait for i to become Ready before i+1
+
+    def _ordinal_pod(self, ss: StatefulSet, ordinal: int) -> Pod:
+        t = ss.template or Pod()
+        pod = t.with_node("")
+        pod.name = f"{ss.name}-{ordinal}"
+        pod.namespace = ss.namespace
+        pod.uid = _new_uid()
+        pod.phase = "Pending"
+        import time as _time
+
+        pod.creation_timestamp = _time.time()
+        pod.labels = dict(t.labels)
+        pod.owner_references = [
+            {"uid": ss.uid, "controller": True, "kind": "StatefulSet", "name": ss.name}
+        ]
+        return pod
+
+
+def _ordinal_of(set_name: str, pod_name: str) -> Optional[int]:
+    prefix = set_name + "-"
+    if not pod_name.startswith(prefix):
+        return None
+    try:
+        return int(pod_name[len(prefix):])
+    except ValueError:
+        return None

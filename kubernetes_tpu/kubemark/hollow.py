@@ -121,7 +121,11 @@ class HollowKubelet:
     def _pods(self) -> List[Pod]:
         if self._pod_informer is not None:
             return self._pod_informer.list()
-        pods, _ = self.api.list("pods")
+        # no informer wired: list ONLY this node's pods (the kubelet's
+        # spec.nodeName field selector — reflector.go's pods-by-node watch)
+        pods, _ = self.api.list(
+            "pods", field_selector={"spec.nodeName": self.node_name}
+        )
         return pods
 
     def _ack_pods(self) -> None:
@@ -148,24 +152,41 @@ class HollowKubelet:
 
 
 class HollowCluster:
-    """N hollow kubelets over one shared pod informer (the kubemark
-    controller's shape: one watch, many node agents)."""
+    """N hollow kubelets. By default each kubelet runs its own
+    field-selected pod informer (`spec.nodeName=<node>`) — the real
+    kubelet topology: the apiserver filters server-side, so node agents
+    never receive the whole cluster's pod events. `shared_informer=True`
+    restores the single-watch kubemark-controller shape (cheaper for
+    thousands of in-process kubelets in one test)."""
 
-    def __init__(self, api, nodes: List[Node], heartbeat_s: float = 1.0):
+    def __init__(self, api, nodes: List[Node], heartbeat_s: float = 1.0,
+                 shared_informer: bool = False):
         from ..client.informer import Informer
 
         self.api = api
-        self.pod_informer = Informer(api, "pods")
-        self.kubelets: Dict[str, HollowKubelet] = {
-            n.name: HollowKubelet(
-                api, n, pod_informer=self.pod_informer, heartbeat_s=heartbeat_s
+        self.pod_informer = Informer(api, "pods") if shared_informer else None
+        self._informers: List = []
+        self.kubelets: Dict[str, HollowKubelet] = {}
+        for n in nodes:
+            if shared_informer:
+                inf = self.pod_informer
+            else:
+                inf = Informer(
+                    api, "pods", field_selector={"spec.nodeName": n.name}
+                )
+                self._informers.append(inf)
+            self.kubelets[n.name] = HollowKubelet(
+                api, n, pod_informer=inf, heartbeat_s=heartbeat_s
             )
-            for n in nodes
-        }
 
     def start(self) -> "HollowCluster":
-        self.pod_informer.start()
-        self.pod_informer.wait_for_sync()
+        if self.pod_informer is not None:
+            self.pod_informer.start()
+            self.pod_informer.wait_for_sync()
+        for inf in self._informers:
+            inf.start()
+        for inf in self._informers:
+            inf.wait_for_sync()
         for k in self.kubelets.values():
             k.start()
         return self
@@ -178,4 +199,7 @@ class HollowCluster:
     def stop(self) -> None:
         for k in self.kubelets.values():
             k.stop()
-        self.pod_informer.stop()
+        if self.pod_informer is not None:
+            self.pod_informer.stop()
+        for inf in self._informers:
+            inf.stop()

@@ -9,6 +9,7 @@ Thread-safe; lock granularity is per-metric.
 
 from __future__ import annotations
 
+import random as _random
 import threading
 from bisect import bisect_left
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -109,6 +110,46 @@ class Histogram(_Metric):
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
         self._totals: Dict[Tuple[str, ...], int] = {}
+        # optional raw-sample reservoir for EXACT percentiles: bucket upper
+        # bounds are honest for serving /metrics, but a benchmark quoting
+        # "p99 pod-schedule latency" must not round to a coarse tail bucket
+        self._samples: Optional[List[float]] = None
+        self._sample_cap = 0
+        self._sample_seen = 0
+
+    def enable_sampling(self, cap: int = 1 << 18) -> None:
+        """Keep raw observed values (uniform reservoir past `cap`) so
+        exact_percentile() can answer to full resolution."""
+        with self._lock:
+            self._samples = []
+            self._sample_cap = cap
+            self._sample_seen = 0
+
+    def reset_samples(self) -> None:
+        with self._lock:
+            if self._samples is not None:
+                self._samples = []
+                self._sample_seen = 0
+
+    def _sample_locked(self, value: float) -> None:
+        s = self._samples
+        self._sample_seen += 1
+        if len(s) < self._sample_cap:
+            s.append(value)
+            return
+        j = _random.randrange(self._sample_seen)
+        if j < self._sample_cap:
+            s[j] = value
+
+    def exact_percentile(self, q: float) -> Optional[float]:
+        """Exact (reservoir-sampled past cap) percentile of the raw values
+        seen since enable_sampling/reset_samples; None without samples."""
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+        i = min(int(q * len(s)), len(s) - 1)
+        return s[i]
 
     def observe(self, value: float, *labels: str) -> None:
         idx = bisect_left(self.buckets, value)
@@ -119,6 +160,8 @@ class Histogram(_Metric):
             counts[idx] += 1
             self._sums[labels] = self._sums.get(labels, 0.0) + value
             self._totals[labels] = self._totals.get(labels, 0) + 1
+            if self._samples is not None:
+                self._sample_locked(value)
 
     def observe_many(self, values: Sequence[float], *labels: str) -> None:
         """Batched observe: one lock acquisition for a whole batch of
@@ -135,6 +178,9 @@ class Histogram(_Metric):
                 counts[i] += 1
             self._sums[labels] = self._sums.get(labels, 0.0) + float(sum(values))
             self._totals[labels] = self._totals.get(labels, 0) + len(values)
+            if self._samples is not None:
+                for v in values:
+                    self._sample_locked(v)
 
     def count(self, *labels: str) -> int:
         with self._lock:

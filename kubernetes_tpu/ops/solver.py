@@ -143,10 +143,11 @@ def solve_greedy(
     ca0/cb0 [TT,V]f32, cs0 [U,N]f32.
 
     Sequential equivalence with tracking: commits stay a strict prefix of
-    the undecided order, truncated at the first SENSITIVE pod (one whose
-    feasibility other commits can change, or whose commit can change
-    others'), so every committed pod's anti/port mask reflects exactly the
-    commits sequentially before it."""
+    the undecided order, truncated at the first pod whose anti/port mask an
+    EARLIER in-round candidate commit could actually change (same term at
+    the same topology bucket, or a port conflict on the same node) — the
+    pairwise barrier in the body; every committed pod's mask reflects
+    exactly the commits sequentially before it."""
     U, N = mask.shape
     if req_any is None:
         req_any = jnp.any(req > 0, axis=-1)
@@ -183,17 +184,6 @@ def solve_greedy(
         TT = t_anti.shape[0]
         t_rows = jnp.arange(TT, dtype=jnp.int32)[:, None]
         Vb = ca0.shape[1]
-        # a spec is SENSITIVE if commits can move its anti/port feasibility
-        # or its commit can move others': owns an anti term, is matched by
-        # one, or carries host ports (pconf diagonal: self-conflict)
-        own_any = (
-            jnp.zeros((U + 1,), bool)
-            .at[jnp.where(t_anti, t_owner, U)]
-            .max(t_anti, mode="drop")[:U]
-        )
-        sens_u = own_any | jnp.any(m_bb, axis=0) | jnp.diagonal(pconf)
-    else:
-        sens_u = None
 
     def chunk_step(carry, inp):
         free, count, nzacc, ca, cb, cs = carry
@@ -206,7 +196,6 @@ def solve_greedy(
         r_any = req_any[sg]  # [K]
         s_q = scoring_req[sg]  # [K, 2]
         if track:
-            sens_k = sens_u[sg]  # [K]
             ownK = (t_owner[None, :] == sg[:, None]) & t_anti[None, :]  # [K, TT]
             mbbK = m_bb[:, sg].T  # [K, TT]
             pconfK = pconf[sg].astype(jnp.float32)  # [K, U]
@@ -275,12 +264,52 @@ def solve_greedy(
             first_rej = jnp.min(jnp.where(rejected, jrange, K))
             commit = active & (jrange < first_rej)
             if track:
-                # commit barrier: nothing past the first sensitive pod
-                # commits this round, so a committed pod's anti/port mask
-                # saw exactly the commits sequentially before it (the first
-                # active pod is always committable → progress holds)
-                first_sens = jnp.min(jnp.where(active & sens_k, jrange, K))
-                commit = commit & (jrange <= first_sens)
+                # commit barrier, PAIRWISE-EXACT via scatter-min: pod j must
+                # not commit this round if an earlier candidate commit i
+                # could change j's anti/port mask — i contributes to a ca/cb
+                # row j reads AT THE SAME topology bucket, or i's commit
+                # port-conflicts j's spec on j's chosen node. Everything
+                # before the first such j commits together (an all-sensitive
+                # batch of same-spec anti pods landing in DISTINCT buckets
+                # commits as one round — the old first-sensitive-pod barrier
+                # made that one pod per round, B serial iterations on the
+                # quadratic config). Computed as min-candidate-index tables
+                # per (term, bucket) and (spec, node) — the same shapes as
+                # the ca/cb/cs updates, not a [TT, K, K] pairwise tensor.
+                # The first active pod is never blocked → progress holds; a
+                # committed pod's mask saw every sequentially-earlier commit.
+                cand_ok = active & (jrange < first_rej)
+                cidx3 = jnp.where(cand_ok, cand, 0)
+                bK = bucket_n[:, cidx3]  # [TT, K] bucket of each choice
+                hkK = haskey_n[:, cidx3] & cand_ok[None, :]
+                contrib = m_bb[:, sg] & hkK  # i bumps ca[t, bK[t, i]]
+                ownk_t = ownK.T & hkK  # j reads ca[t, bK[t, j]]
+                idxK = jnp.broadcast_to(jrange[None, :], bK.shape).astype(jnp.int32)
+                mi_contrib = jnp.full((TT, Vb), K, jnp.int32).at[
+                    t_rows, jnp.where(contrib, bK, Vb)
+                ].min(idxK, mode="drop")
+                mi_own = jnp.full((TT, Vb), K, jnp.int32).at[
+                    t_rows, jnp.where(ownk_t, bK, Vb)
+                ].min(idxK, mode="drop")
+                g_contrib = jnp.take_along_axis(
+                    mi_contrib, jnp.where(hkK, bK, 0), axis=1
+                )  # [TT, K] earliest same-bucket contributor
+                g_own = jnp.take_along_axis(mi_own, jnp.where(hkK, bK, 0), axis=1)
+                blockA_j = jnp.any(ownk_t & (g_contrib < jrange[None, :]), axis=0)
+                blockB_j = jnp.any(contrib & (g_own < jrange[None, :]), axis=0)
+                # ports: earliest candidate per (spec, node); j blocked when
+                # a port-conflicting spec has an earlier candidate on j's
+                # chosen node
+                mi_sn = jnp.full((U, N), K, jnp.int32).at[
+                    jnp.where(cand_ok, sg, U), cidx3
+                ].min(jnp.where(cand_ok, jrange, K).astype(jnp.int32), mode="drop")
+                g_sn = mi_sn[:, cidx3]  # [U, K] per spec, at j's node
+                blockP_j = jnp.any(
+                    (pconfK.T > 0.5)[:, :] & (g_sn < jrange[None, :]), axis=0
+                )
+                blocked = cand_ok & (blockA_j | blockB_j | blockP_j)
+                first_block = jnp.min(jnp.where(blocked, jrange, K))
+                commit = commit & (jrange < first_block)
             # apply commits (duplicate indices accumulate; index N drops)
             target = jnp.where(commit, cand, N)
             free = free.at[target].add(

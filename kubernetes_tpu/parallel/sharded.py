@@ -66,6 +66,7 @@ def _solver_body(
     *,
     deterministic: bool,
     n_local: int,
+    n_shards: int = 1,
 ):
     """shard_map body: chunked prefix-acceptance greedy (the multi-chip
     twin of ops.solver.solve_greedy, bit-identical results). Pods are
@@ -103,12 +104,6 @@ def _solver_body(
         TT = t_anti.shape[0]
         t_rows = jnp.arange(TT, dtype=jnp.int32)[:, None]
         Vb = ca0.shape[1]
-        own_any = (
-            jnp.zeros((U + 1,), bool)
-            .at[jnp.where(t_anti, t_owner, U)]
-            .max(t_anti, mode="drop")[:U]
-        )
-        sens_u = own_any | jnp.any(m_bb, axis=0) | jnp.diagonal(pconf)
     else:
         _z = jnp.zeros((1, 1), jnp.float32)
         ca0 = cb0 = _z
@@ -125,7 +120,6 @@ def _solver_body(
         r_any = req_any[sg]
         s_q = scoring_req[sg]  # [K, 2]
         if track:
-            sens_k = sens_u[sg]
             ownK = (t_owner[None, :] == sg[:, None]) & t_anti[None, :]  # [K, TT]
             mbbK = m_bb[:, sg].T  # [K, TT]
             pconfK = pconf[sg].astype(jnp.float32)  # [K, U]
@@ -201,11 +195,49 @@ def _solver_body(
             )
             commit = active & (jrange < first_rej)
             if track:
-                # commit barrier (ops/solver.py contract): nothing past the
-                # first sensitive pod commits this round, so a committed
-                # pod's anti/port mask saw exactly the prior commits
-                first_sens = jnp.min(jnp.where(active & sens_k, jrange, K))
-                commit = commit & (jrange <= first_sens)
+                # scatter-min commit barrier (ops/solver.py contract, multi-
+                # chip twin): every candidate's topology bucket + haskey bit
+                # is pmax-broadcast from its node's owner shard, then the
+                # replicated min-candidate-index tables truncate at the
+                # first pod an earlier in-round commit could affect
+                cand_ok = active & (jrange < first_rej)
+                lidx3 = jnp.where(local & cand_ok, lidx, 0)
+                bK = jnp.where(
+                    (local & cand_ok)[None, :], bucket_nl[:, lidx3], -1
+                )  # [TT, K] local half
+                bK = jax.lax.pmax(bK, AXIS_NODES)  # owner shard wins
+                hkK = jax.lax.pmax(
+                    haskey_nl[:, lidx3] & (local & cand_ok)[None, :], AXIS_NODES
+                )
+                contrib = m_bb[:, sg] & hkK
+                ownk_t = ownK.T & hkK
+                idxK = jnp.broadcast_to(jrange[None, :], bK.shape).astype(jnp.int32)
+                TT = bK.shape[0]
+                mi_contrib = jnp.full((TT, Vb), K, jnp.int32).at[
+                    t_rows, jnp.where(contrib, bK, Vb)
+                ].min(idxK, mode="drop")
+                mi_own = jnp.full((TT, Vb), K, jnp.int32).at[
+                    t_rows, jnp.where(ownk_t, bK, Vb)
+                ].min(idxK, mode="drop")
+                g_contrib = jnp.take_along_axis(
+                    mi_contrib, jnp.where(hkK, bK, 0), axis=1
+                )
+                g_own = jnp.take_along_axis(mi_own, jnp.where(hkK, bK, 0), axis=1)
+                blockA_j = jnp.any(ownk_t & (g_contrib < jrange[None, :]), axis=0)
+                blockB_j = jnp.any(contrib & (g_own < jrange[None, :]), axis=0)
+                U_ = mask.shape[0]
+                n_total = n_local * n_shards
+                cg = jnp.where(cand_ok, cand, 0)
+                mi_sn = jnp.full((U_, n_total), K, jnp.int32).at[
+                    jnp.where(cand_ok, sg, U_), cg
+                ].min(jnp.where(cand_ok, jrange, K).astype(jnp.int32), mode="drop")
+                g_sn = mi_sn[:, cg]  # [U, K]
+                blockP_j = jnp.any(
+                    (pconfK.T > 0.5) & (g_sn < jrange[None, :]), axis=0
+                )
+                blocked = cand_ok & (blockA_j | blockB_j | blockP_j)
+                first_block = jnp.min(jnp.where(blocked, jrange, K))
+                commit = commit & (jrange < first_block)
             mine = commit & local
             target = jnp.where(mine, lidx, n_local)
             free = free.at[target].add(-(mine[:, None] * r_q), mode="drop")
@@ -339,7 +371,12 @@ def make_sharded_pipeline(mesh: Mesh):
             inb = None
             in_specs = base_specs
         solver = jax.shard_map(
-            partial(_solver_body, deterministic=deterministic, n_local=n_local),
+            partial(
+                _solver_body,
+                deterministic=deterministic,
+                n_local=n_local,
+                n_shards=n_shards,
+            ),
             mesh=mesh,
             in_specs=in_specs,
             out_specs=(

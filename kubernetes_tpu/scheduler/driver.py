@@ -237,14 +237,22 @@ class _BatchConflictIndex:
         value with any commit whose pod the term selects.
 
     Rolled-back gang members are tombstoned rather than unindexed (rollback
-    is rare; lookups skip them)."""
+    is rare; lookups skip them).
+
+    Buckets group their entries by SPEC (controller replicas share labels
+    and terms), and selector-match results are memoized per (direction,
+    commit spec, term index, candidate spec): a domain holding hundreds of
+    same-spec commits costs ONE match evaluation plus a liveness peek
+    instead of a pod_matches_term call per commit — the difference between
+    ~2us and ~250us per LIGHT recheck on the quadratic config."""
 
     def __init__(self):
-        # (key, value of commit node) → [(committed pod, its anti term)]
-        self._anti_by_kv: Dict[Tuple[str, str], List[Tuple[Pod, object]]] = {}
-        # (key, value of commit node) → [committed pods]
-        self._commits_by_kv: Dict[Tuple[str, str], List[Pod]] = {}
+        # (key, value of commit node) → {spec: [(committed pod, term, t_i)]}
+        self._anti_by_kv: Dict[Tuple[str, str], Dict] = {}
+        # (key, value of commit node) → {spec: [committed pods]}
+        self._commits_by_kv: Dict[Tuple[str, str], Dict] = {}
         self._rolled_back: set = set()
+        self._match_memo: Dict[Tuple, bool] = {}
         self.any_anti = False
         self.any_ports = False
         self.commits: List[Pod] = []  # flat, in commit order
@@ -253,34 +261,55 @@ class _BatchConflictIndex:
         self.commits.append(pod)
         if pod.host_ports():
             self.any_ports = True
+        spec = spec_key(pod)
         for kv in node.labels.items():
-            self._commits_by_kv.setdefault(kv, []).append(pod)
+            self._commits_by_kv.setdefault(kv, {}).setdefault(spec, []).append(pod)
 
     def add_anti(self, pod: Pod, node) -> None:
         self.any_anti = True
-        for term in get_pod_anti_affinity_terms(pod.affinity):
+        spec = spec_key(pod)
+        for t_i, term in enumerate(get_pod_anti_affinity_terms(pod.affinity)):
             k = term.topology_key
             v = node.labels.get(k) if k else None
             if v is not None:
-                self._anti_by_kv.setdefault((k, v), []).append((pod, term))
+                self._anti_by_kv.setdefault((k, v), {}).setdefault(
+                    spec, []
+                ).append((pod, term, t_i))
 
     def remove(self, pod: Pod) -> None:
         self._rolled_back.add(id(pod))
 
+    def _any_live(self, entries, pod_of=lambda e: e) -> bool:
+        return any(id(pod_of(e)) not in self._rolled_back for e in entries)
+
     def anti_conflict(self, pod: Pod, node) -> bool:
+        p_spec = spec_key(pod)
+        memo = self._match_memo
         for kv in node.labels.items():
-            for c, term in self._anti_by_kv.get(kv, ()):
-                if id(c) not in self._rolled_back and pod_matches_term(pod, c, term):
+            for c_spec, entries in self._anti_by_kv.get(kv, {}).items():
+                # one representative match per (commit spec, term, pod spec)
+                c, term, t_i = entries[0]
+                mk = ("A", c_spec, t_i, p_spec)
+                hit = memo.get(mk)
+                if hit is None:
+                    hit = pod_matches_term(pod, c, term)
+                    memo[mk] = hit
+                if hit and self._any_live(entries, lambda e: e[0]):
                     return True
         a = pod.affinity
         if a is not None and a.pod_anti_affinity is not None:
-            for term in a.pod_anti_affinity.required:
+            for t_i, term in enumerate(a.pod_anti_affinity.required):
                 k = term.topology_key
                 v = node.labels.get(k) if k else None
                 if v is None:
                     continue
-                for c in self._commits_by_kv.get((k, v), ()):
-                    if id(c) not in self._rolled_back and pod_matches_term(c, pod, term):
+                for c_spec, entries in self._commits_by_kv.get((k, v), {}).items():
+                    mk = ("B", p_spec, t_i, c_spec)
+                    hit = memo.get(mk)
+                    if hit is None:
+                        hit = pod_matches_term(entries[0], pod, term)
+                        memo[mk] = hit
+                    if hit and self._any_live(entries):
                         return True
         return False
 

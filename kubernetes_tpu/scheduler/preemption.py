@@ -18,7 +18,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..api.selectors import match_label_selector
 from ..api.types import Pod, PodDisruptionBudget
-from ..oracle.nodeinfo import NodeInfo, Snapshot
+from ..oracle.nodeinfo import DEFAULT_BIND_ALL_HOST_IP, NodeInfo, Snapshot
 from ..oracle.predicates import (
     check_node_unschedulable,
     compute_predicate_metadata,

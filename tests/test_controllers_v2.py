@@ -1,0 +1,331 @@
+"""Round-4 controllers: garbage collector (ownerReference cascade),
+DaemonSet (default-scheduler placement via matchFields pin), Endpoints,
+StatefulSet (ordered ordinals), Namespace lifecycle — each through the
+real scheduler loop where placement matters. Reference anchors:
+garbagecollector.go:83, daemon_controller.go, endpoints_controller.go,
+stateful_set.go, namespaced_resources_deleter.go."""
+
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Container,
+    DaemonSet,
+    LabelSelector,
+    Namespace,
+    Pod,
+    Quantity,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    ReplicaSet,
+    Service,
+    StatefulSet,
+)
+from kubernetes_tpu.apiserver import FakeAPIServer
+from kubernetes_tpu.client import APIBinder, start_scheduler_informers
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.models.generators import make_node
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.scheduler.eventhandlers import EventHandlers
+
+
+def _template(app: str, cpu="100m") -> Pod:
+    return Pod(
+        name="template", labels={"app": app},
+        containers=[Container(name="c", requests={
+            RESOURCE_CPU: Quantity.parse(cpu),
+            RESOURCE_MEMORY: Quantity.parse("64Mi"),
+        })],
+    )
+
+
+def _pods(api, app=None):
+    pods, _ = api.list("pods")
+    if app is None:
+        return pods
+    return [p for p in pods if p.labels.get("app") == app]
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def stack():
+    """apiserver + scheduler loop (driven manually) + controller manager."""
+    api = FakeAPIServer()
+    for i in range(3):
+        api.create("nodes", make_node(
+            f"n{i}", cpu_milli=4000, mem=8 * 2**30,
+            labels={"kubernetes.io/hostname": f"n{i}",
+                    "disk": "ssd" if i < 2 else "hdd"},
+        ))
+    sched = Scheduler(batch_size=16, deterministic=True, enable_preemption=False)
+    sched.binder = Binder(APIBinder(api).bind)
+    handlers = EventHandlers(sched.cache, sched.queue, "default-scheduler")
+    informers = start_scheduler_informers(api, handlers)
+    for inf in informers.values():
+        inf.wait_for_sync()
+    cm = ControllerManager(api).start()
+
+    def drain(expect, app=None, deadline=20.0):
+        end = time.monotonic() + deadline
+        while time.monotonic() < end:
+            sched.schedule_batch()
+            sched.wait_for_binds()
+            bound = [p for p in _pods(api, app) if p.node_name]
+            if len(bound) >= expect and cm.wait_idle(timeout=0.5):
+                return bound
+            time.sleep(0.05)
+        raise AssertionError(
+            f"drain: wanted {expect} bound, have "
+            f"{[(p.key(), p.node_name, p.phase) for p in _pods(api, app)]}"
+        )
+
+    yield api, sched, cm, drain
+    cm.stop()
+    for inf in informers.values():
+        inf.stop()
+
+
+def test_gc_cascades_deployment_to_pods(stack):
+    api, sched, cm, drain = stack
+    api.create("deployments", __import__(
+        "kubernetes_tpu.api.types", fromlist=["Deployment"]
+    ).Deployment(
+        name="web", replicas=4,
+        selector=LabelSelector(match_labels={"app": "web"}),
+        template=_template("web"),
+    ))
+    assert cm.wait_idle()
+    drain(4, "web")
+    # delete the Deployment DIRECTLY: GC must cascade RS → pods
+    api.delete("deployments", "default/web")
+    _wait(lambda: cm.wait_idle(0.5) and not api.list("replicasets")[0]
+          and not _pods(api, "web"),
+          msg="gc cascade deployment→rs→pods")
+    assert cm.garbagecollector.deleted >= 1
+
+
+def test_daemonset_one_pod_per_matching_node_via_scheduler(stack):
+    api, sched, cm, drain = stack
+    tmpl = _template("agent")
+    tmpl.node_selector = {"disk": "ssd"}
+    api.create("daemonsets", DaemonSet(
+        name="agent", selector=LabelSelector(match_labels={"app": "agent"}),
+        template=tmpl,
+    ))
+    assert cm.wait_idle()
+    bound = drain(2, "agent")
+    # exactly the two ssd nodes, each exactly once, placed by the SCHEDULER
+    # through the matchFields metadata.name pin
+    assert sorted(p.node_name for p in bound) == ["n0", "n1"]
+    # a NEW eligible node gets its daemon
+    api.create("nodes", make_node(
+        "n3", cpu_milli=4000, mem=8 * 2**30,
+        labels={"kubernetes.io/hostname": "n3", "disk": "ssd"},
+    ))
+    _wait(lambda: cm.wait_idle(0.5) and len(_pods(api, "agent")) == 3,
+          msg="daemon pod for new node")
+    bound2 = drain(3, "agent")
+    assert sorted(p.node_name for p in bound2) == ["n0", "n1", "n3"]
+
+
+def test_endpoints_follow_service_selector(stack):
+    api, sched, cm, drain = stack
+    api.create("services", Service(name="svc", selector={"app": "web"}))
+    api.create("replicasets", ReplicaSet(
+        name="web", replicas=3,
+        selector=LabelSelector(match_labels={"app": "web"}),
+        template=_template("web"),
+    ))
+    assert cm.wait_idle()
+    bound = drain(3, "web")
+    _wait(lambda: len(api.get("endpoints", "default/svc").addresses) == 3,
+          msg="endpoints populated")
+    ep = api.get("endpoints", "default/svc")
+    assert sorted(ep.addresses) == sorted(p.key() for p in bound)
+    # scale down → membership shrinks
+    rs = api.get("replicasets", "default/web")
+    rs.replicas = 1
+    api.update("replicasets", rs)
+    _wait(lambda: cm.wait_idle(0.5)
+          and len(api.get("endpoints", "default/svc").addresses) == 1,
+          msg="endpoints shrink")
+    # service deletion → endpoints deleted
+    api.delete("services", "default/svc")
+    def _gone():
+        try:
+            api.get("endpoints", "default/svc")
+            return False
+        except KeyError:
+            return True
+    _wait(lambda: cm.wait_idle(0.5) and _gone(), msg="endpoints removed")
+
+
+def test_statefulset_ordered_identities(stack):
+    api, sched, cm, drain = stack
+    api.create("statefulsets", StatefulSet(
+        name="db", replicas=3,
+        selector=LabelSelector(match_labels={"app": "db"}),
+        template=_template("db"),
+    ))
+    assert cm.wait_idle()
+    # OrderedReady: db-1 is created only after db-0 Runs — drive the loop
+    # with explicit Running acks (no kubelet in this stack)
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        sched.schedule_batch()
+        sched.wait_for_binds()
+        for p in _pods(api, "db"):
+            if p.node_name and p.phase == "Pending":
+                p.phase = "Running"
+                api.update("pods", p)
+        cm.wait_idle(0.3)
+        names = sorted(p.name for p in _pods(api, "db") if p.phase == "Running")
+        if names == ["db-0", "db-1", "db-2"]:
+            break
+        time.sleep(0.05)
+    assert sorted(p.name for p in _pods(api, "db")) == ["db-0", "db-1", "db-2"]
+    # scale down: HIGHEST ordinal goes first
+    ss = api.get("statefulsets", "default/db")
+    ss.replicas = 2
+    api.update("statefulsets", ss)
+    _wait(lambda: cm.wait_idle(0.5)
+          and sorted(p.name for p in _pods(api, "db")) == ["db-0", "db-1"],
+          msg="ordinal 2 deleted first")
+
+
+def test_namespace_termination_drains_contents(stack):
+    api, sched, cm, drain = stack
+    api.create("namespaces", Namespace(name="team-a"))
+    tmpl = _template("batch")
+    tmpl.namespace = "team-a"
+    api.create("replicasets", ReplicaSet(
+        name="batch", namespace="team-a", replicas=3,
+        selector=LabelSelector(match_labels={"app": "batch"}),
+        template=tmpl,
+    ))
+    assert cm.wait_idle()
+    drain(3, "batch")
+    ns = api.get("namespaces", "team-a")
+    ns.phase = "Terminating"
+    api.update("namespaces", ns)
+    def _empty():
+        pods = [p for p in _pods(api) if p.namespace == "team-a"]
+        rss = [r for r in api.list("replicasets")[0] if r.namespace == "team-a"]
+        try:
+            api.get("namespaces", "team-a")
+            ns_gone = False
+        except KeyError:
+            ns_gone = True
+        return not pods and not rss and ns_gone
+    _wait(lambda: cm.wait_idle(0.5) and _empty(), msg="namespace drained")
+
+
+def test_kubectl_apply_scale_to_running_on_hollow_nodes():
+    """VERDICT #10's bar: a manifest round-trips kubectl apply →
+    controllers → scheduler → RUNNING on hollow kubelets, then
+    kubectl scale grows it — all over the HTTP transport as a separate
+    process."""
+    import json
+    import subprocess
+    import sys
+    import tempfile
+
+    from kubernetes_tpu.apiserver import APIServerHTTP
+    from kubernetes_tpu.kubemark import HollowCluster
+
+    api = FakeAPIServer()
+    nodes = [make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30) for i in range(3)]
+    srv = APIServerHTTP(api).start()
+    sched = Scheduler(batch_size=16, deterministic=True, enable_preemption=False)
+    sched.binder = Binder(APIBinder(api).bind)
+    handlers = EventHandlers(sched.cache, sched.queue, "default-scheduler")
+    informers = start_scheduler_informers(api, handlers)
+    for inf in informers.values():
+        inf.wait_for_sync()
+    hollow = HollowCluster(api, nodes, heartbeat_s=0.5).start()
+    cm = ControllerManager(api).start()
+
+    def kubectl(*args, stdin=None):
+        r = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.kubectl",
+             "--server", srv.url, *args],
+            capture_output=True, text=True, input=stdin, timeout=60,
+        )
+        assert r.returncode == 0, (args, r.stdout, r.stderr)
+        return r.stdout
+
+    manifest = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {
+            "replicas": 2,
+            "selector": {"matchLabels": {"app": "web"}},
+            "template": {
+                "metadata": {"labels": {"app": "web"}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "100m", "memory": "64Mi"}}}]},
+            },
+        },
+    }
+    try:
+        with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+            json.dump(manifest, f)
+            path = f.name
+        out = kubectl("apply", "-f", path)
+        assert "deployment/web created" in out
+
+        def running(n):
+            return [p for p in _pods(api, "web")
+                    if p.node_name and p.phase == "Running"]
+
+        deadline = time.monotonic() + 25.0
+        while time.monotonic() < deadline:
+            sched.schedule_batch()
+            sched.wait_for_binds()
+            cm.wait_idle(0.3)
+            if len(running(2)) >= 2:
+                break
+            time.sleep(0.05)
+        assert len(running(2)) == 2, [(p.key(), p.phase) for p in _pods(api)]
+
+        out = kubectl("scale", "deployment/web", "--replicas", "5")
+        assert "scaled to 5" in out
+        deadline = time.monotonic() + 25.0
+        while time.monotonic() < deadline:
+            sched.schedule_batch()
+            sched.wait_for_binds()
+            cm.wait_idle(0.3)
+            if len(running(5)) >= 5:
+                break
+            time.sleep(0.05)
+        assert len(running(5)) == 5
+
+        # re-apply with replicas=1: configured, controllers shrink
+        manifest["spec"]["replicas"] = 1
+        out = kubectl("apply", "-f", "-", stdin=json.dumps(manifest))
+        assert "deployment/web configured" in out
+        deadline = time.monotonic() + 25.0
+        while time.monotonic() < deadline:
+            cm.wait_idle(0.3)
+            live = [p for p in _pods(api, "web") if p.phase != "Failed"]
+            if len(live) == 1:
+                break
+            time.sleep(0.05)
+        assert len([p for p in _pods(api, "web") if p.phase != "Failed"]) == 1
+    finally:
+        cm.stop()
+        hollow.stop()
+        for inf in informers.values():
+            inf.stop()
+        srv.stop()

@@ -222,3 +222,31 @@ def test_device_batch_matches_sequential_host():
         dev_plan = [(n, [v.key() for v in vs], free) for n, vs, free in plans]
         assert dev_plan == host_plan, (trial, dev_plan, host_plan)
         assert saw_evict  # the generator actually exercised eviction steps
+
+
+def test_fast_path_with_ported_preemptor():
+    """A hostPort-carrying preemptor routes through the fast path (ports do
+    not disqualify it) — the port-conflict branch must run, not NameError
+    (round-4 review finding)."""
+    from kubernetes_tpu.api.types import ContainerPort
+
+    nodes = [make_node(f"n{i}", cpu_milli=8000, mem=16 * 2**30) for i in range(4)]
+    existing = []
+    for i in range(4):
+        p = make_pod(f"low-{i}", cpu_milli=6000, mem=2**30)
+        p.priority = 0
+        p.node_name = f"n{i}"
+        # one low pod holds the port the preemptor wants
+        if i == 0:
+            p.containers[0].ports = [ContainerPort(host_port=8080, container_port=80)]
+        existing.append(p)
+    snap = Snapshot(nodes, existing)
+    pre = make_pod("hi", cpu_milli=4000, mem=2**30)
+    pre.priority = 1000
+    pre.containers[0].ports = [ContainerPort(host_port=8080, container_port=80)]
+    node, victims, _ = preempt(pre, snap)
+    # any candidate works: evicting the 6000m victim frees both cpu AND
+    # (on n0) the port — the call just must not crash and must be exact
+    assert node is not None and len(victims) == 1
+    v = _select_victims_fast(pre, snap.get(node), (), None)
+    assert [p.key() for p in v.pods] == [p.key() for p in victims]
