@@ -345,14 +345,28 @@ class Node:
 
 @dataclass
 class PodDisruptionBudget:
-    """policy/v1beta1 PodDisruptionBudget — the scheduling-visible subset:
-    selector + status.disruptionsAllowed, which preemption consults via
-    filterPodsWithPDBViolation (core/generic_scheduler.go:1055)."""
+    """policy/v1beta1 PodDisruptionBudget. The scheduler consults
+    status.disruptionsAllowed in preemption's PDB filter
+    (filterPodsWithPDBViolation, core/generic_scheduler.go:1055); the
+    disruption controller (pkg/controller/disruption/disruption.go)
+    computes that status from spec.minAvailable / spec.maxUnavailable
+    against the currently-healthy matching pods."""
 
     name: str = ""
     namespace: str = "default"
     selector: Optional[LabelSelector] = None
+    resource_version: str = ""
+    # spec — int, or a "N%" string resolved against the expected pod count
+    min_available: Optional[Any] = None
+    max_unavailable: Optional[Any] = None
+    # status (disruption.go updatePdbStatus)
     disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
 
 
 @dataclass
@@ -1012,6 +1026,15 @@ class Job:
     parallelism: int = 1
     completions: int = 1
     template: Optional[Pod] = None
+    # TTL-after-finished (alpha in this reference era,
+    # pkg/controller/ttlafterfinished/ttlafterfinished_controller.go)
+    ttl_seconds_after_finished: Optional[int] = None
+    owner_references: List[Dict[str, Any]] = field(default_factory=list)
+    # status (job_controller.go syncJob's status update)
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    completion_time: Optional[float] = None
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
@@ -1020,6 +1043,7 @@ class Job:
 def job_from_k8s(obj: dict) -> Job:
     meta = obj.get("metadata") or {}
     spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
     tmpl = spec.get("template")
     template = None
     if tmpl is not None:
@@ -1037,6 +1061,12 @@ def job_from_k8s(obj: dict) -> Job:
         parallelism=int(spec.get("parallelism") if spec.get("parallelism") is not None else 1),
         completions=int(spec.get("completions") if spec.get("completions") is not None else 1),
         template=template,
+        ttl_seconds_after_finished=spec.get("ttlSecondsAfterFinished"),
+        owner_references=list(meta.get("ownerReferences") or []),
+        active=int(status.get("active", 0)),
+        succeeded=int(status.get("succeeded", 0)),
+        failed=int(status.get("failed", 0)),
+        completion_time=_parse_time(status.get("completionTime")),
     )
 
 
@@ -1045,6 +1075,8 @@ def job_to_k8s(job: Job) -> dict:
         "parallelism": job.parallelism,
         "completions": job.completions,
     }
+    if job.ttl_seconds_after_finished is not None:
+        spec["ttlSecondsAfterFinished"] = job.ttl_seconds_after_finished
     if job.template is not None:
         t = pod_to_k8s(job.template)
         spec["template"] = {
@@ -1054,7 +1086,15 @@ def job_to_k8s(job: Job) -> dict:
     meta: Dict[str, Any] = {"name": job.name, "namespace": job.namespace, "uid": job.uid}
     if job.resource_version:
         meta["resourceVersion"] = job.resource_version
-    return {"apiVersion": "batch/v1", "kind": "Job", "metadata": meta, "spec": spec}
+    if job.owner_references:
+        meta["ownerReferences"] = list(job.owner_references)
+    status: Dict[str, Any] = {
+        "active": job.active, "succeeded": job.succeeded, "failed": job.failed,
+    }
+    if job.completion_time is not None:
+        status["completionTime"] = _format_time(job.completion_time)
+    return {"apiVersion": "batch/v1", "kind": "Job", "metadata": meta, "spec": spec,
+            "status": status}
 
 
 def deployment_from_k8s(obj: dict) -> Deployment:
@@ -1126,7 +1166,7 @@ def _workload_from_k8s(cls, api_kind: str, obj: dict, extra=None):
 
 def _workload_to_k8s(obj, api_kind: str, extra_spec=None) -> dict:
     spec: Dict[str, Any] = {}
-    if getattr(obj, "replicas", None) is not None and hasattr(obj, "replicas"):
+    if getattr(obj, "replicas", None) is not None:
         spec["replicas"] = obj.replicas
     if obj.selector is not None:
         spec["selector"] = _label_selector_to(obj.selector)
@@ -1163,9 +1203,7 @@ def daemonset_from_k8s(obj: dict) -> DaemonSet:
 
 
 def daemonset_to_k8s(ds: DaemonSet) -> dict:
-    d = _workload_to_k8s(ds, "DaemonSet")
-    d["spec"].pop("replicas", None)
-    return d
+    return _workload_to_k8s(ds, "DaemonSet")  # no replicas attr → none emitted
 
 
 def replicaset_to_k8s(rs: ReplicaSet) -> dict:
@@ -1265,6 +1303,498 @@ def _affinity_to(aff: Affinity) -> dict:
                 ]
             d[key] = e
     return d
+
+
+def pdb_from_k8s(obj: dict) -> PodDisruptionBudget:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    return PodDisruptionBudget(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        resource_version=str(meta.get("resourceVersion", "")),
+        selector=_label_selector_from(spec.get("selector")),
+        min_available=spec.get("minAvailable"),
+        max_unavailable=spec.get("maxUnavailable"),
+        disruptions_allowed=int(status.get("disruptionsAllowed", 0)),
+        current_healthy=int(status.get("currentHealthy", 0)),
+        desired_healthy=int(status.get("desiredHealthy", 0)),
+        expected_pods=int(status.get("expectedPods", 0)),
+    )
+
+
+def pdb_to_k8s(pdb: PodDisruptionBudget) -> dict:
+    meta: Dict[str, Any] = {"name": pdb.name, "namespace": pdb.namespace}
+    if pdb.resource_version:
+        meta["resourceVersion"] = pdb.resource_version
+    spec: Dict[str, Any] = {}
+    if pdb.selector is not None:
+        spec["selector"] = _label_selector_to(pdb.selector)
+    if pdb.min_available is not None:
+        spec["minAvailable"] = pdb.min_available
+    if pdb.max_unavailable is not None:
+        spec["maxUnavailable"] = pdb.max_unavailable
+    return {
+        "apiVersion": "policy/v1beta1",
+        "kind": "PodDisruptionBudget",
+        "metadata": meta,
+        "spec": spec,
+        "status": {
+            "disruptionsAllowed": pdb.disruptions_allowed,
+            "currentHealthy": pdb.current_healthy,
+            "desiredHealthy": pdb.desired_healthy,
+            "expectedPods": pdb.expected_pods,
+        },
+    }
+
+
+@dataclass
+class ReplicationController:
+    """core/v1 ReplicationController — the original replica manager
+    (pkg/controller/replication/replication_controller.go is a thin
+    adapter over the ReplicaSet reconciler; the wire selector is a plain
+    map, not a LabelSelector)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    resource_version: str = ""
+    replicas: int = 1
+    selector: Optional[LabelSelector] = None  # converted from the v1 map
+    template: Optional[Pod] = None
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def replicationcontroller_from_k8s(obj: dict) -> ReplicationController:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    tmpl = spec.get("template")
+    template = None
+    if tmpl is not None:
+        tmeta = dict(tmpl.get("metadata") or {})
+        tmeta.setdefault("namespace", meta.get("namespace", "default"))
+        tmeta.setdefault("name", meta.get("name", "") + "-template")
+        template = pod_from_k8s({"metadata": tmeta, "spec": tmpl.get("spec") or {}})
+    sel = spec.get("selector")
+    return ReplicationController(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid") or _new_uid(),
+        resource_version=str(meta.get("resourceVersion", "")),
+        replicas=int(spec.get("replicas") if spec.get("replicas") is not None else 1),
+        selector=LabelSelector(match_labels=dict(sel)) if sel else None,
+        template=template,
+    )
+
+
+def replicationcontroller_to_k8s(rc: ReplicationController) -> dict:
+    spec: Dict[str, Any] = {"replicas": rc.replicas}
+    if rc.selector is not None:
+        spec["selector"] = dict(rc.selector.match_labels)
+    if rc.template is not None:
+        t = pod_to_k8s(rc.template)
+        spec["template"] = {
+            "metadata": {"labels": t["metadata"].get("labels", {})},
+            "spec": t["spec"],
+        }
+    meta: Dict[str, Any] = {"name": rc.name, "namespace": rc.namespace, "uid": rc.uid}
+    if rc.resource_version:
+        meta["resourceVersion"] = rc.resource_version
+    return {"apiVersion": "v1", "kind": "ReplicationController", "metadata": meta, "spec": spec}
+
+
+@dataclass
+class CronJob:
+    """batch/v1beta1 CronJob (pkg/apis/batch/types.go CronJobSpec;
+    reconciled by pkg/controller/cronjob — the reference's syncAll polls
+    every 10s rather than watching). The job template carries the Job
+    spec subset (parallelism/completions/pod template)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=_new_uid)
+    resource_version: str = ""
+    schedule: str = "* * * * *"
+    suspend: bool = False
+    concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
+    job_template: Optional[Job] = None
+    # status
+    last_schedule_time: Optional[float] = None
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def cronjob_from_k8s(obj: dict) -> CronJob:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    jt = spec.get("jobTemplate")
+    job_template = None
+    if jt is not None:
+        job_template = job_from_k8s({
+            "metadata": {"name": meta.get("name", ""), "namespace": meta.get("namespace", "default")},
+            "spec": jt.get("spec") or {},
+        })
+    return CronJob(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid") or _new_uid(),
+        resource_version=str(meta.get("resourceVersion", "")),
+        schedule=spec.get("schedule", "* * * * *"),
+        suspend=bool(spec.get("suspend", False)),
+        concurrency_policy=spec.get("concurrencyPolicy", "Allow"),
+        job_template=job_template,
+        last_schedule_time=_parse_time(status.get("lastScheduleTime")),
+    )
+
+
+def cronjob_to_k8s(cj: CronJob) -> dict:
+    meta: Dict[str, Any] = {"name": cj.name, "namespace": cj.namespace, "uid": cj.uid}
+    if cj.resource_version:
+        meta["resourceVersion"] = cj.resource_version
+    spec: Dict[str, Any] = {
+        "schedule": cj.schedule,
+        "suspend": cj.suspend,
+        "concurrencyPolicy": cj.concurrency_policy,
+    }
+    if cj.job_template is not None:
+        spec["jobTemplate"] = {"spec": job_to_k8s(cj.job_template)["spec"]}
+    out = {"apiVersion": "batch/v1beta1", "kind": "CronJob", "metadata": meta, "spec": spec}
+    if cj.last_schedule_time is not None:
+        out["status"] = {"lastScheduleTime": _format_time(cj.last_schedule_time)}
+    return out
+
+
+@dataclass
+class ResourceQuota:
+    """core/v1 ResourceQuota: spec.hard caps aggregate usage per namespace
+    (counts and request/limit sums); status.used is recomputed by the
+    resourcequota controller (pkg/controller/resourcequota) and enforced
+    at admission (plugin/pkg/admission/resourcequota)."""
+
+    name: str = ""
+    namespace: str = "default"
+    resource_version: str = ""
+    hard: Dict[str, int] = field(default_factory=dict)  # scheduler units (cpu→milli)
+    used: Dict[str, int] = field(default_factory=dict)
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def _quota_amounts_from(d: Optional[dict]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for k, v in (d or {}).items():
+        base = k.split(".", 1)[1] if k.startswith(("requests.", "limits.")) else k
+        out[k] = _request_value(base, parse_quantity(str(v)))
+    return out
+
+
+def _quota_amounts_to(d: Dict[str, int]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for k, v in d.items():
+        base = k.split(".", 1)[1] if k.startswith(("requests.", "limits.")) else k
+        out[k] = f"{v}m" if base == RESOURCE_CPU else str(v)
+    return out
+
+
+def resourcequota_from_k8s(obj: dict) -> ResourceQuota:
+    meta = obj.get("metadata") or {}
+    return ResourceQuota(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        resource_version=str(meta.get("resourceVersion", "")),
+        hard=_quota_amounts_from((obj.get("spec") or {}).get("hard")),
+        used=_quota_amounts_from((obj.get("status") or {}).get("used")),
+    )
+
+
+def resourcequota_to_k8s(rq: ResourceQuota) -> dict:
+    meta: Dict[str, Any] = {"name": rq.name, "namespace": rq.namespace}
+    if rq.resource_version:
+        meta["resourceVersion"] = rq.resource_version
+    return {
+        "apiVersion": "v1",
+        "kind": "ResourceQuota",
+        "metadata": meta,
+        "spec": {"hard": _quota_amounts_to(rq.hard)},
+        "status": {"hard": _quota_amounts_to(rq.hard), "used": _quota_amounts_to(rq.used)},
+    }
+
+
+@dataclass
+class LimitRangeItem:
+    """One v1 LimitRangeItem (type Container is what the LimitRanger
+    admission plugin defaults from)."""
+
+    type: str = "Container"
+    default: Dict[str, Quantity] = field(default_factory=dict)  # limits default
+    default_request: Dict[str, Quantity] = field(default_factory=dict)
+    max: Dict[str, Quantity] = field(default_factory=dict)
+    min: Dict[str, Quantity] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRange:
+    """core/v1 LimitRange — consumed by the LimitRanger admission plugin
+    (plugin/pkg/admission/limitranger/admission.go): defaults container
+    requests/limits and enforces min/max at pod-create time."""
+
+    name: str = ""
+    namespace: str = "default"
+    resource_version: str = ""
+    limits: List[LimitRangeItem] = field(default_factory=list)
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def limitrange_from_k8s(obj: dict) -> LimitRange:
+    meta = obj.get("metadata") or {}
+    items = []
+    for it in (obj.get("spec") or {}).get("limits") or []:
+        items.append(LimitRangeItem(
+            type=it.get("type", "Container"),
+            default=_qmap(it.get("default")),
+            default_request=_qmap(it.get("defaultRequest")),
+            max=_qmap(it.get("max")),
+            min=_qmap(it.get("min")),
+        ))
+    return LimitRange(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        resource_version=str(meta.get("resourceVersion", "")),
+        limits=items,
+    )
+
+
+def limitrange_to_k8s(lr: LimitRange) -> dict:
+    meta: Dict[str, Any] = {"name": lr.name, "namespace": lr.namespace}
+    if lr.resource_version:
+        meta["resourceVersion"] = lr.resource_version
+    return {
+        "apiVersion": "v1",
+        "kind": "LimitRange",
+        "metadata": meta,
+        "spec": {"limits": [
+            {
+                "type": it.type,
+                **({"default": {k: _quantity_str(k, v) for k, v in it.default.items()}} if it.default else {}),
+                **({"defaultRequest": {k: _quantity_str(k, v) for k, v in it.default_request.items()}} if it.default_request else {}),
+                **({"max": {k: _quantity_str(k, v) for k, v in it.max.items()}} if it.max else {}),
+                **({"min": {k: _quantity_str(k, v) for k, v in it.min.items()}} if it.min else {}),
+            }
+            for it in lr.limits
+        ]},
+    }
+
+
+@dataclass
+class ServiceAccount:
+    """core/v1 ServiceAccount — identity subset; the serviceaccount
+    controller (pkg/controller/serviceaccount) guarantees 'default' exists
+    in every namespace."""
+
+    name: str = ""
+    namespace: str = "default"
+    resource_version: str = ""
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def serviceaccount_from_k8s(obj: dict) -> ServiceAccount:
+    meta = obj.get("metadata") or {}
+    return ServiceAccount(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        resource_version=str(meta.get("resourceVersion", "")),
+    )
+
+
+def serviceaccount_to_k8s(sa: ServiceAccount) -> dict:
+    meta: Dict[str, Any] = {"name": sa.name, "namespace": sa.namespace}
+    if sa.resource_version:
+        meta["resourceVersion"] = sa.resource_version
+    return {"apiVersion": "v1", "kind": "ServiceAccount", "metadata": meta}
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    """autoscaling/v1 HorizontalPodAutoscaler (pkg/apis/autoscaling;
+    reconciled by pkg/controller/podautoscaler): scales the target
+    workload's replicas toward targetCPUUtilizationPercentage using the
+    pod metrics the metrics kinds serve."""
+
+    name: str = ""
+    namespace: str = "default"
+    resource_version: str = ""
+    # spec
+    target_kind: str = "Deployment"
+    target_name: str = ""
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_cpu_utilization_pct: int = 80
+    # status
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization_pct: Optional[int] = None
+    last_scale_time: Optional[float] = None
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def hpa_from_k8s(obj: dict) -> HorizontalPodAutoscaler:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    ref = spec.get("scaleTargetRef") or {}
+    return HorizontalPodAutoscaler(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        resource_version=str(meta.get("resourceVersion", "")),
+        target_kind=ref.get("kind", "Deployment"),
+        target_name=ref.get("name", ""),
+        min_replicas=int(spec.get("minReplicas") if spec.get("minReplicas") is not None else 1),
+        max_replicas=int(spec.get("maxReplicas") if spec.get("maxReplicas") is not None else 10),
+        target_cpu_utilization_pct=int(spec.get("targetCPUUtilizationPercentage", 80)),
+        current_replicas=int(status.get("currentReplicas", 0)),
+        desired_replicas=int(status.get("desiredReplicas", 0)),
+        current_cpu_utilization_pct=status.get("currentCPUUtilizationPercentage"),
+        last_scale_time=_parse_time(status.get("lastScaleTime")),
+    )
+
+
+def hpa_to_k8s(hpa: HorizontalPodAutoscaler) -> dict:
+    meta: Dict[str, Any] = {"name": hpa.name, "namespace": hpa.namespace}
+    if hpa.resource_version:
+        meta["resourceVersion"] = hpa.resource_version
+    status: Dict[str, Any] = {
+        "currentReplicas": hpa.current_replicas,
+        "desiredReplicas": hpa.desired_replicas,
+    }
+    if hpa.current_cpu_utilization_pct is not None:
+        status["currentCPUUtilizationPercentage"] = hpa.current_cpu_utilization_pct
+    if hpa.last_scale_time is not None:
+        status["lastScaleTime"] = _format_time(hpa.last_scale_time)
+    return {
+        "apiVersion": "autoscaling/v1",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": meta,
+        "spec": {
+            "scaleTargetRef": {"kind": hpa.target_kind, "name": hpa.target_name,
+                               "apiVersion": "apps/v1"},
+            "minReplicas": hpa.min_replicas,
+            "maxReplicas": hpa.max_replicas,
+            "targetCPUUtilizationPercentage": hpa.target_cpu_utilization_pct,
+        },
+        "status": status,
+    }
+
+
+@dataclass
+class PodMetrics:
+    """metrics.k8s.io PodMetrics — aggregate usage for one pod, published
+    by the node runtime (hollow kubelets synthesize it); read by the HPA
+    controller and `kubectl top pods`."""
+
+    name: str = ""
+    namespace: str = "default"
+    resource_version: str = ""
+    cpu_milli: int = 0
+    memory_bytes: int = 0
+    window_s: float = 30.0
+    timestamp: float = 0.0
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+def _window_s(v) -> float:
+    if isinstance(v, str):
+        return float(v.rstrip("s") or 30)
+    return float(v or 30)
+
+
+def podmetrics_from_k8s(obj: dict) -> PodMetrics:
+    meta = obj.get("metadata") or {}
+    usage: Dict[str, int] = {}
+    for c in obj.get("containers") or []:
+        for k, v in (c.get("usage") or {}).items():
+            usage[k] = usage.get(k, 0) + _request_value(k, parse_quantity(str(v)))
+    return PodMetrics(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        resource_version=str(meta.get("resourceVersion", "")),
+        cpu_milli=usage.get(RESOURCE_CPU, 0),
+        memory_bytes=usage.get(RESOURCE_MEMORY, 0),
+        window_s=_window_s(obj.get("window")),
+        timestamp=_parse_time(obj.get("timestamp")) or 0.0,
+    )
+
+
+def podmetrics_to_k8s(pm: PodMetrics) -> dict:
+    meta: Dict[str, Any] = {"name": pm.name, "namespace": pm.namespace}
+    if pm.resource_version:
+        meta["resourceVersion"] = pm.resource_version
+    return {
+        "apiVersion": "metrics.k8s.io/v1beta1",
+        "kind": "PodMetrics",
+        "metadata": meta,
+        "timestamp": _format_time(pm.timestamp) if pm.timestamp else None,
+        "window": f"{pm.window_s:g}s",
+        "containers": [{
+            "name": "total",
+            "usage": {"cpu": f"{pm.cpu_milli}m", "memory": str(pm.memory_bytes)},
+        }],
+    }
+
+
+@dataclass
+class NodeMetrics:
+    """metrics.k8s.io NodeMetrics — node aggregate usage for
+    `kubectl top nodes`. Cluster-scoped (key = node name)."""
+
+    name: str = ""
+    resource_version: str = ""
+    cpu_milli: int = 0
+    memory_bytes: int = 0
+    window_s: float = 30.0
+    timestamp: float = 0.0
+
+    def key(self) -> str:
+        return self.name
+
+
+def nodemetrics_from_k8s(obj: dict) -> NodeMetrics:
+    meta = obj.get("metadata") or {}
+    usage = obj.get("usage") or {}
+    return NodeMetrics(
+        name=meta.get("name", ""),
+        resource_version=str(meta.get("resourceVersion", "")),
+        cpu_milli=_request_value(RESOURCE_CPU, parse_quantity(str(usage.get("cpu", "0")))),
+        memory_bytes=_request_value(RESOURCE_MEMORY, parse_quantity(str(usage.get("memory", "0")))),
+        window_s=_window_s(obj.get("window")),
+        timestamp=_parse_time(obj.get("timestamp")) or 0.0,
+    )
+
+
+def nodemetrics_to_k8s(nm: NodeMetrics) -> dict:
+    meta: Dict[str, Any] = {"name": nm.name}
+    if nm.resource_version:
+        meta["resourceVersion"] = nm.resource_version
+    return {
+        "apiVersion": "metrics.k8s.io/v1beta1",
+        "kind": "NodeMetrics",
+        "metadata": meta,
+        "timestamp": _format_time(nm.timestamp) if nm.timestamp else None,
+        "window": f"{nm.window_s:g}s",
+        "usage": {"cpu": f"{nm.cpu_milli}m", "memory": str(nm.memory_bytes)},
+    }
 
 
 def node_to_k8s(node: Node) -> dict:

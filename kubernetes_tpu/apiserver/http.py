@@ -34,26 +34,44 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from ..api.types import (
+    cronjob_from_k8s,
+    cronjob_to_k8s,
     daemonset_from_k8s,
     daemonset_to_k8s,
     deployment_from_k8s,
     deployment_to_k8s,
     endpoints_from_k8s,
     endpoints_to_k8s,
+    hpa_from_k8s,
+    hpa_to_k8s,
     job_from_k8s,
     job_to_k8s,
+    limitrange_from_k8s,
+    limitrange_to_k8s,
     node_from_k8s,
     node_to_k8s,
+    nodemetrics_from_k8s,
+    nodemetrics_to_k8s,
     namespace_from_k8s,
     namespace_to_k8s,
+    pdb_from_k8s,
+    pdb_to_k8s,
     pod_from_k8s,
     pod_to_k8s,
+    podmetrics_from_k8s,
+    podmetrics_to_k8s,
     priorityclass_from_k8s,
     priorityclass_to_k8s,
     replicaset_from_k8s,
     replicaset_to_k8s,
+    replicationcontroller_from_k8s,
+    replicationcontroller_to_k8s,
+    resourcequota_from_k8s,
+    resourcequota_to_k8s,
     service_from_k8s,
     service_to_k8s,
+    serviceaccount_from_k8s,
+    serviceaccount_to_k8s,
     statefulset_from_k8s,
     statefulset_to_k8s,
 )
@@ -110,6 +128,15 @@ _CODECS: Dict[str, Tuple[Callable, Callable, str]] = {
     "services": (service_to_k8s, service_from_k8s, "ServiceList"),
     "endpoints": (endpoints_to_k8s, endpoints_from_k8s, "EndpointsList"),
     "namespaces": (namespace_to_k8s, namespace_from_k8s, "NamespaceList"),
+    "replicationcontrollers": (replicationcontroller_to_k8s, replicationcontroller_from_k8s, "ReplicationControllerList"),
+    "cronjobs": (cronjob_to_k8s, cronjob_from_k8s, "CronJobList"),
+    "poddisruptionbudgets": (pdb_to_k8s, pdb_from_k8s, "PodDisruptionBudgetList"),
+    "serviceaccounts": (serviceaccount_to_k8s, serviceaccount_from_k8s, "ServiceAccountList"),
+    "resourcequotas": (resourcequota_to_k8s, resourcequota_from_k8s, "ResourceQuotaList"),
+    "limitranges": (limitrange_to_k8s, limitrange_from_k8s, "LimitRangeList"),
+    "horizontalpodautoscalers": (hpa_to_k8s, hpa_from_k8s, "HorizontalPodAutoscalerList"),
+    "podmetrics": (podmetrics_to_k8s, podmetrics_from_k8s, "PodMetricsList"),
+    "nodemetrics": (nodemetrics_to_k8s, nodemetrics_from_k8s, "NodeMetricsList"),
 }
 
 
@@ -157,7 +184,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _obj_key(kind: str, rest) -> Optional[str]:
         """nodes/leases/priorityclasses are cluster-scoped (key = name);
         everything else is namespace/name — mirroring store._key_of."""
-        if kind in ("nodes", "leases", "priorityclasses", "namespaces"):
+        if kind in ("nodes", "leases", "priorityclasses", "namespaces", "nodemetrics"):
             return rest[0] if len(rest) == 1 else None
         return f"{rest[0]}/{rest[1]}" if len(rest) == 2 else None
 

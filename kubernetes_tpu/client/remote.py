@@ -19,26 +19,44 @@ from typing import Any, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from ..api.types import (
+    cronjob_from_k8s,
+    cronjob_to_k8s,
     daemonset_from_k8s,
     daemonset_to_k8s,
     deployment_from_k8s,
     deployment_to_k8s,
     endpoints_from_k8s,
     endpoints_to_k8s,
+    hpa_from_k8s,
+    hpa_to_k8s,
     namespace_from_k8s,
     namespace_to_k8s,
     job_from_k8s,
     job_to_k8s,
+    limitrange_from_k8s,
+    limitrange_to_k8s,
     node_from_k8s,
     node_to_k8s,
+    nodemetrics_from_k8s,
+    nodemetrics_to_k8s,
+    pdb_from_k8s,
+    pdb_to_k8s,
     pod_from_k8s,
     pod_to_k8s,
+    podmetrics_from_k8s,
+    podmetrics_to_k8s,
     priorityclass_from_k8s,
     priorityclass_to_k8s,
     replicaset_from_k8s,
     replicaset_to_k8s,
+    replicationcontroller_from_k8s,
+    replicationcontroller_to_k8s,
+    resourcequota_from_k8s,
+    resourcequota_to_k8s,
     service_from_k8s,
     service_to_k8s,
+    serviceaccount_from_k8s,
+    serviceaccount_to_k8s,
     statefulset_from_k8s,
     statefulset_to_k8s,
 )
@@ -61,6 +79,15 @@ _CODECS = {
     "services": (service_to_k8s, service_from_k8s),
     "endpoints": (endpoints_to_k8s, endpoints_from_k8s),
     "namespaces": (namespace_to_k8s, namespace_from_k8s),
+    "replicationcontrollers": (replicationcontroller_to_k8s, replicationcontroller_from_k8s),
+    "cronjobs": (cronjob_to_k8s, cronjob_from_k8s),
+    "poddisruptionbudgets": (pdb_to_k8s, pdb_from_k8s),
+    "serviceaccounts": (serviceaccount_to_k8s, serviceaccount_from_k8s),
+    "resourcequotas": (resourcequota_to_k8s, resourcequota_from_k8s),
+    "limitranges": (limitrange_to_k8s, limitrange_from_k8s),
+    "horizontalpodautoscalers": (hpa_to_k8s, hpa_from_k8s),
+    "podmetrics": (podmetrics_to_k8s, podmetrics_from_k8s),
+    "nodemetrics": (nodemetrics_to_k8s, nodemetrics_from_k8s),
 }
 
 

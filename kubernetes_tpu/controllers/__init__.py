@@ -2,10 +2,11 @@
 
 The reference starts ~35 reconcile loops from one binary
 (cmd/kube-controller-manager/app/controllermanager.go:373
-NewControllerInitializers). This package rebuilds the nine that close the
-scheduling loop — workload replication, node health, ownership, service
-membership, and namespace lifecycle — as informer-driven reconcilers over
-the (fake or HTTP) apiserver:
+NewControllerInitializers). This package rebuilds the seventeen that
+cover workload replication, node health, ownership, service membership,
+namespace lifecycle, garbage collection, scheduled/finished workloads,
+disruption budgets, quotas and autoscaling — as informer-driven
+reconcilers over the (fake or HTTP) apiserver:
 
   * ReplicaSetController (pkg/controller/replicaset/replica_set.go):
     selector/owner-matched live pods vs .spec.replicas; creates missing
@@ -32,34 +33,71 @@ the (fake or HTTP) apiserver:
     what makes a "node death" flow end-to-end: evict → ReplicaSet refill →
     scheduler re-place.
 
+Round-4 additions (pkg/controller counterparts in parentheses):
+
+  * ReplicationControllerController (replication/) — the RC adapter over
+    the ReplicaSet reconciler.
+  * PodGCController (podgc/) — terminated-pod threshold sweep, orphaned
+    pods on deleted nodes, unscheduled terminating pods.
+  * TTLAfterFinishedController (ttlafterfinished/) — deletes finished
+    Jobs after ttlSecondsAfterFinished.
+  * CronJobController (cronjob/) — cron-schedule evaluation (utils/cron)
+    spawning owned Jobs under Allow/Forbid/Replace policies.
+  * DisruptionController (disruption/) — PDB status: currentHealthy /
+    desiredHealthy / disruptionsAllowed, feeding preemption + eviction.
+  * ServiceAccountController (serviceaccount/) — 'default' SA per
+    namespace.
+  * ResourceQuotaController (resourcequota/) — status.used reconciliation
+    (enforcement lives in the admission chain).
+  * HorizontalPodAutoscalerController (podautoscaler/) — v1 CPU-percent
+    scaling from the PodMetrics kind.
+
 Controllers share one informer set and drain per-controller workqueues
 (client-go util/workqueue semantics: dedup-while-pending, re-add-after-get).
+Clock-driven controllers (cron, TTL, GC, HPA) also hang off the manager's
+resync ticker, the analogue of the reference's per-controller periods.
 """
 
+from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .disruption import DisruptionController
 from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollectorController
+from .hpa import HorizontalPodAutoscalerController
 from .job import JobController
 from .manager import DEFAULT_CONTROLLERS, ControllerManager
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController, TAINT_NOT_READY
+from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .replication import ReplicationControllerController
+from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
 from .statefulset import StatefulSetController
+from .ttlafterfinished import TTLAfterFinishedController
 from .workqueue import WorkQueue
 
 __all__ = [
     "ControllerManager",
+    "CronJobController",
     "DEFAULT_CONTROLLERS",
     "DaemonSetController",
     "DeploymentController",
+    "DisruptionController",
     "EndpointsController",
     "GarbageCollectorController",
+    "HorizontalPodAutoscalerController",
     "JobController",
     "NamespaceController",
     "NodeLifecycleController",
+    "PodGCController",
     "ReplicaSetController",
+    "ReplicationControllerController",
+    "ResourceQuotaController",
+    "ServiceAccountController",
     "StatefulSetController",
     "TAINT_NOT_READY",
+    "TTLAfterFinishedController",
     "WorkQueue",
 ]

@@ -1,0 +1,121 @@
+"""CronJob controller (pkg/controller/cronjob/cronjob_controller.go).
+
+The reference polls: syncAll() every 10s lists all CronJobs + Jobs and,
+per CronJob, computes the unmet schedule times since lastScheduleTime
+(getRecentUnmetScheduleTimes, utils.go) and starts a Job for the most
+recent one, honoring concurrencyPolicy:
+
+* Allow  — start regardless of running jobs
+* Forbid — skip this cycle if an owned job is still active
+* Replace — delete active owned jobs, then start
+
+Job names are `{cronjob}-{scheduled-minute-epoch}` (getJobName), which
+also dedupes: if the job for a scheduled time already exists, it is not
+started twice. Owner references make the garbage collector cascade
+cronjob deletion to its jobs (and through jobs to pods).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import time
+from typing import List, Optional
+
+from ..api.types import CronJob, Job
+from ..apiserver.store import ConflictError
+from ..utils.cron import CronParseError, CronSchedule
+
+logger = logging.getLogger("kubernetes_tpu.controllers.cronjob")
+
+
+class CronJobController:
+    def __init__(self, api, cronjob_informer, job_informer, queue):
+        self.api = api
+        self.cronjob_informer = cronjob_informer
+        self.job_informer = job_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.cronjob_informer.add_event_handler(
+            on_add=lambda cj: self.queue.add(cj.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+        )
+
+    def resync_all(self) -> None:
+        for cj in self.cronjob_informer.list():
+            self.queue.add(cj.key())
+
+    def _owned_jobs(self, cj: CronJob) -> List[Job]:
+        return [
+            j for j in self.job_informer.list()
+            if any(r.get("uid") == cj.uid and r.get("controller")
+                   for r in j.owner_references)
+        ]
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        cj: Optional[CronJob] = self.cronjob_informer.get(key)
+        if cj is None or cj.suspend or cj.job_template is None:
+            return
+        try:
+            sched = CronSchedule(cj.schedule)
+        except CronParseError:
+            logger.warning("cronjob %s: unparseable schedule %r", key, cj.schedule)
+            return
+        now = time.time()
+        # no lastScheduleTime yet: only look back one window, not to the
+        # epoch (the reference starts from cronJob creation time)
+        last = cj.last_schedule_time if cj.last_schedule_time is not None else now - 61
+        unmet = sched.unmet_since(last, now)
+        if not unmet:
+            if cj.last_schedule_time is not None and sched.next_after(last) is not None \
+                    and sched.next_after(last) <= now:
+                # unmet_since gave up: >100 missed starts (long downtime /
+                # clock skew). The reference sticks with a warning event;
+                # we self-heal by advancing lastScheduleTime so the next
+                # due time schedules normally (documented divergence).
+                logger.warning("cronjob %s: too many missed start times; "
+                               "advancing lastScheduleTime", key)
+                healed = copy.copy(cj)
+                healed.last_schedule_time = now
+                try:
+                    self.api.update("cronjobs", healed)
+                except KeyError:
+                    pass
+            return
+        scheduled = unmet[-1]  # most recent only (reference: startJob for the last)
+
+        active = [j for j in self._owned_jobs(cj)
+                  if j.completion_time is None]
+        if cj.concurrency_policy == "Forbid" and active:
+            return
+        if cj.concurrency_policy == "Replace":
+            for j in active:
+                try:
+                    self.api.delete("jobs", j.key())
+                except KeyError:
+                    pass
+
+        job = copy.deepcopy(cj.job_template)
+        job.name = f"{cj.name}-{int(scheduled // 60)}"
+        job.namespace = cj.namespace
+        job.resource_version = ""
+        job.owner_references = [
+            {"uid": cj.uid, "controller": True, "kind": "CronJob", "name": cj.name}
+        ]
+        from ..api.types import _new_uid
+
+        job.uid = _new_uid()
+        try:
+            self.api.create("jobs", job)
+        except ConflictError:
+            pass  # this scheduled time already started (dedupe by name)
+
+        updated = copy.copy(self.cronjob_informer.get(key) or cj)
+        updated.last_schedule_time = scheduled
+        try:
+            self.api.update("cronjobs", updated)
+        except KeyError:
+            pass

@@ -81,9 +81,16 @@ class DaemonSetController:
             on_update=lambda old, new: self.queue.add(new.key()),
             on_delete=lambda ds: self.queue.add(ds.key()),
         )
-        # node membership changes re-reconcile every daemonset
+        # node membership AND eligibility changes re-reconcile every
+        # daemonset (daemon_controller.go updateNode re-runs
+        # nodeShouldRunDaemonPod when labels/taints change)
         self.node_informer.add_event_handler(
             on_add=lambda n: self._enqueue_all(),
+            on_update=lambda old, new: (
+                self._enqueue_all()
+                if old.labels != new.labels or old.taints != new.taints
+                else None
+            ),
             on_delete=lambda n: self._enqueue_all(),
         )
         self.pod_informer.add_event_handler(
@@ -112,13 +119,27 @@ class DaemonSetController:
         nodes = {n.name: n for n in self.node_informer.list()}
         want = {nm for nm, n in nodes.items() if self._eligible(ds, n)}
         have: dict = {}
+        terminal: dict = {}  # Failed/Succeeded daemon pods holding the name
         for p in self.pod_informer.list():
-            if not owned_by(p, ds.uid) or p.phase in ("Failed", "Succeeded"):
+            if not owned_by(p, ds.uid):
                 continue
             target = p.node_name or _pinned_node(p)
+            if p.phase in ("Failed", "Succeeded"):
+                terminal.setdefault(target, []).append(p)
+                continue
             have.setdefault(target, []).append(p)
         for nm in sorted(want):
             if nm not in have:
+                dead = terminal.get(nm)
+                if dead:
+                    # the deterministic name {ds}-{node} is still held by a
+                    # terminal pod — free it first (delete event re-syncs)
+                    for p in dead:
+                        try:
+                            self.api.delete("pods", p.key())
+                        except KeyError:
+                            pass
+                    continue
                 self.api.create("pods", self._daemon_pod(ds, nm))
         for nm, pods in have.items():
             surplus: List[Pod] = pods[1:] if nm in want else pods

@@ -1,0 +1,94 @@
+"""Disruption controller (pkg/controller/disruption/disruption.go).
+
+Maintains PodDisruptionBudget status: for each PDB, the currently-healthy
+count of pods matching its selector, the desired-healthy floor derived
+from spec.minAvailable / spec.maxUnavailable, and
+disruptionsAllowed = currentHealthy - desiredHealthy (floored at 0) —
+the number preemption's PDB filter and the eviction subresource consult.
+
+Expected-pod resolution: the reference walks the pod's controller scale
+(getExpectedPodCount); here expected = matching non-terminal pods, which
+is exact for absolute minAvailable and for maxUnavailable against the
+live set (percentages resolve against that count — documented
+divergence for mid-rollout percent budgets).
+
+Healthy = Running phase on a node (the reference requires the Ready
+condition; hollow kubelets report Running as their ready signal).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import math
+from typing import Optional
+
+from ..api.selectors import match_label_selector
+from ..api.types import Pod, PodDisruptionBudget
+
+logger = logging.getLogger("kubernetes_tpu.controllers.disruption")
+
+
+def _resolve(value, expected: int) -> int:
+    """IntOrString: int, or 'N%' of expected rounded UP (the reference's
+    GetValueFromIntOrPercent with roundUp=true for minAvailable)."""
+    if isinstance(value, str) and value.endswith("%"):
+        return math.ceil(expected * int(value[:-1]) / 100.0)
+    return int(value)
+
+
+class DisruptionController:
+    def __init__(self, api, pdb_informer, pod_informer, queue):
+        self.api = api
+        self.pdb_informer = pdb_informer
+        self.pod_informer = pod_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.pdb_informer.add_event_handler(
+            on_add=lambda p: self.queue.add(p.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+        )
+        self.pod_informer.add_event_handler(
+            on_add=lambda p: self._enqueue_for_pod(p),
+            on_update=lambda old, new: self._enqueue_for_pod(new),
+            on_delete=lambda p: self._enqueue_for_pod(p),
+        )
+
+    def _enqueue_for_pod(self, pod: Pod) -> None:
+        for pdb in self.pdb_informer.list():
+            if pdb.namespace == pod.namespace and match_label_selector(pdb.selector, pod.labels):
+                self.queue.add(pdb.key())
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        pdb: Optional[PodDisruptionBudget] = self.pdb_informer.get(key)
+        if pdb is None:
+            return
+        matching = [
+            p for p in self.pod_informer.list()
+            if p.namespace == pdb.namespace and p.phase not in ("Succeeded", "Failed")
+            and match_label_selector(pdb.selector, p.labels)
+        ]
+        expected = len(matching)
+        healthy = sum(1 for p in matching if p.phase == "Running" and p.node_name)
+        if pdb.min_available is not None:
+            desired = _resolve(pdb.min_available, expected)
+        elif pdb.max_unavailable is not None:
+            desired = expected - _resolve(pdb.max_unavailable, expected)
+        else:
+            desired = expected  # no budget spec: nothing may be disrupted
+        allowed = max(0, healthy - max(0, desired))
+        if (pdb.current_healthy == healthy and pdb.desired_healthy == desired
+                and pdb.expected_pods == expected and pdb.disruptions_allowed == allowed):
+            return
+        updated = copy.copy(pdb)
+        updated.current_healthy = healthy
+        updated.desired_healthy = max(0, desired)
+        updated.expected_pods = expected
+        updated.disruptions_allowed = allowed
+        try:
+            self.api.update("poddisruptionbudgets", updated)
+        except KeyError:
+            pass

@@ -26,8 +26,10 @@ logger = logging.getLogger("kubernetes_tpu.controllers.garbagecollector")
 
 # dependent kind → owner kinds whose disappearance orphans it
 DEPENDENTS: Dict[str, List[str]] = {
-    "pods": ["replicasets", "jobs", "statefulsets", "daemonsets"],
+    "pods": ["replicasets", "jobs", "statefulsets", "daemonsets",
+             "replicationcontrollers"],
     "replicasets": ["deployments"],
+    "jobs": ["cronjobs"],
     "endpoints": ["services"],
 }
 
@@ -41,6 +43,8 @@ _OWNER_WIRE_KIND = {
     "daemonsets": "DaemonSet",
     "deployments": "Deployment",
     "services": "Service",
+    "replicationcontrollers": "ReplicationController",
+    "cronjobs": "CronJob",
 }
 
 
@@ -62,15 +66,62 @@ class GarbageCollectorController:
             inf.add_event_handler(
                 on_delete=lambda obj, _k=kind: self.queue.add(_SWEEP)
             )
-        # dependents arriving AFTER their owner died must not linger
+        # dependents arriving AFTER their owner died must not linger —
+        # but a full-cluster sweep per pod ADDED would be O(cluster) per
+        # event under bench churn; enqueue a targeted single-object check
+        # instead (the graph-based reference enqueues exactly the one
+        # dependent too, garbagecollector.go attemptToDeleteItem)
         for kind in DEPENDENTS:
             inf = self.informers.get(kind)
             if inf is None:
                 continue
-            inf.add_event_handler(on_add=lambda obj: self.queue.add(_SWEEP))
+            inf.add_event_handler(
+                on_add=lambda obj, _k=kind: self.queue.add((_k, obj.key()))
+            )
 
-    def sync(self, key: str) -> None:
-        self.sweep()
+    def sync(self, key) -> None:
+        if key == _SWEEP:
+            self.sweep()
+        else:
+            self.check_one(*key)
+
+    def check_one(self, kind: str, obj_key: str) -> None:
+        """Targeted attemptToDeleteItem: is THIS object's controller owner
+        still alive? (No cluster scan.)"""
+        inf = self.informers.get(kind)
+        if inf is None:
+            return
+        obj = inf.get(obj_key)
+        if obj is None:
+            return
+        refs = getattr(obj, "owner_references", None)
+        if not refs:
+            if kind == "endpoints":
+                svc_inf = self.informers.get("services")
+                if svc_inf is not None and svc_inf.get(obj.key()) is None:
+                    self.deleted += self._delete(kind, obj)
+            return
+        ctrl = next((r for r in refs if r.get("controller")), None)
+        if ctrl is None:
+            return
+        for ok in DEPENDENTS.get(kind, ()):
+            if _OWNER_WIRE_KIND.get(ok) != ctrl.get("kind"):
+                continue
+            oinf = self.informers.get(ok)
+            if oinf is None:
+                return
+            if not any(getattr(o, "uid", None) == ctrl.get("uid") for o in oinf.list()):
+                # informer caches can lag; confirm with a live owner get
+                # before the destructive delete (same discipline as podgc)
+                owner_key = f"{getattr(obj, 'namespace', 'default')}/{ctrl.get('name')}"
+                try:
+                    live = self.api.get(ok, owner_key)
+                    if getattr(live, "uid", None) == ctrl.get("uid"):
+                        return  # owner exists; cache was behind
+                except KeyError:
+                    pass
+                self.deleted += self._delete(kind, obj)
+            return
 
     def sweep(self) -> int:
         """One orphan sweep over every dependent kind. Returns deletions."""

@@ -1,0 +1,144 @@
+"""Horizontal pod autoscaler (pkg/controller/podautoscaler/horizontal.go).
+
+Scales a target workload (Deployment / ReplicaSet / ReplicationController
+/ StatefulSet) toward spec.targetCPUUtilizationPercentage using the
+v1 algorithm (replica_calculator.go GetResourceReplicas):
+
+  utilization = sum(usage) / sum(requests) over measured pods (percent)
+  desired = ceil(usageRatio × measuredPodCount), clamped [min, max]
+
+Multiplying by the MEASURED pod count (not scale.replicas) is what makes
+the loop robust to informer lag: right after a scale-up, the target's
+replica count is already higher while the new pod is not yet visible —
+ratio × scale.replicas would compound the scale-up into an overshoot.
+
+Metrics come from the PodMetrics kind (metrics.k8s.io analogue) that the
+node runtime publishes. Missing-metrics conservatism follows
+replica_calculator.go: when scaling UP, pods without metrics are assumed
+to use 0 (so a just-created replica dampens further scale-up instead of
+being invisible); when scaling DOWN, they are assumed at 100% of request.
+A 10% tolerance band suppresses thrashy scaling (horizontal.go
+tolerance)."""
+
+from __future__ import annotations
+
+import copy
+import logging
+import math
+import time
+from typing import Optional
+
+from ..api.selectors import match_label_selector
+from ..api.types import HorizontalPodAutoscaler, RESOURCE_CPU
+from ..apiserver.store import ConflictError
+
+logger = logging.getLogger("kubernetes_tpu.controllers.hpa")
+
+TOLERANCE = 0.1  # horizontal.go defaultTolerance
+
+_TARGET_KINDS = {
+    "Deployment": "deployments",
+    "ReplicaSet": "replicasets",
+    "ReplicationController": "replicationcontrollers",
+    "StatefulSet": "statefulsets",
+}
+
+
+class HorizontalPodAutoscalerController:
+    def __init__(self, api, hpa_informer, pod_informer, podmetrics_informer, queue):
+        self.api = api
+        self.hpa_informer = hpa_informer
+        self.pod_informer = pod_informer
+        self.podmetrics_informer = podmetrics_informer
+        self.queue = queue
+        self.sync_count = 0
+        self.scale_count = 0
+
+    def register(self) -> None:
+        self.hpa_informer.add_event_handler(
+            on_add=lambda h: self.queue.add(h.key()),
+            on_update=lambda old, new: self.queue.add(new.key()),
+        )
+
+    def resync_all(self) -> None:
+        for h in self.hpa_informer.list():
+            self.queue.add(h.key())
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        hpa: Optional[HorizontalPodAutoscaler] = self.hpa_informer.get(key)
+        if hpa is None:
+            return
+        kind = _TARGET_KINDS.get(hpa.target_kind)
+        if kind is None or hpa.target_cpu_utilization_pct <= 0:
+            # the reference's API validation requires target >= 1; with no
+            # validation webhook here, a zero target must not divide
+            return
+        try:
+            target = self.api.get(kind, f"{hpa.namespace}/{hpa.target_name}")
+        except KeyError:
+            return
+        current = target.replicas
+        matching = [
+            p for p in self.pod_informer.list()
+            if p.namespace == hpa.namespace and p.phase not in ("Succeeded", "Failed")
+            and match_label_selector(target.selector, p.labels)
+        ]
+        usage = requests = 0
+        measured = 0  # pods with both a cpu request and a metrics sample
+        missing_req = 0  # pods with a request but no metrics sample yet
+        missing = 0
+        for p in matching:
+            req = p.resource_request().get(RESOURCE_CPU, 0)
+            if req <= 0:
+                continue
+            m = self.podmetrics_informer.get(p.key())
+            if m is None:
+                missing += 1
+                missing_req += req
+                continue
+            usage += m.cpu_milli
+            requests += req
+            measured += 1
+        if requests <= 0 or current <= 0:
+            return  # no usable metrics yet
+        utilization = 100.0 * usage / requests
+        ratio = utilization / hpa.target_cpu_utilization_pct
+        count = measured
+        if missing and abs(ratio - 1.0) > TOLERANCE:
+            # replica_calculator.go: re-run with missing pods at 0 usage
+            # (scale up) or full request (scale down); if the adjusted
+            # ratio flips direction, hold steady
+            if ratio > 1.0:
+                adj = (100.0 * usage / (requests + missing_req)) / hpa.target_cpu_utilization_pct
+                ratio = adj if adj > 1.0 else 1.0
+            else:
+                adj = (100.0 * (usage + missing_req) / (requests + missing_req)) \
+                    / hpa.target_cpu_utilization_pct
+                ratio = adj if adj < 1.0 else 1.0
+            count = measured + missing
+        desired = current if abs(ratio - 1.0) <= TOLERANCE else math.ceil(count * ratio)
+        desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+
+        if desired != current:
+            scaled = copy.copy(target)
+            scaled.replicas = desired
+            try:
+                self.api.update(kind, scaled)
+                self.scale_count += 1
+            except (KeyError, ConflictError):
+                return  # retried on the next tick
+
+        st = copy.copy(self.hpa_informer.get(key) or hpa)
+        if (st.current_replicas == current and st.desired_replicas == desired
+                and st.current_cpu_utilization_pct == int(utilization)):
+            return
+        st.current_replicas = current
+        st.desired_replicas = desired
+        st.current_cpu_utilization_pct = int(utilization)
+        if desired != current:
+            st.last_scale_time = time.time()
+        try:
+            self.api.update("horizontalpodautoscalers", st)
+        except KeyError:
+            pass

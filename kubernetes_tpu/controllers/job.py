@@ -56,14 +56,22 @@ class JobController:
             return
         active: List[Pod] = []
         succeeded = 0
+        failed = 0
         for p in self.pod_informer.list():
             if not owned_by(p, job.uid):
                 continue
             if p.phase == "Succeeded":
                 succeeded += 1
-            elif p.phase != "Failed":
+            elif p.phase == "Failed":
+                failed += 1
+            else:
                 active.append(p)
-        if succeeded >= job.completions:
+        # a job that has completed STAYS completed even if its Succeeded
+        # pods are later garbage-collected (the reference's Complete
+        # condition is terminal; completionTime is never cleared)
+        finished = job.completion_time is not None or succeeded >= job.completions
+        self._update_status(job, len(active), succeeded, failed, finished)
+        if finished:
             return  # done; stragglers run to their own completion
         # keep `parallelism` active, bounded by the completions still needed
         want_active = min(job.parallelism, job.completions - succeeded)
@@ -82,3 +90,32 @@ class JobController:
 
     def _new_pod(self, job: Job) -> Pod:
         return new_child_pod(job.template, "Job", job.name, job.uid, job.namespace)
+
+    def _update_status(self, job: Job, active: int, succeeded: int, failed: int,
+                       finished: bool) -> None:
+        """syncJob's status write (job_controller.go updateJobStatus):
+        counts + completionTime stamped once when completions are reached.
+        Skipped when nothing changed so the MODIFIED→enqueue→sync cycle
+        settles instead of looping; completionTime is write-once, so a
+        finished job whose counts are stable never re-writes."""
+        counts_equal = (job.active == active and job.succeeded == succeeded
+                        and job.failed == failed)
+        needs_time = finished and job.completion_time is None
+        if counts_equal and not needs_time:
+            return
+        import copy as _copy
+        import time as _time
+
+        cached = self.job_informer.get(job.key())
+        if cached is None:
+            return
+        updated = _copy.copy(cached)  # never mutate the informer's object
+        updated.active = active
+        updated.succeeded = succeeded
+        updated.failed = failed
+        if finished and updated.completion_time is None:
+            updated.completion_time = _time.time()
+        try:
+            self.api.update("jobs", updated)
+        except KeyError:
+            pass

@@ -16,15 +16,23 @@ import threading
 from typing import Dict, List, Optional
 
 from ..client.informer import Informer
+from .cronjob import CronJobController
 from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .disruption import DisruptionController
 from .endpoints import EndpointsController
 from .garbagecollector import GarbageCollectorController
+from .hpa import HorizontalPodAutoscalerController
 from .job import JobController
 from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .replication import ReplicationControllerController
+from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
 from .statefulset import StatefulSetController
+from .ttlafterfinished import TTLAfterFinishedController
 from .workqueue import WorkQueue
 
 logger = logging.getLogger("kubernetes_tpu.controllers.manager")
@@ -32,13 +40,17 @@ logger = logging.getLogger("kubernetes_tpu.controllers.manager")
 DEFAULT_CONTROLLERS = (
     "deployment", "replicaset", "job", "nodelifecycle",
     "garbagecollector", "daemonset", "endpoints", "statefulset", "namespace",
+    "replication", "podgc", "ttlafterfinished", "cronjob", "disruption",
+    "serviceaccount", "resourcequota", "horizontalpodautoscaler",
 )
 
 
 class ControllerManager:
     def __init__(self, api,
                  controllers=DEFAULT_CONTROLLERS,
-                 node_monitor_grace_s=None):
+                 node_monitor_grace_s=None,
+                 resync_period_s: float = 1.0,
+                 terminated_pod_threshold: int = 0):
         self.api = api
         self.informers: Dict[str, Informer] = {
             "pods": Informer(api, "pods"),
@@ -51,11 +63,23 @@ class ControllerManager:
             "services": Informer(api, "services"),
             "endpoints": Informer(api, "endpoints"),
             "namespaces": Informer(api, "namespaces"),
+            "replicationcontrollers": Informer(api, "replicationcontrollers"),
+            "cronjobs": Informer(api, "cronjobs"),
+            "poddisruptionbudgets": Informer(api, "poddisruptionbudgets"),
+            "serviceaccounts": Informer(api, "serviceaccounts"),
+            "resourcequotas": Informer(api, "resourcequotas"),
+            "horizontalpodautoscalers": Informer(api, "horizontalpodautoscalers"),
+            "podmetrics": Informer(api, "podmetrics"),
         }
         self.controllers = []
         self._queues: List[WorkQueue] = []
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # controllers whose clock-driven work (cron schedules, TTL expiry,
+        # GC sweeps, metric polls) has no apiserver event: one shared
+        # ticker calls their resync_all() every resync_period_s
+        self._tickables = []
+        self._resync_period_s = resync_period_s
         if "replicaset" in controllers:
             q = WorkQueue()
             self.replicaset = ReplicaSetController(
@@ -114,6 +138,72 @@ class ControllerManager:
             )
             self.controllers.append(self.namespace)
             self._queues.append(q)
+        if "replication" in controllers:
+            q = WorkQueue()
+            self.replication = ReplicationControllerController(
+                api, self.informers["replicationcontrollers"],
+                self.informers["pods"], q,
+            )
+            self.controllers.append(self.replication)
+            self._queues.append(q)
+        if "podgc" in controllers:
+            q = WorkQueue()
+            self.podgc = PodGCController(
+                api, self.informers["pods"], self.informers["nodes"], q,
+                terminated_pod_threshold=terminated_pod_threshold,
+            )
+            self.controllers.append(self.podgc)
+            self._queues.append(q)
+            self._tickables.append(self.podgc)
+        if "ttlafterfinished" in controllers:
+            q = WorkQueue()
+            self.ttlafterfinished = TTLAfterFinishedController(
+                api, self.informers["jobs"], q
+            )
+            self.controllers.append(self.ttlafterfinished)
+            self._queues.append(q)
+            self._tickables.append(self.ttlafterfinished)
+        if "cronjob" in controllers:
+            q = WorkQueue()
+            self.cronjob = CronJobController(
+                api, self.informers["cronjobs"], self.informers["jobs"], q
+            )
+            self.controllers.append(self.cronjob)
+            self._queues.append(q)
+            self._tickables.append(self.cronjob)
+        if "disruption" in controllers:
+            q = WorkQueue()
+            self.disruption = DisruptionController(
+                api, self.informers["poddisruptionbudgets"],
+                self.informers["pods"], q,
+            )
+            self.controllers.append(self.disruption)
+            self._queues.append(q)
+        if "serviceaccount" in controllers:
+            q = WorkQueue()
+            self.serviceaccount = ServiceAccountController(
+                api, self.informers["namespaces"],
+                self.informers["serviceaccounts"], q,
+            )
+            self.controllers.append(self.serviceaccount)
+            self._queues.append(q)
+        if "resourcequota" in controllers:
+            q = WorkQueue()
+            self.resourcequota = ResourceQuotaController(
+                api, self.informers["resourcequotas"],
+                self.informers["pods"], q,
+            )
+            self.controllers.append(self.resourcequota)
+            self._queues.append(q)
+        if "horizontalpodautoscaler" in controllers:
+            q = WorkQueue()
+            self.horizontalpodautoscaler = HorizontalPodAutoscalerController(
+                api, self.informers["horizontalpodautoscalers"],
+                self.informers["pods"], self.informers["podmetrics"], q,
+            )
+            self.controllers.append(self.horizontalpodautoscaler)
+            self._queues.append(q)
+            self._tickables.append(self.horizontalpodautoscaler)
         if "nodelifecycle" in controllers:
             q = WorkQueue()
             self.nodelifecycle = NodeLifecycleController(
@@ -146,7 +236,19 @@ class ControllerManager:
             )
             t.start()
             self._threads.append(t)
+        if self._tickables:
+            t = threading.Thread(target=self._tick_loop, name="ctrl-resync", daemon=True)
+            t.start()
+            self._threads.append(t)
         return self
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(self._resync_period_s):
+            for c in self._tickables:
+                try:
+                    c.resync_all()
+                except Exception:
+                    logger.exception("resync tick failed for %s", type(c).__name__)
 
     def _monitor_loop(self, controller, period_s: float) -> None:
         """monitorNodeHealth's clock: staleness has no apiserver event,

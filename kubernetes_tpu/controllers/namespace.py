@@ -20,6 +20,9 @@ logger = logging.getLogger("kubernetes_tpu.controllers.namespace")
 NAMESPACED_KINDS = (
     "pods", "replicasets", "deployments", "jobs", "statefulsets",
     "daemonsets", "services", "endpoints", "events",
+    "replicationcontrollers", "cronjobs", "poddisruptionbudgets",
+    "serviceaccounts", "resourcequotas", "limitranges",
+    "horizontalpodautoscalers", "podmetrics",
 )
 
 

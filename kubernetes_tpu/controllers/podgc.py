@@ -1,0 +1,78 @@
+"""Pod garbage collector (pkg/controller/podgc/gc_controller.go).
+
+Three sweeps, run on the manager's resync tick (the reference runs
+gcCheckPeriod=20s; the period is the manager's knob here):
+
+* gcTerminated: when the number of terminated pods (Succeeded/Failed)
+  exceeds `terminated_pod_threshold`, delete the oldest beyond the
+  threshold (threshold <= 0 disables, matching the reference default
+  of 12500 being flag-set).
+* gcOrphaned: pods bound to a node that no longer exists are deleted —
+  the kubelet that would report them is gone (gc_controller.go:129).
+* gcUnscheduledTerminating: pods with a deletionTimestamp that never got
+  a node can never terminate gracefully; force-delete (gc_controller.go:160).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+logger = logging.getLogger("kubernetes_tpu.controllers.podgc")
+
+
+class PodGCController:
+    def __init__(self, api, pod_informer, node_informer, queue,
+                 terminated_pod_threshold: int = 0):
+        self.api = api
+        self.pod_informer = pod_informer
+        self.node_informer = node_informer
+        self.queue = queue
+        self.terminated_pod_threshold = terminated_pod_threshold
+        self.sync_count = 0
+        self.deleted_count = 0
+
+    def register(self) -> None:
+        # a node deletion can orphan pods immediately; otherwise the
+        # periodic resync drives the sweeps
+        self.node_informer.add_event_handler(
+            on_delete=lambda n: self.queue.add("gc"),
+        )
+
+    def resync_all(self) -> None:
+        self.queue.add("gc")
+
+    def _delete(self, pod) -> None:
+        try:
+            self.api.delete("pods", pod.key())
+            self.deleted_count += 1
+        except KeyError:
+            pass
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        pods = self.pod_informer.list()
+        node_names = {n.name for n in self.node_informer.list()}
+
+        terminated = [p for p in pods if p.phase in ("Succeeded", "Failed")]
+        if 0 < self.terminated_pod_threshold < len(terminated):
+            excess = len(terminated) - self.terminated_pod_threshold
+            for p in sorted(terminated, key=lambda p: p.creation_timestamp)[:excess]:
+                self._delete(p)
+
+        for p in pods:
+            if p.node_name and p.node_name not in node_names:
+                # informer caches can lag each other (pod ADDED applied
+                # before its node's ADDED): confirm absence against the
+                # apiserver before the destructive delete, as the
+                # reference does (gc_controller.go:142 live node get)
+                try:
+                    self.api.get("nodes", p.node_name)
+                    continue  # node exists; the informer was behind
+                except KeyError:
+                    pass
+                logger.info("podgc: orphaned pod %s (node %s gone)", p.key(), p.node_name)
+                self._delete(p)
+            elif p.deletion_timestamp is not None and not p.node_name:
+                logger.info("podgc: unscheduled terminating pod %s", p.key())
+                self._delete(p)

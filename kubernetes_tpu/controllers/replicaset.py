@@ -37,7 +37,13 @@ def _adoptable(pod: Pod, rs: ReplicaSet) -> bool:
 
 class ReplicaSetController:
     """One reconcile loop: replicasets + pods informers → workqueue →
-    manageReplicas through the (fake) apiserver."""
+    manageReplicas through the (fake) apiserver.
+
+    OWNER_KIND parameterizes the ownerReference kind so the
+    ReplicationController adapter (pkg/controller/replication wraps the
+    same reconciler in the reference) can subclass with its own kind."""
+
+    OWNER_KIND = "ReplicaSet"
 
     def __init__(self, api, rs_informer, pod_informer, queue):
         self.api = api
@@ -62,7 +68,7 @@ class ReplicaSetController:
 
     def _enqueue_owner(self, pod: Pod) -> None:
         for ref in pod.owner_references:
-            if ref.get("controller") and ref.get("kind") == "ReplicaSet":
+            if ref.get("controller") and ref.get("kind") == self.OWNER_KIND:
                 self.queue.add(f"{pod.namespace}/{ref.get('name')}")
                 return
         # orphan: any RS whose selector matches may want it
@@ -98,4 +104,4 @@ class ReplicaSetController:
                     pass
 
     def _new_replica(self, rs: ReplicaSet) -> Pod:
-        return new_child_pod(rs.template, "ReplicaSet", rs.name, rs.uid, rs.namespace)
+        return new_child_pod(rs.template, self.OWNER_KIND, rs.name, rs.uid, rs.namespace)

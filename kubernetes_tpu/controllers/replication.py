@@ -1,0 +1,14 @@
+"""ReplicationController controller (pkg/controller/replication/
+replication_controller.go — in the reference this is literally an adapter
+that reuses the ReplicaSet reconciler over converted RC objects;
+conversion.go wraps the clientset). Same move here: the RC kind decodes
+its v1 map selector into a LabelSelector, and the reconciler subclasses
+ReplicaSetController with the RC owner kind."""
+
+from __future__ import annotations
+
+from .replicaset import ReplicaSetController
+
+
+class ReplicationControllerController(ReplicaSetController):
+    OWNER_KIND = "ReplicationController"

@@ -1,0 +1,48 @@
+"""ServiceAccount controller (pkg/controller/serviceaccount/
+serviceaccounts_controller.go): ensures every Active namespace carries
+the 'default' ServiceAccount, recreating it if deleted. The reference
+also recreates on SA-delete events; both triggers are wired."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api.types import Namespace, ServiceAccount
+from ..apiserver.store import ConflictError
+
+logger = logging.getLogger("kubernetes_tpu.controllers.serviceaccount")
+
+MANAGED_NAMES = ("default",)
+
+
+class ServiceAccountController:
+    def __init__(self, api, namespace_informer, serviceaccount_informer, queue):
+        self.api = api
+        self.namespace_informer = namespace_informer
+        self.serviceaccount_informer = serviceaccount_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.namespace_informer.add_event_handler(
+            on_add=lambda ns: self.queue.add(ns.name),
+            on_update=lambda old, new: self.queue.add(new.name),
+        )
+        self.serviceaccount_informer.add_event_handler(
+            on_delete=lambda sa: self.queue.add(sa.namespace),
+        )
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        ns: Optional[Namespace] = self.namespace_informer.get(key)
+        if ns is None or ns.phase != "Active":
+            return
+        have = {sa.name for sa in self.serviceaccount_informer.list()
+                if sa.namespace == key}
+        for name in MANAGED_NAMES:
+            if name not in have:
+                try:
+                    self.api.create("serviceaccounts", ServiceAccount(name=name, namespace=key))
+                except ConflictError:
+                    pass

@@ -51,11 +51,16 @@ class StatefulSetController:
         if ss is None:
             return  # cascade is the GC's job
         by_ordinal: Dict[int, Pod] = {}
+        terminal: Dict[int, Pod] = {}  # Failed/Succeeded pods still holding a name
         for p in self.pod_informer.list():
             if not owned_by(p, ss.uid):
                 continue
             ordinal = _ordinal_of(ss.name, p.name)
-            if ordinal is not None and p.phase not in ("Failed", "Succeeded"):
+            if ordinal is None:
+                continue
+            if p.phase in ("Failed", "Succeeded"):
+                terminal[ordinal] = p
+            else:
                 by_ordinal[ordinal] = p
         # scale-down first: highest ordinal, one per sync (OrderedReady)
         surplus = sorted((o for o in by_ordinal if o >= ss.replicas), reverse=True)
@@ -71,6 +76,17 @@ class StatefulSetController:
         for i in range(ss.replicas):
             p = by_ordinal.get(i)
             if p is None:
+                dead = terminal.get(i)
+                if dead is not None:
+                    # the terminal pod still owns the ordinal NAME — it
+                    # must be deleted before the identity can be reborn
+                    # (stateful_set_control.go replaces failed pods by
+                    # delete-then-recreate under the same name)
+                    try:
+                        self.api.delete("pods", dead.key())
+                    except KeyError:
+                        pass
+                    return  # the delete event re-enqueues; create next sync
                 self.api.create("pods", self._ordinal_pod(ss, i))
                 return
             if p.phase != "Running":

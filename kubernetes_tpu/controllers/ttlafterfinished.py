@@ -1,0 +1,57 @@
+"""TTL-after-finished controller
+(pkg/controller/ttlafterfinished/ttlafterfinished_controller.go, alpha
+behind the TTLAfterFinished gate in this reference era).
+
+Deletes a Job `ttlSecondsAfterFinished` seconds after it finishes
+(status.completionTime set by the job controller). Deletion cascades to
+the Job's pods through the garbage collector (ownerReferences). Jobs
+whose TTL has not expired yet are retried on the resync tick (the
+reference uses a delaying workqueue; the manager tick is our clock).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from ..api.types import Job
+
+logger = logging.getLogger("kubernetes_tpu.controllers.ttlafterfinished")
+
+
+class TTLAfterFinishedController:
+    def __init__(self, api, job_informer, queue):
+        self.api = api
+        self.job_informer = job_informer
+        self.queue = queue
+        self.sync_count = 0
+
+    def register(self) -> None:
+        self.job_informer.add_event_handler(
+            on_add=lambda j: self._maybe_enqueue(j),
+            on_update=lambda old, new: self._maybe_enqueue(new),
+        )
+
+    def _maybe_enqueue(self, job: Job) -> None:
+        if job.ttl_seconds_after_finished is not None and job.completion_time is not None:
+            self.queue.add(job.key())
+
+    def resync_all(self) -> None:
+        for j in self.job_informer.list():
+            self._maybe_enqueue(j)
+
+    def sync(self, key: str) -> None:
+        self.sync_count += 1
+        job: Optional[Job] = self.job_informer.get(key)
+        if job is None or job.ttl_seconds_after_finished is None:
+            return
+        if job.completion_time is None:
+            return  # not finished yet
+        if time.time() < job.completion_time + job.ttl_seconds_after_finished:
+            return  # not expired; the next tick re-enqueues
+        logger.info("ttlafterfinished: deleting job %s", key)
+        try:
+            self.api.delete("jobs", key)
+        except KeyError:
+            pass

@@ -247,7 +247,11 @@ class _BatchConflictIndex:
     ~2us and ~250us per LIGHT recheck on the quadratic config."""
 
     def __init__(self):
-        # (key, value of commit node) → {spec: [(committed pod, term, t_i)]}
+        # (key, value of commit node) → {spec: {t_i: [(committed pod, term)]}}
+        # — keyed by term INDEX inside the spec bucket: two anti terms
+        # sharing a topology key land in the same (kv, spec) bucket and
+        # must each be evaluated (one representative per term, not per
+        # bucket)
         self._anti_by_kv: Dict[Tuple[str, str], Dict] = {}
         # (key, value of commit node) → {spec: [committed pods]}
         self._commits_by_kv: Dict[Tuple[str, str], Dict] = {}
@@ -273,8 +277,8 @@ class _BatchConflictIndex:
             v = node.labels.get(k) if k else None
             if v is not None:
                 self._anti_by_kv.setdefault((k, v), {}).setdefault(
-                    spec, []
-                ).append((pod, term, t_i))
+                    spec, {}
+                ).setdefault(t_i, []).append((pod, term))
 
     def remove(self, pod: Pod) -> None:
         self._rolled_back.add(id(pod))
@@ -286,16 +290,18 @@ class _BatchConflictIndex:
         p_spec = spec_key(pod)
         memo = self._match_memo
         for kv in node.labels.items():
-            for c_spec, entries in self._anti_by_kv.get(kv, {}).items():
-                # one representative match per (commit spec, term, pod spec)
-                c, term, t_i = entries[0]
-                mk = ("A", c_spec, t_i, p_spec)
-                hit = memo.get(mk)
-                if hit is None:
-                    hit = pod_matches_term(pod, c, term)
-                    memo[mk] = hit
-                if hit and self._any_live(entries, lambda e: e[0]):
-                    return True
+            for c_spec, by_term in self._anti_by_kv.get(kv, {}).items():
+                # one representative match per (commit spec, term, pod
+                # spec) — every DISTINCT term of the spec is consulted
+                for t_i, entries in by_term.items():
+                    c, term = entries[0]
+                    mk = ("A", c_spec, t_i, p_spec)
+                    hit = memo.get(mk)
+                    if hit is None:
+                        hit = pod_matches_term(pod, c, term)
+                        memo[mk] = hit
+                    if hit and self._any_live(entries, lambda e: e[0]):
+                        return True
         a = pod.affinity
         if a is not None and a.pod_anti_affinity is not None:
             for t_i, term in enumerate(a.pod_anti_affinity.required):

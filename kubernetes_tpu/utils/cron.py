@@ -1,0 +1,128 @@
+"""Minimal 5-field cron schedule evaluation for the CronJob controller.
+
+The reference vendors robfig/cron (vendor/github.com/robfig/cron) for
+`getRecentUnmetScheduleTimes` (pkg/controller/cronjob/utils.go). This is a
+from-scratch evaluator for the standard subset CronJob specs actually use:
+minute hour day-of-month month day-of-week, each field being `*`, `*/n`,
+`a`, `a-b`, `a,b,c` or combinations joined by commas. Day-of-month and
+day-of-week combine with OR when both are restricted (POSIX cron rule).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Set, Tuple
+
+_RANGES = [(0, 59), (0, 23), (1, 31), (1, 12), (0, 6)]
+
+
+class CronParseError(ValueError):
+    pass
+
+
+def _parse_field(expr: str, lo: int, hi: int) -> Tuple[Set[int], bool]:
+    """→ (allowed values, is_wildcard)."""
+    allowed: Set[int] = set()
+    wildcard = False
+    for part in expr.split(","):
+        part = part.strip()
+        step = 1
+        if "/" in part:
+            part, _, step_s = part.partition("/")
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise CronParseError(f"bad step {step_s!r}")
+            if step <= 0:
+                raise CronParseError(f"bad step {step}")
+        if part in ("*", ""):
+            if step == 1:
+                wildcard = True
+            allowed.update(range(lo, hi + 1, step))
+            continue
+        if "-" in part:
+            a_s, _, b_s = part.partition("-")
+            try:
+                a, b = int(a_s), int(b_s)
+            except ValueError:
+                raise CronParseError(f"bad range {part!r}")
+        else:
+            try:
+                a = b = int(part)
+            except ValueError:
+                raise CronParseError(f"bad value {part!r}")
+        if not (lo <= a <= hi and lo <= b <= hi and a <= b):
+            raise CronParseError(f"value out of range [{lo},{hi}]: {part!r}")
+        allowed.update(range(a, b + 1, step))
+    return allowed, wildcard
+
+
+class CronSchedule:
+    def __init__(self, spec: str):
+        fields = spec.split()
+        if len(fields) != 5:
+            raise CronParseError(f"want 5 fields, got {len(fields)}: {spec!r}")
+        parsed = [_parse_field(f, lo, hi) for f, (lo, hi) in zip(fields, _RANGES)]
+        (self.minutes, _), (self.hours, _) = parsed[0], parsed[1]
+        (self.dom, self.dom_star), (self.months, _), (self.dow, self.dow_star) = (
+            parsed[2], parsed[3], parsed[4])
+
+    def _day_matches(self, t: time.struct_time) -> bool:
+        dom_ok = t.tm_mday in self.dom
+        # python weekday: Mon=0; cron: Sun=0
+        dow_ok = ((t.tm_wday + 1) % 7) in self.dow
+        if self.dom_star and self.dow_star:
+            return True
+        if self.dom_star:
+            return dow_ok
+        if self.dow_star:
+            return dom_ok
+        return dom_ok or dow_ok  # POSIX OR rule when both restricted
+
+    def matches(self, epoch: float) -> bool:
+        t = time.localtime(epoch)
+        return (t.tm_min in self.minutes and t.tm_hour in self.hours
+                and t.tm_mon in self.months and self._day_matches(t))
+
+    def next_after(self, epoch: float, horizon_days: int = 366) -> Optional[float]:
+        """First scheduled time strictly after `epoch` (minute granularity)."""
+        # round up to the next whole minute
+        t = int(epoch // 60 + 1) * 60
+        end = t + horizon_days * 86400
+        while t < end:
+            st = time.localtime(t)
+            if st.tm_mon not in self.months:
+                # skip to the 1st of next month
+                y, m = st.tm_year, st.tm_mon + 1
+                if m > 12:
+                    y, m = y + 1, 1
+                t = int(time.mktime((y, m, 1, 0, 0, 0, 0, 0, -1)))
+                continue
+            if not self._day_matches(st):
+                t = int(time.mktime((st.tm_year, st.tm_mon, st.tm_mday, 0, 0, 0, 0, 0, -1))) + 86400
+                continue
+            if st.tm_hour not in self.hours:
+                t = int(time.mktime((st.tm_year, st.tm_mon, st.tm_mday, st.tm_hour, 0, 0, 0, 0, -1))) + 3600
+                continue
+            if st.tm_min not in self.minutes:
+                t += 60
+                continue
+            return float(t)
+        return None
+
+    def unmet_since(self, last: float, now: float, limit: int = 100) -> List[float]:
+        """Scheduled times in (last, now] — getRecentUnmetScheduleTimes.
+        The walk is BOUNDED at limit+1 iterations: past 100 missed starts
+        the reference gives up with a too-many-missed-times event
+        (cronjob_controller.go — its answer to clock skew / long
+        downtime); we signal the same state by returning an empty list,
+        and the CronJob controller recovers by advancing
+        lastScheduleTime instead of walking months of minutes."""
+        out: List[float] = []
+        t = self.next_after(last)
+        while t is not None and t <= now:
+            out.append(t)
+            if len(out) > limit:
+                return []  # too many missed starts — give up, bounded
+            t = self.next_after(t)
+        return out

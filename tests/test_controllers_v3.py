@@ -1,0 +1,343 @@
+"""Wave-2 controllers (controller count 9 → 17): ReplicationController,
+PodGC, TTLAfterFinished, CronJob, Disruption (PDB status), ServiceAccount,
+ResourceQuota, HorizontalPodAutoscaler. Reference anchors:
+pkg/controller/{replication,podgc,ttlafterfinished,cronjob,disruption,
+serviceaccount,resourcequota,podautoscaler}. Where placement matters the
+pods flow through the real scheduler loop (same harness as
+test_controllers_v2)."""
+
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Container,
+    CronJob,
+    Deployment,
+    HorizontalPodAutoscaler,
+    Job,
+    LabelSelector,
+    Namespace,
+    Pod,
+    PodDisruptionBudget,
+    PodMetrics,
+    Quantity,
+    RESOURCE_CPU,
+    RESOURCE_MEMORY,
+    ReplicationController,
+    ResourceQuota,
+)
+from kubernetes_tpu.apiserver import FakeAPIServer
+from kubernetes_tpu.client import APIBinder, start_scheduler_informers
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.models.generators import make_node
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.scheduler.eventhandlers import EventHandlers
+from kubernetes_tpu.utils.cron import CronSchedule
+
+
+def _template(app: str, cpu="100m") -> Pod:
+    return Pod(
+        name="template", labels={"app": app},
+        containers=[Container(name="c", requests={
+            RESOURCE_CPU: Quantity.parse(cpu),
+            RESOURCE_MEMORY: Quantity.parse("64Mi"),
+        })],
+    )
+
+
+def _pods(api, app=None):
+    pods, _ = api.list("pods")
+    if app is None:
+        return pods
+    return [p for p in pods if p.labels.get("app") == app]
+
+
+def _wait(pred, timeout=15.0, msg="condition"):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def stack():
+    api = FakeAPIServer()
+    for i in range(3):
+        api.create("nodes", make_node(
+            f"n{i}", cpu_milli=4000, mem=8 * 2**30,
+            labels={"kubernetes.io/hostname": f"n{i}"},
+        ))
+    sched = Scheduler(batch_size=16, deterministic=True, enable_preemption=False)
+    sched.binder = Binder(APIBinder(api).bind)
+    handlers = EventHandlers(sched.cache, sched.queue, "default-scheduler")
+    informers = start_scheduler_informers(api, handlers)
+    for inf in informers.values():
+        inf.wait_for_sync()
+    cm = ControllerManager(api, resync_period_s=0.2).start()
+
+    def drain(expect, app=None, deadline=20.0):
+        end = time.monotonic() + deadline
+        while time.monotonic() < end:
+            sched.schedule_batch()
+            sched.wait_for_binds()
+            bound = [p for p in _pods(api, app) if p.node_name]
+            if len(bound) >= expect and cm.wait_idle(timeout=0.5):
+                return bound
+            time.sleep(0.05)
+        raise AssertionError(
+            f"drain: wanted {expect} bound, have "
+            f"{[(p.key(), p.node_name, p.phase) for p in _pods(api, app)]}"
+        )
+
+    yield api, sched, cm, drain
+    cm.stop()
+    for inf in informers.values():
+        inf.stop()
+
+
+# ---------------------------------------------------------------------------
+# cron schedule evaluation (vendored robfig/cron equivalent)
+# ---------------------------------------------------------------------------
+
+def test_cron_schedule_basics():
+    s = CronSchedule("*/5 * * * *")
+    base = time.mktime((2026, 8, 1, 12, 2, 0, 0, 0, -1))
+    nxt = s.next_after(base)
+    assert time.localtime(nxt).tm_min == 5
+    # exactly on a boundary → strictly after
+    on = time.mktime((2026, 8, 1, 12, 5, 0, 0, 0, -1))
+    assert time.localtime(s.next_after(on)).tm_min == 10
+
+    daily = CronSchedule("30 3 * * *")
+    t = time.localtime(daily.next_after(base))
+    assert (t.tm_hour, t.tm_min) == (3, 30) and t.tm_mday == 2
+
+    unmet = s.unmet_since(base, base + 11 * 60)
+    assert [time.localtime(u).tm_min for u in unmet] == [5, 10]
+
+    with pytest.raises(Exception):
+        CronSchedule("not a schedule")
+    # bounded give-up: a month-stale lastScheduleTime must not walk
+    # 40k minutes — too-many-missed returns [] (cronjob controller then
+    # self-heals by advancing lastScheduleTime)
+    t0 = time.monotonic()
+    assert s.unmet_since(base - 30 * 86400, base) == []
+    assert time.monotonic() - t0 < 1.0
+    # day-of-week field: Sunday=0; 2026-08-02 is a Sunday
+    sun = CronSchedule("0 12 * * 0")
+    sat = time.mktime((2026, 8, 1, 13, 0, 0, 0, 0, -1))
+    t = time.localtime(sun.next_after(sat))
+    assert (t.tm_mday, t.tm_hour) == (2, 12)
+
+
+# ---------------------------------------------------------------------------
+# controllers
+# ---------------------------------------------------------------------------
+
+def test_replicationcontroller_scales_and_replaces(stack):
+    api, sched, cm, drain = stack
+    api.create("replicationcontrollers", ReplicationController(
+        name="rc", replicas=3,
+        selector=LabelSelector(match_labels={"app": "rc"}),
+        template=_template("rc"),
+    ))
+    bound = drain(3, app="rc")
+    assert len(bound) == 3
+    assert all(any(r.get("kind") == "ReplicationController"
+                   for r in p.owner_references) for p in bound)
+    # kill one replica: the RC adapter refills it
+    api.delete("pods", bound[0].key())
+    drain(3, app="rc")
+
+    # scale down through the API
+    rc = api.get("replicationcontrollers", "default/rc")
+    rc.replicas = 1
+    api.update("replicationcontrollers", rc)
+    _wait(lambda: len([p for p in _pods(api, "rc")
+                       if p.phase not in ("Succeeded", "Failed")]) == 1,
+          msg="RC scale-down to 1")
+
+
+def test_podgc_orphaned_and_unscheduled_terminating(stack):
+    api, sched, cm, drain = stack
+    # pod bound to a node that was deleted → orphan sweep removes it
+    orphan = Pod(name="orphan", labels={"app": "gcpod"}, node_name="gone-node",
+                 containers=_template("gcpod").containers)
+    api.create("pods", orphan)
+    # unscheduled pod already marked terminating → force-deleted
+    doomed = Pod(name="doomed", labels={"app": "gcpod"},
+                 containers=_template("gcpod").containers)
+    doomed.deletion_timestamp = time.time()
+    api.create("pods", doomed)
+    _wait(lambda: len(_pods(api, "gcpod")) == 0, msg="podgc sweeps")
+
+
+def test_job_status_ttl_and_cascade(stack):
+    api, sched, cm, drain = stack
+    api.create("jobs", Job(
+        name="once", parallelism=1, completions=1,
+        template=_template("once"), ttl_seconds_after_finished=1,
+    ))
+    bound = drain(1, app="once")
+    # workload reports success
+    p = api.get("pods", bound[0].key())
+    p.phase = "Succeeded"
+    api.update("pods", p)
+    # job controller stamps status.completionTime; TTL controller deletes
+    # the job 1s later; the GC cascade then removes its pods
+    _wait(lambda: "default/once" not in
+          {j.key() for j in api.list("jobs")[0]}, msg="TTL deletes finished job")
+    _wait(lambda: len(_pods(api, "once")) == 0, msg="GC cascades job pods")
+
+
+def test_finished_job_stays_finished_after_pod_gc(stack):
+    """A completed Job whose Succeeded pods are later deleted must neither
+    re-create pods nor hot-loop status writes (completionTime is
+    write-once terminal, job_controller.go Complete condition)."""
+    api, sched, cm, drain = stack
+    api.create("jobs", Job(name="keep", parallelism=1, completions=1,
+                           template=_template("keep")))
+    bound = drain(1, app="keep")
+    p = api.get("pods", bound[0].key())
+    p.phase = "Succeeded"
+    api.update("pods", p)
+    _wait(lambda: api.get("jobs", "default/keep").completion_time is not None,
+          msg="job completion stamped")
+    # simulate PodGC's terminated sweep removing the succeeded pod
+    api.delete("pods", bound[0].key())
+    time.sleep(0.5)
+    job = api.get("jobs", "default/keep")
+    assert job.completion_time is not None and job.succeeded == 0
+    assert len(_pods(api, "keep")) == 0  # no replacement pods
+    rv = job.resource_version
+    time.sleep(0.5)
+    assert api.get("jobs", "default/keep").resource_version == rv  # settled
+
+
+def test_cronjob_spawns_scheduled_jobs(stack):
+    api, sched, cm, drain = stack
+    cj = CronJob(
+        name="tick", schedule="* * * * *",
+        job_template=Job(parallelism=1, completions=1, template=_template("tick")),
+    )
+    # two minute-boundaries already unmet → the controller starts the most
+    # recent one immediately (getRecentUnmetScheduleTimes semantics)
+    cj.last_schedule_time = time.time() - 120
+    api.create("cronjobs", cj)
+    _wait(lambda: len(api.list("jobs")[0]) >= 1, msg="cronjob spawned a job")
+    jobs, _ = api.list("jobs")
+    assert all(any(r.get("kind") == "CronJob" for r in j.owner_references)
+               for j in jobs)
+    stored = api.get("cronjobs", "default/tick")
+    assert stored.last_schedule_time is not None and stored.last_schedule_time > cj.last_schedule_time
+    drain(1, app="tick")  # its pod flows through the real scheduler
+
+
+def test_cronjob_forbid_policy_skips_while_active(stack):
+    api, sched, cm, drain = stack
+    cj = CronJob(
+        name="fb", schedule="* * * * *", concurrency_policy="Forbid",
+        job_template=Job(parallelism=1, completions=1, template=_template("fb")),
+    )
+    cj.last_schedule_time = time.time() - 120
+    api.create("cronjobs", cj)
+    _wait(lambda: len(api.list("jobs")[0]) == 1, msg="first job")
+    # the job is active (no completion); further unmet times must NOT start
+    # a second one while Forbid holds
+    time.sleep(0.6)  # several resync ticks
+    assert len(api.list("jobs")[0]) == 1
+
+
+def test_disruption_controller_computes_pdb_status(stack):
+    api, sched, cm, drain = stack
+    api.create("poddisruptionbudgets", PodDisruptionBudget(
+        name="budget", selector=LabelSelector(match_labels={"app": "pdb"}),
+        min_available=2,
+    ))
+    for i in range(3):
+        p = Pod(name=f"pdb-{i}", labels={"app": "pdb"},
+                containers=_template("pdb").containers)
+        api.create("pods", p)
+    drain(3, app="pdb")
+    for p in _pods(api, "pdb"):
+        live = api.get("pods", p.key())
+        live.phase = "Running"
+        api.update("pods", live)
+    def status_ok():
+        pdb = api.get("poddisruptionbudgets", "default/budget")
+        return (pdb.current_healthy == 3 and pdb.desired_healthy == 2
+                and pdb.disruptions_allowed == 1 and pdb.expected_pods == 3)
+    _wait(status_ok, msg="PDB status")
+
+    # percentage maxUnavailable: 34% of 3 → 1.02 ceil → 2 → desired=1, allowed=2
+    pdb = api.get("poddisruptionbudgets", "default/budget")
+    pdb.min_available = None
+    pdb.max_unavailable = "34%"
+    api.update("poddisruptionbudgets", pdb)
+    def pct_ok():
+        got = api.get("poddisruptionbudgets", "default/budget")
+        return got.desired_healthy == 1 and got.disruptions_allowed == 2
+    _wait(pct_ok, msg="percent maxUnavailable")
+
+
+def test_serviceaccount_default_created_and_recreated(stack):
+    api, sched, cm, drain = stack
+    api.create("namespaces", Namespace(name="prod"))
+    _wait(lambda: any(sa.key() == "prod/default"
+                      for sa in api.list("serviceaccounts")[0]),
+          msg="default SA created")
+    api.delete("serviceaccounts", "prod/default")
+    _wait(lambda: any(sa.key() == "prod/default"
+                      for sa in api.list("serviceaccounts")[0]),
+          msg="default SA recreated")
+
+
+def test_resourcequota_status_tracks_usage(stack):
+    api, sched, cm, drain = stack
+    api.create("resourcequotas", ResourceQuota(
+        name="quota", namespace="default",
+        hard={"pods": 5, "requests.cpu": 1000, "count/services": 2},
+    ))
+    for i in range(2):
+        api.create("pods", Pod(name=f"q-{i}", labels={"app": "q"},
+                               containers=_template("q", cpu="300m").containers))
+    def used_ok():
+        rq = api.get("resourcequotas", "default/quota")
+        return rq.used.get("pods") == 2 and rq.used.get("requests.cpu") == 600
+    _wait(used_ok, msg="quota usage")
+    api.delete("pods", "default/q-0")
+    _wait(lambda: api.get("resourcequotas", "default/quota").used.get("pods") == 1,
+          msg="quota replenished on delete")
+
+
+def test_hpa_scales_deployment_from_pod_metrics(stack):
+    api, sched, cm, drain = stack
+    api.create("deployments", Deployment(
+        name="web", replicas=1,
+        selector=LabelSelector(match_labels={"app": "web"}),
+        template=_template("web", cpu="100m"),
+    ))
+    bound = drain(1, app="web")
+    api.create("horizontalpodautoscalers", HorizontalPodAutoscaler(
+        name="web", target_kind="Deployment", target_name="web",
+        min_replicas=1, max_replicas=4, target_cpu_utilization_pct=100,
+    ))
+    # usage = 200m against a 100m request → 200% of target → desired 2
+    for p in _pods(api, "web"):
+        api.create("podmetrics", PodMetrics(
+            name=p.name, namespace=p.namespace, cpu_milli=200, timestamp=time.time(),
+        ))
+    _wait(lambda: api.get("deployments", "default/web").replicas == 2,
+          msg="HPA scaled deployment to 2")
+    drain(2, app="web")
+    # the new replica has no metrics yet: missing-metrics conservatism
+    # (assumed 0 on the way up) must HOLD at 2, not run to max_replicas
+    time.sleep(0.8)  # several resync ticks
+    assert api.get("deployments", "default/web").replicas == 2
+    hpa = api.get("horizontalpodautoscalers", "default/web")
+    assert hpa.desired_replicas == 2 and hpa.current_cpu_utilization_pct == 200

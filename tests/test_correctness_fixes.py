@@ -72,6 +72,45 @@ def test_constraint_free_pod_respects_earlier_anti_affinity_commit():
     assert res.assignments["default/carrier"] != res.assignments["default/free"]
 
 
+def test_multi_anti_terms_same_topology_key_both_enforced():
+    """A committed pod carrying TWO required anti terms with the SAME
+    topologyKey must block later pods matching EITHER term — the conflict
+    index buckets terms by (kv, spec) and must evaluate every distinct
+    term, not just a bucket representative (predicates.go:1284 iterates
+    all existing-pod terms). Unit-tests _BatchConflictIndex directly: the
+    device inb tables cover same-dispatch pods, but the host index is the
+    guard on speculative-chain rechecks."""
+    from kubernetes_tpu.scheduler.driver import _BatchConflictIndex
+
+    nodes = _host_nodes(2)
+    t_x = PodAffinityTerm(
+        label_selector=LabelSelector(match_labels={"app": "x"}), topology_key=HOSTNAME)
+    t_y = PodAffinityTerm(
+        label_selector=LabelSelector(match_labels={"app": "y"}), topology_key=HOSTNAME)
+    carrier = make_pod("carrier", labels={"team": "z"})
+    carrier.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[t_x, t_y]))
+    ix = _BatchConflictIndex()
+    ix.add_commit(carrier, nodes[0])
+    ix.add_anti(carrier, nodes[0])
+    hits_first = make_pod("first", labels={"app": "x"})
+    hits_second = make_pod("second", labels={"app": "y"})
+    clean = make_pod("clean", labels={"app": "z"})
+    assert ix.anti_conflict(hits_first, nodes[0])
+    assert ix.anti_conflict(hits_second, nodes[0])  # the dropped-term case
+    assert not ix.anti_conflict(clean, nodes[0])
+    assert not ix.anti_conflict(hits_second, nodes[1])  # other domain is fine
+    # end-to-end: same pair through a real batch still places apart
+    sched, _ = _mk(nodes)
+    carrier.priority = 100
+    later = make_pod("later", labels={"app": "y"})
+    later.priority = 0
+    sched.queue.add(carrier)
+    sched.queue.add(later)
+    res = sched.schedule_batch()
+    assert res.scheduled == 2, res
+    assert res.assignments["default/carrier"] != res.assignments["default/later"]
+
+
 def test_constraint_free_pod_fails_when_anti_affinity_blocks_everywhere():
     # one node: carrier takes it; the matching constraint-free pod must NOT
     # be committed onto the same host (the reference's sequential loop
