@@ -826,8 +826,8 @@ def pod_from_k8s(obj: dict) -> Pod:
         node_name=spec.get("nodeName", ""),
         **(
             {"creation_timestamp": _parse_time(meta.get("creationTimestamp"))}
-            if meta.get("creationTimestamp") is not None
-            else {}
+            if _parse_time(meta.get("creationTimestamp")) is not None
+            else {}  # unparseable -> default_factory now() (never None)
         ),
         node_selector=dict(spec.get("nodeSelector") or {}),
         affinity=_affinity_from(spec.get("affinity")),
@@ -1416,6 +1416,7 @@ class CronJob:
     namespace: str = "default"
     uid: str = field(default_factory=_new_uid)
     resource_version: str = ""
+    creation_timestamp: float = field(default_factory=time.time)
     schedule: str = "* * * * *"
     suspend: bool = False
     concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
@@ -1443,6 +1444,8 @@ def cronjob_from_k8s(obj: dict) -> CronJob:
         namespace=meta.get("namespace", "default"),
         uid=meta.get("uid") or _new_uid(),
         resource_version=str(meta.get("resourceVersion", "")),
+        **({"creation_timestamp": _parse_time(meta.get("creationTimestamp"))}
+           if _parse_time(meta.get("creationTimestamp")) is not None else {}),
         schedule=spec.get("schedule", "* * * * *"),
         suspend=bool(spec.get("suspend", False)),
         concurrency_policy=spec.get("concurrencyPolicy", "Allow"),
@@ -1452,7 +1455,8 @@ def cronjob_from_k8s(obj: dict) -> CronJob:
 
 
 def cronjob_to_k8s(cj: CronJob) -> dict:
-    meta: Dict[str, Any] = {"name": cj.name, "namespace": cj.namespace, "uid": cj.uid}
+    meta: Dict[str, Any] = {"name": cj.name, "namespace": cj.namespace, "uid": cj.uid,
+                            "creationTimestamp": _format_time(cj.creation_timestamp)}
     if cj.resource_version:
         meta["resourceVersion"] = cj.resource_version
     spec: Dict[str, Any] = {

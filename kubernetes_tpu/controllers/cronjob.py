@@ -65,9 +65,12 @@ class CronJobController:
             logger.warning("cronjob %s: unparseable schedule %r", key, cj.schedule)
             return
         now = time.time()
-        # no lastScheduleTime yet: only look back one window, not to the
-        # epoch (the reference starts from cronJob creation time)
-        last = cj.last_schedule_time if cj.last_schedule_time is not None else now - 61
+        # no lastScheduleTime yet: the earliest time we may fire for is the
+        # CronJob's creation (getRecentUnmetScheduleTimes earliestTime =
+        # sj.ObjectMeta.CreationTimestamp) — never a boundary that predates
+        # the object
+        last = cj.last_schedule_time if cj.last_schedule_time is not None \
+            else cj.creation_timestamp
         unmet = sched.unmet_since(last, now)
         if not unmet:
             if cj.last_schedule_time is not None and sched.next_after(last) is not None \
@@ -86,6 +89,7 @@ class CronJobController:
                     pass
             return
         scheduled = unmet[-1]  # most recent only (reference: startJob for the last)
+        job_name = f"{cj.name}-{int(scheduled // 60)}"
 
         active = [j for j in self._owned_jobs(cj)
                   if j.completion_time is None]
@@ -93,13 +97,19 @@ class CronJobController:
             return
         if cj.concurrency_policy == "Replace":
             for j in active:
+                if j.name == job_name:
+                    # already the job for this scheduled time (informer lag
+                    # can replay the same unmet time before the status write
+                    # lands) — deleting it would free the name and defeat
+                    # the ConflictError dedupe below, churning the job
+                    continue
                 try:
                     self.api.delete("jobs", j.key())
                 except KeyError:
                     pass
 
         job = copy.deepcopy(cj.job_template)
-        job.name = f"{cj.name}-{int(scheduled // 60)}"
+        job.name = job_name
         job.namespace = cj.namespace
         job.resource_version = ""
         job.owner_references = [

@@ -45,7 +45,9 @@ _TARGET_KINDS = {
 
 
 class HorizontalPodAutoscalerController:
-    def __init__(self, api, hpa_informer, pod_informer, podmetrics_informer, queue):
+    def __init__(self, api, hpa_informer, pod_informer, podmetrics_informer, queue,
+                 downscale_forbidden_s: float = 300.0,
+                 upscale_forbidden_s: float = 180.0):
         self.api = api
         self.hpa_informer = hpa_informer
         self.pod_informer = pod_informer
@@ -53,6 +55,11 @@ class HorizontalPodAutoscalerController:
         self.queue = queue
         self.sync_count = 0
         self.scale_count = 0
+        # horizontal.go shouldScale: a rescale is only allowed once the
+        # forbidden window since lastScaleTime has passed (5m down / 3m up
+        # defaults), so transient metric dips/spikes don't flap replicas
+        self.downscale_forbidden_s = downscale_forbidden_s
+        self.upscale_forbidden_s = upscale_forbidden_s
 
     def register(self) -> None:
         self.hpa_informer.add_event_handler(
@@ -120,12 +127,24 @@ class HorizontalPodAutoscalerController:
         desired = current if abs(ratio - 1.0) <= TOLERANCE else math.ceil(count * ratio)
         desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
 
+        if desired != current and hpa.last_scale_time is not None:
+            since = time.time() - hpa.last_scale_time
+            window = (self.downscale_forbidden_s if desired < current
+                      else self.upscale_forbidden_s)
+            if since < window:
+                # forbidden window: hold the scale but still publish status
+                # (reconcileAutoscaler sets desiredReplicas = currentReplicas
+                # when shouldScale is false, then writes status regardless)
+                desired = current
+
+        scaled_now = False
         if desired != current:
             scaled = copy.copy(target)
             scaled.replicas = desired
             try:
                 self.api.update(kind, scaled)
                 self.scale_count += 1
+                scaled_now = True
             except (KeyError, ConflictError):
                 return  # retried on the next tick
 
@@ -136,7 +155,7 @@ class HorizontalPodAutoscalerController:
         st.current_replicas = current
         st.desired_replicas = desired
         st.current_cpu_utilization_pct = int(utilization)
-        if desired != current:
+        if scaled_now:
             st.last_scale_time = time.time()
         try:
             self.api.update("horizontalpodautoscalers", st)

@@ -195,6 +195,10 @@ class ControllerManager:
             )
             self.controllers.append(self.resourcequota)
             self._queues.append(q)
+            # count/{kind} usage has no per-kind watch; the periodic
+            # resync refreshes it after non-pod deletes (the reference
+            # quota controller runs a full resync for the same reason)
+            self._tickables.append(self.resourcequota)
         if "horizontalpodautoscaler" in controllers:
             q = WorkQueue()
             self.horizontalpodautoscaler = HorizontalPodAutoscalerController(

@@ -341,3 +341,107 @@ def test_hpa_scales_deployment_from_pod_metrics(stack):
     assert api.get("deployments", "default/web").replicas == 2
     hpa = api.get("horizontalpodautoscalers", "default/web")
     assert hpa.desired_replicas == 2 and hpa.current_cpu_utilization_pct == 200
+
+
+# ---------------------------------------------------------------------------
+# round-5 advisor fixes: creation floor, Replace race, HPA windows, quota resync
+# ---------------------------------------------------------------------------
+
+def _clear_minute_boundary(margin=3.0):
+    """Sleep past the next minute boundary if it is closer than `margin`,
+    so minute-schedule tests can't race a real boundary mid-assert."""
+    now = time.time()
+    nxt = 60.0 * (int(now // 60) + 1)
+    if nxt - now < margin:
+        time.sleep(nxt - now + 0.1)
+
+
+def test_cronjob_fresh_object_waits_for_post_creation_boundary(stack):
+    # cronjob_controller.go getRecentUnmetScheduleTimes: earliestTime is the
+    # CronJob's creationTimestamp when lastScheduleTime is unset — a freshly
+    # created '* * * * *' job must NOT fire for a boundary that predates it
+    api, sched, cm, drain = stack
+    _clear_minute_boundary()
+    api.create("cronjobs", CronJob(
+        name="fresh", schedule="* * * * *",
+        job_template=Job(parallelism=1, completions=1, template=_template("fresh")),
+    ))
+    time.sleep(1.0)  # several resync ticks
+    assert len(api.list("jobs")[0]) == 0, \
+        "fresh cronjob fired for a pre-creation minute boundary"
+
+
+def test_cronjob_replace_does_not_churn_own_scheduled_job(stack):
+    # Replace must not delete the active job that already represents the
+    # current scheduled time (informer-lag replay of the same unmet time
+    # would otherwise free the name and defeat the ConflictError dedupe)
+    api, sched, cm, drain = stack
+    _clear_minute_boundary(margin=8.0)  # test body runs ~2-3s; stay clear
+    cj = CronJob(
+        name="rep", schedule="* * * * *", concurrency_policy="Replace",
+        job_template=Job(parallelism=1, completions=1, template=_template("rep")),
+    )
+    cj.last_schedule_time = time.time() - 120
+    api.create("cronjobs", cj)
+    _wait(lambda: len(api.list("jobs")[0]) == 1, msg="first job")
+    job = api.list("jobs")[0][0]
+    # replay: rewind lastScheduleTime as if the status write were unobserved
+    stored = api.get("cronjobs", "default/rep")
+    stored.last_schedule_time = time.time() - 120
+    api.update("cronjobs", stored)
+    time.sleep(0.8)  # several resync ticks recompute the same scheduled time
+    jobs, _ = api.list("jobs")
+    assert len(jobs) == 1 and jobs[0].uid == job.uid, \
+        "Replace churned the job for its own scheduled time"
+
+
+def test_hpa_forbidden_windows_gate_rescale(stack):
+    # horizontal.go shouldScale: no rescale within the upscale (3m) /
+    # downscale (5m) forbidden window after lastScaleTime
+    api, sched, cm, drain = stack
+    api.create("deployments", Deployment(
+        name="win", replicas=1,
+        selector=LabelSelector(match_labels={"app": "win"}),
+        template=_template("win", cpu="100m"),
+    ))
+    drain(1, app="win")
+    hpa = HorizontalPodAutoscaler(
+        name="win", target_kind="Deployment", target_name="win",
+        min_replicas=1, max_replicas=4, target_cpu_utilization_pct=100,
+    )
+    hpa.last_scale_time = time.time()  # a scale "just happened"
+    api.create("horizontalpodautoscalers", hpa)
+    for p in _pods(api, "win"):
+        api.create("podmetrics", PodMetrics(
+            name=p.name, namespace=p.namespace, cpu_milli=200, timestamp=time.time(),
+        ))
+    time.sleep(0.8)  # several resync ticks at 200% of target
+    assert api.get("deployments", "default/win").replicas == 1, \
+        "scaled inside the upscale forbidden window"
+    held = api.get("horizontalpodautoscalers", "default/win")
+    # status is still published while the scale is held (setStatus runs
+    # regardless of shouldScale; desiredReplicas reports current)
+    assert held.current_cpu_utilization_pct == 200 and held.desired_replicas == 1
+    # age the last scale past both windows → the held rescale proceeds
+    stored = api.get("horizontalpodautoscalers", "default/win")
+    stored.last_scale_time = time.time() - 400
+    api.update("horizontalpodautoscalers", stored)
+    _wait(lambda: api.get("deployments", "default/win").replicas == 2,
+          msg="rescale after window elapsed")
+
+
+def test_resourcequota_count_usage_refreshes_on_resync(stack):
+    # deleting a counted non-pod object emits no pod event; the periodic
+    # resync must still replenish count/{kind} usage
+    api, sched, cm, drain = stack
+    from kubernetes_tpu.api.types import Service
+    api.create("resourcequotas", ResourceQuota(
+        name="cq", namespace="default", hard={"count/services": 5},
+    ))
+    api.create("services", Service(name="s1", selector={"app": "a"}))
+    api.create("services", Service(name="s2", selector={"app": "b"}))
+    _wait(lambda: api.get("resourcequotas", "default/cq").used.get("count/services") == 2,
+          msg="count usage up")
+    api.delete("services", "default/s2")
+    _wait(lambda: api.get("resourcequotas", "default/cq").used.get("count/services") == 1,
+          msg="count usage replenished by resync")
