@@ -6,7 +6,9 @@ from .admission import (
     AdmissionError,
     Authorizer,
     DefaultTolerationSeconds,
+    LimitRangerAdmission,
     PriorityAdmission,
+    ResourceQuotaAdmission,
     default_admission_chain,
     install_system_priority_classes,
 )
@@ -29,7 +31,9 @@ __all__ = [
     "AdmissionError",
     "Authorizer",
     "DefaultTolerationSeconds",
+    "LimitRangerAdmission",
     "PriorityAdmission",
+    "ResourceQuotaAdmission",
     "default_admission_chain",
     "install_system_priority_classes",
     "APIServerHTTP",

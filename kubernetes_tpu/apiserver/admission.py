@@ -26,13 +26,16 @@ needing real policy plug in there.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..api.types import (
+    LimitRange,
     Pod,
     PriorityClass,
+    ResourceQuota,
     SYSTEM_PRIORITY_CLASSES,
     Toleration,
+    _request_value,
 )
 
 DEFAULT_NOT_READY_TOLERATION_SECONDS = 300
@@ -155,11 +158,160 @@ class DefaultTolerationSeconds:
         return pod
 
 
+class LimitRangerAdmission:
+    """LimitRanger (plugin/pkg/admission/limitranger/admission.go:77):
+    at pod CREATE, apply each namespace LimitRange's Container-type
+    defaults (defaultRequest → requests, default → limits; a defaulted
+    limit also backs an absent request, matching the API defaulting the
+    reference gets from pkg/apis/core/v1/defaults.go), then enforce
+    min/max. Defaulted requests CHANGE WHAT THE SCHEDULER SEES — a pod
+    with no requests in a defaulting namespace is scheduled at the
+    defaults, not at zero."""
+
+    def admit(self, store, kind: str, op: str, obj: Any):
+        if kind != "pods" or op != "CREATE":
+            return None
+        pod: Pod = obj
+        try:
+            ranges, _ = store.list("limitranges")
+        except Exception:
+            return None
+        ranges = [lr for lr in ranges if lr.namespace == pod.namespace]
+        if not ranges:
+            return None
+        mutated = False
+        for lr in ranges:
+            for item in lr.limits:
+                if item.type != "Container":
+                    continue
+                for c in list(pod.containers) + list(pod.init_containers):
+                    for r, q in item.default.items():
+                        if r not in c.limits:
+                            c.limits[r] = q
+                            mutated = True
+                    for r, q in item.default_request.items():
+                        if r not in c.requests:
+                            c.requests[r] = q
+                            mutated = True
+                    # no defaultRequest for r but a limit (given or
+                    # defaulted) exists → request defaults to the limit
+                    for r, q in c.limits.items():
+                        if r not in c.requests:
+                            c.requests[r] = q
+                            mutated = True
+                    for r, q in item.min.items():
+                        lo = _request_value(r, q)
+                        got = c.requests.get(r)
+                        if got is not None and _request_value(r, got) < lo:
+                            raise AdmissionError(
+                                f"minimum {r} usage per Container is {lo}, "
+                                f"but request is {_request_value(r, got)}"
+                            )
+                    for r, q in item.max.items():
+                        hi = _request_value(r, q)
+                        for which, d in (("request", c.requests), ("limit", c.limits)):
+                            got = d.get(r)
+                            if got is not None and _request_value(r, got) > hi:
+                                raise AdmissionError(
+                                    f"maximum {r} usage per Container is {hi}, "
+                                    f"but {which} is {_request_value(r, got)}"
+                                )
+        if mutated:
+            # requests changed after a possible resource_request() memo on
+            # this copy — drop stale memos so the scheduler sees defaults
+            pod.__dict__.pop("_req_cache", None)
+        return pod
+
+
+class ResourceQuotaAdmission:
+    """ResourceQuota admission (plugin/pkg/admission/resourcequota/
+    admission.go + controller.go checkQuotas): a CREATE that would push a
+    matching quota's usage over spec.hard is REJECTED before the object
+    exists — the scheduler never sees it. Admitted usage is charged to
+    quota.status.used synchronously (the reference's quota admission
+    writes status through the API the same way); the resourcequota
+    controller's full recompute corrects drift and replenishes on delete.
+    Charges are compare-and-swap on resourceVersion so concurrent creates
+    can't both squeeze through the last unit of quota."""
+
+    #: kinds whose CREATE is never quota-checked (quota objects themselves,
+    #: and status-ish kinds the reference's evaluator registry skips)
+    _EXEMPT = {"resourcequotas", "events", "podmetrics", "leases"}
+
+    def admit(self, store, kind: str, op: str, obj: Any):
+        if op != "CREATE" or kind in self._EXEMPT:
+            return None
+        ns = getattr(obj, "namespace", None)
+        if not ns:
+            return None
+        try:
+            quotas, _ = store.list("resourcequotas")
+        except Exception:
+            return None
+        for quota in quotas:
+            if quota.namespace != ns:
+                continue
+            delta = self._delta(quota, kind, obj)
+            if not delta:
+                continue
+            self._charge(store, quota.key(), delta)
+        return None
+
+    @staticmethod
+    def _delta(quota: ResourceQuota, kind: str, obj: Any) -> Dict[str, int]:
+        delta: Dict[str, int] = {}
+        if kind == "pods":
+            if "pods" in quota.hard:
+                delta["pods"] = 1
+            req = None
+            for k in quota.hard:
+                if k.startswith("requests."):
+                    if req is None:
+                        req = obj.resource_request()
+                    delta[k] = req.get(k.split(".", 1)[1], 0)
+        ck = f"count/{kind}"
+        if ck in quota.hard:
+            delta[ck] = 1
+        return {k: v for k, v in delta.items() if v}
+
+    @staticmethod
+    def _charge(store, quota_key: str, delta: Dict[str, int]) -> None:
+        from .store import ConflictError, NotFoundError
+
+        for _ in range(16):  # CAS retry under concurrent admissions
+            try:
+                live: ResourceQuota = store.get("resourcequotas", quota_key)
+            except NotFoundError:
+                return  # quota deleted mid-admission: nothing to enforce
+            new_used = dict(live.used)
+            for k, d in delta.items():
+                new_used[k] = new_used.get(k, 0) + d
+                if new_used[k] > live.hard.get(k, 0):
+                    raise AdmissionError(
+                        f"exceeded quota: {quota_key.split('/', 1)[1]}, "
+                        f"requested: {k}={d}, used: {k}={live.used.get(k, 0)}, "
+                        f"limited: {k}={live.hard[k]}"
+                    )
+            live.used = new_used
+            try:
+                store.update("resourcequotas", live, check_rv=True)
+                return
+            except ConflictError:
+                continue  # another admission charged first — re-read
+        raise AdmissionError(f"quota {quota_key}: charge contention, retry")
+
+
 def default_admission_chain() -> AdmissionChain:
     """The default-on scheduling-relevant plugin set (the reference enables
-    Priority and DefaultTolerationSeconds in its recommended plugins,
-    kubeapiserver/options/plugins.go)."""
-    return AdmissionChain([PriorityAdmission(), DefaultTolerationSeconds()])
+    Priority, DefaultTolerationSeconds, LimitRanger and ResourceQuota in
+    its recommended plugins, kubeapiserver/options/plugins.go; quota runs
+    LAST so it charges post-mutation values)."""
+    return AdmissionChain([
+        PriorityAdmission(),
+        DefaultTolerationSeconds(),
+        LimitRangerAdmission(),
+        ResourceQuotaAdmission(),
+    ])
 
 
 def install_system_priority_classes(store) -> None:

@@ -112,3 +112,137 @@ def test_default_toleration_ignores_noschedule_only(api):
     ne = [t for t in created.tolerations
           if t.key == "node.kubernetes.io/not-ready" and t.effect == "NoExecute"]
     assert len(ne) == 1 and ne[0].toleration_seconds == 300
+
+
+# ---------------------------------------------------------------------------
+# LimitRanger (plugin/pkg/admission/limitranger/admission.go:77)
+# ---------------------------------------------------------------------------
+
+def _lr(namespace="default", **item_kwargs):
+    from kubernetes_tpu.api.types import LimitRange, LimitRangeItem
+
+    return LimitRange(name="limits", namespace=namespace,
+                      limits=[LimitRangeItem(type="Container", **item_kwargs)])
+
+
+def _bare_pod(name, namespace="default"):
+    from kubernetes_tpu.api.types import Container, Pod
+
+    return Pod(name=name, namespace=namespace, containers=[Container(name="c")])
+
+
+def test_limitranger_defaults_requests(api):
+    from kubernetes_tpu.api.types import Quantity, RESOURCE_CPU, RESOURCE_MEMORY
+
+    api.create("limitranges", _lr(default_request={
+        RESOURCE_CPU: Quantity.parse("200m"), RESOURCE_MEMORY: Quantity.parse("128Mi"),
+    }))
+    created = api.create("pods", _bare_pod("nolimits"))
+    req = created.resource_request()
+    # THIS is what the scheduler's informer sees: the defaults, not zero
+    assert req[RESOURCE_CPU] == 200 and req[RESOURCE_MEMORY] == 128 * 2**20
+
+
+def test_limitranger_default_limit_backs_request(api):
+    from kubernetes_tpu.api.types import Quantity, RESOURCE_CPU
+
+    api.create("limitranges", _lr(default={RESOURCE_CPU: Quantity.parse("500m")}))
+    created = api.create("pods", _bare_pod("limonly"))
+    c = created.containers[0]
+    assert c.limits[RESOURCE_CPU].milli_value() == 500
+    assert created.resource_request()[RESOURCE_CPU] == 500
+
+
+def test_limitranger_min_max_enforced(api):
+    from kubernetes_tpu.api.types import Container, Pod, Quantity, RESOURCE_CPU
+
+    api.create("limitranges", _lr(
+        min={RESOURCE_CPU: Quantity.parse("100m")},
+        max={RESOURCE_CPU: Quantity.parse("1")},
+    ))
+    lo = Pod(name="toolow", containers=[
+        Container(name="c", requests={RESOURCE_CPU: Quantity.parse("50m")})])
+    with pytest.raises(AdmissionError):
+        api.create("pods", lo)
+    hi = Pod(name="toohigh", containers=[
+        Container(name="c", requests={RESOURCE_CPU: Quantity.parse("2")})])
+    with pytest.raises(AdmissionError):
+        api.create("pods", hi)
+    ok = Pod(name="inband", containers=[
+        Container(name="c", requests={RESOURCE_CPU: Quantity.parse("500m")})])
+    api.create("pods", ok)
+
+
+def test_limitranger_namespace_scoped(api):
+    from kubernetes_tpu.api.types import Quantity, RESOURCE_CPU
+
+    api.create("limitranges", _lr(namespace="prod",
+                                  default_request={RESOURCE_CPU: Quantity.parse("200m")}))
+    created = api.create("pods", _bare_pod("elsewhere", namespace="default"))
+    assert created.resource_request().get(RESOURCE_CPU, 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# ResourceQuota admission (plugin/pkg/admission/resourcequota/admission.go)
+# ---------------------------------------------------------------------------
+
+def test_quota_rejects_over_pod_count(api):
+    from kubernetes_tpu.api.types import ResourceQuota
+
+    api.create("resourcequotas", ResourceQuota(name="q", hard={"pods": 2}))
+    api.create("pods", make_pod("q1", cpu_milli=100, mem=2**20))
+    api.create("pods", make_pod("q2", cpu_milli=100, mem=2**20))
+    with pytest.raises(AdmissionError):
+        api.create("pods", make_pod("q3", cpu_milli=100, mem=2**20))
+    # usage was charged synchronously at admission
+    assert api.get("resourcequotas", "default/q").used["pods"] == 2
+
+
+def test_quota_rejects_over_cpu_sum(api):
+    from kubernetes_tpu.api.types import ResourceQuota
+
+    api.create("resourcequotas", ResourceQuota(
+        name="cpu", hard={"requests.cpu": 1000}))
+    api.create("pods", make_pod("c1", cpu_milli=600, mem=2**20))
+    with pytest.raises(AdmissionError):
+        api.create("pods", make_pod("c2", cpu_milli=600, mem=2**20))
+    api.create("pods", make_pod("c3", cpu_milli=400, mem=2**20))
+    assert api.get("resourcequotas", "default/cpu").used["requests.cpu"] == 1000
+
+
+def test_quota_count_kind(api):
+    from kubernetes_tpu.api.types import ResourceQuota, Service
+
+    api.create("resourcequotas", ResourceQuota(
+        name="svc", hard={"count/services": 1}))
+    api.create("services", Service(name="s1", selector={"a": "b"}))
+    with pytest.raises(AdmissionError):
+        api.create("services", Service(name="s2", selector={"a": "b"}))
+
+
+def test_quota_charged_after_limitranger_defaults(api):
+    """Quota runs LAST: a pod whose requests come entirely from LimitRange
+    defaults is charged at the defaulted value, not zero."""
+    from kubernetes_tpu.api.types import Quantity, RESOURCE_CPU, ResourceQuota
+
+    api.create("limitranges", _lr(default_request={RESOURCE_CPU: Quantity.parse("600m")}))
+    api.create("resourcequotas", ResourceQuota(
+        name="both", hard={"requests.cpu": 1000}))
+    api.create("pods", _bare_pod("d1"))
+    with pytest.raises(AdmissionError):
+        api.create("pods", _bare_pod("d2"))  # 600 + 600 > 1000
+
+
+def test_quota_over_http_is_422(api):
+    from kubernetes_tpu.api.types import ResourceQuota
+
+    api.create("resourcequotas", ResourceQuota(name="w", hard={"pods": 1}))
+    srv = APIServerHTTP(api).start()
+    try:
+        remote = RemoteAPIServer(srv.url)
+        remote.create("pods", make_pod("h1", cpu_milli=100, mem=2**20))
+        with pytest.raises(AdmissionError) as exc:
+            remote.create("pods", make_pod("h2", cpu_milli=100, mem=2**20))
+        assert "exceeded quota" in str(exc.value)
+    finally:
+        srv.stop()
