@@ -1619,6 +1619,188 @@ def serviceaccount_from_k8s(obj: dict) -> ServiceAccount:
     )
 
 
+# ---------------------------------------------------------------------------
+# RBAC (rbac.authorization.k8s.io/v1; staging/src/k8s.io/api/rbac/v1/types.go,
+# evaluated by plugin/pkg/auth/authorizer/rbac/rbac.go)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PolicyRule:
+    """rbac/v1 PolicyRule subset: verbs × resources, '*' wildcards
+    (rbac.go RuleAllows / VerbMatches / ResourceMatches)."""
+
+    verbs: List[str] = field(default_factory=list)
+    resources: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RoleRef:
+    kind: str = "ClusterRole"  # ClusterRole | Role
+    name: str = ""
+
+
+@dataclass
+class Subject:
+    kind: str = "User"  # User | Group | ServiceAccount
+    name: str = ""
+    namespace: str = ""  # ServiceAccount subjects only
+
+
+@dataclass
+class Role:
+    """Namespaced rule set; granted inside its namespace via RoleBinding."""
+
+    name: str = ""
+    namespace: str = "default"
+    resource_version: str = ""
+    rules: List[PolicyRule] = field(default_factory=list)
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class ClusterRole:
+    """Cluster-scoped rule set; granted everywhere via ClusterRoleBinding
+    or inside one namespace via RoleBinding (rbac.go appliesTo)."""
+
+    name: str = ""
+    resource_version: str = ""
+    rules: List[PolicyRule] = field(default_factory=list)
+
+    def key(self) -> str:
+        return self.name
+
+
+@dataclass
+class RoleBinding:
+    name: str = ""
+    namespace: str = "default"
+    resource_version: str = ""
+    role_ref: RoleRef = field(default_factory=RoleRef)
+    subjects: List[Subject] = field(default_factory=list)
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class ClusterRoleBinding:
+    name: str = ""
+    resource_version: str = ""
+    role_ref: RoleRef = field(default_factory=RoleRef)
+    subjects: List[Subject] = field(default_factory=list)
+
+    def key(self) -> str:
+        return self.name
+
+
+def _rules_from(items) -> List[PolicyRule]:
+    return [PolicyRule(verbs=list(r.get("verbs") or []),
+                       resources=list(r.get("resources") or []))
+            for r in (items or [])]
+
+
+def _rules_to(rules: List[PolicyRule]) -> List[dict]:
+    return [{"verbs": list(r.verbs), "resources": list(r.resources)}
+            for r in rules]
+
+
+def _subjects_from(items) -> List[Subject]:
+    return [Subject(kind=s.get("kind", "User"), name=s.get("name", ""),
+                    namespace=s.get("namespace", ""))
+            for s in (items or [])]
+
+
+def _subjects_to(subjects: List[Subject]) -> List[dict]:
+    return [{"kind": s.kind, "name": s.name,
+             **({"namespace": s.namespace} if s.namespace else {})}
+            for s in subjects]
+
+
+def role_from_k8s(obj: dict) -> Role:
+    meta = obj.get("metadata") or {}
+    return Role(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        resource_version=str(meta.get("resourceVersion", "")),
+        rules=_rules_from(obj.get("rules")),
+    )
+
+
+def role_to_k8s(r: Role) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "Role",
+        "metadata": {"name": r.name, "namespace": r.namespace,
+                     **({"resourceVersion": r.resource_version} if r.resource_version else {})},
+        "rules": _rules_to(r.rules),
+    }
+
+
+def clusterrole_from_k8s(obj: dict) -> ClusterRole:
+    meta = obj.get("metadata") or {}
+    return ClusterRole(
+        name=meta.get("name", ""),
+        resource_version=str(meta.get("resourceVersion", "")),
+        rules=_rules_from(obj.get("rules")),
+    )
+
+
+def clusterrole_to_k8s(r: ClusterRole) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+        "metadata": {"name": r.name,
+                     **({"resourceVersion": r.resource_version} if r.resource_version else {})},
+        "rules": _rules_to(r.rules),
+    }
+
+
+def _roleref_from(d) -> RoleRef:
+    d = d or {}
+    return RoleRef(kind=d.get("kind", "ClusterRole"), name=d.get("name", ""))
+
+
+def rolebinding_from_k8s(obj: dict) -> RoleBinding:
+    meta = obj.get("metadata") or {}
+    return RoleBinding(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        resource_version=str(meta.get("resourceVersion", "")),
+        role_ref=_roleref_from(obj.get("roleRef")),
+        subjects=_subjects_from(obj.get("subjects")),
+    )
+
+
+def rolebinding_to_k8s(b: RoleBinding) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "RoleBinding",
+        "metadata": {"name": b.name, "namespace": b.namespace,
+                     **({"resourceVersion": b.resource_version} if b.resource_version else {})},
+        "roleRef": {"kind": b.role_ref.kind, "name": b.role_ref.name},
+        "subjects": _subjects_to(b.subjects),
+    }
+
+
+def clusterrolebinding_from_k8s(obj: dict) -> ClusterRoleBinding:
+    meta = obj.get("metadata") or {}
+    return ClusterRoleBinding(
+        name=meta.get("name", ""),
+        resource_version=str(meta.get("resourceVersion", "")),
+        role_ref=_roleref_from(obj.get("roleRef")),
+        subjects=_subjects_from(obj.get("subjects")),
+    )
+
+
+def clusterrolebinding_to_k8s(b: ClusterRoleBinding) -> dict:
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRoleBinding",
+        "metadata": {"name": b.name,
+                     **({"resourceVersion": b.resource_version} if b.resource_version else {})},
+        "roleRef": {"kind": b.role_ref.kind, "name": b.role_ref.name},
+        "subjects": _subjects_to(b.subjects),
+    }
+
+
 def serviceaccount_to_k8s(sa: ServiceAccount) -> dict:
     meta: Dict[str, Any] = {"name": sa.name, "namespace": sa.namespace}
     if sa.resource_version:

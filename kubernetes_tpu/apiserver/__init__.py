@@ -12,6 +12,14 @@ from .admission import (
     default_admission_chain,
     install_system_priority_classes,
 )
+from .auth import (
+    ForbiddenError,
+    RBACAuthorizer,
+    TokenAuthenticator,
+    UnauthorizedError,
+    UserInfo,
+    install_bootstrap_rbac,
+)
 from .http import APIServerHTTP
 from .store import (
     ADDED,
@@ -36,6 +44,12 @@ __all__ = [
     "ResourceQuotaAdmission",
     "default_admission_chain",
     "install_system_priority_classes",
+    "ForbiddenError",
+    "RBACAuthorizer",
+    "TokenAuthenticator",
+    "UnauthorizedError",
+    "UserInfo",
+    "install_bootstrap_rbac",
     "APIServerHTTP",
     "DELETED",
     "MODIFIED",

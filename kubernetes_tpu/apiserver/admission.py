@@ -48,9 +48,13 @@ class AdmissionError(Exception):
 
 
 class Authorizer:
-    """authn/authz seam (always-allow): the chain position of the
-    reference's authentication/authorization filters. Replace `allow` to
-    enforce policy."""
+    """In-process authz seam (always-allow). REAL authn/authz lives in
+    the HTTP front door (apiserver/auth.py: TokenAuthenticator +
+    RBACAuthorizer wired into APIServerHTTP), matching the reference
+    where authentication/authorization are handler-chain filters, not
+    admission plugins. This seam remains for in-process (loopback)
+    callers, which the reference also exempts via the loopback client's
+    system:masters identity."""
 
     def allow(self, kind: str, op: str, obj: Any) -> bool:
         return True

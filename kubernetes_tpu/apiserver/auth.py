@@ -1,0 +1,239 @@
+"""Authentication + RBAC authorization for the apiserver HTTP front door.
+
+The reference routes every request through authentication → authorization
+before admission (DefaultBuildHandlerChain,
+staging/src/k8s.io/apiserver/pkg/server/config.go:539). This module is
+that filter pair, TPU-framework-sized:
+
+* `TokenAuthenticator` — bearer-token authn
+  (staging/src/k8s.io/apiserver/pkg/authentication/token/tokenfile):
+  a token maps to a `UserInfo` (name + groups). No token or an unknown
+  token → 401 (no anonymous fallthrough — the deny-by-default posture).
+* `RBACAuthorizer` — plugin/pkg/auth/authorizer/rbac/rbac.go:74
+  VisitRulesFor semantics: ClusterRoleBindings grant their ClusterRole's
+  rules everywhere; RoleBindings grant their Role's (or referenced
+  ClusterRole's) rules inside the binding's namespace. A request is
+  allowed iff some bound rule matches (verb, resource) with '*'
+  wildcards; everything else is DENIED.
+
+Identity conventions follow the reference's bootstrap policy
+(plugin/pkg/auth/authorizer/rbac/bootstrappolicy/policy.go): the
+scheduler runs as `system:kube-scheduler`, the controller-manager as
+`system:kube-controller-manager`, kubelets in group `system:nodes`, and
+cluster operators in group `system:masters` (bound to cluster-admin).
+`install_bootstrap_rbac` seeds those roles/bindings at startup the way
+the reference's PostStartHook reconciles bootstrap policy.
+
+Verbs: get, list, watch, create, update, delete; the pods/binding
+subresource authorizes as resource "pods/binding", verb "create"
+(the registry's BindingREST is a create on the binding subresource).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..api.types import (
+    ClusterRole,
+    ClusterRoleBinding,
+    PolicyRule,
+    Role,
+    RoleBinding,
+    RoleRef,
+    Subject,
+)
+
+class UnauthorizedError(Exception):
+    """401: request carried no (or an unknown) bearer token."""
+
+
+class ForbiddenError(Exception):
+    """403: authenticated, but RBAC denies the (verb, resource)."""
+
+
+GROUP_MASTERS = "system:masters"
+GROUP_NODES = "system:nodes"
+GROUP_AUTHENTICATED = "system:authenticated"
+USER_SCHEDULER = "system:kube-scheduler"
+USER_CONTROLLER_MANAGER = "system:kube-controller-manager"
+
+
+@dataclass(frozen=True)
+class UserInfo:
+    """authentication/user.Info subset: name + groups."""
+
+    name: str
+    groups: Tuple[str, ...] = ()
+
+    def all_groups(self) -> Tuple[str, ...]:
+        # every authenticated user is in system:authenticated
+        # (group_adder.go AuthenticatedGroupAdder)
+        return self.groups + (GROUP_AUTHENTICATED,)
+
+
+class TokenAuthenticator:
+    """Static bearer-token table (tokenfile authenticator)."""
+
+    def __init__(self, tokens: Optional[Dict[str, UserInfo]] = None):
+        self._tokens: Dict[str, UserInfo] = dict(tokens or {})
+        self._lock = threading.Lock()
+
+    def add(self, token: str, user: UserInfo) -> None:
+        with self._lock:
+            self._tokens[token] = user
+
+    def authenticate(self, authorization: Optional[str]) -> Optional[UserInfo]:
+        """`Authorization` header value → UserInfo, or None (→ 401)."""
+        if not authorization or not authorization.startswith("Bearer "):
+            return None
+        token = authorization[len("Bearer "):].strip()
+        if not token:
+            return None
+        with self._lock:
+            return self._tokens.get(token)
+
+
+def _subject_matches(s: Subject, user: UserInfo) -> bool:
+    if s.kind == "User":
+        return s.name == user.name
+    if s.kind == "Group":
+        return s.name in user.all_groups()
+    if s.kind == "ServiceAccount":
+        # serviceaccount usernames follow the apiserver convention
+        return user.name == f"system:serviceaccount:{s.namespace}:{s.name}"
+    return False
+
+
+def _rule_allows(rule: PolicyRule, verb: str, resource: str) -> bool:
+    # rbac.go VerbMatches / ResourceMatches: exact or '*'; a rule naming
+    # the bare resource also covers it, but subresources ("pods/binding")
+    # must be named explicitly or wildcarded (ResourceMatches only
+    # wildcards the whole string or via "pods/*")
+    if "*" not in rule.verbs and verb not in rule.verbs:
+        return False
+    for r in rule.resources:
+        if r == "*" or r == resource:
+            return True
+        if r.endswith("/*") and resource.startswith(r[:-1]):
+            return True
+    return False
+
+
+class RBACAuthorizer:
+    """Evaluate (user, verb, resource, namespace) against stored RBAC
+    kinds on every request — deny unless some binding's rule allows."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def _cluster_rules(self, user: UserInfo) -> Iterable[PolicyRule]:
+        try:
+            bindings, _ = self.store.list("clusterrolebindings")
+        except Exception:
+            return
+        for b in bindings:
+            if not any(_subject_matches(s, user) for s in b.subjects):
+                continue
+            try:
+                role: ClusterRole = self.store.get("clusterroles", b.role_ref.name)
+            except KeyError:
+                continue
+            yield from role.rules
+
+    def _namespace_rules(self, user: UserInfo, namespace: str) -> Iterable[PolicyRule]:
+        try:
+            bindings, _ = self.store.list("rolebindings")
+        except Exception:
+            return
+        for b in bindings:
+            if b.namespace != namespace:
+                continue
+            if not any(_subject_matches(s, user) for s in b.subjects):
+                continue
+            try:
+                if b.role_ref.kind == "ClusterRole":
+                    role = self.store.get("clusterroles", b.role_ref.name)
+                else:
+                    role = self.store.get("roles", f"{b.namespace}/{b.role_ref.name}")
+            except KeyError:
+                continue
+            yield from role.rules
+
+    def authorize(self, user: UserInfo, verb: str, resource: str,
+                  namespace: Optional[str]) -> bool:
+        for rule in self._cluster_rules(user):
+            if _rule_allows(rule, verb, resource):
+                return True
+        if namespace:
+            for rule in self._namespace_rules(user, namespace):
+                if _rule_allows(rule, verb, resource):
+                    return True
+        return False
+
+
+def install_bootstrap_rbac(store) -> None:
+    """Seed bootstrap policy (bootstrappolicy/policy.go subset): the
+    cluster-admin role + system component roles and their bindings.
+    Idempotent, like the reference's bootstrap reconciler."""
+    from .store import ConflictError
+
+    def _put(kind, obj):
+        try:
+            store.create(kind, obj)
+        except ConflictError:
+            pass
+
+    _put("clusterroles", ClusterRole(
+        name="cluster-admin",
+        rules=[PolicyRule(verbs=["*"], resources=["*"])],
+    ))
+    _put("clusterrolebindings", ClusterRoleBinding(
+        name="cluster-admin",
+        role_ref=RoleRef(kind="ClusterRole", name="cluster-admin"),
+        subjects=[Subject(kind="Group", name=GROUP_MASTERS)],
+    ))
+    # scheduler: read everything scheduling-visible; write binds, pod
+    # status/nominations, events, leader-election leases
+    # (bootstrappolicy/policy.go "system:kube-scheduler")
+    _put("clusterroles", ClusterRole(
+        name="system:kube-scheduler",
+        rules=[
+            PolicyRule(verbs=["get", "list", "watch"], resources=["*"]),
+            PolicyRule(verbs=["create"], resources=["pods/binding", "events"]),
+            PolicyRule(verbs=["update", "delete"], resources=["pods"]),
+            PolicyRule(verbs=["create", "update"], resources=["leases"]),
+        ],
+    ))
+    _put("clusterrolebindings", ClusterRoleBinding(
+        name="system:kube-scheduler",
+        role_ref=RoleRef(kind="ClusterRole", name="system:kube-scheduler"),
+        subjects=[Subject(kind="User", name=USER_SCHEDULER)],
+    ))
+    # kubelets: read their world, heartbeat nodes/leases, report pod
+    # status ("system:node" — without the per-node restriction of the
+    # NodeAuthorizer, which the reference layers on separately)
+    _put("clusterroles", ClusterRole(
+        name="system:node",
+        rules=[
+            PolicyRule(verbs=["get", "list", "watch"],
+                       resources=["pods", "nodes", "services", "endpoints"]),
+            PolicyRule(verbs=["create", "update"],
+                       resources=["nodes", "leases", "events", "podmetrics",
+                                  "nodemetrics"]),
+            PolicyRule(verbs=["update", "delete"], resources=["pods"]),
+        ],
+    ))
+    _put("clusterrolebindings", ClusterRoleBinding(
+        name="system:node",
+        role_ref=RoleRef(kind="ClusterRole", name="system:node"),
+        subjects=[Subject(kind="Group", name=GROUP_NODES)],
+    ))
+    # controller-manager: the reference grants each controller a scoped
+    # role; collapsed here to full access under one identity
+    _put("clusterrolebindings", ClusterRoleBinding(
+        name="system:kube-controller-manager",
+        role_ref=RoleRef(kind="ClusterRole", name="cluster-admin"),
+        subjects=[Subject(kind="User", name=USER_CONTROLLER_MANAGER)],
+    ))

@@ -74,6 +74,14 @@ from ..api.types import (
     serviceaccount_to_k8s,
     statefulset_from_k8s,
     statefulset_to_k8s,
+    clusterrole_from_k8s,
+    clusterrole_to_k8s,
+    clusterrolebinding_from_k8s,
+    clusterrolebinding_to_k8s,
+    role_from_k8s,
+    role_to_k8s,
+    rolebinding_from_k8s,
+    rolebinding_to_k8s,
 )
 from ..utils.events import event_from_k8s, event_to_k8s
 from .admission import AdmissionError
@@ -137,7 +145,16 @@ _CODECS: Dict[str, Tuple[Callable, Callable, str]] = {
     "horizontalpodautoscalers": (hpa_to_k8s, hpa_from_k8s, "HorizontalPodAutoscalerList"),
     "podmetrics": (podmetrics_to_k8s, podmetrics_from_k8s, "PodMetricsList"),
     "nodemetrics": (nodemetrics_to_k8s, nodemetrics_from_k8s, "NodeMetricsList"),
+    "roles": (role_to_k8s, role_from_k8s, "RoleList"),
+    "clusterroles": (clusterrole_to_k8s, clusterrole_from_k8s, "ClusterRoleList"),
+    "rolebindings": (rolebinding_to_k8s, rolebinding_from_k8s, "RoleBindingList"),
+    "clusterrolebindings": (clusterrolebinding_to_k8s, clusterrolebinding_from_k8s,
+                            "ClusterRoleBindingList"),
 }
+
+#: kinds keyed by bare name (store._key_of has no namespace for these)
+_CLUSTER_SCOPED = {"nodes", "leases", "priorityclasses", "namespaces",
+                   "nodemetrics", "clusterroles", "clusterrolebindings"}
 
 
 def _parse_selector(vals) -> Optional[Dict[str, str]]:
@@ -162,11 +179,44 @@ def _status(code: int, reason: str, message: str) -> bytes:
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     store: FakeAPIServer = None  # type: ignore  # set per-server subclass
+    authenticator = None  # TokenAuthenticator | None (None = open server)
+    authorizer = None  # RBACAuthorizer | None (None = authn only)
 
     def log_message(self, fmt, *args):  # quiet
         pass
 
     # -- helpers -------------------------------------------------------------
+
+    def _auth(self, verb: str, resource: str, namespace: Optional[str]) -> bool:
+        """authn → authz filter pair (DefaultBuildHandlerChain order,
+        apiserver/pkg/server/config.go:539). True = proceed; False =
+        response already sent (401 unauthenticated / 403 forbidden)."""
+        if self.authenticator is None:
+            return True
+        user = self.authenticator.authenticate(self.headers.get("Authorization"))
+        if user is None:
+            body = _status(401, "Unauthorized", "invalid or missing bearer token")
+            self.send_response(401)
+            self.send_header("WWW-Authenticate", "Bearer")
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return False
+        if self.authorizer is not None and not self.authorizer.authorize(
+                user, verb, resource, namespace):
+            self._send_json(403, _status(
+                403, "Forbidden",
+                f'user "{user.name}" cannot {verb} resource "{resource}"'
+                + (f' in namespace "{namespace}"' if namespace else "")))
+            return False
+        return True
+
+    @staticmethod
+    def _ns_of(kind: str, rest) -> Optional[str]:
+        if kind in _CLUSTER_SCOPED:
+            return None
+        return rest[0] if len(rest) >= 2 else None
 
     def _send_json(self, code: int, payload: Any) -> None:
         body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
@@ -182,9 +232,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     @staticmethod
     def _obj_key(kind: str, rest) -> Optional[str]:
-        """nodes/leases/priorityclasses are cluster-scoped (key = name);
-        everything else is namespace/name — mirroring store._key_of."""
-        if kind in ("nodes", "leases", "priorityclasses", "namespaces", "nodemetrics"):
+        """Cluster-scoped kinds take key = name; everything else is
+        namespace/name — mirroring store._key_of."""
+        if kind in _CLUSTER_SCOPED:
             return rest[0] if len(rest) == 1 else None
         return f"{rest[0]}/{rest[1]}" if len(rest) == 2 else None
 
@@ -210,6 +260,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(404, _status(404, "NotFound", f"unknown kind {kind}"))
         to_k8s, _, list_kind = codec
         if rest:
+            if not self._auth("get", kind, self._ns_of(kind, rest)):
+                return
             key = self._obj_key(kind, rest)
             if key is None:
                 return self._send_json(404, _status(404, "NotFound", self.path))
@@ -222,7 +274,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(404, _status(404, "NotFound", self.path))
             return self._send_json(200, to_k8s(obj))
         if q.get("watch", ["0"])[0] in ("1", "true"):
+            if not self._auth("watch", kind, None):
+                return
             return self._serve_watch(kind, to_k8s, q)
+        if not self._auth("list", kind, None):
+            return
         items, rv = self.store.list(
             kind,
             label_selector=_parse_selector(q.get("labelSelector")),
@@ -296,6 +352,8 @@ class _Handler(BaseHTTPRequestHandler):
         kind, rest, _ = r
         # bind subresource
         if kind == "pods" and len(rest) == 3 and rest[2] == "binding":
+            if not self._auth("create", "pods/binding", rest[0]):
+                return
             body = self._read_body()
             node = ((body.get("target") or {}).get("name")) or ""
             try:
@@ -313,6 +371,9 @@ class _Handler(BaseHTTPRequestHandler):
             obj = from_k8s(self._read_body())
         except Exception as e:  # malformed JSON/object → 400, not a dropped conn
             return self._send_json(400, _status(400, "BadRequest", str(e)))
+        ns = None if kind in _CLUSTER_SCOPED else getattr(obj, "namespace", None)
+        if not self._auth("create", kind, ns):
+            return
         try:
             created = self.store.create(kind, obj)
         except ConflictError as e:
@@ -329,6 +390,8 @@ class _Handler(BaseHTTPRequestHandler):
         codec = _CODECS.get(kind)
         if codec is None or self._obj_key(kind, rest) is None:
             return self._send_json(404, _status(404, "NotFound", self.path))
+        if not self._auth("update", kind, self._ns_of(kind, rest)):
+            return
         to_k8s, from_k8s, _ = codec
         body = self._read_body()
         obj = from_k8s(body)
@@ -351,6 +414,8 @@ class _Handler(BaseHTTPRequestHandler):
         key = self._obj_key(kind, rest)
         if key is None:
             return self._send_json(404, _status(404, "NotFound", self.path))
+        if not self._auth("delete", kind, self._ns_of(kind, rest)):
+            return
         try:
             self.store.delete(kind, key)
         except KeyError:
@@ -359,11 +424,22 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class APIServerHTTP:
-    """Serve a FakeAPIServer store over HTTP (daemon threads)."""
+    """Serve a FakeAPIServer store over HTTP (daemon threads).
 
-    def __init__(self, store: FakeAPIServer, host: str = "127.0.0.1", port: int = 0):
+    Pass `authenticator` (apiserver.auth.TokenAuthenticator) to require
+    bearer tokens (401 otherwise), and `authorizer`
+    (apiserver.auth.RBACAuthorizer) to enforce RBAC (403 on deny).
+    Both None (the default) keeps the open-server behavior for
+    local/simulation use."""
+
+    def __init__(self, store: FakeAPIServer, host: str = "127.0.0.1", port: int = 0,
+                 authenticator=None, authorizer=None):
         self.store = store
-        handler = type("BoundHandler", (_Handler,), {"store": store})
+        handler = type("BoundHandler", (_Handler,), {
+            "store": store,
+            "authenticator": authenticator,
+            "authorizer": authorizer,
+        })
         self._srv = ThreadingHTTPServer((host, port), handler)
         self._srv.daemon_threads = True
         self._thread: Optional[threading.Thread] = None

@@ -59,8 +59,17 @@ from ..api.types import (
     serviceaccount_to_k8s,
     statefulset_from_k8s,
     statefulset_to_k8s,
+    clusterrole_from_k8s,
+    clusterrole_to_k8s,
+    clusterrolebinding_from_k8s,
+    clusterrolebinding_to_k8s,
+    role_from_k8s,
+    role_to_k8s,
+    rolebinding_from_k8s,
+    rolebinding_to_k8s,
 )
 from ..apiserver.admission import AdmissionError
+from ..apiserver.auth import ForbiddenError, UnauthorizedError
 from ..apiserver.http import _lease_from_k8s, _lease_to_k8s
 from ..utils.events import event_from_k8s, event_to_k8s
 from ..apiserver.store import ConflictError, GoneError, NotFoundError, WatchEvent, _key_of
@@ -88,6 +97,10 @@ _CODECS = {
     "horizontalpodautoscalers": (hpa_to_k8s, hpa_from_k8s),
     "podmetrics": (podmetrics_to_k8s, podmetrics_from_k8s),
     "nodemetrics": (nodemetrics_to_k8s, nodemetrics_from_k8s),
+    "roles": (role_to_k8s, role_from_k8s),
+    "clusterroles": (clusterrole_to_k8s, clusterrole_from_k8s),
+    "rolebindings": (rolebinding_to_k8s, rolebinding_from_k8s),
+    "clusterrolebindings": (clusterrolebinding_to_k8s, clusterrolebinding_from_k8s),
 }
 
 
@@ -143,23 +156,34 @@ class _RemoteWatcher:
 class RemoteAPIServer:
     """FakeAPIServer's surface, HTTP-backed. Drop-in for Informer."""
 
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 token: Optional[str] = None):
         u = urlparse(base_url)
         self._host = u.hostname
         self._port = u.port or 80
         self._timeout = timeout
+        # bearer-token identity (rest.Config.BearerToken): sent on every
+        # request; None = anonymous (only works against an open server)
+        self._token = token
 
     def _conn(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
         return http.client.HTTPConnection(
             self._host, self._port, timeout=timeout or self._timeout
         )
 
+    def _headers(self, payload: Optional[bytes] = None) -> dict:
+        h = {}
+        if payload:
+            h["Content-Type"] = "application/json"
+        if self._token:
+            h["Authorization"] = f"Bearer {self._token}"
+        return h
+
     def _req(self, method: str, path: str, body: Optional[dict] = None):
         conn = self._conn()
         try:
             payload = json.dumps(body).encode() if body is not None else None
-            conn.request(method, path, body=payload,
-                         headers={"Content-Type": "application/json"} if payload else {})
+            conn.request(method, path, body=payload, headers=self._headers(payload))
             resp = conn.getresponse()
             data = resp.read()
             if resp.status == 410:
@@ -170,6 +194,10 @@ class RemoteAPIServer:
                 raise NotFoundError(path)
             if resp.status == 422:
                 raise AdmissionError(data.decode())
+            if resp.status == 401:
+                raise UnauthorizedError(data.decode())
+            if resp.status == 403:
+                raise ForbiddenError(data.decode())
             if resp.status >= 400:
                 raise RuntimeError(f"{method} {path}: {resp.status} {data[:200]!r}")
             return json.loads(data) if data else {}
@@ -204,13 +232,22 @@ class RemoteAPIServer:
         qs = self._sel_params(label_selector, field_selector)
         conn = self._conn(timeout=None)  # streams block until events arrive
         conn.request(
-            "GET", f"/api/v1/{kind}?watch=1&resourceVersion={since_rv}{qs}"
+            "GET", f"/api/v1/{kind}?watch=1&resourceVersion={since_rv}{qs}",
+            headers=self._headers(),
         )
         resp = conn.getresponse()
         if resp.status == 410:
             data = resp.read()
             conn.close()
             raise GoneError(data.decode())
+        if resp.status == 401:
+            data = resp.read()
+            conn.close()
+            raise UnauthorizedError(data.decode())
+        if resp.status == 403:
+            data = resp.read()
+            conn.close()
+            raise ForbiddenError(data.decode())
         if resp.status != 200:
             data = resp.read()
             conn.close()

@@ -45,6 +45,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="sim: also serve the apiserver over HTTP (REST list+watch) on "
              "this port so out-of-process clients/replicas can integrate",
     )
+    p.add_argument(
+        "--token-auth-file", default="",
+        help="with --serve-api: require bearer tokens and enforce RBAC "
+             "(401/403). CSV lines token,user,group1|group2 — the "
+             "kube-apiserver --token-auth-file format; bootstrap RBAC "
+             "roles/bindings are installed at startup",
+    )
     p.add_argument("--batch-size", type=int, default=256)
     p.add_argument(
         "--mesh", default="auto",
@@ -197,8 +204,27 @@ def run_sim(args) -> int:
     if args.serve_api:
         from .apiserver import APIServerHTTP
 
-        api_http = APIServerHTTP(api, port=args.serve_api).start()
-        print(f"apiserver HTTP on {api_http.url} (list/watch/create/bind)")
+        authn = authz = None
+        if getattr(args, "token_auth_file", ""):
+            from .apiserver import (RBACAuthorizer, TokenAuthenticator,
+                                    UserInfo, install_bootstrap_rbac)
+
+            tokens = {}
+            with open(args.token_auth_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    parts = [p.strip() for p in line.split(",")]
+                    groups = tuple(g for g in (parts[2].split("|") if len(parts) > 2
+                                               else ()) if g)
+                    tokens[parts[0]] = UserInfo(parts[1], groups)
+            install_bootstrap_rbac(api)
+            authn, authz = TokenAuthenticator(tokens), RBACAuthorizer(api)
+        api_http = APIServerHTTP(api, port=args.serve_api,
+                                 authenticator=authn, authorizer=authz).start()
+        mode = "RBAC-secured" if authn else "open"
+        print(f"apiserver HTTP on {api_http.url} (list/watch/create/bind, {mode})")
     sched.binder = Binder(APIBinder(api).bind)
     # scheduler events land in the apiserver's events kind (kubectl get
     # events shows Scheduled/FailedScheduling/Preempted series)
