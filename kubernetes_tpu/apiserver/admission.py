@@ -65,14 +65,22 @@ class AdmissionChain:
         self.plugins = list(plugins or [])
         self.authorizer = authorizer or Authorizer()
 
-    def admit(self, store, kind: str, op: str, obj: Any) -> Any:
+    def admit(self, store, kind: str, op: str, obj: Any,
+              undo: Optional[List[Callable[[], None]]] = None) -> Any:
         """Run the chain for one write; returns the (possibly mutated)
         object or raises AdmissionError. `store` gives plugins read access
-        (PriorityClass lookups)."""
+        (PriorityClass lookups). Plugins with external side effects (quota
+        charges) declare `supports_undo = True` and append rollback
+        callables to `undo` — the store runs them (reversed) when the
+        write itself fails AFTER admission (duplicate-name ConflictError),
+        so a rejected create can't strand quota usage."""
         if not self.authorizer.allow(kind, op, obj):
             raise AdmissionError(f"{op} {kind} forbidden")
         for p in self.plugins:
-            out = p.admit(store, kind, op, obj)
+            if undo is not None and getattr(p, "supports_undo", False):
+                out = p.admit(store, kind, op, obj, undo=undo)
+            else:
+                out = p.admit(store, kind, op, obj)
             if out is not None:
                 obj = out
         return obj
@@ -203,14 +211,18 @@ class LimitRangerAdmission:
                         if r not in c.requests:
                             c.requests[r] = q
                             mutated = True
+                    # min binds requests AND limits, exactly like max below
+                    # (the reference's limitranger minConstraint checks
+                    # both; an explicit limit under min must reject)
                     for r, q in item.min.items():
                         lo = _request_value(r, q)
-                        got = c.requests.get(r)
-                        if got is not None and _request_value(r, got) < lo:
-                            raise AdmissionError(
-                                f"minimum {r} usage per Container is {lo}, "
-                                f"but request is {_request_value(r, got)}"
-                            )
+                        for which, d in (("request", c.requests), ("limit", c.limits)):
+                            got = d.get(r)
+                            if got is not None and _request_value(r, got) < lo:
+                                raise AdmissionError(
+                                    f"minimum {r} usage per Container is {lo}, "
+                                    f"but {which} is {_request_value(r, got)}"
+                                )
                     for r, q in item.max.items():
                         hi = _request_value(r, q)
                         for which, d in (("request", c.requests), ("limit", c.limits)):
@@ -242,7 +254,12 @@ class ResourceQuotaAdmission:
     #: and status-ish kinds the reference's evaluator registry skips)
     _EXEMPT = {"resourcequotas", "events", "podmetrics", "leases"}
 
-    def admit(self, store, kind: str, op: str, obj: Any):
+    #: external side effects (status.used charges) need rollback when the
+    #: store rejects the write after admission (AdmissionChain.admit undo)
+    supports_undo = True
+
+    def admit(self, store, kind: str, op: str, obj: Any,
+              undo: Optional[List[Callable[[], None]]] = None):
         if op != "CREATE" or kind in self._EXEMPT:
             return None
         ns = getattr(obj, "namespace", None)
@@ -252,13 +269,31 @@ class ResourceQuotaAdmission:
             quotas, _ = store.list("resourcequotas")
         except Exception:
             return None
+        # two-phase (compute-all, check-all, then charge) so a rejection by
+        # a LATER matching quota — or by the store's duplicate-name check —
+        # never strands usage on an earlier one (the reference's admission
+        # evaluates every matching quota atomically, checkQuotas)
+        charges: List[tuple] = []
         for quota in quotas:
             if quota.namespace != ns:
                 continue
             delta = self._delta(quota, kind, obj)
-            if not delta:
-                continue
-            self._charge(store, quota.key(), delta)
+            if delta:
+                charges.append((quota.key(), delta))
+        applied: List[tuple] = []
+        try:
+            for quota_key, delta in charges:
+                self._charge(store, quota_key, delta)
+                applied.append((quota_key, delta))
+        except AdmissionError:
+            for quota_key, delta in reversed(applied):
+                self._uncharge(store, quota_key, delta)
+            raise
+        if undo is not None:
+            for quota_key, delta in applied:
+                undo.append(
+                    lambda qk=quota_key, d=delta: self._uncharge(store, qk, d)
+                )
         return None
 
     @staticmethod
@@ -303,6 +338,28 @@ class ResourceQuotaAdmission:
             except ConflictError:
                 continue  # another admission charged first — re-read
         raise AdmissionError(f"quota {quota_key}: charge contention, retry")
+
+    @staticmethod
+    def _uncharge(store, quota_key: str, delta: Dict[str, int]) -> None:
+        """CAS-decrement a previous charge (floored at 0 — the controller's
+        full recompute is the drift backstop). Best-effort: a vanished
+        quota needs no refund."""
+        from .store import ConflictError, NotFoundError
+
+        for _ in range(16):
+            try:
+                live: ResourceQuota = store.get("resourcequotas", quota_key)
+            except NotFoundError:
+                return
+            new_used = dict(live.used)
+            for k, d in delta.items():
+                new_used[k] = max(new_used.get(k, 0) - d, 0)
+            live.used = new_used
+            try:
+                store.update("resourcequotas", live, check_rv=True)
+                return
+            except ConflictError:
+                continue
 
 
 def default_admission_chain() -> AdmissionChain:

@@ -393,8 +393,35 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._auth("update", kind, self._ns_of(kind, rest)):
             return
         to_k8s, from_k8s, _ = codec
-        body = self._read_body()
-        obj = from_k8s(body)
+        try:
+            body = self._read_body()
+            obj = from_k8s(body)
+        except Exception as e:  # malformed JSON/object → 400, not a dropped conn
+            return self._send_json(400, _status(400, "BadRequest", str(e)))
+        # The URL path is the authorization subject AND the write key: a
+        # body claiming a different namespace/name would be authorized
+        # against the path namespace but stored under the body's key — an
+        # RBAC bypass (a user bound in "dev" overwriting "prod" objects).
+        # The reference apiserver rejects path/body mismatches with 400
+        # (rest.BeforeUpdate name/namespace validation); empty body fields
+        # inherit the path (the reference's defaulting).
+        path_name = rest[0] if kind in _CLUSTER_SCOPED else rest[1]
+        body_name = getattr(obj, "name", "") or ""
+        if body_name and body_name != path_name:
+            return self._send_json(400, _status(
+                400, "BadRequest",
+                f"name in body ({body_name}) must match URL path ({path_name})"))
+        if body_name != path_name and hasattr(obj, "name"):
+            obj.name = path_name
+        if kind not in _CLUSTER_SCOPED:
+            path_ns = rest[0]
+            body_ns = getattr(obj, "namespace", "") or ""
+            if body_ns and body_ns != path_ns:
+                return self._send_json(400, _status(
+                    400, "BadRequest",
+                    f"namespace in body ({body_ns}) must match URL path ({path_ns})"))
+            if body_ns != path_ns and hasattr(obj, "namespace"):
+                obj.namespace = path_ns
         check_rv = bool(((body.get("metadata") or {}).get("resourceVersion")))
         try:
             updated = self.store.update(kind, obj, check_rv=check_rv)

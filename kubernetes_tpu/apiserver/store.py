@@ -179,8 +179,27 @@ class FakeAPIServer:
     # -- REST surface ---------------------------------------------------------
 
     def create(self, kind: str, obj: Any) -> Any:
+        undo: List[Any] = []
         if self._admission is not None:
-            obj = self._admission.admit(self, kind, "CREATE", copy.deepcopy(obj))
+            obj = self._admission.admit(self, kind, "CREATE", copy.deepcopy(obj),
+                                        undo=undo)
+        try:
+            return self._create_admitted(kind, obj)
+        except Exception:
+            # admission ran (and e.g. charged quota) for a write the store
+            # did not accept — duplicate-name ConflictError (the CronJob
+            # Replace/dedupe path), a WAL write failure, anything. Run the
+            # plugins' rollbacks OUTSIDE the lock (they re-enter the
+            # store) so the usage doesn't strand until the quota
+            # controller's resync.
+            for fn in reversed(undo):
+                try:
+                    fn()
+                except Exception:
+                    pass  # rollback is best-effort; the controller resyncs
+            raise
+
+    def _create_admitted(self, kind: str, obj: Any) -> Any:
         with self._lock:
             objs = self._objects.setdefault(kind, {})
             key = _key_of(obj)
@@ -190,8 +209,15 @@ class FakeAPIServer:
             stored.resource_version = str(self._bump())
             objs[key] = stored
             if self._wal is not None:
-                self._wal.append("PUT", kind, key, self._current_rv, stored)
-                self._wal.maybe_compact(self._objects, self._current_rv)
+                try:
+                    self._wal.append("PUT", kind, key, self._current_rv, stored)
+                    self._wal.maybe_compact(self._objects, self._current_rv)
+                except Exception:
+                    # a create that raises must leave no object behind —
+                    # create()'s admission rollback (quota uncharge) relies
+                    # on failure meaning the write didn't happen
+                    del objs[key]
+                    raise
             self._emit(kind, ADDED, copy.deepcopy(stored), self._current_rv)
             return copy.deepcopy(stored)
 

@@ -26,6 +26,48 @@ import time
 from typing import Optional
 
 
+def load_token_auth_file(path: str) -> dict:
+    """Parse a kube-apiserver --token-auth-file (CSV lines
+    token,user[,group1|group2]) → {token: UserInfo}. Real CSV parsing
+    (quoted fields may contain commas, as the reference's
+    NewCSVTokenAuthenticator gets from encoding/csv); malformed lines —
+    fewer than two fields, or an empty token/user — are a configuration
+    error reported with the line number, never a silent skip or an
+    IndexError."""
+    import csv
+
+    from .apiserver import UserInfo
+
+    tokens = {}
+    with open(path, newline="") as f:
+        reader = csv.reader(f)
+        while True:
+            try:
+                row = next(reader)
+            except StopIteration:
+                break
+            except csv.Error as e:
+                # reader-level parse errors (unterminated quote, NUL byte)
+                # must surface as the same clean configuration error the
+                # malformed-row path produces, not a _csv.Error traceback
+                raise ValueError(f"{path}:{reader.line_num}: {e}") from e
+            lineno = reader.line_num
+            parts = [p.strip() for p in row]
+            if not parts or not any(parts):
+                continue  # blank line
+            if parts[0].startswith("#"):
+                continue  # comment
+            if len(parts) < 2 or not parts[0] or not parts[1]:
+                raise ValueError(
+                    f"{path}:{lineno}: expected 'token,user[,group1|group2]' "
+                    f"with a non-empty token and user, got {','.join(row)!r}"
+                )
+            groups = tuple(g for g in (parts[2].split("|") if len(parts) > 2
+                                       else ()) if g)
+            tokens[parts[0]] = UserInfo(parts[1], groups)
+    return tokens
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="kubernetes-tpu-scheduler",
@@ -207,18 +249,12 @@ def run_sim(args) -> int:
         authn = authz = None
         if getattr(args, "token_auth_file", ""):
             from .apiserver import (RBACAuthorizer, TokenAuthenticator,
-                                    UserInfo, install_bootstrap_rbac)
+                                    install_bootstrap_rbac)
 
-            tokens = {}
-            with open(args.token_auth_file) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line or line.startswith("#"):
-                        continue
-                    parts = [p.strip() for p in line.split(",")]
-                    groups = tuple(g for g in (parts[2].split("|") if len(parts) > 2
-                                               else ()) if g)
-                    tokens[parts[0]] = UserInfo(parts[1], groups)
+            try:
+                tokens = load_token_auth_file(args.token_auth_file)
+            except ValueError as e:
+                raise SystemExit(f"--token-auth-file: {e}")
             install_bootstrap_rbac(api)
             authn, authz = TokenAuthenticator(tokens), RBACAuthorizer(api)
         api_http = APIServerHTTP(api, port=args.serve_api,

@@ -1,0 +1,53 @@
+"""Compile-plan subsystem: every XLA compilation decision in one place.
+
+The drain loop must NEVER block on the XLA compiler. Lazily-jitted
+programs do exactly that: each fresh (shape bucket, jit-static) signature
+mid-drain is a multi-second trace+compile stall on a remote-attached TPU
+(round-5 verdict: `dispatch_s: 2.39` and `spec_misses` on the quadratic
+config, `mirror_rebuilds: 1` on the gang config). Production JAX serving
+stacks solve this with padded shape buckets + ahead-of-time lowering + a
+persistent compilation cache (the jax AOT / `jax.export` idiom); this
+package applies the same discipline to the scheduler's pods×nodes solve:
+
+* `ladder`  — the shape-ladder policy: the ONE bucket quantizer
+  (`pow2_bucket` / `node_axis_bucket`, previously private to
+  state/tensors) plus `SolveSpec`, the canonical description of one XLA
+  program signature (shape buckets × jit statics), and `ShapeLadder`,
+  which rounds raw sizes up to declared rungs so tail batches and
+  term-light batches re-execute an existing program instead of tracing a
+  fresh one.
+* `plan`    — `CompilePlan`: the registry of declared specs with
+  hit/miss/compile telemetry. A spec miss after warmup is the failure
+  mode this subsystem exists to kill; the plan counts it, logs it, and
+  the inline jit fallback still compiles it (correctness never waits on
+  coverage).
+* `cache`   — `PersistentCompileCache`: the declared ladder serialized
+  to disk keyed by spec hash + jaxlib version/backend, plus the XLA
+  persistent compilation-cache hookup and (where the backend supports
+  it) serialized compiled executables — a process restart re-warms the
+  previous ladder from disk instead of rediscovering it.
+* `warmup`  — `WarmupService`: lowers + executes the declared ladder
+  against the live mirror banks at driver startup and re-warms on
+  growth events (bucket growth, mirror rebuilds) on a background
+  thread, so the drain loop never meets a cold signature.
+"""
+
+from .ladder import (
+    ShapeLadder,
+    SolveSpec,
+    node_axis_bucket,
+    pow2_bucket,
+)
+from .plan import CompilePlan
+from .cache import PersistentCompileCache
+from .warmup import WarmupService
+
+__all__ = [
+    "CompilePlan",
+    "PersistentCompileCache",
+    "ShapeLadder",
+    "SolveSpec",
+    "WarmupService",
+    "node_axis_bucket",
+    "pow2_bucket",
+]

@@ -1,0 +1,240 @@
+"""Persistent compile cache: the declared ladder + XLA artifacts on disk.
+
+Two layers, both keyed by spec hash + jaxlib version + backend platform:
+
+1. **Ladder registry** (`ladder.json`): which SolveSpecs this deployment
+   has ever compiled, with their observed compile times. A fresh process
+   loads it and warms exactly that ladder instead of rediscovering it
+   one mid-drain stall at a time.
+2. **XLA artifacts**: the jax persistent compilation cache
+   (`jax_compilation_cache_dir`) holds the compiled HLO keyed by jax's
+   own fingerprint, so the re-warm pays trace time only (~5-20x cheaper
+   than trace+compile). Where the backend supports executable
+   serialization (`jax.experimental.serialize_executable`), whole
+   executables round-trip through `exec/<hash>.bin` as well — the
+   serializer is injectable so tests exercise the round-trip with a
+   stubbed backend and no real XLA dependency.
+
+Everything here is best-effort: a missing/corrupt/version-mismatched
+cache degrades to a cold warmup, never to an error.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .ladder import SolveSpec
+
+logger = logging.getLogger("kubernetes_tpu.compile")
+
+LADDER_FILE = "ladder.json"
+EXEC_DIR = "exec"
+
+#: env var naming the cache root; unset = no persistence (in-memory plan only)
+CACHE_DIR_ENV = "KTPU_COMPILE_CACHE_DIR"
+
+
+def _environment_key() -> Dict[str, str]:
+    """Version/platform key the cache is valid for: a jaxlib upgrade or a
+    backend switch invalidates serialized artifacts wholesale."""
+    try:
+        import jax
+        import jaxlib
+
+        platform = "unknown"
+        try:
+            platform = jax.default_backend()
+        except Exception:
+            pass
+        return {
+            "jax": getattr(jax, "__version__", "unknown"),
+            "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+            "platform": platform,
+        }
+    except Exception:  # jax absent (pure-host tooling): cache still works
+        return {"jax": "none", "jaxlib": "none", "platform": "none"}
+
+
+class JaxExecutableSerializer:
+    """Default executable serializer: jax.experimental.serialize_executable
+    (pickle-based AOT round-trip). Raises NotImplementedError when the
+    installed jax/backend can't do it — callers treat that as 'no
+    executable layer', keeping the ladder + XLA-cache layers working."""
+
+    def serialize(self, compiled) -> bytes:
+        from jax.experimental import serialize_executable
+
+        payload, _, _ = serialize_executable.serialize(compiled)
+        return payload
+
+    def deserialize(self, blob: bytes):  # pragma: no cover - needs real AOT
+        raise NotImplementedError(
+            "deserialization needs the original in_tree/out_tree; use the "
+            "ladder re-warm path instead"
+        )
+
+
+class PersistentCompileCache:
+    """On-disk ladder registry + artifact store rooted at `path`."""
+
+    def __init__(self, path: str, serializer=None):
+        self.path = path
+        self.serializer = serializer
+        self._lock = threading.Lock()
+        self.enabled_xla_cache = False
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_env(cls) -> Optional["PersistentCompileCache"]:
+        path = os.environ.get(CACHE_DIR_ENV, "")
+        return cls(path) if path else None
+
+    # -- XLA persistent cache hookup -----------------------------------------
+
+    def enable_xla_cache(self, min_compile_secs: float = 0.5) -> bool:
+        """Point jax's persistent compilation cache at <path>/xla (unless
+        the process already configured one — bench.py does). Best-effort."""
+        try:
+            import jax
+
+            if getattr(jax.config, "jax_compilation_cache_dir", None):
+                self.enabled_xla_cache = True  # someone already set it up
+                return True
+            d = os.path.join(self.path, "xla")
+            os.makedirs(d, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+            )
+            self.enabled_xla_cache = True
+            return True
+        except Exception:
+            return False
+
+    # -- ladder registry ------------------------------------------------------
+
+    def _ladder_path(self) -> str:
+        return os.path.join(self.path, LADDER_FILE)
+
+    def save_ladder(self, records: Sequence[Tuple[SolveSpec, float]]) -> bool:
+        """Persist the declared ladder: [(spec, compile_seconds)]. Merges
+        with what's already on disk (two schedulers sharing a cache dir
+        union their ladders) and is atomic (tmp+rename)."""
+        with self._lock:
+            existing: Dict[str, Dict] = {}
+            current = self._read()
+            if current is not None:
+                existing = {e["hash"]: e for e in current.get("specs", [])}
+            for spec, secs in records:
+                h = spec.hash_hex()
+                prev = existing.get(h)
+                entry = {
+                    "hash": h,
+                    "spec": spec.to_dict(),
+                    "compile_s": round(float(secs), 4),
+                }
+                if prev is not None:
+                    # keep the larger observed compile time: it's the cold
+                    # cost a fresh process should budget for
+                    entry["compile_s"] = max(entry["compile_s"], prev.get("compile_s", 0.0))
+                existing[h] = entry
+            doc = {
+                "version": 1,
+                "environment": _environment_key(),
+                "specs": sorted(existing.values(), key=lambda e: e["hash"]),
+            }
+            try:
+                os.makedirs(self.path, exist_ok=True)
+                tmp = self._ladder_path() + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(doc, f, indent=1)
+                os.replace(tmp, self._ladder_path())
+                return True
+            except OSError:
+                return False
+
+    def _read(self) -> Optional[Dict]:
+        try:
+            with open(self._ladder_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def load_ladder(self) -> List[Tuple[SolveSpec, float]]:
+        """The persisted ladder, or [] when absent/corrupt/from a different
+        jaxlib+backend (a version bump means none of the XLA artifacts are
+        reusable — warming the old ladder would be cold anyway, and its
+        shapes may no longer match the encoders)."""
+        doc = self._read()
+        if doc is None or doc.get("version") != 1:
+            return []
+        if doc.get("environment") != _environment_key():
+            logger.info(
+                "compile cache at %s is for %s (now %s): ignoring",
+                self.path, doc.get("environment"), _environment_key(),
+            )
+            return []
+        out = []
+        for entry in doc.get("specs", []):
+            try:
+                out.append(
+                    (SolveSpec.from_dict(entry["spec"]), float(entry.get("compile_s", 0.0)))
+                )
+            except Exception:
+                continue  # one bad entry must not void the ladder
+        return out
+
+    def clear(self) -> None:
+        """Drop every persisted artifact (docs: `rm -rf` equivalent, used
+        after encoder changes that shift shapes/semantics)."""
+        import shutil
+
+        with self._lock:
+            shutil.rmtree(self.path, ignore_errors=True)
+
+    # -- serialized executables ----------------------------------------------
+
+    def _exec_path(self, spec: SolveSpec) -> str:
+        return os.path.join(self.path, EXEC_DIR, spec.hash_hex() + ".bin")
+
+    def save_executable(self, spec: SolveSpec, compiled) -> bool:
+        """Serialize one compiled executable (best-effort; False when the
+        serializer/backend can't). `compiled` is whatever the serializer
+        understands — a jax.stages.Compiled for the default."""
+        ser = self.serializer
+        if ser is None:
+            ser = self.serializer = JaxExecutableSerializer()
+        try:
+            blob = ser.serialize(compiled)
+        except Exception:
+            return False
+        try:
+            os.makedirs(os.path.join(self.path, EXEC_DIR), exist_ok=True)
+            tmp = self._exec_path(spec) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self._exec_path(spec))
+            return True
+        except OSError:
+            return False
+
+    def load_executable(self, spec: SolveSpec):
+        """Deserialize a previously saved executable, or None (missing
+        file, serializer unable, version mismatch)."""
+        ser = self.serializer
+        if ser is None:
+            ser = self.serializer = JaxExecutableSerializer()
+        try:
+            with open(self._exec_path(spec), "rb") as f:
+                blob = f.read()
+        except OSError:
+            return None
+        try:
+            return ser.deserialize(blob)
+        except Exception:
+            return None

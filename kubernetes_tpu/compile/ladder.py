@@ -1,0 +1,218 @@
+"""Shape-ladder policy: canonical bucket quantizers + the SolveSpec key.
+
+Every distinct (shape bucket, jit-static) combination the solve pipeline
+executes is one XLA program. The ladder declares WHICH combinations are
+legal: raw sizes round UP to a rung, so a 37-pod tail batch executes the
+64-bucket program that already exists instead of tracing a fresh 37-shape
+one. The quantizers here are the single source of truth — state/tensors'
+`_bucket`/`_node_bucket` are aliases of these (the bucket policy moved
+behind the ladder), so encoders, the driver, and the warmup service can
+never disagree about what shapes exist.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def pow2_bucket(n: int, minimum: int = 16) -> int:
+    """Next power-of-two capacity ≥ n (bounded recompilation buckets)."""
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def node_axis_bucket(n: int, minimum: int = 16) -> int:
+    """Node-axis capacity: power of two up to 2048, then the next multiple
+    of 2048. Every [*, N] kernel pays for the padding — at 10k nodes a
+    pow-2 bucket (16384) wastes 64% of all mask/score/topology work, while
+    2048-multiples cap waste at <20% and still divide evenly for any
+    power-of-two device-mesh shard count (parallel/sharded.py)."""
+    if n <= 2048:
+        return pow2_bucket(n, minimum)
+    return -(-n // 2048) * 2048
+
+
+def next_rung(n: int, minimum: int = 16) -> int:
+    """The rung ABOVE the one holding n — what a growth event lands on.
+    The warmup service compiles this ahead of time (headroom warming) so
+    the growth, when it happens, finds a hot program."""
+    return pow2_bucket(pow2_bucket(n, minimum) + 1, minimum)
+
+
+#: every term kind mask_and_score can gate on (ops/pipeline.py)
+ALL_TERM_KINDS = frozenset({
+    "spread_hard", "spread_soft", "aff_req", "anti_req", "pref",
+    "sel_spread", "et_anti", "et_score",
+})
+
+KIND_SOLVE = "solve"
+KIND_SOLVE_GANG = "solve_gang"
+KIND_FILTER = "filter"
+KIND_PREEMPT = "preempt"
+
+
+@dataclass(frozen=True)
+class SolveSpec:
+    """Canonical description of ONE XLA program signature of the solve
+    stack: the shape buckets of every padded axis plus the jit statics.
+    Hashable and orderable so it can key plan registries and serialize to
+    the persistent ladder. Axes not used by a kind stay 0.
+
+    Axis legend: b = pod batch, u = unique pod specs, t = batch terms,
+    n = nodes, v = topology segment buckets (n_buckets static), k = label
+    key slots, r = resource slots, s = existing-pod signatures, pt =
+    existing-pod term patterns. For KIND_PREEMPT, b is the preemptor
+    bucket and v the victim-slot bucket."""
+
+    kind: str = KIND_SOLVE
+    b: int = 0
+    u: int = 0
+    t: int = 0
+    n: int = 0
+    v: int = 0
+    k: int = 0
+    r: int = 0
+    s: int = 0
+    pt: int = 0
+    term_kinds: frozenset = frozenset()
+    config_repr: str = "None"  # SolveConfig repr (jit static; opaque here)
+    deterministic: bool = False
+    with_carry: bool = False
+    track_inbatch: bool = False
+
+    def key(self) -> Tuple:
+        return (
+            self.kind, self.b, self.u, self.t, self.n, self.v, self.k,
+            self.r, self.s, self.pt, tuple(sorted(self.term_kinds)),
+            self.config_repr, self.deterministic, self.with_carry,
+            self.track_inbatch,
+        )
+
+    def hash_hex(self) -> str:
+        import hashlib
+
+        return hashlib.sha1(repr(self.key()).encode()).hexdigest()[:16]
+
+    def short(self) -> str:
+        """Compact human form for logs/telemetry."""
+        kinds = ",".join(sorted(self.term_kinds)) or "-"
+        flags = "".join(
+            c for c, on in (
+                ("c", self.with_carry), ("i", self.track_inbatch),
+                ("d", self.deterministic),
+            ) if on
+        ) or "-"
+        return (
+            f"{self.kind}[b{self.b}/u{self.u}/t{self.t}/n{self.n}/v{self.v}"
+            f"/k{self.k}/r{self.r}/s{self.s}/p{self.pt}|{kinds}|{flags}]"
+        )
+
+    def to_dict(self) -> Dict:
+        return {
+            "kind": self.kind, "b": self.b, "u": self.u, "t": self.t,
+            "n": self.n, "v": self.v, "k": self.k, "r": self.r,
+            "s": self.s, "pt": self.pt,
+            "term_kinds": sorted(self.term_kinds),
+            "config_repr": self.config_repr,
+            "deterministic": self.deterministic,
+            "with_carry": self.with_carry,
+            "track_inbatch": self.track_inbatch,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "SolveSpec":
+        return cls(
+            kind=d.get("kind", KIND_SOLVE),
+            b=int(d.get("b", 0)), u=int(d.get("u", 0)), t=int(d.get("t", 0)),
+            n=int(d.get("n", 0)), v=int(d.get("v", 0)), k=int(d.get("k", 0)),
+            r=int(d.get("r", 0)), s=int(d.get("s", 0)), pt=int(d.get("pt", 0)),
+            term_kinds=frozenset(d.get("term_kinds", ())),
+            config_repr=d.get("config_repr", "None"),
+            deterministic=bool(d.get("deterministic", False)),
+            with_carry=bool(d.get("with_carry", False)),
+            track_inbatch=bool(d.get("track_inbatch", False)),
+        )
+
+
+class ShapeLadder:
+    """Rounds raw axis sizes up to declared rungs and tracks the declared
+    spec set. The pod/term/segment axes quantize to powers of two, the
+    node axis to the node-axis policy — identical to what the encoders
+    produce, so a canonicalized spec always names shapes that real banks
+    can have."""
+
+    def __init__(self, minimum: int = 16):
+        self.minimum = minimum
+        self._declared: Dict[Tuple, SolveSpec] = {}
+
+    # -- canonicalization ---------------------------------------------------
+
+    def canonicalize(self, spec: SolveSpec) -> SolveSpec:
+        """Round every padded axis up to its rung; u never exceeds b (a
+        batch cannot hold more unique specs than pods).
+
+        KIND_PREEMPT specs pass through UNCHANGED: the preempt call site
+        buckets its own axes (minimum 8, scheduler/preemption.py) and the
+        spec must name the EXACT executed shapes — re-rounding here with
+        this ladder's minimum would collapse distinct kernel signatures
+        onto one key and report a mid-drain compile as a plan hit."""
+        if spec.kind == KIND_PREEMPT:
+            return spec
+        m = self.minimum
+        b = pow2_bucket(spec.b, m) if spec.b else 0
+        u = min(pow2_bucket(spec.u, m), b) if spec.u and b else (
+            pow2_bucket(spec.u, m) if spec.u else 0
+        )
+        return replace(
+            spec,
+            b=b,
+            u=u,
+            t=pow2_bucket(spec.t, m) if spec.t else 0,
+            n=node_axis_bucket(spec.n, m) if spec.n else 0,
+            v=pow2_bucket(spec.v, m) if spec.v else 0,
+        )
+
+    def growth_specs(self, spec: SolveSpec) -> List[SolveSpec]:
+        """The specs one growth event away on the axes that actually grow
+        mid-drain — the headroom-warming set: unique-spec count, term
+        table, segment buckets (monotone driver buckets), and the
+        signature/pattern banks (whose overflow quadruples capacity and
+        forces a mirror rebuild — state/cache.TensorMirror._rebuild — so
+        pre-compiling the post-rebuild solve turns a multi-second stall
+        into just the re-encode). The node axis is excluded: cluster
+        growth arrives via informer events, not mid-drain."""
+        out = []
+        if spec.u and spec.u < spec.b:
+            out.append(replace(spec, u=min(next_rung(spec.u, self.minimum), spec.b)))
+        if spec.t:
+            out.append(replace(spec, t=next_rung(spec.t, self.minimum)))
+        if spec.v:
+            out.append(replace(spec, v=next_rung(spec.v, self.minimum)))
+        if spec.s:
+            out.append(replace(spec, s=spec.s * 4))
+        if spec.pt:
+            out.append(replace(spec, pt=spec.pt * 4))
+        return [self.canonicalize(s) for s in out]
+
+    # -- declaration --------------------------------------------------------
+
+    def declare(self, spec: SolveSpec) -> SolveSpec:
+        c = self.canonicalize(spec)
+        self._declared.setdefault(c.key(), c)
+        return c
+
+    def undeclare(self, spec: SolveSpec) -> None:
+        self._declared.pop(self.canonicalize(spec).key(), None)
+
+    def covers(self, spec: SolveSpec) -> bool:
+        return self.canonicalize(spec).key() in self._declared
+
+    @property
+    def declared(self) -> List[SolveSpec]:
+        return list(self._declared.values())
+
+    def __len__(self) -> int:
+        return len(self._declared)

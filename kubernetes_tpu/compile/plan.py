@@ -1,0 +1,233 @@
+"""CompilePlan: the registry of declared XLA program signatures.
+
+Every solve entry point (ops/pipeline solve/gang/filter, ops/preempt)
+routes its signature through `admit()` before dispatch. The plan
+canonicalizes it onto the ladder, answers "was this pre-declared?", and
+keeps the telemetry the north-star bench asserts on: per-spec compile
+time, hit/miss counters, ladder coverage, and the
+misses-after-warmup gauge that must read ZERO on a healthy drain. A miss
+never blocks anything — the jit fallback compiles inline — but it is
+logged loudly (utils/trace logger) because each one is a multi-second
+stall the warmup should have paid.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .cache import PersistentCompileCache
+from .ladder import ShapeLadder, SolveSpec
+
+logger = logging.getLogger("kubernetes_tpu.compile")
+
+SOURCE_WARMUP = "warmup"
+SOURCE_PERSISTED = "persisted"
+SOURCE_INLINE = "inline"
+
+
+class CompilePlan:
+    """Thread-safe (the warmup worker declares from its own thread while
+    the driver admits from the scheduling loop)."""
+
+    def __init__(
+        self,
+        ladder: Optional[ShapeLadder] = None,
+        cache: Optional[PersistentCompileCache] = None,
+    ):
+        self.ladder = ladder or ShapeLadder()
+        self.cache = cache
+        self._lock = threading.Lock()
+        # spec key -> {"spec", "compile_s", "source", "count"}
+        self._records: Dict[Tuple, Dict] = {}
+        self.warmed = False
+        self.stats: Dict[str, float] = {
+            "hits": 0,
+            "misses": 0,
+            "misses_after_warmup": 0,
+            "compiles": 0,
+            "compile_s": 0.0,
+        }
+
+    @classmethod
+    def default(cls) -> "CompilePlan":
+        """Plan with persistence iff KTPU_COMPILE_CACHE_DIR names a dir."""
+        return cls(cache=PersistentCompileCache.from_env())
+
+    # -- the hot-path gate ----------------------------------------------------
+
+    def canonicalize(self, spec: SolveSpec) -> SolveSpec:
+        return self.ladder.canonicalize(spec)
+
+    def admit(self, spec: SolveSpec) -> bool:
+        """Account one dispatch of `spec` (already at canonical buckets —
+        the driver's monotone buckets are ladder rungs by construction).
+        Returns True on a hit (program already declared). A miss declares
+        the spec (the inline jit compile that follows makes it real) and,
+        after warmup, bumps the miss gauge and logs — the signal that the
+        ladder under-covers the workload."""
+        c = self.ladder.canonicalize(spec)
+        with self._lock:
+            rec = self._records.get(c.key())
+            if rec is not None:
+                rec["count"] += 1
+                self.stats["hits"] += 1
+                self._metric_hit()
+                return True
+            self.stats["misses"] += 1
+            after = self.warmed
+            if after:
+                self.stats["misses_after_warmup"] += 1
+            self._declare_locked(c, 0.0, SOURCE_INLINE)
+        self._metric_miss(after)
+        if after:
+            logger.warning(
+                "compile-plan MISS after warmup: %s — compiling inline "
+                "(declare this spec in the warmup ladder)", c.short(),
+            )
+        return False
+
+    # -- declaration / compile accounting -------------------------------------
+
+    def _declare_locked(self, c: SolveSpec, secs: float, source: str) -> None:
+        self.ladder.declare(c)
+        self._records[c.key()] = {
+            "spec": c, "compile_s": float(secs), "source": source, "count": 0,
+        }
+
+    def declare(self, spec: SolveSpec, source: str = SOURCE_WARMUP) -> SolveSpec:
+        """Pre-declare a spec (warmup/persisted ladder) without counting a
+        dispatch."""
+        c = self.ladder.canonicalize(spec)
+        with self._lock:
+            if c.key() not in self._records:
+                self._declare_locked(c, 0.0, source)
+        return c
+
+    def note_compiled(self, spec: SolveSpec, seconds: float, source: str) -> None:
+        """Record an actual trace+compile of `spec` (warmup measures its
+        warm calls; the driver attributes a missed dispatch's wall)."""
+        c = self.ladder.canonicalize(spec)
+        with self._lock:
+            rec = self._records.get(c.key())
+            if rec is None:
+                self._declare_locked(c, seconds, source)
+                rec = self._records[c.key()]
+            else:
+                rec["compile_s"] = max(rec["compile_s"], float(seconds))
+                if rec["source"] == SOURCE_INLINE and source != SOURCE_INLINE:
+                    rec["source"] = source
+            self.stats["compiles"] += 1
+            self.stats["compile_s"] += float(seconds)
+        self._metric_compile(seconds)
+        if source == SOURCE_INLINE and self.warmed:
+            # a mid-drain trace+compile is a slow-cycle event: surface it
+            # through the utiltrace contract, not just the miss counter
+            from ..utils.trace import log_slow
+
+            log_slow("xla_inline_compile", seconds, spec=c.short())
+
+    def undeclare(self, spec: SolveSpec) -> None:
+        """Forget a declared spec. The warmup service calls this when a
+        PERSISTED spec's warm fails or is skipped: leaving it declared
+        would make the drain's real inline compile count as a plan HIT —
+        silently defeating the misses-after-warmup honesty gauge."""
+        c = self.ladder.canonicalize(spec)
+        with self._lock:
+            self._records.pop(c.key(), None)
+            self.ladder.undeclare(c)
+
+    def is_declared(self, spec: SolveSpec) -> bool:
+        with self._lock:
+            return self.ladder.canonicalize(spec).key() in self._records
+
+    def mark_warmed(self) -> None:
+        """Warmup finished: from here every miss is a drain stall."""
+        with self._lock:
+            self.warmed = True
+
+    # -- persistence -----------------------------------------------------------
+
+    def load_persisted(self) -> List[SolveSpec]:
+        """Declare the on-disk ladder (restart path) and return its specs
+        for the warmup service to compile (the XLA persistent cache makes
+        each one cheap)."""
+        if self.cache is None:
+            return []
+        out = []
+        for spec, secs in self.cache.load_ladder():
+            c = self.declare(spec, source=SOURCE_PERSISTED)
+            with self._lock:
+                rec = self._records[c.key()]
+                rec["compile_s"] = max(rec["compile_s"], secs)
+            out.append(c)
+        return out
+
+    def persist(self) -> bool:
+        if self.cache is None:
+            return False
+        with self._lock:
+            records = [(r["spec"], r["compile_s"]) for r in self._records.values()]
+        return self.cache.save_ladder(records)
+
+    # -- telemetry -------------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """One dict for bench detail / driver stats / debugging."""
+        with self._lock:
+            total = self.stats["hits"] + self.stats["misses"]
+            return {
+                "declared_specs": len(self._records),
+                "hits": int(self.stats["hits"]),
+                "misses": int(self.stats["misses"]),
+                "misses_after_warmup": int(self.stats["misses_after_warmup"]),
+                "compiles": int(self.stats["compiles"]),
+                "compile_s": round(self.stats["compile_s"], 3),
+                "coverage": round(self.stats["hits"] / total, 4) if total else None,
+                "warmed": self.warmed,
+                "specs": sorted(
+                    (
+                        {
+                            "spec": r["spec"].short(),
+                            "source": r["source"],
+                            "compile_s": round(r["compile_s"], 3),
+                            "dispatches": r["count"],
+                        }
+                        for r in self._records.values()
+                    ),
+                    key=lambda e: -e["compile_s"],
+                ),
+            }
+
+    # -- metrics glue (lazy import: the plan must work without the registry) --
+
+    def _metrics(self):
+        try:
+            from ..metrics import metrics as M
+
+            return M
+        except Exception:  # pragma: no cover
+            return None
+
+    def _metric_hit(self) -> None:
+        M = self._metrics()
+        if M is not None:
+            M.compile_plan_lookups.inc("hit")
+            M.compile_ladder_specs.set(len(self._records))
+
+    def _metric_miss(self, after_warmup: bool) -> None:
+        M = self._metrics()
+        if M is not None:
+            M.compile_plan_lookups.inc("miss")
+            M.compile_ladder_specs.set(len(self._records))
+            if after_warmup:
+                M.compile_spec_misses_after_warmup.set(
+                    self.stats["misses_after_warmup"]
+                )
+
+    def _metric_compile(self, seconds: float) -> None:
+        M = self._metrics()
+        if M is not None:
+            M.xla_compile_duration.observe(seconds)

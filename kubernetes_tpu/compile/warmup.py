@@ -1,0 +1,313 @@
+"""AOT warmup service: compile the declared ladder OFF the drain loop.
+
+Executes each declared SolveSpec once against template arguments whose
+shapes/dtypes/pytree structure are — by construction — identical to what
+the driver dispatches (the templates come from the same encoder classes:
+NodeBank/PodBatch/SigBank/PatternBank/compile_batch_terms), so the jit
+call cache the drain hits is the very cache this service populates.
+
+Two modes:
+* **foreground** (`warm_specs`) at driver startup — `Scheduler.warmup()`
+  drives it with the persisted ladder plus the live peeked batch;
+* **background** (`warm_async`) for growth events — when a bucket grows
+  or a bank rebuild looms, the next rung compiles on a daemon worker
+  thread while the drain keeps executing the current rung. The worker
+  never touches the TensorMirror's mutable dirty-row bookkeeping: live
+  device dicts are snapshotted by the CALLING (driver) thread and handed
+  over; otherwise the worker builds synthetic banks from scratch.
+
+A warm that fails (encoder drift, backend quirk) is counted and logged,
+never raised — the inline jit fallback still guarantees correctness.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .ladder import (
+    KIND_FILTER,
+    KIND_PREEMPT,
+    KIND_SOLVE,
+    KIND_SOLVE_GANG,
+    SolveSpec,
+)
+from .plan import CompilePlan, SOURCE_PERSISTED, SOURCE_WARMUP
+
+logger = logging.getLogger("kubernetes_tpu.compile")
+
+
+class WarmupService:
+    """Owns no policy: the plan says WHAT to compile, this service does."""
+
+    def __init__(self, scheduler, plan: Optional[CompilePlan] = None):
+        self.sched = scheduler
+        self.plan = plan if plan is not None else scheduler.compile_plan
+        self._lock = threading.Lock()
+        self._done: set = set()
+        self._pending: List[Tuple[SolveSpec, Optional[Tuple]]] = []
+        self._worker: Optional[threading.Thread] = None
+        # True from the moment a worker is started until it observes an
+        # empty queue UNDER THE LOCK and exits. Checked instead of
+        # Thread.is_alive(): a worker that decided to exit is still alive
+        # for a moment, and an enqueue landing in that window would see
+        # is_alive() and start nothing — specs stuck unwarmed (lost
+        # wakeup).
+        self._worker_active = False
+        self.stats: Dict[str, float] = {"warmed": 0, "failures": 0, "warm_s": 0.0}
+
+    # -- public entry points --------------------------------------------------
+
+    def warm_specs(
+        self, specs: Sequence[SolveSpec], dev: Optional[Tuple] = None,
+        source: str = SOURCE_WARMUP,
+    ) -> int:
+        """Foreground warm (caller's thread — safe to read the mirror).
+        Returns the number of specs actually executed."""
+        n = 0
+        for spec in specs:
+            if self._warm_one(spec, dev, source):
+                n += 1
+        return n
+
+    def warm_async(self, specs: Sequence[SolveSpec], dev: Optional[Tuple] = None) -> None:
+        """Queue specs for the background worker. `dev` is a (na, ea, xp)
+        device-dict snapshot taken by the caller — background warms MUST
+        NOT call mirror.device_arrays() themselves (its dirty-row
+        bookkeeping is not thread-safe); without a snapshot the worker
+        builds synthetic banks."""
+        with self._lock:
+            queued = {s.key() for s, _ in self._pending}
+            for s in specs:
+                c = self.plan.canonicalize(s)
+                if c.key() in self._done or c.key() in queued:
+                    continue
+                self._pending.append((c, dev))
+                queued.add(c.key())
+            if self._pending and not self._worker_active:
+                self._worker_active = True
+                if not getattr(self, "_atexit_armed", False):
+                    # an XLA compile in flight on a daemon thread when the
+                    # interpreter exits aborts the process (C++ terminate);
+                    # drain queued work and let the in-flight one finish
+                    # even when the embedding app never calls close()
+                    import atexit
+
+                    atexit.register(self._atexit_join)
+                    self._atexit_armed = True
+                self._worker = threading.Thread(
+                    target=self._drain, name="compile-warmup", daemon=True
+                )
+                self._worker.start()
+
+    def _atexit_join(self) -> None:
+        self.stop()
+        self.join()
+
+    def stop(self) -> None:
+        """Drop queued (not-yet-started) warms. The in-flight spec still
+        finishes — interrupting an XLA compile mid-flight aborts the
+        process at teardown; callers stop() then join()."""
+        with self._lock:
+            self._pending.clear()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout)
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending:
+                    self._worker_active = False
+                    return
+                spec, dev = self._pending.pop(0)
+            self._warm_one(spec, dev, SOURCE_WARMUP)
+
+    # -- the actual warm -------------------------------------------------------
+
+    def _warm_one(self, spec: SolveSpec, dev, source: str) -> bool:
+        c = self.plan.canonicalize(spec)
+        with self._lock:
+            if c.key() in self._done:
+                return False
+        try:
+            secs = self.warm_spec(c, dev)
+        except Exception:
+            self.stats["failures"] += 1
+            logger.warning("warmup failed for %s", c.short(), exc_info=True)
+            if source == SOURCE_PERSISTED:
+                # the spec was declared at LOAD time on the promise of this
+                # warm — withdraw the declaration, so a later dispatch of
+                # it counts as the (real) miss it is. Other sources were
+                # never pre-declared (undeclaring could forget a spec an
+                # inline compile legitimately made hot).
+                self.plan.undeclare(c)
+            return False
+        if secs is None:
+            if source == SOURCE_PERSISTED:
+                self.plan.undeclare(c)
+            return False  # incompatible with the current deployment: skipped
+        with self._lock:
+            self._done.add(c.key())
+        self.stats["warmed"] += 1
+        self.stats["warm_s"] += secs
+        self.plan.declare(c, source=source)
+        self.plan.note_compiled(c, secs, source)
+        return True
+
+    def warm_spec(self, spec: SolveSpec, dev=None) -> Optional[float]:
+        """Execute one spec at its declared shapes; returns wall seconds,
+        or None when the spec can't be realized here (a SolveConfig this
+        process can't reconstruct, zero-size axes)."""
+        if spec.kind == KIND_PREEMPT:
+            return self._warm_preempt(spec)  # no SolveConfig static
+        if spec.config_repr != repr(self.sched.solve_config):
+            return None  # persisted ladder from a differently-policied run
+        if not (spec.b and spec.u and spec.t and spec.n and spec.v):
+            return None
+
+        import jax
+        import numpy as np
+
+        from ..ops import filters as F
+        from ..ops.pipeline import filter_mask, solve_pipeline, solve_pipeline_gang
+        from ..state.terms import compile_batch_terms
+        from ..state.tensors import PodBatch
+
+        mirror = self.sched.mirror
+        vocab = mirror.vocab
+        na, ea, xp = self._banks_for(spec, dev)
+        if na is None:
+            return None
+        batch = PodBatch(vocab, spec.u)
+        tb, aux = compile_batch_terms(vocab, [], capacity=spec.t, b_capacity=spec.u)
+        pb = {
+            "sig": np.zeros(spec.b, np.int32),
+            "valid": np.zeros(spec.b, bool),
+            "priority": np.zeros(spec.b, np.int32),
+        }
+        ids = self.sched._ids if self.sched._ids is not None else F.make_ids(vocab)
+        key = jax.random.PRNGKey(0)
+        args = (na, batch.arrays(), ea, tb.arrays(), xp, aux, ids, key)
+        statics = dict(
+            deterministic=spec.deterministic,
+            config=self.sched.solve_config,
+            term_kinds=spec.term_kinds,
+            n_buckets=spec.v,
+        )
+        use_sharded = (
+            self.sched._sharded is not None
+            and spec.n % max(self.sched._mesh_shards, 1) == 0
+        )
+        t0 = time.perf_counter()
+        if spec.kind == KIND_FILTER:
+            out = filter_mask(args[0], args[1], args[2], args[3], args[4],
+                              args[5], args[6], **statics)
+            jax.block_until_ready(out)
+        elif spec.kind == KIND_SOLVE_GANG:
+            fn = self.sched._sharded.gang if use_sharded else solve_pipeline_gang
+            garr = np.full(spec.b, -1, np.int32)
+            out = fn(*args, garr, pb=pb, carry=None, return_carry=True, **statics)
+            if spec.with_carry:
+                out = fn(*args, garr, pb=pb, carry=out[3], return_carry=True, **statics)
+            jax.block_until_ready(out[0])
+        else:
+            fn = self.sched._sharded if use_sharded else solve_pipeline
+            out = fn(
+                *args, pb=pb, carry=None, return_carry=True,
+                track_inbatch=spec.track_inbatch, **statics,
+            )
+            if spec.with_carry:
+                out = fn(
+                    *args, pb=pb, carry=out[2], return_carry=True,
+                    track_inbatch=spec.track_inbatch, **statics,
+                )
+            jax.block_until_ready(out[0])
+        return time.perf_counter() - t0
+
+    # -- templates -------------------------------------------------------------
+
+    def _banks_for(self, spec: SolveSpec, dev):
+        """(na, ea, xp) argument dicts at the spec's bank shapes. The live
+        snapshot (`dev`, or the mirror when called from the driver thread)
+        is used when every bank axis matches; otherwise synthetic banks are
+        built from the encoder classes — shape-exact for specs one growth
+        rung AHEAD of the live banks (sig/pattern/node growth warming)."""
+        mirror = self.sched.mirror
+        live_shape = (
+            mirror.nodes.capacity, mirror.nodes.key_capacity,
+            mirror.nodes.alloc.shape[1], mirror.eps.capacity,
+            mirror.pats.capacity,
+        )
+        if (spec.n, spec.k, spec.r, spec.s, spec.pt) == live_shape:
+            if dev is not None:
+                return dev
+            if threading.current_thread() is threading.main_thread():
+                return mirror.device_arrays()
+            # background thread without a snapshot: fall through to synthetic
+        return self._synthetic_banks(spec)
+
+    def _synthetic_banks(self, spec: SolveSpec):
+        import numpy as np
+
+        from ..state.tensors import EncodingConfig, NodeBank, SigBank, Vocab
+        from ..state.terms import PatternBank
+
+        mirror = self.sched.mirror
+        if (spec.k, spec.r) != (
+            mirror.nodes.key_capacity, mirror.nodes.alloc.shape[1]
+        ):
+            # a different key/resource width needs its own vocab config;
+            # the ids the kernels consume are scalars, so a throwaway
+            # vocab still yields the identical program signature
+            vocab = Vocab(EncodingConfig(key_slots=spec.k, resource_slots=spec.r))
+        else:
+            vocab = mirror.vocab
+        if vocab.config.key_slots != spec.k or vocab.config.resource_slots != spec.r:
+            return None, None, None  # config grew concurrently: skip
+        nb = NodeBank(vocab, spec.n)
+        # the live node dict carries image_scaled (ImageTable.apply runs on
+        # every rebuild); mirror its CURRENT width — image-vocab growth is
+        # its own (rare) recompile, not this spec's axis
+        img = getattr(mirror.nodes, "image_scaled", None)
+        img_w = img.shape[1] if img is not None else 64
+        nb.image_scaled = np.zeros((spec.n, img_w), np.int64)
+        eb = SigBank(vocab, spec.s, spec.n)
+        pb = PatternBank(vocab, spec.pt, spec.n)
+        return nb.arrays(), eb.arrays(), pb.arrays()
+
+    def _warm_preempt(self, spec: SolveSpec) -> Optional[float]:
+        """ops/preempt.preempt_batch at (b=preemptors, n=nodes,
+        v=victim slots, r=resource slots)."""
+        if not (spec.b and spec.n and spec.v and spec.r):
+            return None
+        import jax
+        import numpy as np
+
+        from ..ops.preempt import preempt_batch
+
+        b, n, v, r = spec.b, spec.n, spec.v, spec.r
+        t0 = time.perf_counter()
+        out = preempt_batch(
+            np.zeros((b, n), bool),
+            np.zeros((b, r), np.int64),
+            np.zeros(b, bool),
+            np.zeros(b, np.int32),
+            np.zeros(b, bool),
+            np.zeros((n, v, r), np.int64),
+            np.zeros((n, v), np.int32),
+            np.zeros((n, v), np.int64),
+            np.zeros((n, v), bool),
+            np.zeros((n, v), bool),
+            np.zeros((n, r), np.int64),
+            np.zeros(n, np.int32),
+            np.zeros(n, bool),
+            np.zeros((n, r), np.int64),
+            np.zeros(n, np.int32),
+        )
+        jax.block_until_ready(out[0])
+        return time.perf_counter() - t0

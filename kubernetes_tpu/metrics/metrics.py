@@ -101,6 +101,28 @@ tensor_sync_duration = registry.register(Histogram(
     "Dirty-row tensor mirror patch latency per batch",
     buckets=_DURATION_BUCKETS,
 ))
+# compile-plan series (kubernetes_tpu/compile): the drain must never meet
+# the XLA compiler — these are the evidence
+xla_compile_duration = registry.register(Histogram(
+    "scheduler_xla_compile_duration_seconds",
+    "Trace+compile wall per solve-spec (warmup or inline fallback)",
+    # compiles run seconds-to-minutes on a remote-attached chip
+    buckets=_DURATION_BUCKETS + (20.0, 60.0, 120.0, 300.0),
+))
+compile_plan_lookups = registry.register(Counter(
+    "scheduler_compile_plan_lookups_total",
+    "Solve-spec plan lookups by result (hit|miss)",
+    label_names=("result",),
+))
+compile_ladder_specs = registry.register(Gauge(
+    "scheduler_compile_ladder_specs",
+    "Declared solve-specs in the compile plan's shape ladder",
+))
+compile_spec_misses_after_warmup = registry.register(Gauge(
+    "scheduler_compile_spec_misses_after_warmup",
+    "Solve-spec misses (inline XLA compiles) AFTER warmup declared the "
+    "ladder — zero on a healthy drain",
+))
 
 
 class _Timer:

@@ -31,6 +31,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..api.types import Pod, PodDisruptionBudget
+from ..compile import CompilePlan, SolveSpec, WarmupService
+from ..compile.ladder import KIND_PREEMPT, KIND_SOLVE, KIND_SOLVE_GANG
+from ..compile.plan import SOURCE_INLINE, SOURCE_PERSISTED
 from ..framework.interface import CycleState, Framework, Status
 from ..api.selectors import match_label_selector
 from ..oracle.predicates import (
@@ -457,6 +460,7 @@ class Scheduler:
         speculate: bool = True,
         spec_depth: int = 2,
         mesh=None,
+        compile_plan: Optional[CompilePlan] = None,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -511,9 +515,27 @@ class Scheduler:
         self._cycle = 0
         self._spread_selectors_fn: Optional[Callable[[Pod], list]] = None
         self._jax = None  # lazily imported so pure-host tests stay light
+        # the compile plan owns every XLA compilation decision: the shape
+        # ladder the buckets below are rungs of, the declared-spec registry,
+        # hit/miss/compile telemetry, and (when configured) the persistent
+        # on-disk ladder a restart re-warms from (kubernetes_tpu/compile)
+        self.compile_plan = compile_plan or CompilePlan.default()
+        self._warm_svc: Optional[WarmupService] = None
+        # growth-event AOT warming arms when warmup() runs — tests that
+        # never warm up must not get surprise background compile threads
+        self._aot_enabled = False
+        # monotone preemptor- and victim-axis buckets for the device
+        # preemption kernel (ops/preempt): a raw per-call pod/victim count
+        # was one XLA signature per distinct count — the round-5
+        # nominee-overlay churn. Both are passed to batch_preempt_device
+        # as floors so the executed shapes equal the warmed ones.
+        self._p_bucket = 0
+        self._pv_bucket = 0
         # monotonic shape buckets: a smaller tail batch or a term-light batch
         # must REUSE the largest shapes seen so far — every fresh shape is a
-        # fresh XLA compile (minutes on a remote TPU)
+        # fresh XLA compile (minutes on a remote TPU). Each bucket is a rung
+        # of compile_plan.ladder (the quantizers are shared), so the specs
+        # the driver admits are canonical by construction.
         self._b_bucket = 16
         self._u_bucket = 16  # unique-spec axis (≤ _b_bucket)
         self._t_bucket = 16
@@ -560,6 +582,73 @@ class Scheduler:
         """Install the getSelectors equivalent (services/RC/RS/SS listers,
         selector_spreading.go getSelectors) used for SelectorSpread scoring."""
         self._spread_selectors_fn = fn
+
+    # -- compile plan --------------------------------------------------------
+
+    def _solve_spec(self, gang: bool, with_carry: bool) -> SolveSpec:
+        """This driver's CURRENT solve-program signature: the monotone
+        buckets (ladder rungs) + every jit static. One definition so
+        dispatch accounting and warmup declaration can never disagree."""
+        m = self.mirror
+        return SolveSpec(
+            kind=KIND_SOLVE_GANG if gang else KIND_SOLVE,
+            b=self._b_bucket,
+            u=self._u_bucket,
+            t=self._t_bucket,
+            n=m.nodes.capacity,
+            v=getattr(self, "_v_bucket", 16),
+            k=m.nodes.key_capacity,
+            r=m.nodes.alloc.shape[1],
+            s=m.eps.capacity,
+            pt=m.pats.capacity,
+            term_kinds=getattr(self, "_term_kinds", frozenset()),
+            config_repr=repr(self.solve_config),
+            deterministic=self.deterministic,
+            with_carry=with_carry,
+            track_inbatch=self._track_inbatch and not gang,
+        )
+
+    def _preempt_spec(self) -> SolveSpec:
+        """The device preemption kernel's signature at current cluster
+        shape (scheduler/preemption.batch_preempt_device axes, which this
+        MUST mirror exactly — preempt specs are not re-rounded by the
+        ladder). The victim axis uses ALL pods per node, an upper bound on
+        the can_disrupt-filtered pool the runtime sees; it becomes the
+        monotone `_pv_bucket` floor passed to batch_preempt_device so the
+        executed v_cap equals the warmed one."""
+        from ..state.tensors import _bucket, _node_bucket
+
+        snap = self.cache.snapshot
+        v_max = max((len(ni.pods) for ni in snap.node_infos.values()), default=1)
+        self._pv_bucket = max(self._pv_bucket, _bucket(v_max, 8))
+        return SolveSpec(
+            kind=KIND_PREEMPT,
+            b=self._p_bucket or _bucket(self.batch_size, 8),
+            n=_node_bucket(max(len(snap.node_infos), 1)),
+            v=self._pv_bucket,
+            # cpu/mem/ephemeral + extended-resource headroom; an exotic
+            # cluster using >5 extended resources pays one inline compile
+            r=8,
+        )
+
+    def _compile_growth_hook(self, spec: SolveSpec, dev) -> None:
+        """Background-warm the specs one growth rung AHEAD of `spec`
+        (unique-spec/term/segment buckets, signature/pattern bank growth)
+        so mid-drain growth lands on a hot program instead of an inline
+        compile. Armed by warmup(); `dev` is this dispatch's device-dict
+        snapshot (the worker must not touch the mirror's bookkeeping)."""
+        if not self._aot_enabled or self._warm_svc is None:
+            return
+        from dataclasses import replace
+
+        lad = self.compile_plan.ladder
+        # both carry variants: after growth, the first fresh solve runs
+        # carry-less and the chained speculative ones carry — each is its
+        # own program (verified: covering only one leaves the other a miss)
+        specs = lad.growth_specs(spec) + lad.growth_specs(
+            replace(spec, with_carry=not spec.with_carry)
+        )
+        self._warm_svc.warm_async(specs, dev)
 
     # -- device solve --------------------------------------------------------
 
@@ -746,7 +835,23 @@ class Scheduler:
         group_names = [pod_group_name(p) for p in pods]
         gang_dev = None
         carry_out = None
-        if any(group_names):
+        is_gang = any(group_names)
+        if not is_gang:
+            # monotone jit-static: once a batch carries required
+            # anti-affinity or host ports, keep the in-batch tracking
+            # variant (a superset program is exact without those features)
+            self._track_inbatch = self._track_inbatch or (
+                "anti_req" in term_kinds
+                or any(p.host_ports() for p in reps)
+            )
+        # route this dispatch through the compile plan: admit its full XLA
+        # program signature (shape-ladder rungs + jit statics). A miss
+        # after warmup is the stall this subsystem exists to kill — it is
+        # counted, logged, and still compiled inline (correctness first).
+        solve_spec = self._solve_spec(gang=is_gang, with_carry=carry is not None)
+        spec_known = self.compile_plan.admit(solve_spec)
+        t_spec = time.perf_counter()
+        if is_gang:
             from ..ops.pipeline import solve_pipeline_gang
 
             gid_map: Dict[str, int] = {}
@@ -764,10 +869,6 @@ class Scheduler:
             gang_dev = gang_ok
         else:
             t_d = time.perf_counter()
-            self._track_inbatch = self._track_inbatch or (
-                "anti_req" in term_kinds
-                or any(p.host_ports() for p in reps)
-            )
             if use_sharded:
                 # same in-batch anti/port sequentialization as the
                 # single-device path: commit counts replicate, the winning
@@ -789,6 +890,16 @@ class Scheduler:
             self.stats["dispatch_s"] = self.stats.get("dispatch_s", 0.0) + (
                 time.perf_counter() - t_d
             )
+        if not spec_known:
+            # attribute this dispatch's wall (trace + compile + enqueue; the
+            # device executes async) to the spec — the compile-stall upper
+            # bound the telemetry reports
+            self.compile_plan.note_compiled(
+                solve_spec,
+                time.perf_counter() - t_spec,
+                SOURCE_INLINE if self.compile_plan.warmed else "warmup",
+            )
+        self._compile_growth_hook(solve_spec, (na_dev, ea_dev, xp_dev))
         self.stats["batch_specs"] = self.stats.get("batch_specs", 0) + len(reps)
         self.stats["solve_s"] += time.perf_counter() - t1
         return dict(
@@ -851,24 +962,63 @@ class Scheduler:
         Dispatches twice: the carry-less first-batch program AND the
         carry-chained speculative variant (different jit signatures).
 
+        Beyond the live-peek dispatch, this is where the AOT compile plan
+        arms: the persisted ladder (a previous process's declared specs)
+        re-compiles against the XLA persistent cache, the device
+        preemption kernel warms when preemption is enabled, headroom specs
+        (one growth rung ahead on each mid-drain-growable axis) queue on
+        the background warmup worker, and the plan is marked warmed — any
+        later spec miss is counted and logged as a drain stall.
+
         The scheduler_perf-equivalent harness calls this in setup so e2e
         measures scheduling, not compilation — the production analogue is
         a scheduler warming its executables at boot before Run().
         Returns the number of pods warmed with (0 = empty queue or a
         warmup failure, both harmless)."""
         infos = self.queue.peek_batch(max_pods or self.batch_size)
-        if not infos:
-            return 0
         saved = dict(self.stats)
+        plan = self.compile_plan
         try:
             self.mirror.sync()
-            disp = self._dispatch_solve(infos)
-            self._finish_solve(disp)
-            if self.speculate:
-                disp2 = self._dispatch_solve(
-                    infos, carry=disp["carry_dev"], allow_rebuild=False
-                )
-                self._finish_solve(disp2)
+            if plan.cache is not None:
+                plan.cache.enable_xla_cache()
+            if self._warm_svc is None:
+                self._warm_svc = WarmupService(self, plan)
+            # restart path: the persisted ladder re-warms first — each spec
+            # is trace-only cost when the XLA persistent cache holds its
+            # artifact (the >=5x warm-vs-cold win the bench asserts)
+            persisted = plan.load_persisted()
+            if persisted:
+                dev = self.mirror.device_arrays()
+                self._warm_svc.warm_specs(persisted, dev=dev, source=SOURCE_PERSISTED)
+            if infos:
+                disp = self._dispatch_solve(infos)
+                self._finish_solve(disp)
+                if self.speculate:
+                    disp2 = self._dispatch_solve(
+                        infos, carry=disp["carry_dev"], allow_rebuild=False
+                    )
+                    self._finish_solve(disp2)
+            if self.enable_preemption:
+                # pin the preemptor-axis bucket so every device preemption
+                # round shares ONE signature (padded scan steps are cheap;
+                # the per-distinct-fails-count compiles were not), then
+                # warm it so the first failed batch doesn't pay the compile
+                from ..state.tensors import _bucket
+
+                self._p_bucket = max(self._p_bucket, _bucket(self.batch_size, 8))
+                self._warm_svc.warm_specs([self._preempt_spec()])
+            if infos:
+                # headroom: compile the next growth rung of each mid-drain-
+                # growable axis in the background while the drain starts —
+                # both carry variants (fresh solve + speculative chain)
+                dev = self.mirror.device_arrays()
+                for wc in ((False, True) if self.speculate else (False,)):
+                    spec = self._solve_spec(gang=False, with_carry=wc)
+                    self._warm_svc.warm_async(plan.ladder.growth_specs(spec), dev)
+            plan.mark_warmed()
+            plan.persist()
+            self._aot_enabled = True
         except Exception:
             # a failed warmup is harmless for correctness but must be
             # VISIBLE: the first real batch will silently pay the compile
@@ -1325,6 +1475,9 @@ class Scheduler:
             and not any(e.supports_preemption() for e in self.extenders)
         ):
             try:
+                from ..state.tensors import _bucket
+
+                self._p_bucket = max(self._p_bucket, _bucket(len(fails), 8))
                 plans = preemption_mod.batch_preempt_device(
                     [i.pod for i in fails],
                     self.cache.snapshot,
@@ -1335,6 +1488,11 @@ class Scheduler:
                     nominated=self.queue.nomination_extras(
                         {i.pod.key() for i in fails}
                     ),
+                    # monotone preemptor/victim buckets + plan routing: one
+                    # kernel signature per cluster shape, not per count
+                    pod_bucket=self._p_bucket,
+                    victim_bucket=self._pv_bucket or None,
+                    plan=self.compile_plan,
                 )
             except Exception:
                 plans = None  # kernel trouble: scalar path answers instead
@@ -2115,10 +2273,17 @@ class Scheduler:
         return n
 
     def close(self) -> None:
-        """Orderly shutdown: re-queue speculatively parked pods, then drain
-        the async bind pipeline. Safe to call more than once."""
+        """Orderly shutdown: re-queue speculatively parked pods, drain the
+        async bind pipeline, and retire the background compile-warmup
+        worker (an XLA compile in flight at interpreter exit aborts the
+        process — queued warms are dropped, the running one completes and
+        the grown ladder persists). Safe to call more than once."""
         self.flush_speculative()
         self.wait_for_binds()
+        if self._warm_svc is not None:
+            self._warm_svc.stop()
+            self._warm_svc.join()
+            self.compile_plan.persist()
 
     def wait_for_binds(self) -> None:
         """Drain the bind pipeline (tests/benchmarks)."""

@@ -425,6 +425,9 @@ def batch_preempt_device(
     nominated: Sequence[Tuple[str, Pod]] = (),
     max_victim_slots: int = 64,
     max_bytes: int = 64 << 20,
+    pod_bucket: Optional[int] = None,
+    victim_bucket: Optional[int] = None,
+    plan=None,
 ):
     """Vectorized victim search for a whole batch of failed pods on DEVICE
     (ops/preempt.preempt_batch): one dispatch evaluates every preemptor
@@ -518,35 +521,48 @@ def batch_preempt_device(
         v_max = max(v_max, len(ordered))
     if v_max > max_victim_slots:
         return None
-    from ..state.tensors import _bucket
+    from ..state.tensors import _bucket, _node_bucket
 
     r_cap = _bucket(len(slots), 8)
-    v_cap = _bucket(v_max, 8)
-    if n * v_cap * r_cap * 8 > max_bytes:
+    v_cap = max(victim_bucket or 0, _bucket(v_max, 8))
+    n_pad_guard = _node_bucket(n)
+    # guard the PADDED allocation (the victim tensors are built at the
+    # node-axis rung, up to ~2x the raw node count)
+    if n_pad_guard * v_cap * r_cap * 8 > max_bytes:
         return None
 
     b = len(pods)
-    p_req = np.zeros((b, r_cap), np.int64)
-    p_req_any = np.zeros(b, bool)
-    p_prio = np.zeros(b, np.int32)
+    # ladder-padded axes (one XLA signature per cluster shape, not per
+    # fails-count): preemptors to the caller's monotone bucket, nodes to
+    # the node-axis rung. Padded rows are inert — p_valid False kills
+    # their scan step's pick; node_valid/cand False keep phantom nodes
+    # out of every fit check.
+    b_pad = max(pod_bucket or 0, _bucket(b, 8))
+    n_pad = n_pad_guard
+    p_req = np.zeros((b_pad, r_cap), np.int64)
+    p_req_any = np.zeros(b_pad, bool)
+    p_prio = np.zeros(b_pad, np.int32)
+    p_valid = np.zeros(b_pad, bool)
+    p_valid[:b] = True
     for k, d in enumerate(reqs):
         for rn, val in d.items():
             p_req[k, slots[rn]] = val
         p_req_any[k] = any(v != 0 for v in d.values())
         p_prio[k] = pods[k].get_priority()
-    vict_req = np.zeros((n, v_cap, r_cap), np.int64)
-    vict_prio = np.zeros((n, v_cap), np.int32)
-    vict_ts = np.zeros((n, v_cap), np.int64)
-    vict_pdb = np.zeros((n, v_cap), bool)
-    vict_valid = np.zeros((n, v_cap), bool)
-    free0 = np.zeros((n, r_cap), np.int64)
-    count_free0 = np.zeros(n, np.int32)
-    node_valid = np.ones(n, bool)
+    vict_req = np.zeros((n_pad, v_cap, r_cap), np.int64)
+    vict_prio = np.zeros((n_pad, v_cap), np.int32)
+    vict_ts = np.zeros((n_pad, v_cap), np.int64)
+    vict_pdb = np.zeros((n_pad, v_cap), bool)
+    vict_valid = np.zeros((n_pad, v_cap), bool)
+    free0 = np.zeros((n_pad, r_cap), np.int64)
+    count_free0 = np.zeros(n_pad, np.int32)
+    node_valid = np.zeros(n_pad, bool)
+    node_valid[:n] = True
     # out-of-batch nominee reservations (the queue's nominated index minus
     # this batch): charged into the fit checks, exactly as podFitsOnNode's
     # pass 1 counts nominated pods
-    nom_extra0 = np.zeros((n, r_cap), np.int64)
-    nom_cnt0 = np.zeros(n, np.int32)
+    nom_extra0 = np.zeros((n_pad, r_cap), np.int64)
+    nom_cnt0 = np.zeros(n_pad, np.int32)
     row_of_name = {name: i for i, name in enumerate(names)}
     for node, npod in nominated:
         row = row_of_name.get(node)
@@ -576,7 +592,7 @@ def batch_preempt_device(
     # spec (replicas share the row) — nodesWherePreemptionMightHelp :1218
     from ..state.tensors import spec_key
 
-    cand = np.zeros((b, n), bool)
+    cand = np.zeros((b_pad, n_pad), bool)
     mask_of: Dict[object, np.ndarray] = {}
     for k, p in enumerate(pods):
         key = spec_key(p)
@@ -593,19 +609,32 @@ def batch_preempt_device(
                 bool,
             )
             mask_of[key] = m
-        cand[k] = m
+        cand[k, :n] = m
+
+    import time as _time
 
     import jax
     import jax.numpy as jnp
 
     from ..ops.preempt import preempt_batch
 
+    # route through the compile plan (when the caller has one): the kernel
+    # signature is (b_pad, n_pad, v_cap, r_cap) — padded axes make it one
+    # spec per cluster shape, which warmup pre-compiles
+    spec = None
+    spec_known = True
+    if plan is not None:
+        from ..compile.ladder import KIND_PREEMPT, SolveSpec
+
+        spec = SolveSpec(kind=KIND_PREEMPT, b=b_pad, n=n_pad, v=v_cap, r=r_cap)
+        spec_known = plan.admit(spec)
+    t_disp = _time.perf_counter()
     nodes_out, victims_out, fits_free_out = preempt_batch(
         jnp.asarray(cand),
         jnp.asarray(p_req),
         jnp.asarray(p_req_any),
         jnp.asarray(p_prio),
-        jnp.ones(b, bool),
+        jnp.asarray(p_valid),
         jnp.asarray(vict_req),
         jnp.asarray(vict_prio),
         jnp.asarray(vict_ts),
@@ -620,6 +649,15 @@ def batch_preempt_device(
     nodes_out, victims_out, fits_free_out = jax.device_get(
         (nodes_out, victims_out, fits_free_out)
     )
+    if plan is not None and not spec_known:
+        # dispatch+fetch wall as the compile-stall upper bound (device_get
+        # blocks on execution; a hot kernel makes this milliseconds)
+        from ..compile.plan import SOURCE_INLINE
+
+        plan.note_compiled(
+            spec, _time.perf_counter() - t_disp,
+            SOURCE_INLINE if plan.warmed else "warmup",
+        )
     plans = []
     for k in range(b):
         row = int(nodes_out[k])

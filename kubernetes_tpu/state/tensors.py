@@ -914,23 +914,12 @@ class PodBatch:
         }
 
 
-def _bucket(n: int, minimum: int = 16) -> int:
-    """Next power-of-two capacity ≥ n (bounded recompilation buckets)."""
-    cap = minimum
-    while cap < n:
-        cap *= 2
-    return cap
-
-
-def _node_bucket(n: int, minimum: int = 16) -> int:
-    """Node-axis capacity: power of two up to 2048, then the next multiple
-    of 2048. Every [*, N] kernel pays for the padding — at 10k nodes a
-    pow-2 bucket (16384) wastes 64% of all mask/score/topology work, while
-    2048-multiples cap waste at <20% and still divide evenly for any
-    power-of-two device-mesh shard count (parallel/sharded.py)."""
-    if n <= 2048:
-        return _bucket(n, minimum)
-    return -(-n // 2048) * 2048
+# bucket policy lives in the compile subsystem's shape ladder (ONE
+# quantizer shared by encoders, driver, and the AOT warmup service — they
+# must never disagree about which shapes exist); these names stay as the
+# encoding layer's aliases
+from ..compile.ladder import node_axis_bucket as _node_bucket  # noqa: E402
+from ..compile.ladder import pow2_bucket as _bucket  # noqa: E402
 
 
 class SigOverflow(KeySlotOverflow):

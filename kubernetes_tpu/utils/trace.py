@@ -17,6 +17,19 @@ logger = logging.getLogger("kubernetes_tpu.trace")
 SLOW_CYCLE_THRESHOLD_S = 0.100  # the reference's 100ms LogIfLong contract
 
 
+def log_slow(name: str, seconds: float,
+             threshold_s: float = SLOW_CYCLE_THRESHOLD_S, **fields) -> bool:
+    """One-shot LogIfLong for an already-measured span (the compile plan
+    reports inline XLA compiles through this — a mid-drain trace+compile
+    is exactly the class of stall the 100ms contract exists to surface).
+    Returns True when it logged."""
+    if seconds < threshold_s:
+        return False
+    ftxt = " ".join(f"{k}={v}" for k, v in fields.items())
+    logger.warning('Trace "%s" %s (total %.1fms)', name, ftxt, seconds * 1000)
+    return True
+
+
 class Trace:
     def __init__(self, name: str, **fields):
         self.name = name

@@ -246,3 +246,91 @@ def test_quota_over_http_is_422(api):
         assert "exceeded quota" in str(exc.value)
     finally:
         srv.stop()
+
+
+def test_limitranger_min_enforced_against_explicit_limit(api):
+    """Advisor finding #5: a container with an explicit LIMIT below
+    item.min must be rejected, exactly as max already checks both."""
+    from kubernetes_tpu.api.types import Container, Pod, Quantity, RESOURCE_CPU
+
+    api.create("limitranges", _lr(min={RESOURCE_CPU: Quantity.parse("100m")}))
+    lo = Pod(name="lowlimit", containers=[
+        Container(name="c",
+                  requests={RESOURCE_CPU: Quantity.parse("150m")},
+                  limits={RESOURCE_CPU: Quantity.parse("50m")})])
+    with pytest.raises(AdmissionError) as exc:
+        api.create("pods", lo)
+    assert "limit" in str(exc.value)
+
+
+def test_quota_not_charged_on_duplicate_create(api):
+    """Advisor finding #2 (the CronJob Replace/dedupe leak): admission
+    charges quota BEFORE the store's duplicate-name check; a
+    ConflictError create must roll the charge back, not strand it until
+    the controller resync."""
+    from kubernetes_tpu.api.types import Job, ResourceQuota
+    from kubernetes_tpu.apiserver import ConflictError
+
+    api.create("resourcequotas", ResourceQuota(name="jq", hard={"count/jobs": 5}))
+    api.create("jobs", Job(name="replace-me"))
+    assert api.get("resourcequotas", "default/jq").used["count/jobs"] == 1
+    # the CronJob Replace path re-creates the same name -> ConflictError
+    for _ in range(3):
+        with pytest.raises(ConflictError):
+            api.create("jobs", Job(name="replace-me"))
+    assert api.get("resourcequotas", "default/jq").used["count/jobs"] == 1
+    # pods leak the same way (requests.* deltas, not just counts)
+    api.create("resourcequotas", ResourceQuota(
+        name="pq", hard={"pods": 10, "requests.cpu": 10_000}))
+    api.create("pods", make_pod("dup", cpu_milli=500, mem=2**20))
+    used0 = dict(api.get("resourcequotas", "default/pq").used)
+    with pytest.raises(ConflictError):
+        api.create("pods", make_pod("dup", cpu_milli=500, mem=2**20))
+    assert api.get("resourcequotas", "default/pq").used == used0
+
+
+def test_quota_multi_quota_rejection_rolls_back_earlier_charges(api):
+    """Two matching quotas: when the SECOND rejects, the first's charge
+    must be rolled back (compute-all, charge-all-or-nothing)."""
+    from kubernetes_tpu.api.types import ResourceQuota
+
+    api.create("resourcequotas", ResourceQuota(name="loose", hard={"pods": 100}))
+    api.create("resourcequotas", ResourceQuota(name="tight", hard={"requests.cpu": 100}))
+    with pytest.raises(AdmissionError):
+        api.create("pods", make_pod("big", cpu_milli=500, mem=2**20))
+    assert api.get("resourcequotas", "default/loose").used.get("pods", 0) == 0
+    assert api.get("resourcequotas", "default/tight").used.get("requests.cpu", 0) == 0
+    # a pod that clears both charges both
+    api.create("pods", make_pod("small", cpu_milli=50, mem=2**20))
+    assert api.get("resourcequotas", "default/loose").used["pods"] == 1
+    assert api.get("resourcequotas", "default/tight").used["requests.cpu"] == 50
+
+
+def test_quota_rolled_back_on_wal_failure(api):
+    """A create that fails AFTER admission for any reason (not just a
+    duplicate name — e.g. a WAL write error) must uncharge quota and
+    leave no object behind."""
+    from kubernetes_tpu.api.types import ResourceQuota
+    from kubernetes_tpu.apiserver import FakeAPIServer, default_admission_chain
+
+    class _BrokenWAL:
+        """Fails pod writes only — the quota uncharge (an update to the
+        resourcequotas kind) must still be able to land."""
+
+        def replay(self):
+            return {}, 0
+
+        def append(self, op, kind, *a, **k):
+            if kind == "pods":
+                raise OSError("disk full")
+
+        def maybe_compact(self, *a, **k):
+            pass
+
+    store = FakeAPIServer(admission=default_admission_chain(), wal=_BrokenWAL())
+    store.create("resourcequotas", ResourceQuota(name="w", hard={"pods": 5}))
+    with pytest.raises(OSError):
+        store.create("pods", make_pod("doomed", cpu_milli=100, mem=2**20))
+    assert store.get("resourcequotas", "default/w").used.get("pods", 0) == 0
+    with pytest.raises(Exception):
+        store.get("pods", "default/doomed")

@@ -279,3 +279,64 @@ def test_events_over_http_and_kubectl(served):
     )
     assert out.returncode == 0, (out.stdout, out.stderr)
     assert "FailedScheduling" in out.stdout and "default/w1" in out.stdout
+
+
+def _raw_put(srv, path, doc, token=None):
+    body = json.dumps(doc).encode()
+    req = urllib.request.Request(
+        srv.url + path, data=body, method="PUT",
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {token}"} if token else {})},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_put_path_body_mismatch_is_400(served):
+    """The URL path is the write key: a body naming a different
+    namespace or name must be rejected with 400 (the reference's
+    BeforeUpdate name/namespace validation), never written."""
+    from kubernetes_tpu.api.types import pod_to_k8s
+
+    store, srv = served
+    a = make_pod("a")
+    other = make_pod("other")
+    other.namespace = "prod"
+    store.create("pods", a)
+    store.create("pods", other)
+    # body namespace != path namespace
+    evil = pod_to_k8s(other)
+    evil["spec"]["nodeName"] = "stolen"
+    code, doc = _raw_put(srv, "/api/v1/pods/default/a", evil)
+    assert code == 400, doc
+    assert store.get("pods", "prod/other").node_name != "stolen"
+    # body name != path name
+    b = pod_to_k8s(a)
+    b["metadata"]["name"] = "someone-else"
+    code, _ = _raw_put(srv, "/api/v1/pods/default/a", b)
+    assert code == 400
+    # empty body namespace inherits the path (defaulting, not rejection)
+    c = pod_to_k8s(a)
+    c["metadata"].pop("namespace", None)
+    c["metadata"].pop("resourceVersion", None)
+    c["spec"]["nodeName"] = "n9"
+    code, _ = _raw_put(srv, "/api/v1/pods/default/a", c)
+    assert code == 200
+    assert store.get("pods", "default/a").node_name == "n9"
+
+
+def test_put_malformed_body_is_400_not_dropped(served):
+    _, srv = served
+    req = urllib.request.Request(
+        srv.url + "/api/v1/pods/default/a", data=b"{ not json",
+        method="PUT", headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 400

@@ -241,3 +241,85 @@ def test_scheduler_loop_fully_authenticated(secured):
         time.sleep(0.05)
     assert inf.get("default/w").node_name == "n0"
     inf.stop()
+
+
+def test_put_body_namespace_cannot_bypass_rbac(secured):
+    """Advisor finding #1 (high): do_PUT authorized the URL-path namespace
+    but keyed the write by the BODY's namespace/name — a user bound only
+    in 'dev' could overwrite any 'prod' object via
+    PUT /api/v1/pods/dev/x with a body claiming prod. Must be 400 and the
+    prod object untouched."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from kubernetes_tpu.api.types import pod_to_k8s
+
+    store, srv = secured
+    target = make_pod("target")
+    target.namespace = "prod"
+    store.create("pods", target)
+    store.create("roles", Role(
+        name="pod-writer", namespace="dev",
+        rules=[PolicyRule(verbs=["create", "get", "update"], resources=["pods"])],
+    ))
+    store.create("rolebindings", RoleBinding(
+        name="dev-writers", namespace="dev",
+        role_ref=RoleRef(kind="Role", name="pod-writer"),
+        subjects=[Subject(kind="User", name="dev-user")],
+    ))
+    mine = make_pod("x")
+    mine.namespace = "dev"
+    _client(srv, token=DEV).create("pods", mine)
+    evil = pod_to_k8s(store.get("pods", "prod/target"))
+    evil["spec"]["nodeName"] = "pwned"
+    evil["metadata"].pop("resourceVersion", None)
+    req = urllib.request.Request(
+        srv.url + "/api/v1/pods/dev/x", data=_json.dumps(evil).encode(),
+        method="PUT", headers={"Content-Type": "application/json",
+                               "Authorization": f"Bearer {DEV}"},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code == 400
+    assert store.get("pods", "prod/target").node_name != "pwned"
+
+
+def test_token_auth_file_parsing():
+    """Advisor finding #3: malformed --token-auth-file lines must be a
+    clear configuration error (line number, expected format), not an
+    IndexError; empty tokens/users never silently authenticate."""
+    import os
+    import tempfile
+
+    from kubernetes_tpu.cmd import load_token_auth_file
+
+    def write(content):
+        f = tempfile.NamedTemporaryFile(
+            "w", suffix=".csv", delete=False)
+        f.write(content)
+        f.close()
+        return f.name
+
+    good = write("# comment\n\ntok1,alice,grp1|grp2\ntok2,bob\n"
+                 'tok3,"Smith, Alice",ops\n')
+    tokens = load_token_auth_file(good)
+    assert tokens["tok1"].name == "alice" and tokens["tok1"].groups == ("grp1", "grp2")
+    assert tokens["tok2"].name == "bob" and tokens["tok2"].groups == ()
+    # quoted CSV field containing a comma (encoding/csv semantics)
+    assert tokens["tok3"].name == "Smith, Alice" and tokens["tok3"].groups == ("ops",)
+    for bad, frag in (
+        ("justonetoken\n", ":1"),
+        ("tok,alice\nno-comma-line\n", ":2"),
+        (",alice\n", ":1"),  # empty token
+        ("tok,\n", ":1"),  # empty user
+    ):
+        path = write(bad)
+        with pytest.raises(ValueError) as ei:
+            load_token_auth_file(path)
+        assert frag in str(ei.value)
+        os.unlink(path)
+    os.unlink(good)

@@ -1,0 +1,399 @@
+"""Compile-plan subsystem tests (kubernetes_tpu/compile): ladder
+canonicalization, padded-vs-unpadded execution parity, persistent cache
+round-trips (stubbed backend — no TPU, no real AOT serialization), the
+warmup service's synthetic-bank growth warming, and the inline-fallback
+miss accounting. All CPU-only tier-1."""
+
+import json
+import os
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.compile import (
+    CompilePlan,
+    PersistentCompileCache,
+    ShapeLadder,
+    SolveSpec,
+    WarmupService,
+)
+from kubernetes_tpu.compile.cache import _environment_key
+from kubernetes_tpu.compile.ladder import (
+    KIND_PREEMPT,
+    KIND_SOLVE,
+    KIND_SOLVE_GANG,
+    node_axis_bucket,
+    pow2_bucket,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+
+def _mk_scheduler(nodes, existing=(), **kw):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(p)
+    binds = []
+    binder = Binder(lambda pod, node: binds.append((pod.key(), node)))
+    kw.setdefault("enable_preemption", False)
+    sched = Scheduler(cache=cache, queue=PriorityQueue(), binder=binder,
+                      deterministic=True, **kw)
+    return sched, binds
+
+
+# --- ladder -----------------------------------------------------------------
+
+def test_bucket_quantizers():
+    assert pow2_bucket(0) == 16 and pow2_bucket(16) == 16
+    assert pow2_bucket(17) == 32 and pow2_bucket(4097) == 8192
+    assert node_axis_bucket(2048) == 2048
+    assert node_axis_bucket(2049) == 4096  # 2x2048, not pow2 jump
+    assert node_axis_bucket(10000) == 10240  # 5x2048
+    # state/tensors' aliases ARE these functions (one quantizer)
+    from kubernetes_tpu.state.tensors import _bucket, _node_bucket
+
+    assert _bucket is pow2_bucket and _node_bucket is node_axis_bucket
+
+
+def test_ladder_canonicalization_and_declaration():
+    lad = ShapeLadder()
+    raw = SolveSpec(kind=KIND_SOLVE, b=37, u=100, t=5, n=3000, v=9,
+                    k=64, r=8, s=256, pt=32)
+    c = lad.canonicalize(raw)
+    assert (c.b, c.t, c.n, c.v) == (64, 16, 4096, 16)
+    assert c.u == 64  # clamped to b: a batch can't hold more specs than pods
+    # canonicalization is idempotent and covers() sees through raw sizes
+    assert lad.canonicalize(c) == c
+    assert not lad.covers(raw)
+    lad.declare(raw)
+    assert lad.covers(raw) and lad.covers(c) and len(lad) == 1
+    # a different static is a different program
+    assert not lad.covers(
+        SolveSpec(kind=KIND_SOLVE, b=37, u=100, t=5, n=3000, v=9,
+                  k=64, r=8, s=256, pt=32, track_inbatch=True)
+    )
+    # preempt specs pass through UNCHANGED: their call site buckets with
+    # minimum 8, and re-rounding here would alias distinct kernel shapes
+    # onto one key (reporting a mid-drain compile as a plan hit)
+    pre = SolveSpec(kind=KIND_PREEMPT, b=8, n=500, v=8, r=8)
+    assert lad.canonicalize(pre) == pre
+
+
+def test_growth_specs_cover_middrain_growth_axes():
+    lad = ShapeLadder()
+    c = lad.canonicalize(SolveSpec(kind=KIND_SOLVE, b=4096, u=64, t=64,
+                                   n=2048, v=64, k=64, r=8, s=256, pt=32))
+    growth = lad.growth_specs(c)
+    axes = {(g.u, g.t, g.v, g.s, g.pt) for g in growth}
+    assert (128, 64, 64, 256, 32) in axes  # unique-spec rung
+    assert (64, 128, 64, 256, 32) in axes  # term rung
+    assert (64, 64, 128, 256, 32) in axes  # segment rung
+    assert (64, 64, 64, 1024, 32) in axes  # sig bank x4 (mirror rebuild)
+    assert (64, 64, 64, 256, 128) in axes  # pattern bank x4
+
+
+def test_spec_roundtrip_and_hash_stability():
+    s = SolveSpec(kind=KIND_SOLVE_GANG, b=64, u=32, t=16, n=256, v=16,
+                  k=64, r=8, s=256, pt=32,
+                  term_kinds=frozenset({"anti_req", "pref"}),
+                  with_carry=True)
+    assert SolveSpec.from_dict(json.loads(json.dumps(s.to_dict()))) == s
+    assert s.hash_hex() == SolveSpec.from_dict(s.to_dict()).hash_hex()
+
+
+# --- padded vs unpadded execution parity ------------------------------------
+
+def test_padded_execution_matches_unpadded():
+    """Padding up to a bigger ladder rung must be bit-identical to the
+    tight shapes: same workload through two drivers, one with pre-grown
+    buckets (the padded-ladder execution path), identical placements."""
+    def build():
+        nodes = [make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30) for i in range(5)]
+        pods = [make_pod(f"p{i}", cpu_milli=700, mem=2**27) for i in range(12)]
+        return nodes, pods
+
+    results = []
+    for pad in (False, True):
+        nodes, pods = build()
+        sched, _ = _mk_scheduler(nodes)
+        if pad:
+            sched._b_bucket = 64
+            sched._u_bucket = 64
+            sched._t_bucket = 32
+            sched._v_bucket = 32
+        for p in pods:
+            sched.queue.add(p)
+        res = sched.schedule_batch()
+        sched.wait_for_binds()
+        results.append(dict(res.assignments))
+    assert results[0] == results[1]
+    assert len(results[0]) == 12
+
+
+def test_preempt_padded_matches_unpadded():
+    """batch_preempt_device's ladder-padded axes (pod bucket, node rung,
+    victim bucket) must not change any plan."""
+    from kubernetes_tpu.oracle import Snapshot
+    from kubernetes_tpu.scheduler.preemption import batch_preempt_device
+
+    nodes = [make_node(f"n{i}", cpu_milli=1000, mem=2**30) for i in range(3)]
+    existing = []
+    for i, n in enumerate(nodes):
+        v = make_pod(f"victim{i}", cpu_milli=900, mem=2**20)
+        v.priority = 0
+        v.node_name = n.name
+        existing.append(v)
+    snap = Snapshot(nodes, existing)
+    pres = []
+    for i in range(2):
+        p = make_pod(f"hi{i}", cpu_milli=800, mem=2**20)
+        p.priority = 100
+        pres.append(p)
+    base = batch_preempt_device(pres, snap)
+    padded = batch_preempt_device(pres, snap, pod_bucket=64, victim_bucket=32)
+    assert base is not None and padded is not None
+
+    def norm(plans):
+        return [(n, [v.key() for v in vs], ff) for n, vs, ff in plans]
+
+    assert norm(base) == norm(padded)
+
+
+# --- warmup coverage ---------------------------------------------------------
+
+def test_warmup_declares_ladder_and_drain_has_no_misses():
+    nodes = [make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30) for i in range(4)]
+    sched, binds = _mk_scheduler(nodes)
+    for i in range(10):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=300, mem=2**20))
+    assert sched.warmup() == 10
+    snap = sched.compile_plan.snapshot()
+    assert snap["warmed"] and snap["declared_specs"] >= 2  # carry + carry-less
+    while True:
+        r = sched.schedule_batch()
+        if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+            break
+    sched.wait_for_binds()
+    assert len(binds) == 10
+    snap = sched.compile_plan.snapshot()
+    assert snap["misses_after_warmup"] == 0, snap
+    assert snap["hits"] >= 1
+
+
+def test_warmup_service_synthetic_growth_banks():
+    """Growth specs (sig/pattern bank one rung ahead) warm against
+    SYNTHETIC banks — shapes the live mirror doesn't have yet."""
+    nodes = [make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30) for i in range(3)]
+    sched, _ = _mk_scheduler(nodes)
+    for i in range(4):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=300, mem=2**20))
+    assert sched.warmup() == 4
+    svc = sched._warm_svc
+    spec = sched._solve_spec(gang=False, with_carry=False)
+    growth = sched.compile_plan.ladder.growth_specs(spec)
+    sig_specs = [g for g in growth if g.s != spec.s or g.pt != spec.pt]
+    assert sig_specs
+    warmed = svc.warm_specs(sig_specs)
+    assert warmed == len(sig_specs)
+    for g in sig_specs:
+        assert sched.compile_plan.is_declared(g)
+
+
+def test_warmup_arms_background_growth_warming():
+    nodes = [make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30) for i in range(3)]
+    sched, _ = _mk_scheduler(nodes)
+    for i in range(4):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=300, mem=2**20))
+    assert not sched._aot_enabled
+    sched.warmup()
+    assert sched._aot_enabled
+    sched.schedule_batch()
+    sched.wait_for_binds()
+    # the headroom worker ran (or is running) without disturbing the drain
+    sched._warm_svc.join(timeout=60)
+    assert sched._warm_svc.stats["failures"] == 0
+
+
+def test_preempt_kernel_warmed_when_preemption_enabled():
+    nodes = [make_node(f"n{i}", cpu_milli=1000, mem=2**30) for i in range(3)]
+    existing = []
+    for i, n in enumerate(nodes):
+        v = make_pod(f"low{i}", cpu_milli=900, mem=2**20)
+        v.priority = 0
+        v.node_name = n.name
+        existing.append(v)
+    sched, _ = _mk_scheduler(nodes, existing=existing,
+                             enable_preemption=True, batch_size=16)
+    hi = make_pod("hi", cpu_milli=800, mem=2**20)
+    hi.priority = 100
+    sched.queue.add(hi)
+    assert sched.warmup() == 1
+    snap = sched.compile_plan.snapshot()
+    assert any(s["spec"].startswith("preempt[") for s in snap["specs"]), snap
+    # the real preemption round must HIT the warmed kernel spec
+    res = sched.schedule_batch()
+    assert res.preempted == 1
+    assert sched.compile_plan.snapshot()["misses_after_warmup"] == 0
+
+
+# --- inline fallback ----------------------------------------------------------
+
+def test_inline_fallback_compiles_and_counts_miss():
+    """An undeclared spec after warmup must still schedule (inline jit)
+    while the plan counts + exposes the miss."""
+    nodes = [make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30) for i in range(3)]
+    sched, binds = _mk_scheduler(nodes)
+    sched.compile_plan.mark_warmed()  # warmed, but nothing declared
+    for i in range(5):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=300, mem=2**20))
+    res = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert res.scheduled == 5 and len(binds) == 5  # correctness never waits
+    snap = sched.compile_plan.snapshot()
+    assert snap["misses_after_warmup"] >= 1
+    assert snap["compiles"] >= 1 and snap["compile_s"] >= 0.0
+    from kubernetes_tpu.metrics import metrics as M
+
+    assert M.compile_spec_misses_after_warmup._values.get(()) is not None
+
+
+# --- persistent cache ---------------------------------------------------------
+
+def test_persistent_ladder_roundtrip(tmp_path):
+    cache = PersistentCompileCache(str(tmp_path / "cc"))
+    plan = CompilePlan(cache=cache)
+    s1 = plan.declare(SolveSpec(kind=KIND_SOLVE, b=64, u=32, t=16, n=256,
+                                v=16, k=64, r=8, s=256, pt=32))
+    plan.note_compiled(s1, 12.5, "warmup")
+    s2 = plan.declare(SolveSpec(kind=KIND_PREEMPT, b=64, n=256, v=16, r=8))
+    assert plan.persist()
+    # fresh process equivalent
+    plan2 = CompilePlan(cache=PersistentCompileCache(str(tmp_path / "cc")))
+    loaded = plan2.load_persisted()
+    assert {x.key() for x in loaded} == {s1.key(), s2.key()}
+    # compile budget survived (the >=5x warm-vs-cold bookkeeping)
+    rec = [e for e in plan2.snapshot()["specs"] if e["spec"] == s1.short()]
+    assert rec and rec[0]["compile_s"] == 12.5
+    assert rec[0]["source"] == "persisted"
+
+
+def test_persistent_ladder_rejects_foreign_environment(tmp_path):
+    cache = PersistentCompileCache(str(tmp_path / "cc"))
+    plan = CompilePlan(cache=cache)
+    plan.declare(SolveSpec(kind=KIND_SOLVE, b=64, u=32, t=16, n=256,
+                           v=16, k=64, r=8, s=256, pt=32))
+    assert plan.persist()
+    # tamper: pretend the ladder came from another jaxlib
+    p = tmp_path / "cc" / "ladder.json"
+    doc = json.loads(p.read_text())
+    doc["environment"]["jaxlib"] = "0.0.0-other"
+    p.write_text(json.dumps(doc))
+    assert CompilePlan(cache=PersistentCompileCache(str(tmp_path / "cc"))).load_persisted() == []
+    # corrupt file → cold start, never an error
+    p.write_text("{ not json")
+    assert CompilePlan(cache=PersistentCompileCache(str(tmp_path / "cc"))).load_persisted() == []
+
+
+class _StubSerializer:
+    """Executable-serialization backend stub: records round-trips without
+    any XLA dependency (the satellite's 'stubbed backend')."""
+
+    def __init__(self):
+        self.serialized = 0
+        self.deserialized = 0
+
+    def serialize(self, compiled) -> bytes:
+        self.serialized += 1
+        return b"EXE:" + repr(compiled).encode()
+
+    def deserialize(self, blob: bytes):
+        self.deserialized += 1
+        assert blob.startswith(b"EXE:")
+        return ("executable", blob[4:].decode())
+
+
+def test_executable_cache_roundtrip_with_stub_backend(tmp_path):
+    stub = _StubSerializer()
+    cache = PersistentCompileCache(str(tmp_path / "cc"), serializer=stub)
+    spec = SolveSpec(kind=KIND_SOLVE, b=64, u=32, t=16, n=256, v=16,
+                     k=64, r=8, s=256, pt=32)
+    assert cache.save_executable(spec, {"fake": "compiled"})
+    out = cache.load_executable(spec)
+    assert out == ("executable", repr({"fake": "compiled"}))
+    assert stub.serialized == 1 and stub.deserialized == 1
+    # unknown spec → None, not an error
+    other = SolveSpec(kind=KIND_SOLVE, b=128, u=32, t=16, n=256, v=16,
+                      k=64, r=8, s=256, pt=32)
+    assert cache.load_executable(other) is None
+
+
+class _FailingSerializer:
+    def serialize(self, compiled):
+        raise NotImplementedError("backend can't serialize")
+
+    def deserialize(self, blob):
+        raise NotImplementedError
+
+
+def test_executable_cache_degrades_without_backend(tmp_path):
+    cache = PersistentCompileCache(str(tmp_path / "cc"), serializer=_FailingSerializer())
+    spec = SolveSpec(kind=KIND_SOLVE, b=64, u=32, t=16, n=256, v=16,
+                     k=64, r=8, s=256, pt=32)
+    assert not cache.save_executable(spec, object())
+    assert cache.load_executable(spec) is None
+
+
+def test_scheduler_restart_rewarmups_from_persisted_ladder(tmp_path):
+    """Process 1 warms + persists; process 2 (fresh Scheduler, same cache
+    dir) re-declares the ladder at warmup and drains with zero misses."""
+    cache_dir = str(tmp_path / "cc")
+
+    def run(pods_prefix):
+        nodes = [make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30) for i in range(3)]
+        plan = CompilePlan(cache=PersistentCompileCache(cache_dir))
+        sched, binds = _mk_scheduler(nodes, compile_plan=plan)
+        for i in range(6):
+            sched.queue.add(make_pod(f"{pods_prefix}{i}", cpu_milli=300, mem=2**20))
+        assert sched.warmup() == 6
+        while True:
+            r = sched.schedule_batch()
+            if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+                break
+        sched.wait_for_binds()
+        return sched.compile_plan.snapshot()
+
+    snap1 = run("a")
+    assert snap1["misses_after_warmup"] == 0
+    snap2 = run("b")
+    assert snap2["misses_after_warmup"] == 0
+    # the restart re-declared the persisted ladder (source recorded)
+    assert any(e["source"] == "persisted" for e in snap2["specs"]), snap2
+
+
+def test_failed_persisted_warm_is_undeclared(tmp_path):
+    """A persisted spec whose warm is skipped/fails must NOT stay
+    declared — a later dispatch of it would otherwise count as a hit
+    while paying a real inline compile (silent stall)."""
+    cache_dir = str(tmp_path / "cc")
+    plan = CompilePlan(cache=PersistentCompileCache(cache_dir))
+    # a spec this deployment can't realize (foreign SolveConfig repr)
+    bogus = SolveSpec(kind=KIND_SOLVE, b=16, u=16, t=16, n=16, v=16,
+                      k=64, r=8, s=256, pt=32,
+                      config_repr="SolveConfig(predicates=frozenset({'X'}))")
+    plan.declare(bogus)
+    assert plan.persist()
+
+    nodes = [make_node(f"n{i}", cpu_milli=4000, mem=8 * 2**30) for i in range(3)]
+    plan2 = CompilePlan(cache=PersistentCompileCache(cache_dir))
+    sched, _ = _mk_scheduler(nodes, compile_plan=plan2)
+    for i in range(4):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=300, mem=2**20))
+    assert sched.warmup() == 4
+    assert not sched.compile_plan.is_declared(bogus)
+    snap = sched.compile_plan.snapshot()
+    assert all(e["spec"] != bogus.short() for e in snap["specs"])
