@@ -503,14 +503,15 @@ def run_config(name, build, opts=None):
     pod_by_key = {p.key(): p for p in pods}
     t0 = time.perf_counter()
     first_batch_s = None
-    scheduled = unsched = preempted = 0
+    scheduled = unsched = preempted = deferred = 0
     idle_rounds = 0
     try:
         while True:
             tb = time.perf_counter()
             r = sched.schedule_batch()
             dt = time.perf_counter() - tb
-            if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+            if (r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0
+                    and r.deferred == 0):
                 # preemption requeues its beneficiaries with BACKOFF (1s
                 # initial, doubling to 10s — pod_backoff.go): wait out the
                 # longest possible backoff before declaring the drain done,
@@ -531,6 +532,7 @@ def run_config(name, build, opts=None):
             scheduled += r.scheduled
             unsched += r.unschedulable  # attempts; see unschedulable_pods below
             preempted += r.preempted
+            deferred += r.deferred  # commit-plane defer-to-next-batch verdicts
             commits.extend(
                 (pod_by_key[k], n) for k, n in r.assignments.items() if k in pod_by_key
             )
@@ -543,6 +545,24 @@ def run_config(name, build, opts=None):
         gc.unfreeze()
         gc.collect()
     steady = sum(batch_times[1:]) or 1e-9
+    # steady throughput must be MEASURABLE even when a config drains in
+    # few batches (the preemption config used to report 0.0): prefer the
+    # canonical batches-2..N rate, fall back to the post-first-batch
+    # window (pods scheduled after the first batch completed over that
+    # wall), and for a genuine single-batch drain fall back to that
+    # batch's own rate — never 0.0 while pods actually scheduled.
+    steady_sched = sum(batch_sched[1:])
+    if len(batch_times) > 1 and steady_sched > 0:
+        pps_steady = steady_sched / steady
+    elif batch_times and batch_times[0] > 0 and batch_sched[0] > 0:
+        post_window = elapsed - (first_batch_s or 0.0)
+        post_sched = scheduled - batch_sched[0]
+        if post_sched > 0 and post_window > 0:
+            pps_steady = post_sched / post_window
+        else:
+            pps_steady = batch_sched[0] / batch_times[0]
+    else:
+        pps_steady = None
     bt = np.array(batch_times) if batch_times else np.array([0.0])
     # warm throughput: MEDIAN per-batch rate (actual scheduled / latency)
     # over the LAST half of batches — excludes the bounded one-time XLA
@@ -593,6 +613,7 @@ def run_config(name, build, opts=None):
         "unschedulable_attempts": unsched,
         "unschedulable_pods": max(len(pods) - scheduled, 0),
         "preempted": preempted,
+        "deferred": deferred,
         # scheduling-only (enqueue clocks rebased at warmup end): warmup/
         # first-compile excluded by construction. The *_warm names are the
         # canonical BASELINE.json latency fields; the unsuffixed names
@@ -606,11 +627,11 @@ def run_config(name, build, opts=None):
         "audit_s": round(audit_s, 3),
         "elapsed_s": round(elapsed, 3),
         "pods_per_sec": round(scheduled / elapsed, 1) if elapsed > 0 else 0.0,
-        # actual pods scheduled in batches 2..N over their wall — real for
-        # every config (the old `scheduled - BATCH` went to 0.0 whenever a
-        # config scheduled fewer pods than one batch, e.g. preemption)
-        "pods_per_sec_steady": round(
-            sum(batch_sched[1:]) / steady, 1) if len(batch_times) > 1 else None,
+        # actual pods scheduled in batches 2..N over their wall, with the
+        # post-first-batch / single-batch fallbacks above — measurable for
+        # every config that scheduled anything (the preemption config used
+        # to report 0.0 when it drained in effectively one batch window)
+        "pods_per_sec_steady": round(pps_steady, 1) if pps_steady is not None else None,
         "pods_per_sec_warm": round(warm_rate, 1) if warm_rate is not None else None,
         "warm_stall_batches": stall_batches,
         "first_batch_s": round(first_batch_s or 0.0, 3),

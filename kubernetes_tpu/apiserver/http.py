@@ -23,6 +23,17 @@ Routes (apiVersion collapsed — kinds are top-level):
   DELETE /api/v1/{kind}/{ns}/{name}              delete
   POST   /api/v1/pods/{ns}/{name}/binding        bind subresource
          ({"target": {"name": node}}, registry BindingREST semantics)
+
+Namespaced paths (the reference's canonical shape for namespaced kinds):
+  GET    /api/v1/namespaces/{ns}/{kind}              list restricted to {ns},
+         authorized against {ns} (a namespaced RoleBinding suffices —
+         bare /api/v1/{kind} list/watch stays cluster-scope authorized)
+  GET    /api/v1/namespaces/{ns}/{kind}?watch=1      watch, events outside
+         {ns} filtered out
+  POST   /api/v1/namespaces/{ns}/{kind}              create in {ns} (body
+         namespace defaults to the path; mismatch → 400)
+  GET/PUT/DELETE /api/v1/namespaces/{ns}/{kind}/{name}   item verbs
+  POST   /api/v1/namespaces/{ns}/pods/{name}/binding    bind subresource
 """
 
 from __future__ import annotations
@@ -246,7 +257,24 @@ class _Handler(BaseHTTPRequestHandler):
             return None
         kind = parts[2]
         rest = parts[3:]
-        return kind, rest, parse_qs(u.query)
+        # namespaced resource paths (the reference's canonical shape):
+        #   /api/v1/namespaces/{ns}/{kind}            list/watch/create IN ns
+        #   /api/v1/namespaces/{ns}/{kind}/{name}...  item verbs
+        # Authorization runs against the REQUEST namespace (a namespaced
+        # RoleBinding suffices), and list/watch results are restricted to
+        # it. Distinguished from the namespaces kind's own item paths by
+        # the second segment naming a known namespaced kind.
+        ns_scope = None
+        if (
+            kind == "namespaces"
+            and len(rest) >= 2
+            and rest[1] in _CODECS
+            and rest[1] not in _CLUSTER_SCOPED
+        ):
+            ns_scope = rest[0]
+            kind = rest[1]
+            rest = [ns_scope] + list(rest[2:])
+        return kind, rest, parse_qs(u.query), ns_scope
 
     # -- verbs ---------------------------------------------------------------
 
@@ -254,12 +282,13 @@ class _Handler(BaseHTTPRequestHandler):
         r = self._route()
         if r is None:
             return self._send_json(404, _status(404, "NotFound", self.path))
-        kind, rest, q = r
+        kind, rest, q, ns_scope = r
         codec = _CODECS.get(kind)
         if codec is None:
             return self._send_json(404, _status(404, "NotFound", f"unknown kind {kind}"))
         to_k8s, _, list_kind = codec
-        if rest:
+        collection = not rest or (ns_scope is not None and len(rest) == 1)
+        if not collection:
             if not self._auth("get", kind, self._ns_of(kind, rest)):
                 return
             key = self._obj_key(kind, rest)
@@ -273,17 +302,26 @@ class _Handler(BaseHTTPRequestHandler):
             if obj is None:
                 return self._send_json(404, _status(404, "NotFound", self.path))
             return self._send_json(200, to_k8s(obj))
+        # list/watch: namespaced paths authorize against the REQUEST
+        # namespace (a user with only a namespaced RoleBinding can list
+        # their own namespace) and see only that namespace's objects;
+        # bare /api/v1/{kind} stays cluster-scoped authorization
         if q.get("watch", ["0"])[0] in ("1", "true"):
-            if not self._auth("watch", kind, None):
+            if not self._auth("watch", kind, ns_scope):
                 return
-            return self._serve_watch(kind, to_k8s, q)
-        if not self._auth("list", kind, None):
+            return self._serve_watch(kind, to_k8s, q, ns=ns_scope)
+        if not self._auth("list", kind, ns_scope):
             return
         items, rv = self.store.list(
             kind,
             label_selector=_parse_selector(q.get("labelSelector")),
             field_selector=_parse_selector(q.get("fieldSelector")),
         )
+        if ns_scope is not None:
+            items = [
+                o for o in items
+                if getattr(o, "namespace", None) == ns_scope
+            ]
         return self._send_json(200, {
             "kind": list_kind,
             "apiVersion": "v1",
@@ -291,7 +329,7 @@ class _Handler(BaseHTTPRequestHandler):
             "items": [to_k8s(o) for o in items],
         })
 
-    def _serve_watch(self, kind: str, to_k8s, q) -> None:
+    def _serve_watch(self, kind: str, to_k8s, q, ns: Optional[str] = None) -> None:
         try:
             since = int((q.get("resourceVersion") or ["0"])[0] or 0)
             timeout = float((q.get("timeoutSeconds") or ["300"])[0])
@@ -321,6 +359,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             while _time.monotonic() < deadline:
                 ev = watcher.next(timeout=0.5)
+                if ev is not None and ns is not None and getattr(
+                        ev.obj, "namespace", None) != ns:
+                    # namespaced watch: events outside the authorized
+                    # namespace never reach the client
+                    continue
                 if ev is None:
                     if watcher.closed:
                         break  # store closed the stream (restart simulation)
@@ -349,8 +392,10 @@ class _Handler(BaseHTTPRequestHandler):
         r = self._route()
         if r is None:
             return self._send_json(404, _status(404, "NotFound", self.path))
-        kind, rest, _ = r
-        # bind subresource
+        kind, rest, _, ns_scope = r
+        # bind subresource (both /api/v1/pods/{ns}/{name}/binding and the
+        # namespaced form /api/v1/namespaces/{ns}/pods/{name}/binding —
+        # _route remaps the latter onto the same rest shape)
         if kind == "pods" and len(rest) == 3 and rest[2] == "binding":
             if not self._auth("create", "pods/binding", rest[0]):
                 return
@@ -364,13 +409,28 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json(409, _status(409, "Conflict", str(e)))
             return self._send_json(201, {"kind": "Status", "status": "Success"})
         codec = _CODECS.get(kind)
-        if codec is None or rest:
+        in_ns_collection = ns_scope is not None and len(rest) == 1
+        if codec is None or (rest and not in_ns_collection):
             return self._send_json(404, _status(404, "NotFound", self.path))
         _, from_k8s, _ = codec
         try:
-            obj = from_k8s(self._read_body())
+            body = self._read_body()
+            obj = from_k8s(body)
         except Exception as e:  # malformed JSON/object → 400, not a dropped conn
             return self._send_json(400, _status(400, "BadRequest", str(e)))
+        if in_ns_collection:
+            # the URL namespace is the authorization subject AND the write
+            # scope: a body without an EXPLICIT namespace inherits it (the
+            # codec's "default" fill is not user intent), a conflicting one
+            # is a 400 (rest.BeforeCreate namespace validation)
+            body_ns = ((body.get("metadata") or {}).get("namespace")) or ""
+            if body_ns and body_ns != ns_scope:
+                return self._send_json(400, _status(
+                    400, "BadRequest",
+                    f"namespace in body ({body_ns}) must match URL path "
+                    f"({ns_scope})"))
+            if hasattr(obj, "namespace"):
+                obj.namespace = ns_scope
         ns = None if kind in _CLUSTER_SCOPED else getattr(obj, "namespace", None)
         if not self._auth("create", kind, ns):
             return
@@ -386,7 +446,7 @@ class _Handler(BaseHTTPRequestHandler):
         r = self._route()
         if r is None:
             return self._send_json(404, _status(404, "NotFound", self.path))
-        kind, rest, _ = r
+        kind, rest, _, _ns_scope = r
         codec = _CODECS.get(kind)
         if codec is None or self._obj_key(kind, rest) is None:
             return self._send_json(404, _status(404, "NotFound", self.path))
@@ -437,7 +497,7 @@ class _Handler(BaseHTTPRequestHandler):
         r = self._route()
         if r is None:
             return self._send_json(404, _status(404, "NotFound", self.path))
-        kind, rest, _ = r
+        kind, rest, _, _ns_scope = r
         key = self._obj_key(kind, rest)
         if key is None:
             return self._send_json(404, _status(404, "NotFound", self.path))

@@ -1,0 +1,40 @@
+"""Commit plane: device-arbitrated intra-batch conflict resolution,
+columnar bulk apply, and solve/commit pipelining (ISSUE 2 tentpole).
+
+The solve half of the cycle was converted to one vectorized device
+program in the seed; this package converts the COMMIT half:
+
+* `arbiter`  — jitted sequential-equivalent verdict pass over the solve's
+  assignment rows (place / defer-to-next-batch), bit-identical to the
+  host recheck walk (`host_arbitrate` is the executable spec).
+* `apply`    — columnar bulk apply: one cache assume + one nomination
+  clear + chunked lean binds per batch; single rollback record per gang.
+* `pipeline` — double-buffered apply worker with ≤1-batch-stale
+  backpressure, overlapping batch N's apply with batch N+1's solve fetch.
+"""
+
+from .apply import ApplyResult, ColumnarApply, GangRollbackRecord
+from .arbiter import (
+    ARBITER_COVERED_KINDS,
+    V_DEFER,
+    V_NOFIT,
+    V_PLACE,
+    arbitrate,
+    host_arbitrate,
+    kinds_covered,
+)
+from .pipeline import CommitPipeline
+
+__all__ = [
+    "ARBITER_COVERED_KINDS",
+    "ApplyResult",
+    "ColumnarApply",
+    "CommitPipeline",
+    "GangRollbackRecord",
+    "V_DEFER",
+    "V_NOFIT",
+    "V_PLACE",
+    "arbitrate",
+    "host_arbitrate",
+    "kinds_covered",
+]

@@ -1,0 +1,105 @@
+"""Columnar bulk apply: one state update per batch instead of per-pod.
+
+The legacy commit loop paid, per pod: a CycleState, an RLock round-trip
+into the cache, a nomination-index lock, and a closure submission. For a
+batch the arbiter fully resolved, all of that collapses to column passes:
+clone every placed pod with its node, ONE bulk cache assume (single lock),
+ONE bulk nomination clear, and chunked lean-bind submissions. The tensor
+mirror needs no special treatment — assume_pods pushes per-pod deltas the
+mirror's next sync() applies as vectorized scatters (apply_adds_bulk).
+
+Gang groups get a single rollback record: every prepared member is held in
+one GangRollbackRecord, and rolling the group back is one bulk cache
+forget plus the per-member unreserve/volume bookkeeping — one object to
+reason about instead of per-member unwind calls scattered through the
+driver.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class ApplyResult:
+    """Outcome of one columnar apply."""
+
+    __slots__ = ("placed", "rejected", "seconds")
+
+    def __init__(self, placed, rejected, seconds):
+        self.placed = placed  # [(info, assumed_pod, node_name)]
+        self.rejected = rejected  # [(info, node_name)] already-assumed keys
+        self.seconds = seconds
+
+
+class ColumnarApply:
+    """Bulk assume + nomination clears for a fully-arbitrated batch."""
+
+    def __init__(self, cache, queue):
+        self.cache = cache
+        self.queue = queue
+
+    def apply(self, batch: List[Tuple]) -> ApplyResult:
+        """`batch` is [(PodInfo, node_name)] in commit order. Returns the
+        placed triples (for bind submission) and the rejected pairs (pod
+        key already in the cache — the caller fails those individually,
+        exactly assume_pod's ValueError contract)."""
+        t0 = time.perf_counter()
+        assumed = [info.pod.with_node(node) for info, node in batch]
+        rejected_idx = set(self.cache.assume_pods(assumed))
+        placed = []
+        rejected = []
+        for j, (info, node) in enumerate(batch):
+            if j in rejected_idx:
+                rejected.append((info, node))
+            else:
+                placed.append((info, assumed[j], node))
+        if placed and self.queue.has_nominations():
+            # DeleteNominatedPodIfExists at assume time (scheduler.go:529),
+            # batched — committed pods stop reserving their nominated nodes
+            self.queue.clear_nominations([p[0].pod.key() for p in placed])
+        return ApplyResult(placed, rejected, time.perf_counter() - t0)
+
+
+class GangRollbackRecord:
+    """One rollback record per gang group: the staged members and the one
+    call that unwinds them all. `forget_pods` undoes every member's cache
+    assume under a single lock; unreserve/volume-forget stay per member
+    (plugin contracts are per pod)."""
+
+    __slots__ = ("group", "members")
+
+    def __init__(self, group: str):
+        self.group = group
+        self.members: List[Tuple] = []  # (info, assumed, node_name, state)
+
+    def stage(self, info, assumed, node_name, state) -> None:
+        self.members.append((info, assumed, node_name, state))
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+    def rollback(
+        self,
+        cache,
+        framework,
+        volume_binder,
+        fail: Callable,
+        cycle: int,
+        msg: str,
+        on_member: Optional[Callable] = None,
+    ) -> int:
+        """Unwind every staged member: bulk cache forget, then per-member
+        volume-forget + unreserve + fail. `on_member(info)` runs per member
+        for caller-side bookkeeping (conflict-index tombstones, counters).
+        Returns the number of members rolled back."""
+        members, self.members = self.members, []
+        cache.forget_pods([m[1] for m in members])
+        for info, assumed, node_name, state in members:
+            if volume_binder is not None:
+                volume_binder.forget_pod_volumes(info.pod)
+            framework.run_unreserve(state, info.pod, node_name)
+            fail(info, cycle, msg)
+            if on_member is not None:
+                on_member(info)
+        return len(members)

@@ -1,0 +1,78 @@
+"""Commit pipelining: double-buffer the bulk apply against the next solve.
+
+The driver's cycle used to be strictly serial on the host: solve-fetch →
+commit → (dispatch next) → solve-fetch → ... The solve side already
+pipelines (speculative dispatch + copy_to_host_async); this module gives
+the COMMIT side the same treatment: batch N's columnar apply + lean-bind
+submission runs on a single worker thread while the main thread fetches
+batch N+1's already-dispatched solve result (a device/tunnel wait that
+needs no host CPU) and runs its pre-commit phases.
+
+Backpressure is the invariant: at most ONE batch's apply may be in flight
+(`submit` drains the previous one first), and the driver drains before
+touching anything the apply mutates — the cache/queue/mirror sync, the
+speculative-chain validity check (cache.mutation_count equality), and the
+end-of-batch preemption pass. The tensor mirror therefore never runs more
+than one batch stale, and never stale at the moment a batch begins
+committing.
+
+The submitted closure owns its own failure handling (per-pod fail paths,
+reject accounting); an escaped exception is remembered and re-raised at
+the next drain so a broken apply surfaces in the driver's per-batch error
+path instead of dying silently on the worker.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, Optional
+
+
+class CommitPipeline:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="commit-apply"
+        )
+        self._inflight: Optional[Future] = None
+        self._lock = threading.Lock()
+        self.stats: Dict[str, float] = {
+            "submitted": 0,
+            "drain_wait_s": 0.0,  # host time actually BLOCKED on an apply
+            "apply_s": 0.0,  # worker wall inside submitted closures
+        }
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        """Run `fn` on the worker; blocks first if a previous apply is
+        still in flight (the ≤1-batch-stale backpressure)."""
+        self.drain()
+        with self._lock:
+            self.stats["submitted"] += 1
+            self._inflight = self._pool.submit(self._run, fn)
+
+    def _run(self, fn: Callable[[], None]) -> None:
+        t0 = time.perf_counter()
+        try:
+            fn()
+        finally:
+            self.stats["apply_s"] += time.perf_counter() - t0
+
+    def drain(self) -> None:
+        """Wait for the in-flight apply (no-op when idle). Re-raises the
+        closure's escaped exception, if any, on the caller's thread."""
+        with self._lock:
+            f, self._inflight = self._inflight, None
+        if f is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            f.result()
+        finally:
+            self.stats["drain_wait_s"] += time.perf_counter() - t0
+
+    def close(self) -> None:
+        try:
+            self.drain()
+        finally:
+            self._pool.shutdown(wait=True)

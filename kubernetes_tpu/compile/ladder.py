@@ -52,6 +52,9 @@ KIND_SOLVE = "solve"
 KIND_SOLVE_GANG = "solve_gang"
 KIND_FILTER = "filter"
 KIND_PREEMPT = "preempt"
+# commit-plane arbiter (kubernetes_tpu/commit/arbiter.py): rides the same
+# b/u/t/n/v axes as the solve it validates, so its rungs are the solve's
+KIND_ARBITER = "arbiter"
 
 
 @dataclass(frozen=True)

@@ -28,6 +28,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .ladder import (
+    KIND_ARBITER,
     KIND_FILTER,
     KIND_PREEMPT,
     KIND_SOLVE,
@@ -207,6 +208,28 @@ class WarmupService:
         if spec.kind == KIND_FILTER:
             out = filter_mask(args[0], args[1], args[2], args[3], args[4],
                               args[5], args[6], **statics)
+            jax.block_until_ready(out)
+        elif spec.kind == KIND_ARBITER:
+            from ..commit.arbiter import arbitrate
+
+            import jax.numpy as jnp
+
+            assign = np.full(spec.b, -1, np.int32)
+            arb_statics = dict(term_kinds=spec.term_kinds, n_buckets=spec.v)
+            carry = None
+            if spec.with_carry:
+                # the driver hands the arbiter the SAME residual tuple the
+                # chained solve ran on — mirror its dtypes exactly
+                f0 = jnp.asarray(na["alloc"]) - jnp.asarray(na["requested"])
+                carry = (
+                    f0,
+                    jnp.asarray(na["pod_count"]).astype(f0.dtype),
+                    jnp.asarray(na["nonzero_req"]).astype(f0.dtype),
+                )
+            out = arbitrate(
+                na, batch.arrays(), ea, tb.arrays(), ids, assign,
+                pb=pb, carry=carry, **arb_statics,
+            )
             jax.block_until_ready(out)
         elif spec.kind == KIND_SOLVE_GANG:
             fn = self.sched._sharded.gang if use_sharded else solve_pipeline_gang

@@ -123,6 +123,25 @@ compile_spec_misses_after_warmup = registry.register(Gauge(
     "Solve-spec misses (inline XLA compiles) AFTER warmup declared the "
     "ladder — zero on a healthy drain",
 ))
+# commit-plane series (kubernetes_tpu/commit): which path a batch's commit
+# took, what the device arbiter decided, and what the bulk apply cost
+commit_plane_batches = registry.register(Counter(
+    "scheduler_commit_plane_batches_total",
+    "Batches by commit path (arbiter = device-arbitrated columnar apply, "
+    "bulk = plugin-free fast path, scalar = legacy per-pod host loop)",
+    label_names=("path",),
+))
+commit_arbiter_verdicts = registry.register(Counter(
+    "scheduler_commit_arbiter_verdicts_total",
+    "Device commit-arbiter verdicts (place|defer|nofit)",
+    label_names=("verdict",),
+))
+commit_apply_duration = registry.register(Histogram(
+    "scheduler_commit_apply_duration_seconds",
+    "Columnar bulk-apply wall per batch (clone + bulk assume + nomination "
+    "clears + bind submission, on the commit-pipeline worker)",
+    buckets=_DURATION_BUCKETS,
+))
 
 
 class _Timer:

@@ -31,8 +31,22 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..api.types import Pod, PodDisruptionBudget
+from ..commit import (
+    ColumnarApply,
+    CommitPipeline,
+    GangRollbackRecord,
+    V_DEFER,
+    V_NOFIT,
+    V_PLACE,
+    kinds_covered,
+)
 from ..compile import CompilePlan, SolveSpec, WarmupService
-from ..compile.ladder import KIND_PREEMPT, KIND_SOLVE, KIND_SOLVE_GANG
+from ..compile.ladder import (
+    KIND_ARBITER,
+    KIND_PREEMPT,
+    KIND_SOLVE,
+    KIND_SOLVE_GANG,
+)
 from ..compile.plan import SOURCE_INLINE, SOURCE_PERSISTED
 from ..framework.interface import CycleState, Framework, Status
 from ..api.selectors import match_label_selector
@@ -63,6 +77,10 @@ class ScheduleResult:
     unschedulable: int = 0
     errors: int = 0
     preempted: int = 0
+    # commit-plane defer-to-next-batch verdicts: pods returned to activeQ
+    # (no backoff) because an earlier commit of their own batch conflicted
+    # — NOT unschedulable, and a drain loop must not stop while any exist
+    deferred: int = 0
     assignments: Dict[str, str] = field(default_factory=dict)
 
 
@@ -141,6 +159,12 @@ class SolveOutput:
     # queue's current counter means no nomination appeared since (clears
     # only make the mask conservative)
     nom_adds: int = -1
+    # commit-plane arbiter verdicts ([len(pods)] V_PLACE/V_DEFER/V_NOFIT,
+    # None when the arbiter was not dispatched — gang batches, plane off)
+    verdicts: Optional[np.ndarray] = None
+    # the term kinds ACTUALLY present in this batch (exact per-batch set,
+    # not the monotone compile union) — the arbiter coverage gate
+    present_kinds: frozenset = frozenset()
 
 
 class ExtenderError(Exception):
@@ -323,6 +347,33 @@ class _BatchConflictIndex:
         return False
 
 
+class LazyConflictIndex:
+    """A _BatchConflictIndex built on demand from raw (pod, node) commit
+    pairs. The arbiter commit path never walks a per-pod index itself —
+    but speculative-chain entries dispatched before this batch still need
+    one (their masks predate these commits). Recording the pairs costs
+    ~0.5us/pod on the critical path; the index materializes on the commit
+    PIPELINE worker (off the hot loop) or lazily at first consume."""
+
+    def __init__(self, pairs: List[Tuple[Pod, object]]):
+        self._pairs = pairs
+        self._ix: Optional[_BatchConflictIndex] = None
+
+    def materialize(self) -> "_BatchConflictIndex":
+        if self._ix is None:
+            ix = _BatchConflictIndex()
+            for pod, node in self._pairs:
+                ix.add_commit(pod, node)
+                a = pod.affinity
+                if a is not None and a.pod_anti_affinity is not None and a.pod_anti_affinity.required:
+                    ix.add_anti(pod, node)
+            self._ix = ix
+        return self._ix
+
+    def anti_conflict(self, pod: Pod, node) -> bool:
+        return self.materialize().anti_conflict(pod, node)
+
+
 # spec_key moved to state/tensors.py (it is an encoding-layer concept and
 # the queue's memo warming must not import the scheduler layer); re-exported
 # here for the driver's own call sites and existing imports
@@ -461,6 +512,7 @@ class Scheduler:
         spec_depth: int = 2,
         mesh=None,
         compile_plan: Optional[CompilePlan] = None,
+        commit_plane: bool = True,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -565,6 +617,21 @@ class Scheduler:
         # per-batch oracle metadata cache (built lazily on first oracle use)
         self._aff_index = None
         self._aff_extra: List = []
+        # commit plane (kubernetes_tpu/commit): device-arbitrated verdicts
+        # + columnar bulk apply + double-buffered apply/bind pipelining.
+        # KTPU_COMMIT_PLANE=0 is the operational kill switch.
+        import os as _os
+
+        self.commit_plane = commit_plane and _os.environ.get(
+            "KTPU_COMMIT_PLANE", "1"
+        ) != "0"
+        self._commit_pipe = CommitPipeline()
+        self._columnar = ColumnarApply(self.cache, self.queue)
+        # defer-to-next-batch escalation: a pod deferred this many times
+        # routes through the legacy oracle re-place instead (progress
+        # guarantee against pathological repeat conflicts)
+        self._defer_counts: Dict[str, int] = {}
+        self._defer_escalate = 3
         # per-phase wall-clock accumulators (the utiltrace/LogIfLong
         # equivalent; bench.py and metrics read these)
         self.stats: Dict[str, float] = {
@@ -608,6 +675,20 @@ class Scheduler:
             track_inbatch=self._track_inbatch and not gang,
         )
 
+    def _arbiter_spec(self, with_carry: bool) -> SolveSpec:
+        """The commit arbiter's XLA signature: the solve's axes (it scans
+        the solve's assignment at the solve's shapes), minus the statics
+        the arbiter has no use for (tie-noise determinism, solver-side
+        in-batch tracking) so carry variants stay the only spec split."""
+        from dataclasses import replace
+
+        return replace(
+            self._solve_spec(gang=False, with_carry=with_carry),
+            kind=KIND_ARBITER,
+            deterministic=False,
+            track_inbatch=False,
+        )
+
     def _preempt_spec(self) -> SolveSpec:
         """The device preemption kernel's signature at current cluster
         shape (scheduler/preemption.batch_preempt_device axes, which this
@@ -648,6 +729,10 @@ class Scheduler:
         specs = lad.growth_specs(spec) + lad.growth_specs(
             replace(spec, with_carry=not spec.with_carry)
         )
+        if self.commit_plane and spec.kind == KIND_SOLVE:
+            # the arbiter grows in lockstep with the solve it validates
+            specs += lad.growth_specs(self._arbiter_spec(spec.with_carry))
+            specs += lad.growth_specs(self._arbiter_spec(not spec.with_carry))
         self._warm_svc.warm_async(specs, dev)
 
     # -- device solve --------------------------------------------------------
@@ -753,9 +838,8 @@ class Scheduler:
         # otherwise compile up to 2^8 variants, while the union costs at
         # most 8 growth compiles and a superset program is still exact
         # (extra kernels compute their term-absent identities)
-        self._term_kinds = getattr(self, "_term_kinds", frozenset()) | _present_term_kinds(
-            tb, self.mirror.pats, aux
-        )
+        present_kinds = _present_term_kinds(tb, self.mirror.pats, aux)
+        self._term_kinds = getattr(self, "_term_kinds", frozenset()) | present_kinds
         term_kinds = self._term_kinds
         # topology segment-axis bound (jit static): only the slots named by
         # CURRENT terms matter — zone-keyed terms need ~#zones buckets while
@@ -899,6 +983,45 @@ class Scheduler:
                 time.perf_counter() - t_spec,
                 SOURCE_INLINE if self.compile_plan.warmed else "warmup",
             )
+        # COMMIT ARBITER dispatch: chained on the solve's assignment ON
+        # DEVICE (async, results fetched with the assign), replaying the
+        # batch in pop order against tracked in-batch state so the host
+        # commit loop gets per-pod place/defer verdicts instead of doing
+        # per-pod rechecks itself. Skipped for batches the verdicts could
+        # never be used on (gang, uncovered term kinds, sharded banks).
+        verdict_dev = None
+        levels_arr = np.array([_recheck_level(r) for r in reps], np.int8)
+        if (
+            self.commit_plane
+            and not is_gang
+            and not use_sharded
+            and kinds_covered(present_kinds)
+            # pure RECHECK_NONE batches are the bulk fast path's domain —
+            # verdicts would go unused, so don't spend device time on them
+            and bool((levels_arr != RECHECK_NONE).any())
+            # a deployment whose plugins/extenders/volumes force the legacy
+            # loop must not pay the verdict scan at all
+            and self._commit_plane_statics_ok()
+        ):
+            from ..commit.arbiter import arbitrate
+
+            arb_spec = self._arbiter_spec(with_carry=carry is not None)
+            arb_known = self.compile_plan.admit(arb_spec)
+            t_arb = time.perf_counter()
+            verdict_dev = arbitrate(
+                na_dev, batch.arrays(), ea_dev, tb.arrays(), ids,
+                assign, pb=pb, carry=carry,
+                term_kinds=term_kinds, n_buckets=n_buckets,
+            )
+            self.stats["arbiter_dispatch_s"] = self.stats.get(
+                "arbiter_dispatch_s", 0.0
+            ) + (time.perf_counter() - t_arb)
+            if not arb_known:
+                self.compile_plan.note_compiled(
+                    arb_spec,
+                    time.perf_counter() - t_arb,
+                    SOURCE_INLINE if self.compile_plan.warmed else "warmup",
+                )
         self._compile_growth_hook(solve_spec, (na_dev, ea_dev, xp_dev))
         self.stats["batch_specs"] = self.stats.get("batch_specs", 0) + len(reps)
         self.stats["solve_s"] += time.perf_counter() - t1
@@ -907,7 +1030,7 @@ class Scheduler:
             pods=pods,
             batch=batch,
             aux=aux,
-            levels=np.array([_recheck_level(r) for r in reps], np.int8),
+            levels=levels_arr,
             sig_arr=np.asarray(sig_list, np.int32),
             assign_dev=assign,
             score_dev=score,
@@ -917,6 +1040,8 @@ class Scheduler:
             speculative=carry is not None,
             tracked=self._track_inbatch and gang_dev is None,
             nom_adds=nom_adds,
+            verdict_dev=verdict_dev,
+            present_kinds=present_kinds,
         )
 
     def _finish_solve(self, disp: Dict) -> SolveOutput:
@@ -928,9 +1053,16 @@ class Scheduler:
         n = len(pods)
         sig_arr = disp["sig_arr"]
         gang_ok_arr = None
+        verdicts = None
         if disp["gang_dev"] is not None:
             assign, gang_ok = jax.device_get((disp["assign_dev"], disp["gang_dev"]))
             gang_ok_arr = np.asarray(gang_ok)[:n]
+        elif disp.get("verdict_dev") is not None:
+            # the arbiter's verdicts ride the same fetch as the assignment
+            assign, verd = jax.device_get(
+                (disp["assign_dev"], disp["verdict_dev"])
+            )
+            verdicts = np.asarray(verd)[:n]
         else:
             # fetch_s = device execution + the [B] assign download
             assign = jax.device_get(disp["assign_dev"])
@@ -950,6 +1082,8 @@ class Scheduler:
             levels=disp["levels"][sig_arr],
             inbatch_tracked=disp.get("tracked", False),
             nom_adds=disp.get("nom_adds", -1),
+            verdicts=verdicts,
+            present_kinds=disp.get("present_kinds", frozenset()),
         )
 
     def warmup(self, max_pods: Optional[int] = None) -> int:
@@ -992,6 +1126,32 @@ class Scheduler:
                 dev = self.mirror.device_arrays()
                 self._warm_svc.warm_specs(persisted, dev=dev, source=SOURCE_PERSISTED)
             if infos:
+                # PREDICTIVE KIND ADOPTION: committing an (anti-)affinity
+                # pod turns it into an existing-pod PATTERN, so the very
+                # first commit grows the term-kind union (et_anti /
+                # et_score) and the second batch would pay an inline
+                # compile mid-drain. Seed the union with the post-commit
+                # kinds of the peeked workload BEFORE dispatching, so
+                # warmup compiles the superset program once (superset
+                # programs are exact — absent kinds compute identities).
+                kinds = set()
+                for pi in infos:
+                    a = pi.pod.affinity
+                    if a is None:
+                        continue
+                    if a.pod_anti_affinity is not None:
+                        if a.pod_anti_affinity.required:
+                            kinds |= {"anti_req", "et_anti"}
+                        if a.pod_anti_affinity.preferred:
+                            kinds |= {"pref", "et_score"}
+                    if a.pod_affinity is not None:
+                        if a.pod_affinity.required:
+                            kinds |= {"aff_req", "et_score"}
+                        if a.pod_affinity.preferred:
+                            kinds |= {"pref", "et_score"}
+                self._term_kinds = (
+                    getattr(self, "_term_kinds", frozenset()) | frozenset(kinds)
+                )
                 disp = self._dispatch_solve(infos)
                 self._finish_solve(disp)
                 if self.speculate:
@@ -1011,11 +1171,18 @@ class Scheduler:
             if infos:
                 # headroom: compile the next growth rung of each mid-drain-
                 # growable axis in the background while the drain starts —
-                # both carry variants (fresh solve + speculative chain)
+                # both carry variants (fresh solve + speculative chain).
+                # The commit arbiter grows in lockstep (its live-shape
+                # programs were warmed by the peeked dispatches above).
                 dev = self.mirror.device_arrays()
                 for wc in ((False, True) if self.speculate else (False,)):
                     spec = self._solve_spec(gang=False, with_carry=wc)
-                    self._warm_svc.warm_async(plan.ladder.growth_specs(spec), dev)
+                    specs = plan.ladder.growth_specs(spec)
+                    if self.commit_plane:
+                        specs = specs + plan.ladder.growth_specs(
+                            self._arbiter_spec(wc)
+                        )
+                    self._warm_svc.warm_async(specs, dev)
             plan.mark_warmed()
             plan.persist()
             self._aot_enabled = True
@@ -1559,6 +1726,274 @@ class Scheduler:
             # (eventhandlers.go:127 -> MoveAllToActiveQueue)
             self.queue.move_all_to_active()
 
+    # -- commit plane --------------------------------------------------------
+
+    def _commit_plane_statics_ok(self) -> bool:
+        """Deployment-static preconditions for arbiter verdicts to ever be
+        USABLE: any host plugin, extender, or volume seam forces the
+        legacy loop, so a scheduler configured with one must not pay the
+        device verdict scan at all. Shared by the dispatch gate (skip the
+        arbitrate() dispatch + verdict fetch entirely) and
+        _arbiter_covers (per-batch decision)."""
+        if (
+            self.extenders
+            or self.volume_binder is not None
+            or self.volume_checker is not None
+        ):
+            return False
+        fw = self.framework
+        for point in (
+            "reserve", "filter", "pre_filter", "score", "post_filter",
+            "permit", "pre_bind", "bind", "post_bind",
+        ):
+            if fw.has_plugins(point):
+                return False
+        return True
+
+    def _arbiter_covers(self, out: SolveOutput, infos, prior_ix) -> bool:
+        """Can this batch commit straight from the device arbiter's
+        verdicts? True when nothing host-side can change or veto a pick
+        beyond what the arbiter tracked: no host plugins/extenders/volume
+        seams, every present term kind arbiter-covered, no encoding
+        overflow, no outstanding nominations (their two-pass host check
+        covers more than the mask's resource fold), and no speculative
+        hard-spread staleness (a stale domain minimum can PASS a pod the
+        sequential walk would veto — anti/ports staleness, by contrast, is
+        patched exactly against the prior batches' conflict indices)."""
+        if out.verdicts is None or out.gang_ok is not None:
+            return False
+        if not self._commit_plane_statics_ok():
+            return False
+        if out.existing_overflow or bool(out.fallback[: len(infos)].any()):
+            return False
+        if not kinds_covered(out.present_kinds):
+            return False
+        if (
+            self.queue.has_nominations()
+            or out.nom_adds != self.queue.nomination_adds
+        ):
+            return False
+        if (out.speculative or prior_ix) and "spread_hard" in out.present_kinds:
+            return False
+        # -1 rows would need the oracle fallback when node rows are excluded
+        if out.node_fallback_any and bool((out.assign[: len(infos)] < 0).any()):
+            return False
+        return True
+
+    def _commit_arbitrated(
+        self, infos: List[PodInfo], out: SolveOutput, res: ScheduleResult,
+        cycle: int, prior_ix: List,
+    ) -> Tuple[Optional[LazyConflictIndex], bool]:
+        """Commit a covered batch from the arbiter's verdicts: V_PLACE pods
+        bulk-apply (columnar assume + chunked lean binds) on the commit
+        pipeline's worker, V_DEFER pods re-queue for the next batch (no
+        backoff — they conflicted with their own batch, they are not
+        unschedulable), V_NOFIT pods take the batched-preemption /
+        unschedulable path exactly like the bulk fast path. Returns
+        (prior_record, dirty): the lazy conflict index speculative-chain
+        entries need when placed pods carried anti/ports, and whether the
+        chain must poison (defers or escalations made the solver's carry
+        diverge from what actually committed)."""
+        n = len(infos)
+        verdicts = out.verdicts
+        assign = out.assign
+        name_of = self.mirror.name_of_row
+        snap_get = self.cache.snapshot.get
+        place: List[Tuple[PodInfo, str]] = []
+        defers: List[Tuple[int, PodInfo]] = []
+        escalate: List[Tuple[int, PodInfo]] = []
+        preempt_fails: List[PodInfo] = []
+        pairs: List[Tuple[Pod, object]] = []
+        any_anti_port = False
+        nofit = 0
+        known_rejects = 0
+        speculative = out.speculative
+        for i in range(n):
+            info = infos[i]
+            v = int(verdicts[i])
+            row = int(assign[i])
+            pod = info.pod
+            if v == V_PLACE and row >= 0:
+                node_name = name_of[row] if 0 <= row < len(name_of) else None
+                ni = snap_get(node_name) if node_name is not None else None
+                if ni is None:
+                    defers.append((i, info))  # node vanished under the solve
+                    continue
+                # cross-batch staleness patch: the speculated mask predates
+                # the commits recorded in prior_ix (anti, memoized per
+                # spec) and, for ported pods, the live node occupancy
+                if prior_ix and any(
+                    ix.anti_conflict(pod, ni.node) for ix in prior_ix
+                ):
+                    defers.append((i, info))
+                    continue
+                if (
+                    speculative
+                    and pod.host_ports()
+                    and ni.host_port_conflict(pod)
+                ):
+                    defers.append((i, info))
+                    continue
+                place.append((info, node_name))
+                pairs.append((pod, ni.node))
+                if bool(out.has_anti[i]) or pod.host_ports():
+                    any_anti_port = True
+            elif v == V_DEFER:
+                defers.append((i, info))
+            elif row < 0 and self.enable_preemption:
+                preempt_fails.append(info)
+            else:
+                nofit += 1
+                res.unschedulable += 1
+                self._fail(info, cycle, "no fit")
+        # defer escalation: a pod deferred _defer_escalate times in a row
+        # routes through the legacy oracle re-place instead — the progress
+        # guarantee against pathological repeat conflicts
+        kept_defers: List[PodInfo] = []
+        for i, info in defers:
+            k = info.pod.key()
+            c = self._defer_counts.get(k, 0) + 1
+            self._defer_counts[k] = c
+            if c >= self._defer_escalate:
+                # escalation CONSUMES the budget: whatever the oracle
+                # decides below, the slate is clean (a recreated pod with
+                # the same key must not inherit a stale count)
+                self._defer_counts.pop(k, None)
+                escalate.append((i, info))
+            else:
+                kept_defers.append(info)
+        if self._defer_counts and place:
+            for info, _node in place:
+                self._defer_counts.pop(info.pod.key(), None)
+        # bounded heuristic state: pods placed via OTHER paths (scalar,
+        # bulk), deleted, or parked unschedulable never clear their entry —
+        # reset wholesale rather than leak under pod churn (a reset merely
+        # restores a pod's defer budget, which is always safe)
+        if len(self._defer_counts) > max(1024, 4 * self.batch_size):
+            self._defer_counts.clear()
+        # re-queue BEFORE the apply is even submitted: the pods must be in
+        # the queue no matter what happens to this batch downstream
+        if kept_defers:
+            self.queue.requeue(kept_defers)
+            res.deferred += len(kept_defers)
+        # exact accounting parity with the bulk fast path: a key the cache
+        # already tracks would be REJECTED by the worker's assume_pods —
+        # fail it NOW (synchronously) so res never reports it scheduled.
+        # One lock for the whole batch; the worker's reject handling stays
+        # as defense for the (informer-race) window after this check.
+        if place:
+            known = self.cache.known_keys([i.pod.key() for i, _ in place])
+            if known:
+                known_rejects = len(known)
+                kept: List[Tuple[PodInfo, str]] = []
+                for info, node_name in place:
+                    if info.pod.key() in known:
+                        res.unschedulable += 1
+                        self._fail(info, cycle, "already assumed")
+                    else:
+                        kept.append((info, node_name))
+                place = kept
+                pairs = [
+                    (pod, node) for pod, node in pairs
+                    if pod.key() not in known
+                ]
+        res.scheduled += len(place)
+        assignments = res.assignments
+        for info, node_name in place:
+            assignments[info.pod.key()] = node_name
+        # columnar apply + lean binds on the pipeline worker: overlaps the
+        # next batch's solve fetch; drained before anything reads the
+        # cache/queue/mirror (schedule_batch head, preemption below)
+        lazy = LazyConflictIndex(pairs) if any_anti_port else None
+        if place:
+            self._submit_columnar(place, cycle, lazy)
+        self.stats["arbiter_batches"] = self.stats.get("arbiter_batches", 0) + 1
+        self.stats["arbiter_place"] = self.stats.get("arbiter_place", 0) + len(place)
+        self.stats["arbiter_defer"] = self.stats.get("arbiter_defer", 0) + len(defers)
+        M.commit_plane_batches.inc("arbiter")
+        M.commit_arbiter_verdicts.inc("place", by=len(place))
+        if defers:
+            M.commit_arbiter_verdicts.inc("defer", by=len(defers))
+        if nofit > 0:
+            M.commit_arbiter_verdicts.inc("nofit", by=nofit)
+        if escalate or preempt_fails:
+            # both read post-apply cluster state (oracle snapshot walks /
+            # end-of-batch preemption) — settle the bulk apply first
+            self._commit_pipe.drain()
+        for i, info in escalate:
+            self.stats["arbiter_escalated"] = (
+                self.stats.get("arbiter_escalated", 0) + 1
+            )
+            pod = info.pod
+            state = CycleState()
+            try:
+                self.stats["oracle_places"] += 1
+                meta = self._pod_meta(pod)
+                node_name = self._oracle_place(pod, out.score[i], meta, state)
+            except Exception:
+                node_name = None
+            if node_name is not None and self._commit(info, node_name, cycle, state):
+                res.scheduled += 1
+                assignments[pod.key()] = node_name
+            else:
+                if node_name is None:
+                    if self.enable_preemption and self._try_preempt(info):
+                        res.preempted += 1
+                        self._aff_index = None
+                        self.queue.move_all_to_active()
+                    self._fail(info, cycle, "no fit")
+                res.unschedulable += 1
+        if preempt_fails:
+            self._preempt_deferred(preempt_fails, cycle, res)
+        dirty = bool(kept_defers or escalate or known_rejects)
+        return lazy, dirty
+
+    def _submit_columnar(
+        self, place: List[Tuple[PodInfo, str]], cycle: int,
+        lazy: Optional[LazyConflictIndex],
+    ) -> None:
+        """Hand a batch's bulk apply to the commit-pipeline worker: one
+        cache assume + nomination clears + chunked lean-bind submissions.
+        The closure owns its failure handling (rejected keys fail their
+        pods individually); the prior conflict index materializes here,
+        off the critical path, before any chain entry can read it (the
+        consume side drains the pipeline first)."""
+        columnar = self._columnar
+        bind_pool = self._bind_pool
+        workers = self._bind_workers
+
+        def apply_batch() -> None:
+            result = columnar.apply(place)
+            M.commit_apply_duration.observe(result.seconds)
+            self.stats["apply_s"] = (
+                self.stats.get("apply_s", 0.0) + result.seconds
+            )
+            t_decided = time.perf_counter()
+            state = CycleState()  # shared: the lean pipeline never reads it
+            items = [
+                (info, assumed, node, state, t_decided)
+                for info, assumed, node in result.placed
+            ]
+            if items:
+                step = max(1, -(-len(items) // workers))
+                for i in range(0, len(items), step):
+                    bind_pool.submit(
+                        self._lean_bind_chunk, items[i : i + step], cycle
+                    )
+            for info, _node in result.rejected:
+                # a pod key already in the cache means a double-schedule
+                # upstream; count loudly and fail it like assume_pod's
+                # ValueError path (the chain's mutation-count equality
+                # check self-corrects for the uncounted assume)
+                self.stats["apply_rejects"] = (
+                    self.stats.get("apply_rejects", 0) + 1
+                )
+                self._fail(info, cycle, "already assumed")
+            if lazy is not None:
+                lazy.materialize()
+
+        self._commit_pipe.submit(apply_batch)
+
     @property
     def _spec_pending(self) -> Optional[Dict]:
         """Head of the speculative chain (None when empty) — kept for
@@ -1608,6 +2043,8 @@ class Scheduler:
             disp["assign_dev"].copy_to_host_async()
             if disp["gang_dev"] is not None:
                 disp["gang_dev"].copy_to_host_async()
+            if disp.get("verdict_dev") is not None:
+                disp["verdict_dev"].copy_to_host_async()
         except AttributeError:
             pass  # non-jax array (tests with stub arrays)
         entry["disp"] = disp
@@ -1624,10 +2061,26 @@ class Scheduler:
         else:
             infos = self.queue.pop_batch(max_pods or self.batch_size)
         if not infos:
-            return res
+            # an apply may still be in flight (a reject re-queues its pod):
+            # settle it before reporting the queue drained, then re-pop once
+            self._commit_pipe.drain()
+            infos = self.queue.pop_batch(max_pods or self.batch_size)
+            if not infos:
+                return res
         cycle = self.queue.scheduling_cycle()
         self.stats["batches"] += 1
         trace = Trace("schedule_batch", pods=len(infos), cycle=cycle)
+        # COMMIT PIPELINING overlap window: the speculated solve's result
+        # fetch is a device/tunnel wait needing no host CPU — start it
+        # BEFORE draining the previous batch's in-flight columnar apply so
+        # the two run concurrently (commit/pipeline.py double buffering).
+        # If the entry turns out non-consumable below, the fetch was the
+        # copy_to_host_async bytes already in flight — nothing wasted.
+        out_pre: Optional[SolveOutput] = None
+        if pending is not None and pending["disp"] is not None:
+            out_pre = self._finish_solve(pending["disp"])
+        self._commit_pipe.drain()
+        trace.step("commit-pipeline drain")
         t_sync = time.perf_counter()
         self.mirror.sync()
         dt_sync = time.perf_counter() - t_sync
@@ -1671,7 +2124,9 @@ class Scheduler:
             t_solve = time.perf_counter()
             if use_pending:
                 self.stats["spec_hits"] = self.stats.get("spec_hits", 0) + 1
-                out = self._finish_solve(pending["disp"])
+                out = out_pre if out_pre is not None else self._finish_solve(
+                    pending["disp"]
+                )
                 self._last_carry = pending["disp"]["carry_dev"]
             else:
                 if pending is not None:
@@ -1786,8 +2241,10 @@ class Scheduler:
         residuals_diverged = False
         # gang groups: members are PREPARED (reserve+assume) as decided but
         # their binds are submitted only once the whole group has landed;
-        # one failing member rolls back the group (all-or-nothing)
-        gang_staged: Dict[str, List[Tuple[PodInfo, Pod, str, CycleState]]] = {}
+        # one failing member rolls back the group (all-or-nothing) through
+        # a SINGLE rollback record per group (commit/apply.py): one bulk
+        # cache forget plus the per-member plugin bookkeeping
+        gang_staged: Dict[str, GangRollbackRecord] = {}
         gang_failed: set = set()
 
         def rollback_group(g: str) -> None:
@@ -1796,16 +2253,19 @@ class Scheduler:
             # rolled-back assumes leave the snapshot: the extras no longer
             # mirror it — drop the cache (rebuilt lazily from live state)
             self._aff_index = None
-            for s_info, s_assumed, s_node, s_state in gang_staged.pop(g, []):
-                self._rollback_prepared(
-                    s_info, s_assumed, s_node, s_state, cycle, "gang incomplete"
-                )
+            rec = gang_staged.pop(g, None)
+            if rec is None or not len(rec):
+                return
+            n = rec.rollback(
+                self.cache, self.framework, self.volume_binder,
+                self._fail, cycle, "gang incomplete",
                 # the rolled-back members no longer occupy any node: prune
                 # them so later LIGHT pods don't see phantom conflicts and
                 # escalate to the O(cluster) oracle path
-                conflict_index.remove(s_info.pod)
-                res.unschedulable += 1
-                residuals_diverged = True  # staged capacity released
+                on_member=lambda info: conflict_index.remove(info.pod),
+            )
+            res.unschedulable += n
+            residuals_diverged = True  # staged capacity released
 
         t_commit = time.perf_counter()
         bind_jobs: List = []  # deferred bind pipelines, chunk-submitted below
@@ -1890,7 +2350,29 @@ class Scheduler:
             res.scheduled += len(assumed_meta) - len(rejected)
             if preempt_fails:
                 self._preempt_deferred(preempt_fails, cycle, res)
+            M.commit_plane_batches.inc("bulk")
             infos = []  # the scalar loop below sees an empty batch
+
+        # DEVICE-ARBITRATED COMMIT (commit plane, kubernetes_tpu/commit):
+        # term-carrying batches the bulk path had to refuse — required
+        # anti-affinity, host ports, DoNotSchedule spread — commit straight
+        # from the arbiter's sequential-equivalent verdicts: V_PLACE pods
+        # columnar-apply on the pipeline worker, V_DEFER pods retry next
+        # batch against the committed state, V_NOFIT pods take the batched
+        # preemption path. The per-pod scalar loop below becomes the
+        # fallback for what the arbiter does not cover (plugins, extenders,
+        # volumes, required affinity, nominations, gangs).
+        arb_prior: Optional[LazyConflictIndex] = None
+        if infos and self._arbiter_covers(out, infos, prior_ix):
+            arb_prior, arb_dirty = self._commit_arbitrated(
+                infos, out, res, cycle, prior_ix
+            )
+            if arb_dirty:
+                residuals_diverged = True
+            trace.step("commit plane (device-arbitrated)")
+            infos = []
+        elif infos:
+            M.commit_plane_batches.inc("scalar")
 
         # commit in pop order so oracle re-checks see earlier assumes,
         # reproducing sequential semantics. pop_batch pops the activeQ heap,
@@ -2108,7 +2590,9 @@ class Scheduler:
                         continue
                     # from here the pod's disposition belongs to the group:
                     # the guard's rollback_group fails staged members
-                    gang_staged.setdefault(group, []).append((info, assumed, node_name, state))
+                    gang_staged.setdefault(
+                        group, GangRollbackRecord(group)
+                    ).stage(info, assumed, node_name, state)
                     disposed = True
                     c_node = self.cache.snapshot.get(node_name) if index_needed else None
                     if c_node is not None:
@@ -2161,7 +2645,8 @@ class Scheduler:
         # declared min-available says part of the group hasn't even been
         # created yet, in which case binding this slice would break
         # all-or-nothing across batches
-        for g, members in list(gang_staged.items()):
+        for g, rec in list(gang_staged.items()):
+            members = rec.members
             need = max((pod_group_min_available(m[0].pod) for m in members), default=0)
             if need and len(members) < need:
                 rollback_group(g)
@@ -2232,6 +2717,11 @@ class Scheduler:
                 if conflict_index.any_anti or conflict_index.any_ports:
                     for e in self._spec_chain:
                         e.setdefault("prior", []).append(conflict_index)
+                elif arb_prior is not None:
+                    # arbiter-committed anti/port pods: chained entries get
+                    # the lazy index (materialized on the pipeline worker)
+                    for e in self._spec_chain:
+                        e.setdefault("prior", []).append(arb_prior)
         trace.step("commit loop")
         M.scheduling_algorithm_duration.observe(trace.total_seconds())
         M.schedule_attempts.inc(M.SCHEDULED, by=res.scheduled)
@@ -2253,8 +2743,12 @@ class Scheduler:
             total.unschedulable += r.unschedulable
             total.errors += r.errors
             total.preempted += r.preempted
+            total.deferred += r.deferred
             total.assignments.update(r.assignments)
-            if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+            if (
+                r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0
+                and r.deferred == 0
+            ):
                 break
         return total
 
@@ -2280,13 +2774,16 @@ class Scheduler:
         the grown ladder persists). Safe to call more than once."""
         self.flush_speculative()
         self.wait_for_binds()
+        self._commit_pipe.close()
         if self._warm_svc is not None:
             self._warm_svc.stop()
             self._warm_svc.join()
             self.compile_plan.persist()
 
     def wait_for_binds(self) -> None:
-        """Drain the bind pipeline (tests/benchmarks)."""
+        """Drain the bind pipeline (tests/benchmarks). The commit pipeline
+        settles first — its worker is what SUBMITS the lean bind chunks."""
+        self._commit_pipe.drain()
         self._bind_pool.shutdown(wait=True)
         self._bind_pool = ThreadPoolExecutor(
             max_workers=self._bind_workers, thread_name_prefix="bind"

@@ -205,6 +205,21 @@ class SchedulerCache:
             del self._pod_states[key]
             self._assumed.discard(key)
 
+    def forget_pods(self, pods: List[Pod]) -> None:
+        """Bulk ForgetPod under ONE lock — the gang-rollback counterpart of
+        assume_pods (commit/apply.GangRollbackRecord unwinds a whole group
+        with one call). Pods not in the assumed state are skipped, exactly
+        like forget_pod."""
+        with self._lock:
+            for pod in pods:
+                key = pod.key()
+                st = self._pod_states.get(key)
+                if st is None or not st.assumed:
+                    continue
+                self._remove_pod_from_node(st.pod)
+                del self._pod_states[key]
+                self._assumed.discard(key)
+
     # -- informer-confirmed pod events (cache.go:389-520) --------------------
 
     def add_pod(self, pod: Pod) -> None:
@@ -245,6 +260,15 @@ class SchedulerCache:
     def is_assumed(self, key: str) -> bool:
         with self._lock:
             return key in self._assumed
+
+    def known_keys(self, keys) -> Set[str]:
+        """Subset of `keys` already tracked by the cache (assumed OR
+        confirmed) — one lock for a whole batch. The commit plane's
+        pre-apply check: a key in here would be REJECTED by assume_pods,
+        so the caller can fail it synchronously with exact accounting."""
+        with self._lock:
+            states = self._pod_states
+            return {k for k in keys if k in states}
 
     def assumed_count(self) -> int:
         """Pods assumed but not yet confirmed by the informer echo."""

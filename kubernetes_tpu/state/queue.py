@@ -24,7 +24,7 @@ import itertools
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..api.types import Pod
 
@@ -207,6 +207,17 @@ class PriorityQueue:
             for info in self._unschedulable.values():
                 info.timestamp = now
             return len(self._infos) + len(self._unschedulable)
+
+    def requeue(self, infos: Sequence[PodInfo]) -> None:
+        """Return popped-but-uncommitted pods to activeQ, preserving their
+        enqueue seq and timestamp — the commit plane's defer-to-next-batch
+        verdict. Unlike add_unschedulable this applies NO backoff: the pod
+        was not unschedulable, it merely conflicted with an earlier commit
+        of its own batch and must re-solve against the committed state."""
+        with self._lock:
+            for info in infos:
+                self._unschedulable.pop(info.pod.key(), None)
+                self._push_active(info)
 
     def peek_batch(self, max_pods: int) -> List[PodInfo]:
         """Up to max_pods PodInfos visible in activeQ WITHOUT popping (heap

@@ -36,7 +36,8 @@ while True:
     t0 = time.perf_counter()
     r = sched.schedule_batch()
     dt = time.perf_counter() - t0
-    if r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0:
+    if (r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0
+            and getattr(r, "deferred", 0) == 0):
         break
     cur = dict(sched.stats)
     delta = {k: round(cur.get(k, 0) - prev.get(k, 0), 3) for k in cur}

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Commit-plane perf smoke: a tiny bench config on the CPU backend.
+
+Runs the REAL bench harness (bench.run_config — warmup, drain, audit,
+compile-plan telemetry) against a miniature mixed workload that exercises
+every commit-plane path: plain pods (bulk fast path), required
+anti-affinity (arbiter tracking), and DoNotSchedule topology spread
+(genuine in-batch arbitration → defer-to-next-batch verdicts). Asserts
+the two invariants the plane lives by:
+
+  * commit-plane coverage > 0 — the device arbiter actually committed
+    batches (a silent fall-back to the per-pod host loop is a regression
+    even when results stay correct);
+  * zero compile-spec misses after warmup — no mid-drain XLA stall,
+    including for the arbiter's own programs (both carry variants).
+
+Fast (~1 min on CPU) so it runs in tier-1 un-slow-marked, wired through
+tests/test_perf_smoke.py; also runnable standalone:
+
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# several small batches instead of one giant one: spec reuse across
+# batches (the zero-miss claim) is only tested if the drain has batches
+os.environ.setdefault("BENCH_SPEC_DEPTH", "2")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+N_NODES = 8
+N_PODS = 96
+SMOKE_BATCH = 32
+
+
+def tiny_commit_plane_config():
+    """(nodes, pods): 8 zoned nodes, 96 pods — 1/8 required anti-affinity,
+    1/8 DoNotSchedule spread, the rest plain (bulk-path) pods."""
+    import bench
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        TopologySpreadConstraint,
+    )
+
+    nodes = [bench.mk_node(i, zone=bench.ZONES[i % 4]) for i in range(N_NODES)]
+    pods = []
+    for i in range(N_PODS):
+        if i % 8 == 0:
+            p = bench.mk_pod(i, cpu="100m", mem="64Mi",
+                             labels={"exclusive": f"x{i % 16}"})
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"exclusive": p.labels["exclusive"]}
+                    ),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ]))
+        elif i % 8 == 1:
+            # a label space of their OWN: every pod a spread selector
+            # matches must itself carry the constraint, or unconstrained
+            # pods could legally skew the domain after placement
+            p = bench.mk_pod(i, cpu="100m", mem="64Mi",
+                             labels={"spread": f"grp{i % 2}"})
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=1,
+                topology_key="failure-domain.beta.kubernetes.io/zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(
+                    match_labels={"spread": p.labels["spread"]}
+                ),
+            )]
+        else:
+            p = bench.mk_pod(i, cpu="100m", mem="64Mi")
+        pods.append(p)
+    return nodes, pods
+
+
+def main() -> dict:
+    import bench
+
+    bench.BATCH = SMOKE_BATCH
+    detail = bench.run_config("tiny_commit_plane_smoke", tiny_commit_plane_config)
+    phase = detail["phase_split_s"]
+    audit = detail["audit"]
+    problems = []
+    if detail["scheduled"] != N_PODS:
+        problems.append(f"scheduled {detail['scheduled']} of {N_PODS} pods")
+    if not phase.get("arbiter_batches", 0):
+        problems.append("commit-plane coverage is ZERO (arbiter never committed a batch)")
+    if not phase.get("arbiter_place", 0):
+        problems.append("arbiter placed no pods")
+    if detail["compile"]["misses_after_warmup"]:
+        problems.append(
+            f"{detail['compile']['misses_after_warmup']} compile-spec "
+            "miss(es) after warmup — mid-drain XLA stalls"
+        )
+    for k, v in audit.items():
+        if k.endswith("_violations") and v:
+            problems.append(f"audit: {k}={v}")
+    assert not problems, "; ".join(problems)
+    return detail
+
+
+if __name__ == "__main__":
+    d = main()
+    p = d["phase_split_s"]
+    print(json.dumps({
+        "config": d["config"],
+        "scheduled": d["scheduled"],
+        "deferred": d.get("deferred", 0),
+        "arbiter_batches": p.get("arbiter_batches", 0),
+        "arbiter_place": p.get("arbiter_place", 0),
+        "arbiter_defer": p.get("arbiter_defer", 0),
+        "commit_s": p.get("commit_s"),
+        "solve_s": p.get("solve_s"),
+        "misses_after_warmup": d["compile"]["misses_after_warmup"],
+    }))

@@ -1,0 +1,35 @@
+"""Tier-1 wiring for scripts/perf_smoke.py: the commit-plane smoke runs
+as a FAST test (deliberately not slow-marked) so a regression that drops
+arbiter coverage to zero or reintroduces mid-drain XLA compiles fails CI,
+not just the nightly bench."""
+
+import os
+import sys
+
+import pytest
+
+pytest.importorskip("jax")
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+
+
+def test_perf_smoke_commit_plane(tmp_path, monkeypatch):
+    # hermetic compile-plan persistence: a ladder left by other runs must
+    # not pre-warm (or mis-warm) this process's specs
+    monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan"))
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main()  # raises AssertionError on any regression
+    phase = detail["phase_split_s"]
+    assert phase["arbiter_batches"] > 0
+    assert phase["arbiter_place"] > 0
+    assert detail["compile"]["misses_after_warmup"] == 0
+    assert detail["scheduled"] == perf_smoke.N_PODS
+    # the defer path is part of the contract: the spread slice of the
+    # workload must actually arbitrate (bit-identity is pinned elsewhere;
+    # this guards the wiring staying live)
+    assert detail["audit"]["hard_spread_skew_violations"] == 0
