@@ -420,7 +420,10 @@ def audit_placement(nodes, commits, existing=(), sample=1000, seed=0, deleted=fr
     }
 
 
-def run_config(name, build, opts=None):
+def run_config(name, build, opts=None, inspect=None):
+    """`inspect(sched)`, when given, runs after the drain settles and
+    before the scheduler closes — the seam perf_smoke uses for bank-parity
+    and donation checks without bench carrying test logic."""
     from kubernetes_tpu.metrics import metrics as M
 
     t_setup = time.perf_counter()
@@ -587,6 +590,8 @@ def run_config(name, build, opts=None):
         pod_p50 = round(pod_p50, 4)
     if pod_p99 is not None:
         pod_p99 = round(pod_p99, 4)
+    if inspect is not None:
+        inspect(sched)
     # retire the background compile-warmup worker OUTSIDE the timed drain
     # (queued warms drop; an in-flight XLA compile at process exit would
     # otherwise abort the interpreter) and persist the grown ladder
@@ -641,6 +646,12 @@ def run_config(name, build, opts=None):
         "warmup_s": round(warmup_s, 3),
         "phase_split_s": {k: round(v, 3) if isinstance(v, float) else v
                           for k, v in sched.stats.items()},
+        # host→device bank traffic by kind (full|rows|usage|fold): the
+        # resident-state plane's win as a measured byte count — on a
+        # covered steady-state drain `usage` stays ~0 and only `fold`
+        # (tiny control arrays) grows with the drain
+        "patch_bytes": dict(sched.mirror.bytes_shipped),
+        "fold_undonated": sched.mirror.folds_undonated,
         "mirror_rebuilds": sched.mirror.rebuild_count,
         # compile-plan telemetry (kubernetes_tpu/compile): misses_after_
         # warmup is the mid-drain-XLA-stall count — zero on a healthy run

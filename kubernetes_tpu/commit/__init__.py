@@ -11,9 +11,14 @@ program in the seed; this package converts the COMMIT half:
   clear + chunked lean binds per batch; single rollback record per gang.
 * `pipeline` — double-buffered apply worker with ≤1-batch-stale
   backpressure, overlapping batch N's apply with batch N+1's solve fetch.
+* `fold`     — resident-state plane planner (ISSUE 3 tentpole): a
+  committed batch's state deltas as padded device control data for
+  ops/fold's donated scatter-adds, so covered batches' solve inputs stop
+  crossing the host↔device wire entirely.
 """
 
 from .apply import ApplyResult, ColumnarApply, GangRollbackRecord
+from .fold import FoldProgram, plan_fold
 from .arbiter import (
     ARBITER_COVERED_KINDS,
     V_DEFER,
@@ -30,7 +35,9 @@ __all__ = [
     "ApplyResult",
     "ColumnarApply",
     "CommitPipeline",
+    "FoldProgram",
     "GangRollbackRecord",
+    "plan_fold",
     "V_DEFER",
     "V_NOFIT",
     "V_PLACE",

@@ -39,14 +39,16 @@ class ColumnarApply:
         self.cache = cache
         self.queue = queue
 
-    def apply(self, batch: List[Tuple]) -> ApplyResult:
+    def apply(self, batch: List[Tuple], folded: bool = False) -> ApplyResult:
         """`batch` is [(PodInfo, node_name)] in commit order. Returns the
         placed triples (for bind submission) and the rejected pairs (pod
         key already in the cache — the caller fails those individually,
-        exactly assume_pod's ValueError contract)."""
+        exactly assume_pod's ValueError contract). `folded` tags the
+        assume deltas as already device-folded (resident-state plane);
+        the caller handles rejected pairs' fold correction."""
         t0 = time.perf_counter()
         assumed = [info.pod.with_node(node) for info, node in batch]
-        rejected_idx = set(self.cache.assume_pods(assumed))
+        rejected_idx = set(self.cache.assume_pods(assumed, folded=folded))
         placed = []
         rejected = []
         for j, (info, node) in enumerate(batch):

@@ -1,0 +1,124 @@
+"""Fold planner: a committed batch's state deltas as device control data.
+
+The resident-state plane's host half. Given the final placed set of a
+batch — (pod, node row) pairs the driver is about to bulk-assume — this
+builds the padded control arrays ops/fold.fold_commit_banks scatters into
+the resident device banks: per-pod request vectors, non-zero scoring
+requests, signature rows, and (for affinity carriers) pattern-count
+triples. Every value comes from the SAME memoized source the host delta
+path reads (state/tensors._req_slot_pairs, oracle.pod_non_zero_request,
+SigBank/PatternBank interning), so the fold is bit-identical to the host
+scatter it replaces.
+
+Signatures/patterns are PRE-interned here (SigBank.prepare_row /
+PatternBank.prepare_pod_rows): the row indices must exist before the fold
+dispatches, and new rows' metadata rides the normal dirty-row patch while
+the counts arrive by fold. Any bank overflow (sig/pattern/key-slot) makes
+plan_fold return None — the caller falls back to the host scatter path
+for the batch and the mirror's next sync rebuilds bigger, exactly as it
+would have anyway.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..oracle.nodeinfo import pod_non_zero_request
+from ..state.tensors import KeySlotOverflow, _bucket, _req_slot_pairs
+
+
+@dataclass
+class FoldProgram:
+    """Padded control arrays for ONE fold_commit_banks dispatch. Index
+    sentinels (row=N, sig=S, pattern=PT) mark padding; the kernel drops
+    out-of-bounds scatters."""
+
+    rows: np.ndarray     # [B] int32 node row
+    req: np.ndarray      # [B, R] int64
+    nz: np.ndarray       # [B, 2] int64
+    cnt: np.ndarray      # [B] int32
+    sig: np.ndarray      # [B] int32
+    pat_row: np.ndarray  # [T] int32
+    pat_col: np.ndarray  # [T] int32
+    pat_cnt: np.ndarray  # [T] int16
+    pods: int            # real (unpadded) commit count
+
+    @property
+    def pat_bucket(self) -> int:
+        return int(self.pat_row.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        """Host→device control bytes this fold ships (the whole wire cost
+        of the batch's bank update)."""
+        return sum(
+            a.nbytes
+            for a in (
+                self.rows, self.req, self.nz, self.cnt, self.sig,
+                self.pat_row, self.pat_col, self.pat_cnt,
+            )
+        )
+
+
+def plan_fold(
+    mirror,
+    pairs: Sequence[Tuple[object, int]],
+    row_bucket: int,
+    pat_bucket: int,
+) -> Optional[FoldProgram]:
+    """Build a FoldProgram for `pairs` = [(pod, node_row)] against
+    `mirror`'s current bank shapes. `row_bucket` must be a ladder rung ≥
+    len(pairs) (the driver's monotone batch bucket); `pat_bucket` is the
+    caller's current pattern-triple rung — grown to the next rung here
+    when the batch carries more pattern instances (the caller keeps the
+    returned program's pat_bucket as its new monotone floor). Returns
+    None on any bank overflow (caller falls back to the host scatter)."""
+    n = len(pairs)
+    if n == 0 or n > row_bucket:
+        return None
+    nodes = mirror.nodes
+    n_cap = nodes.capacity
+    width = nodes.requested.shape[1]
+    s_cap = mirror.eps.capacity
+    p_cap = mirror.pats.capacity
+    rows = np.full(row_bucket, n_cap, np.int32)
+    req = np.zeros((row_bucket, width), np.int64)
+    nz = np.zeros((row_bucket, 2), np.int64)
+    cnt = np.zeros(row_bucket, np.int32)
+    sig = np.full(row_bucket, s_cap, np.int32)
+    triples: List[Tuple[int, int]] = []
+    vocab = mirror.vocab
+    try:
+        for i, (pod, row) in enumerate(pairs):
+            rows[i] = row
+            for s, v in _req_slot_pairs(vocab, pod):
+                if s >= width:
+                    raise KeySlotOverflow()
+                req[i, s] = v
+            c, m = pod_non_zero_request(pod)
+            nz[i, 0] = c
+            nz[i, 1] = m
+            cnt[i] = 1
+            sig[i] = mirror.eps.prepare_row(pod)
+            for prow in mirror.pats.prepare_pod_rows(pod):
+                triples.append((row, prow))
+    except KeySlotOverflow:
+        # covers SigOverflow/PatternOverflow subclasses: the banks are
+        # full — the host path raises the same way and rebuilds bigger
+        return None
+    t_bucket = max(pat_bucket, _bucket(max(len(triples), 1)))
+    pat_row = np.full(t_bucket, n_cap, np.int32)
+    pat_col = np.full(t_bucket, p_cap, np.int32)
+    pat_cnt = np.zeros(t_bucket, np.int16)
+    for j, (prow, pcol) in enumerate(triples):
+        pat_row[j] = prow
+        pat_col[j] = pcol
+        pat_cnt[j] = 1
+    prog = FoldProgram(
+        rows=rows, req=req, nz=nz, cnt=cnt, sig=sig,
+        pat_row=pat_row, pat_col=pat_col, pat_cnt=pat_cnt, pods=n,
+    )
+    return prog

@@ -55,6 +55,11 @@ KIND_PREEMPT = "preempt"
 # commit-plane arbiter (kubernetes_tpu/commit/arbiter.py): rides the same
 # b/u/t/n/v axes as the solve it validates, so its rungs are the solve's
 KIND_ARBITER = "arbiter"
+# resident-state fold (ops/fold.py): b = commit-row bucket (the solve's
+# batch rung), t = pattern-triple bucket, n/r/s/pt = bank capacities. The
+# nominee-overlay variant is the same kind with s=pt=t=0 (it touches only
+# the usage columns — a genuinely different XLA program).
+KIND_FOLD = "fold"
 
 
 @dataclass(frozen=True)

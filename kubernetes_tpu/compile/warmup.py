@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from .ladder import (
     KIND_ARBITER,
     KIND_FILTER,
+    KIND_FOLD,
     KIND_PREEMPT,
     KIND_SOLVE,
     KIND_SOLVE_GANG,
@@ -166,6 +167,8 @@ class WarmupService:
         process can't reconstruct, zero-size axes)."""
         if spec.kind == KIND_PREEMPT:
             return self._warm_preempt(spec)  # no SolveConfig static
+        if spec.kind == KIND_FOLD:
+            return self._warm_fold(spec)  # no SolveConfig static
         if spec.config_repr != repr(self.sched.solve_config):
             return None  # persisted ladder from a differently-policied run
         if not (spec.b and spec.u and spec.t and spec.n and spec.v):
@@ -302,6 +305,52 @@ class WarmupService:
         eb = SigBank(vocab, spec.s, spec.n)
         pb = PatternBank(vocab, spec.pt, spec.n)
         return nb.arrays(), eb.arrays(), pb.arrays()
+
+    def _warm_fold(self, spec: SolveSpec) -> Optional[float]:
+        """ops/fold at the spec's shapes. Always synthetic zero banks —
+        the LIVE resident banks must never be donated into a warm (the
+        drain still needs them). Dtypes mirror the mirror's canonicalized
+        uploads (jnp.asarray of the host banks' numpy dtypes), so the jit
+        cache entry is the one the driver's dispatch hits. Donating
+        freshly built arrays keeps the warmed program the donated one."""
+        if not (spec.b and spec.n and spec.r):
+            return None
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops.fold import fold_commit_banks, fold_usage
+
+        b, n, r = spec.b, spec.n, spec.r
+        req_bank = jnp.asarray(np.zeros((n, r), np.int64))
+        pc_bank = jnp.asarray(np.zeros(n, np.int32))
+        rows = np.full(b, n, np.int32)  # all-padding sentinel lanes
+        t0 = time.perf_counter()
+        if spec.s:  # commit variant (signature + pattern count scatters)
+            if not (spec.t and spec.pt):
+                return None
+            out = fold_commit_banks(
+                req_bank,
+                jnp.asarray(np.zeros((n, 2), np.int64)),
+                pc_bank,
+                jnp.asarray(np.zeros((n, spec.s), np.int16)),
+                jnp.asarray(np.zeros((n, spec.pt), np.int16)),
+                rows,
+                np.zeros((b, r), np.int64),
+                np.zeros((b, 2), np.int64),
+                np.zeros(b, np.int32),
+                np.full(b, spec.s, np.int32),
+                np.full(spec.t, n, np.int32),
+                np.full(spec.t, spec.pt, np.int32),
+                np.zeros(spec.t, np.int16),
+            )
+        else:  # nominee-overlay variant (usage columns only)
+            out = fold_usage(
+                req_bank, pc_bank, rows,
+                np.zeros((b, r), np.int64), np.zeros(b, np.int32),
+            )
+        jax.block_until_ready(out[0])
+        return time.perf_counter() - t0
 
     def _warm_preempt(self, spec: SolveSpec) -> Optional[float]:
         """ops/preempt.preempt_batch at (b=preemptors, n=nodes,

@@ -142,6 +142,23 @@ commit_apply_duration = registry.register(Histogram(
     "clears + bind submission, on the commit-pipeline worker)",
     buckets=_DURATION_BUCKETS,
 ))
+# resident-state plane (kubernetes_tpu/ops/fold): every byte the tensor
+# mirror ships host→device, by transport kind — full bank uploads, dirty
+# node-row scatters, usage-column scatters, and fold control data. On a
+# covered steady-state drain only `fold` (tiny control arrays) should
+# grow; `usage` staying ~0 IS the tentpole's win, as a measured number.
+mirror_bytes_shipped = registry.register(Counter(
+    "scheduler_mirror_bytes_shipped_total",
+    "Host-to-device bank bytes shipped by the tensor mirror, by kind "
+    "(full = whole-bank upload, rows = dirty node-row scatter, usage = "
+    "usage-column scatter, fold = device-fold control data)",
+    label_names=("kind",),
+))
+fold_batches = registry.register(Counter(
+    "scheduler_fold_batches_total",
+    "Commit batches whose state deltas were folded into the resident "
+    "device banks (no host scatter shipped for their rows)",
+))
 
 
 class _Timer:

@@ -1,0 +1,82 @@
+"""Resident-state fold kernels: apply commit deltas to the device banks
+IN PLACE (buffer donation), so a covered batch's solve inputs never make
+the device→host→device round trip.
+
+The mirror's patch path (state/cache.TensorMirror.device_arrays) re-ships
+every dirty row as a host slice + scatter: after a 4096-pod commit batch
+that is ~600 bytes/row of usage columns and signature counts crossing the
+wire — `patch_s`/`fetch_s` seconds per drain on a remote-attached chip.
+But the host applies those SAME deltas as integer adds (NodeBank
+.apply_pod_deltas_bulk, SigBank.apply_adds_bulk, PatternBank.apply_delta)
+— a pure function of tiny control data the host already has at commit
+time. These kernels run that function ON DEVICE instead: ship only the
+control (rows, request vectors, signature indices — a few hundred KB at
+worst), scatter-add into the resident banks, and DONATE the input buffers
+so the tens-of-MB banks are updated in place rather than copied.
+
+Bit-exactness contract: integer adds commute with the dtype truncation
+the upload path applies (two's-complement wrap), and the control values
+come from the exact memoized sources the host delta path reads
+(_req_slot_pairs, pod_non_zero_request, SigBank/PatternBank interning) —
+so a folded row is bit-identical to what the host scatter would have
+shipped. tests/test_fold_plane.py pins this after seeded drains.
+
+Padding discipline: control arrays are padded to ladder buckets with
+OUT-OF-BOUNDS sentinel indices (row = N, sig = S, ...) and mode="drop" —
+padded lanes scatter nowhere, so any bucket executes exactly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+def fold_commit_banks(
+    requested,    # [N, R] node usage matrix (donated)
+    nonzero_req,  # [N, 2] (donated)
+    pod_count,    # [N]    (donated)
+    sig_counts,   # [N, S] SigBank.counts (donated)
+    pat_counts,   # [N, PT] PatternBank.counts (donated)
+    rows,         # [B] int32 node row per commit (sentinel N = pad)
+    req,          # [B, R] request vector per commit (_req_slot_pairs)
+    nz,           # [B, 2] pod_non_zero_request per commit
+    cnt,          # [B] int32 1 per real commit, 0 pad
+    sig,          # [B] int32 signature row per commit (sentinel S = pad)
+    pat_row,      # [T] int32 node row per pattern instance (sentinel N)
+    pat_col,      # [T] int32 pattern row (sentinel PT)
+    pat_cnt,      # [T] int16 instance count (0 pad)
+):
+    """One committed batch folded into the resident banks. Returns the
+    post-commit (requested, nonzero_req, pod_count, sig_counts,
+    pat_counts) — aliased into the donated input buffers by XLA."""
+    requested = requested.at[rows].add(req.astype(requested.dtype), mode="drop")
+    nonzero_req = nonzero_req.at[rows].add(nz.astype(nonzero_req.dtype), mode="drop")
+    pod_count = pod_count.at[rows].add(cnt.astype(pod_count.dtype), mode="drop")
+    sig_counts = sig_counts.at[rows, sig].add(cnt.astype(sig_counts.dtype), mode="drop")
+    pat_counts = pat_counts.at[pat_row, pat_col].add(
+        pat_cnt.astype(pat_counts.dtype), mode="drop"
+    )
+    return requested, nonzero_req, pod_count, sig_counts, pat_counts
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def fold_usage(
+    requested,  # [N, R] (donated)
+    pod_count,  # [N]    (donated)
+    rows,       # [B] int32 node row (sentinel N = pad)
+    vecs,       # [B, R] request vector per entry
+    cnt,        # [B] int32 pod-count delta per entry
+):
+    """Usage-column-only fold (the out-of-batch NOMINEE overlay): adds the
+    nominees' requests to the resident columns in place. Because integer
+    adds are exactly invertible, the caller restores the pristine bank by
+    calling this again with negated vecs/cnt — donation both ways, zero
+    bank copies (the old overlay path copied the entire node-bank dict
+    per dispatch)."""
+    return (
+        requested.at[rows].add(vecs.astype(requested.dtype), mode="drop"),
+        pod_count.at[rows].add(cnt.astype(pod_count.dtype), mode="drop"),
+    )

@@ -43,6 +43,7 @@ from ..commit import (
 from ..compile import CompilePlan, SolveSpec, WarmupService
 from ..compile.ladder import (
     KIND_ARBITER,
+    KIND_FOLD,
     KIND_PREEMPT,
     KIND_SOLVE,
     KIND_SOLVE_GANG,
@@ -513,6 +514,7 @@ class Scheduler:
         mesh=None,
         compile_plan: Optional[CompilePlan] = None,
         commit_plane: bool = True,
+        fold_plane: bool = True,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -625,6 +627,24 @@ class Scheduler:
         self.commit_plane = commit_plane and _os.environ.get(
             "KTPU_COMMIT_PLANE", "1"
         ) != "0"
+        # resident-state plane (ops/fold + commit/fold): covered commits
+        # fold their state deltas into the device banks IN PLACE (buffer
+        # donation) instead of round-tripping them host→device as dirty-
+        # row scatters. Transport-only — scheduling decisions are bit-
+        # identical either way (tests pin this). KTPU_FOLD_PLANE=0 is the
+        # operational kill switch.
+        self.fold_plane = fold_plane and _os.environ.get(
+            "KTPU_FOLD_PLANE", "1"
+        ) != "0"
+        # with the fold plane on, the driver owns the only live reference
+        # to the resident bank dicts (background warms get synthetic
+        # banks), so the mirror's row scatters may donate them too
+        self.mirror.donate_patches = self.fold_plane
+        # monotone pattern-triple bucket for the commit fold's [T] axis
+        # and nominee-row bucket for the overlay fold's [B] axis — ladder
+        # rungs, so each stays one XLA signature as it grows
+        self._fp_bucket = 16
+        self._nom_bucket = 16
         self._commit_pipe = CommitPipeline()
         self._columnar = ColumnarApply(self.cache, self.queue)
         # defer-to-next-batch escalation: a pod deferred this many times
@@ -689,6 +709,57 @@ class Scheduler:
             track_inbatch=False,
         )
 
+    def _fold_spec(self, nominee: bool = False) -> SolveSpec:
+        """The resident-state fold's XLA signature (ops/fold): commit
+        variant at (b = the solve's batch rung, t = pattern-triple rung,
+        bank capacities), nominee-overlay variant at (b = nominee rung)
+        with s=pt=t=0 — it touches only the usage columns."""
+        m = self.mirror
+        r = m.nodes.alloc.shape[1]
+        if nominee:
+            return SolveSpec(
+                kind=KIND_FOLD, b=self._nom_bucket, n=m.nodes.capacity,
+                r=r, config_repr="fold",
+            )
+        return SolveSpec(
+            kind=KIND_FOLD, b=self._b_bucket, t=self._fp_bucket,
+            n=m.nodes.capacity, r=r, s=m.eps.capacity, pt=m.pats.capacity,
+            config_repr="fold",
+        )
+
+    def _dispatch_fold(self, pairs: List[Tuple[Pod, int]]) -> bool:
+        """Fold a committed batch's state deltas into the resident device
+        banks (the resident-state plane's hot path). `pairs` is the FINAL
+        placed set as (pod, node row). Returns True when the fold landed —
+        the caller then tags the matching cache assumes `folded=True` so
+        the mirror skips re-shipping those rows. Any overflow or
+        non-resident bank falls back to the host scatter path silently:
+        the fold is transport, never correctness."""
+        if not self.fold_plane or not self.mirror.can_fold():
+            return False
+        from ..commit.fold import plan_fold
+
+        t0 = time.perf_counter()
+        prog = plan_fold(self.mirror, pairs, self._b_bucket, self._fp_bucket)
+        if prog is None:
+            return False
+        self._fp_bucket = max(self._fp_bucket, prog.pat_bucket)
+        spec = self._fold_spec()
+        known = self.compile_plan.admit(spec)
+        if not self.mirror.fold_commit(prog):
+            return False
+        if not known:
+            self.compile_plan.note_compiled(
+                spec, time.perf_counter() - t0,
+                SOURCE_INLINE if self.compile_plan.warmed else "warmup",
+            )
+        dt = time.perf_counter() - t0
+        self.stats["fold_batches"] = self.stats.get("fold_batches", 0) + 1
+        self.stats["fold_pods"] = self.stats.get("fold_pods", 0) + len(pairs)
+        self.stats["fold_s"] = self.stats.get("fold_s", 0.0) + dt
+        M.fold_batches.inc()
+        return True
+
     def _preempt_spec(self) -> SolveSpec:
         """The device preemption kernel's signature at current cluster
         shape (scheduler/preemption.batch_preempt_device axes, which this
@@ -733,7 +804,15 @@ class Scheduler:
             # the arbiter grows in lockstep with the solve it validates
             specs += lad.growth_specs(self._arbiter_spec(spec.with_carry))
             specs += lad.growth_specs(self._arbiter_spec(not spec.with_carry))
-        self._warm_svc.warm_async(specs, dev)
+        if self.fold_plane and spec.kind == KIND_SOLVE:
+            # the commit fold grows with the banks it scatters into
+            # (sig/pattern capacity, pattern-triple rung)
+            specs += lad.growth_specs(self._fold_spec())
+        # with the fold plane on, the resident bank buffers get DONATED
+        # (folds + row patches): a background warm holding this dispatch's
+        # snapshot would read deleted arrays — hand it nothing and let it
+        # build shape-exact synthetic banks instead
+        self._warm_svc.warm_async(specs, None if self.fold_plane else dev)
 
     # -- device solve --------------------------------------------------------
 
@@ -865,7 +944,11 @@ class Scheduler:
         # residuals). nomination_adds is recorded so consumers can tell
         # whether new nominations appeared after this dispatch.
         nom_adds = self.queue.nomination_adds
-        if self.queue.has_nominations():
+        if self.queue.has_nominations() and carry is None:
+            # (with a carry, apply_carry REPLACES the usage columns with
+            # the chained residuals — which already inherit the previous
+            # dispatch's nominee fold — so overlaying na_dev would be
+            # dead work: skip it entirely)
             from ..state.tensors import _req_slot_pairs
 
             extras = self.queue.nomination_extras({p.key() for p in pods})
@@ -886,7 +969,33 @@ class Scheduler:
                 if ok:
                     rows.append(row)
                     vecs.append(vec)
-            if rows:
+            if rows and self.fold_plane and self.mirror.can_fold():
+                # donated in-place overlay (ops/fold.fold_usage), restored
+                # by the exact integer inverse after the dispatches below
+                # — the old path copied the ENTIRE node-bank dict per
+                # dispatch (XLA copies every passed-through array when
+                # nothing is donated). Monotone rung + plan admission so
+                # it stops showing up as an unplanned signature.
+                self._nom_bucket = max(self._nom_bucket, _bucket(len(rows)))
+                nb = self._nom_bucket
+                pad = nb - len(rows)
+                n_cap = self.mirror.nodes.capacity
+                nspec = self._fold_spec(nominee=True)
+                nknown = self.compile_plan.admit(nspec)
+                t_nf = time.perf_counter()
+                na_dev = self.mirror.fold_nominees(
+                    np.asarray(rows + [n_cap] * pad, np.int32),
+                    np.asarray(vecs + [np.zeros(width, np.int64)] * pad),
+                    np.asarray([1] * len(rows) + [0] * pad, np.int32),
+                )
+                if not nknown:
+                    self.compile_plan.note_compiled(
+                        nspec, time.perf_counter() - t_nf,
+                        SOURCE_INLINE if self.compile_plan.warmed else "warmup",
+                    )
+            elif rows:
+                # fallback overlay (sharded/stale banks, plane off): the
+                # legacy whole-dict copy
                 nb = _bucket(len(rows))
                 pad = nb - len(rows)
                 na_dev = _nominee_fold_fn()(
@@ -1022,6 +1131,11 @@ class Scheduler:
                     time.perf_counter() - t_arb,
                     SOURCE_INLINE if self.compile_plan.warmed else "warmup",
                 )
+        # the nominee overlay's job ends with the dispatches above: fold
+        # it back out (exact integer inverse, donated both ways) so the
+        # resident banks return to mirroring the host before any commit
+        # fold or row patch lands on them
+        self.mirror._restore_nominees()
         self._compile_growth_hook(solve_spec, (na_dev, ea_dev, xp_dev))
         self.stats["batch_specs"] = self.stats.get("batch_specs", 0) + len(reps)
         self.stats["solve_s"] += time.perf_counter() - t1
@@ -1168,13 +1282,34 @@ class Scheduler:
 
                 self._p_bucket = max(self._p_bucket, _bucket(self.batch_size, 8))
                 self._warm_svc.warm_specs([self._preempt_spec()])
+            if self.fold_plane:
+                # resident-state fold programs at the live bank shapes
+                # (foreground, synthetic zero banks — the live banks must
+                # never be donated into a warm). The commit variant rides
+                # the solve's batch rung; the nominee-overlay variant is
+                # warmed across its pow-2 rungs up to 4x batch size, since
+                # outstanding nominations accumulate across batches and
+                # each rung is a trivially cheap two-scatter program.
+                # the nominee variant warms regardless of preemption:
+                # nominations can also arrive from the informer (a pod
+                # with nominatedNodeName left by a prior incarnation), and
+                # an unwarmed rung is a mid-drain inline compile
+                from dataclasses import replace
+
+                fold_specs = [self._fold_spec()]
+                nom = self._fold_spec(nominee=True)
+                b, cap = 16, _bucket(self.batch_size * 4)
+                while b <= cap:
+                    fold_specs.append(replace(nom, b=b))
+                    b *= 2
+                self._warm_svc.warm_specs(fold_specs)
             if infos:
                 # headroom: compile the next growth rung of each mid-drain-
                 # growable axis in the background while the drain starts —
                 # both carry variants (fresh solve + speculative chain).
                 # The commit arbiter grows in lockstep (its live-shape
                 # programs were warmed by the peeked dispatches above).
-                dev = self.mirror.device_arrays()
+                dev = None if self.fold_plane else self.mirror.device_arrays()
                 for wc in ((False, True) if self.speculate else (False,)):
                     spec = self._solve_spec(gang=False, with_carry=wc)
                     specs = plan.ladder.growth_specs(spec)
@@ -1183,6 +1318,10 @@ class Scheduler:
                             self._arbiter_spec(wc)
                         )
                     self._warm_svc.warm_async(specs, dev)
+                if self.fold_plane:
+                    self._warm_svc.warm_async(
+                        plan.ladder.growth_specs(self._fold_spec()), None
+                    )
             plan.mark_warmed()
             plan.persist()
             self._aot_enabled = True
@@ -1804,6 +1943,7 @@ class Scheduler:
         escalate: List[Tuple[int, PodInfo]] = []
         preempt_fails: List[PodInfo] = []
         pairs: List[Tuple[Pod, object]] = []
+        fold_pairs: List[Tuple[Pod, int]] = []
         any_anti_port = False
         nofit = 0
         known_rejects = 0
@@ -1836,6 +1976,7 @@ class Scheduler:
                     continue
                 place.append((info, node_name))
                 pairs.append((pod, ni.node))
+                fold_pairs.append((pod, row))
                 if bool(out.has_anti[i]) or pod.host_ports():
                     any_anti_port = True
             elif v == V_DEFER:
@@ -1897,6 +2038,10 @@ class Scheduler:
                     (pod, node) for pod, node in pairs
                     if pod.key() not in known
                 ]
+                fold_pairs = [
+                    (pod, row) for pod, row in fold_pairs
+                    if pod.key() not in known
+                ]
         res.scheduled += len(place)
         assignments = res.assignments
         for info, node_name in place:
@@ -1906,7 +2051,14 @@ class Scheduler:
         # cache/queue/mirror (schedule_batch head, preemption below)
         lazy = LazyConflictIndex(pairs) if any_anti_port else None
         if place:
-            self._submit_columnar(place, cycle, lazy)
+            # RESIDENT-STATE FOLD: the placed set's deltas land in the
+            # device banks now (donated scatter-adds), the worker's bulk
+            # assume is tagged `folded`, and the mirror skips re-shipping
+            # those rows — a covered batch's solve inputs never cross the
+            # wire. Late assume rejects (informer race) are corrected by
+            # the worker via note_failed_fold (host-wins row re-ship).
+            folded = self._dispatch_fold(fold_pairs)
+            self._submit_columnar(place, cycle, lazy, folded=folded)
         self.stats["arbiter_batches"] = self.stats.get("arbiter_batches", 0) + 1
         self.stats["arbiter_place"] = self.stats.get("arbiter_place", 0) + len(place)
         self.stats["arbiter_defer"] = self.stats.get("arbiter_defer", 0) + len(defers)
@@ -1950,7 +2102,7 @@ class Scheduler:
 
     def _submit_columnar(
         self, place: List[Tuple[PodInfo, str]], cycle: int,
-        lazy: Optional[LazyConflictIndex],
+        lazy: Optional[LazyConflictIndex], folded: bool = False,
     ) -> None:
         """Hand a batch's bulk apply to the commit-pipeline worker: one
         cache assume + nomination clears + chunked lean-bind submissions.
@@ -1963,7 +2115,7 @@ class Scheduler:
         workers = self._bind_workers
 
         def apply_batch() -> None:
-            result = columnar.apply(place)
+            result = columnar.apply(place, folded=folded)
             M.commit_apply_duration.observe(result.seconds)
             self.stats["apply_s"] = (
                 self.stats.get("apply_s", 0.0) + result.seconds
@@ -1980,7 +2132,7 @@ class Scheduler:
                     bind_pool.submit(
                         self._lean_bind_chunk, items[i : i + step], cycle
                     )
-            for info, _node in result.rejected:
+            for info, node in result.rejected:
                 # a pod key already in the cache means a double-schedule
                 # upstream; count loudly and fail it like assume_pod's
                 # ValueError path (the chain's mutation-count equality
@@ -1988,6 +2140,11 @@ class Scheduler:
                 self.stats["apply_rejects"] = (
                     self.stats.get("apply_rejects", 0) + 1
                 )
+                if folded:
+                    # its fold lane landed on device with no host delta to
+                    # match: queue the row for a host-wins re-ship (the
+                    # driver drains this worker before its next sync)
+                    self.mirror.note_failed_fold(node)
                 self._fail(info, cycle, "already assumed")
             if lazy is not None:
                 lazy.materialize()
@@ -2309,6 +2466,7 @@ class Scheduler:
         if fast_bulk:
             name_of = self.mirror.name_of_row
             assumed_meta: List[Tuple[PodInfo, Pod, str]] = []
+            fold_rows: List[int] = []
             fail = self._fail
             perf = time.perf_counter
             for i, row in enumerate(assign_l):
@@ -2326,9 +2484,24 @@ class Scheduler:
                     fail(info, cycle, "no fit")
                     continue
                 assumed_meta.append((info, info.pod.with_node(node_name), node_name))
-            rejected = set(
-                self.cache.assume_pods([m[1] for m in assumed_meta])
+                fold_rows.append(row)
+            # RESIDENT-STATE FOLD: this batch's usage/signature deltas go
+            # straight into the device banks (donated scatter-adds) — the
+            # matching assumes below are tagged `folded` so the mirror
+            # never re-ships their rows. Dispatched BEFORE the assume so
+            # any reject (informer race) is corrected by a host-wins
+            # re-ship of its row, never by a device state we can't undo.
+            folded = bool(assumed_meta) and self._dispatch_fold(
+                [(m[0].pod, r) for m, r in zip(assumed_meta, fold_rows)]
             )
+            rejected = set(
+                self.cache.assume_pods(
+                    [m[1] for m in assumed_meta], folded=folded
+                )
+            )
+            if folded:
+                for j in rejected:
+                    self.mirror.note_failed_fold(assumed_meta[j][2])
             if self.queue.has_nominations():
                 # DeleteNominatedPodIfExists at assume time (scheduler.go:
                 # 529), batched — committed pods stop reserving their

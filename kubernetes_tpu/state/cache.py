@@ -44,6 +44,7 @@ from .terms import PatternBank, PatternOverflow
 DEFAULT_ASSUME_TTL = 30.0  # cache.go durationToExpireAssumedPod (30s default)
 
 _ROW_SCATTER = None
+_ROW_SCATTER_DONATED = None
 
 
 def _row_scatter_fn():
@@ -63,6 +64,29 @@ def _row_scatter_fn():
 
         _ROW_SCATTER = scatter
     return _ROW_SCATTER
+
+
+def _row_scatter_donated_fn():
+    """The same row-scatter with the resident bank DONATED: updated arrays
+    scatter in place and untouched arrays alias straight through — the
+    tens-of-MB banks stop being copied per patch. Only used when the
+    driver enables it (TensorMirror.donate_patches): donation deletes the
+    caller's input arrays, so every other holder of the bank dicts (e.g.
+    warmup snapshots) must have been cut over to synthetic banks first."""
+    global _ROW_SCATTER_DONATED
+    if _ROW_SCATTER_DONATED is None:
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def scatter(dev, idx, updates):
+            out = dict(dev)
+            for k, u in updates.items():
+                out[k] = dev[k].at[idx].set(u)
+            return out
+
+        _ROW_SCATTER_DONATED = scatter
+    return _ROW_SCATTER_DONATED
 
 
 @dataclass
@@ -88,9 +112,14 @@ class SchedulerCache:
         # bumped on every snapshot mutation — the driver's speculative
         # pipeline uses it to detect state changes it did not account for
         self.mutation_count = 0
-        # (node, pod, ±1) single-pod changes (assume/confirm/remove) — the
-        # overwhelmingly common event; consumed by TensorMirror.sync
-        self.pod_deltas: List[Tuple[str, Pod, int]] = []
+        # (node, pod, ±1, folded) single-pod changes (assume/confirm/
+        # remove) — the overwhelmingly common event; consumed by
+        # TensorMirror.sync. `folded` marks adds whose usage/count deltas
+        # were ALREADY applied to the resident device banks by a commit
+        # fold (ops/fold) — sync applies them to the host arrays exactly
+        # the same, but records their rows as device-folded so
+        # device_arrays() does not re-ship what the device already has.
+        self.pod_deltas: List[Tuple[str, Pod, int, bool]] = []
         # zone-interleaved iteration (internal/cache/node_tree.go) for the
         # host-side placement loops' tie distribution
         from .node_tree import NodeTree
@@ -102,7 +131,7 @@ class SchedulerCache:
     def _node_info(self, name: str) -> Optional[NodeInfo]:
         return self.snapshot.get(name)
 
-    def _add_pod_to_node(self, pod: Pod) -> None:
+    def _add_pod_to_node(self, pod: Pod, folded: bool = False) -> None:
         ni = self.snapshot.get(pod.node_name)
         if ni is None:
             # pod on an unknown node: track headlessly (reference keeps an
@@ -118,7 +147,7 @@ class SchedulerCache:
         # single-pod change: a DELTA, not node dirt — the mirror patches the
         # node row + signature/pattern counts in O(1) instead of re-counting
         # every pod on the node
-        self._push_delta(pod.node_name, pod, 1)
+        self._push_delta(pod.node_name, pod, 1, folded)
 
     def _remove_pod_from_node(self, pod: Pod) -> None:
         ni = self.snapshot.get(pod.node_name)
@@ -129,17 +158,19 @@ class SchedulerCache:
             self.mutation_count += 1
             self._push_delta(pod.node_name, removed, -1)
 
-    def _push_delta(self, name: str, pod: Pod, sign: int) -> None:
+    def _push_delta(self, name: str, pod: Pod, sign: int, folded: bool = False) -> None:
         # bounded: with no mirror attached (or one that syncs rarely) the
         # delta log must not pin every churned Pod forever — past the bound,
-        # collapse it into the node-count-bounded dirty set
+        # collapse it into the node-count-bounded dirty set (a re-encoded
+        # node row ships fully, so collapsed FOLDED deltas stay correct:
+        # host wins the whole row)
         if len(self.pod_deltas) >= max(1024, 4 * len(self.snapshot.node_infos)):
-            for n, _, _ in self.pod_deltas:
+            for n, _, _, _ in self.pod_deltas:
                 self.dirty_nodes.add(n)
             self.pod_deltas.clear()
             self.dirty_nodes.add(name)
             return
-        self.pod_deltas.append((name, pod, sign))
+        self.pod_deltas.append((name, pod, sign, folded))
 
     # -- assumed pod state machine (cache.go:270-388) ------------------------
 
@@ -153,12 +184,16 @@ class SchedulerCache:
             self._assumed.add(key)
             self._add_pod_to_node(pod)
 
-    def assume_pods(self, pods: List[Pod]) -> List[int]:
+    def assume_pods(self, pods: List[Pod], folded: bool = False) -> List[int]:
         """Bulk AssumePod under ONE lock (the per-pod RLock round-trip was
         a measurable slice of the commit loop at 4096-pod batches). Returns
         the indices of pods REJECTED because their key is already in the
         cache — the caller fails those individually (assume_pod's
-        ValueError, per pod)."""
+        ValueError, per pod). `folded=True` tags the pushed deltas as
+        already device-folded (resident-state plane) — the caller must
+        have dispatched the matching fold_commit, and must report any
+        REJECTED index's node via TensorMirror.note_failed_fold (its fold
+        lane landed on device but no delta will reach the host)."""
         rejected: List[int] = []
         with self._lock:
             states = self._pod_states
@@ -170,7 +205,7 @@ class SchedulerCache:
                     continue
                 states[key] = _PodState(pod=pod, assumed=True)
                 assumed.add(key)
-                self._add_pod_to_node(pod)
+                self._add_pod_to_node(pod, folded)
         return rejected
 
     def finish_binding(self, pod: Pod) -> None:
@@ -390,6 +425,37 @@ class TensorMirror:
         # usage rows whose delta pod carried (anti-)affinity terms: only
         # those change the pattern-count matrix
         self._pending_pat_rows: Set[int] = set()
+        # --- resident-state plane (ops/fold, commit/fold) ---------------
+        # rows whose deltas since the last upload were applied ON DEVICE
+        # by a commit fold: device == host for those rows already, so
+        # device_arrays() must NOT re-ship them. A row appearing in BOTH a
+        # folded and a pending set ships anyway — the host scatter is a
+        # full-value overwrite, so host always wins on overlap.
+        self._folded_usage_rows: Set[int] = set()
+        self._folded_pat_rows: Set[int] = set()
+        # device-fold generation tag: how many folds the resident banks
+        # carry beyond `device_generation` (the host sync generation the
+        # last full/row upload reflected). Purely observational — the row
+        # sets above are the operative bookkeeping.
+        self.fold_count = 0
+        self.folds_undonated = 0  # folds whose donation silently copied
+        self.device_generation = 0
+        # nominee overlay in flight: (rows, vecs, cnt) to fold back out
+        # (integer adds are exactly invertible). Every resident-bank
+        # consumer calls _restore_nominees() first, so a caller that died
+        # between fold and unfold cannot leave the banks corrupted.
+        self._nominee_overlay = None
+        # fold lanes whose cache assume was REJECTED after dispatch (the
+        # informer race): their node rows must re-ship from host. Appended
+        # by the commit worker (list.append is atomic); drained by sync(),
+        # which the driver only runs after the commit pipeline settles.
+        self._failed_fold_names: List[str] = []
+        # host→device traffic ledger, by kind (full|rows|usage|fold) —
+        # also exported as scheduler_mirror_bytes_shipped_total
+        self.bytes_shipped: Dict[str, int] = {}
+        # the driver opts patches into buffer donation once it owns the
+        # only live reference to the bank dicts (fold plane on)
+        self.donate_patches = False
         self._rebuild()
 
     def reserve(self, n_nodes: int, n_pods: int = 0) -> None:
@@ -449,6 +515,10 @@ class TensorMirror:
         self._pending_node_rows.clear()
         self._pending_usage_rows.clear()
         self._pending_pat_rows.clear()
+        self._folded_usage_rows.clear()
+        self._folded_pat_rows.clear()
+        self._failed_fold_names.clear()
+        self._nominee_overlay = None  # donated buffers are gone with the banks
         self.eps.dirty_sig_rows.clear()
         self.pats.dirty_pattern_rows.clear()
         self.generation = 0
@@ -493,7 +563,18 @@ class TensorMirror:
         (O(1) each — no per-node re-count). Returns True if a full rebuild
         happened (device arrays change shape → recompile)."""
         cache = self.cache
+        self._restore_nominees()
         with cache._lock:
+            # fold lanes whose assume was rejected after dispatch: the
+            # device rows carry phantom deltas the host never applied —
+            # force those rows back onto the host-wins patch path
+            if self._failed_fold_names:
+                names, self._failed_fold_names = self._failed_fold_names, []
+                for nm in names:
+                    row = self.row_of.get(nm)
+                    if row is not None:
+                        self._pending_usage_rows.add(row)
+                        self._pending_pat_rows.add(row)
             dirty = set(cache.dirty_nodes)
             removed = set(cache.removed_nodes)
             deltas = list(cache.pod_deltas)
@@ -559,6 +640,7 @@ class TensorMirror:
                 bulk_rows: List[int] = []
                 bulk_pods: List[Pod] = []
                 bulk_held: List[Dict[int, int]] = []
+                bulk_folded: List[bool] = []
 
                 def flush_bulk() -> None:
                     if not bulk_pods:
@@ -566,12 +648,18 @@ class TensorMirror:
                     rows_arr = np.asarray(bulk_rows, np.int64)
                     self.eps.apply_adds_bulk(rows_arr, bulk_pods, bulk_held)
                     self.nodes.apply_pod_deltas_bulk(rows_arr, bulk_pods)
-                    self._pending_usage_rows.update(bulk_rows)
+                    # device-FOLDED adds already live in the resident
+                    # banks: their rows go to the folded set (skipped at
+                    # upload) instead of the pending set (shipped)
+                    for r, f in zip(bulk_rows, bulk_folded):
+                        (self._folded_usage_rows if f
+                         else self._pending_usage_rows).add(r)
                     bulk_rows.clear()
                     bulk_pods.clear()
                     bulk_held.clear()
+                    bulk_folded.clear()
 
-                for name, pod, sign in deltas:
+                for name, pod, sign, folded in deltas:
                     if name in reencoded or name not in self.row_of:
                         continue
                     row = self.row_of[name]
@@ -583,20 +671,31 @@ class TensorMirror:
                         bulk_rows.append(row)
                         bulk_pods.append(pod)
                         bulk_held.append(self._node_sigs.setdefault(name, {}))
+                        bulk_folded.append(folded)
                         continue
                     flush_bulk()
                     self.eps.apply_delta(
                         row, pod, sign, self._node_sigs.setdefault(name, {})
                     )
+                    # only ADDS fold (commits); a folded flag on anything
+                    # else is ignored — the pending (host-wins) path is
+                    # always safe
+                    f = folded and sign > 0
                     if pod_has_affinity_constraints(pod):
                         self.pats.apply_delta(
                             row, pod, sign, self._node_pats.setdefault(name, {})
                         )
-                        self._pending_pat_rows.add(row)
+                        (self._folded_pat_rows if f
+                         else self._pending_pat_rows).add(row)
                     self.nodes.apply_pod_delta(row, pod, sign)
                     if pod.host_ports():
+                        # the port table changed too (list-shaped, not
+                        # foldable): the full-row refresh below ships the
+                        # row — host wins regardless of the fold
                         ports_dirty.add(name)
-                    self._pending_usage_rows.add(row)
+                        f = False
+                    (self._folded_usage_rows if f
+                     else self._pending_usage_rows).add(row)
                 flush_bulk()
                 # ported pods and fallback rows: the port table is a sorted
                 # list snapshot — refresh those nodes fully (rare)
@@ -644,11 +743,16 @@ class TensorMirror:
 
     def device_arrays(self):
         """(nodes, eps, pats) as DEVICE-resident dicts, patched with only
-        the rows sync() touched since the last call. Full upload only after
-        a rebuild (shape change) — otherwise each changed array ships one
-        [rows, ...] slice + scatter."""
+        the rows sync() touched since the last call — MINUS the rows a
+        commit fold already applied on device (the resident-state plane:
+        a covered steady-state batch ships nothing here at all). Full
+        upload only after a rebuild (shape change) — otherwise each
+        changed array ships one [rows, ...] slice + scatter; with
+        `donate_patches` the resident buffers are donated into the
+        scatter, so the banks update in place instead of being copied."""
         import jax.numpy as jnp
 
+        self._restore_nominees()
         host_n = self.nodes.arrays()
         host_e = self.eps.arrays()
         host_p = self.pats.arrays()
@@ -660,22 +764,34 @@ class TensorMirror:
             self._dev_pats = {
                 k: self._to_dev(v, k == "counts") for k, v in host_p.items()
             }
+            self._ship("full", sum(
+                _nbytes(v)
+                for d in (host_n, host_e, host_p)
+                for v in d.values()
+            ))
             self._device_stale = False
             self._image_stale = False
             self._pending_node_rows.clear()
             self._pending_usage_rows.clear()
             self._pending_pat_rows.clear()
+            self._folded_usage_rows.clear()
+            self._folded_pat_rows.clear()
+            self.fold_count = 0
+            self.device_generation = getattr(self, "generation", 0)
             self.eps.dirty_sig_rows.clear()
             self.pats.dirty_pattern_rows.clear()
             return self._dev_nodes, self._dev_eps, self._dev_pats
 
         import numpy as _np
 
-        scatter = _row_scatter_fn()
+        scatter = (
+            _row_scatter_donated_fn() if self.donate_patches
+            else _row_scatter_fn()
+        )
 
         import jax.dtypes
 
-        def patch(dev: Dict, host: Dict, rows: List[int], skip=()) -> Dict:
+        def patch(dev: Dict, host: Dict, rows: List[int], skip=(), kind="rows") -> Dict:
             # full re-upload for new/resized arrays (rare: vocab growth);
             # compare against the CANONICALIZED dtype — with x64 disabled
             # jnp.asarray downcasts int64 host banks to int32 on device, and
@@ -697,6 +813,7 @@ class TensorMirror:
                     k: self._to_dev(v, host is host_n or k == "counts")
                     for k, v in changed.items()
                 })
+                self._ship("full", sum(_nbytes(v) for v in changed.values()))
             if not rows:
                 return dev
             cap = next(iter(host.values())).shape[0]
@@ -708,11 +825,15 @@ class TensorMirror:
             padded = list(rows[:rb]) + [rows[0]] * max(rb - len(rows), 0)
             idx = _np.asarray(padded, _np.int32)
             updates = {k: _np.ascontiguousarray(h[idx]) for k, h in host.items()}
+            self._ship(kind, idx.nbytes + sum(u.nbytes for u in updates.values()))
             return scatter(dev, jnp.asarray(idx), updates)
 
         nrows = sorted(self._pending_node_rows)
         # usage-only rows (post-commit deltas): only 3 node arrays + the
-        # banks' count matrices changed — ship those, not the whole row set
+        # banks' count matrices changed — ship those, not the whole row
+        # set. Rows whose deltas were ALL device-folded appear in neither
+        # set and ship NOTHING: device == host there by construction
+        # (host wins any overlap — the scatter is a full-value overwrite).
         urows = sorted(self._pending_usage_rows - self._pending_node_rows)
         crows = sorted(self._pending_usage_rows | self._pending_node_rows)
         srows = sorted(self.eps.dirty_sig_rows)
@@ -723,7 +844,7 @@ class TensorMirror:
             usage_host = {
                 k: host_n[k] for k in ("requested", "nonzero_req", "pod_count")
             }
-            self._dev_nodes = patch(self._dev_nodes, usage_host, urows)
+            self._dev_nodes = patch(self._dev_nodes, usage_host, urows, kind="usage")
         self._image_stale = False
 
         # the eps/pats dicts have TWO row spaces each: metadata ([S]/[PT]-
@@ -745,11 +866,167 @@ class TensorMirror:
         self._pending_node_rows.clear()
         self._pending_usage_rows.clear()
         self._pending_pat_rows.clear()
+        # folded rows are settled: the fold applied them, and any overlap
+        # with the pending sets just shipped host truth over them
+        self._folded_usage_rows.clear()
+        self._folded_pat_rows.clear()
+        self.fold_count = 0
+        self.device_generation = getattr(self, "generation", 0)
         self.eps.dirty_sig_rows.clear()
         self.pats.dirty_pattern_rows.clear()
         return self._dev_nodes, self._dev_eps, self._dev_pats
+
+    # -- resident-state plane (ops/fold + commit/fold) ----------------------
+
+    def _ship(self, kind: str, nbytes: int) -> None:
+        """Account host→device bank traffic (satellite of the fold plane:
+        the win must be a measured byte count, not just patch_s)."""
+        self.bytes_shipped[kind] = self.bytes_shipped.get(kind, 0) + int(nbytes)
+        try:
+            from ..metrics import metrics as M
+
+            M.mirror_bytes_shipped.inc(kind, by=int(nbytes))
+        except Exception:  # pragma: no cover - metrics must never break sync
+            pass
+
+    def can_fold(self) -> bool:
+        """Device banks resident, current-shaped, and single-device: the
+        preconditions for folding commits in place. Sharded banks
+        (set_mesh) keep the host scatter path — the fold's donation
+        contract is per-buffer and the sharded pipeline re-dispatches
+        through its own partitioner."""
+        return (
+            self._dev_nodes is not None
+            and not self._device_stale
+            and getattr(self, "_mesh", None) is None
+        )
+
+    def fold_commit(self, prog) -> bool:
+        """Apply a planned commit fold (commit/fold.FoldProgram) to the
+        resident banks with buffer donation. Returns False when the banks
+        are not foldable right now (caller falls back to the host scatter
+        path — correctness never depends on the fold landing). On a raise
+        mid-dispatch the banks' state is unknown → full re-upload heals."""
+        self._restore_nominees()
+        if not self.can_fold():
+            return False
+        from ..ops.fold import fold_commit_banks
+
+        n, e, p = self._dev_nodes, self._dev_eps, self._dev_pats
+        donated = (
+            n["requested"], n["nonzero_req"], n["pod_count"],
+            e["counts"], p["counts"],
+        )
+        try:
+            req_d, nz_d, pc_d, ec_d, xc_d = fold_commit_banks(
+                *donated,
+                prog.rows, prog.req, prog.nz, prog.cnt, prog.sig,
+                prog.pat_row, prog.pat_col, prog.pat_cnt,
+            )
+        except Exception:
+            self._device_stale = True
+            raise
+        self._dev_nodes = {
+            **n, "requested": req_d, "nonzero_req": nz_d, "pod_count": pc_d,
+        }
+        self._dev_eps = {**e, "counts": ec_d}
+        self._dev_pats = {**p, "counts": xc_d}
+        self.fold_count += 1
+        if any(not a.is_deleted() for a in donated):
+            # a dropped donation is silent in XLA: the fold still lands,
+            # but that bank was COPIED (double HBM + hidden memcpy) —
+            # the counts matrices are the largest and likeliest to hit an
+            # aliasing restriction, so every donated input is checked.
+            # Counted so perf_smoke can assert it never happens.
+            self.folds_undonated += 1
+        self._ship("fold", prog.nbytes)
+        return True
+
+    def note_failed_fold(self, node_name: str) -> None:
+        """A fold lane's cache assume was rejected AFTER the fold
+        dispatched (informer race): the device row carries a delta the
+        host never applied. Queue the row for a host-wins re-ship at the
+        next sync. Callers (the commit worker) run strictly before the
+        driver's next pipeline drain → sync, so the plain append is safe."""
+        self._failed_fold_names.append(node_name)
+
+    def fold_nominees(self, rows: np.ndarray, vecs: np.ndarray, cnt: np.ndarray):
+        """Overlay out-of-batch nominees' requests onto the resident usage
+        columns IN PLACE (donation) — the nominee accounting of
+        podFitsOnNode pass 1, without the full-bank copy the old jitted
+        overlay paid per dispatch. The overlay is recorded and folded back
+        out by unfold_nominees (integer adds invert exactly); every other
+        resident-bank consumer restores it defensively first."""
+        from ..ops.fold import fold_usage
+
+        self._restore_nominees()
+        n = self._dev_nodes
+        try:
+            req_d, pc_d = fold_usage(n["requested"], n["pod_count"], rows, vecs, cnt)
+        except Exception:
+            self._device_stale = True
+            raise
+        self._dev_nodes = {**n, "requested": req_d, "pod_count": pc_d}
+        self._nominee_overlay = (rows, vecs, cnt)
+        self._ship("fold", rows.nbytes + vecs.nbytes + cnt.nbytes)
+        return self._dev_nodes
+
+    def unfold_nominees(self) -> None:
+        """Fold the nominee overlay back out (exact integer inverse)."""
+        overlay = self._nominee_overlay
+        if overlay is None:
+            return
+        from ..ops.fold import fold_usage
+
+        rows, vecs, cnt = overlay
+        self._nominee_overlay = None
+        n = self._dev_nodes
+        try:
+            req_d, pc_d = fold_usage(n["requested"], n["pod_count"], rows, -vecs, -cnt)
+        except Exception:
+            self._device_stale = True
+            raise
+        self._dev_nodes = {**n, "requested": req_d, "pod_count": pc_d}
+        self._ship("fold", rows.nbytes + vecs.nbytes + cnt.nbytes)
+
+    def _restore_nominees(self) -> None:
+        if self._nominee_overlay is not None:
+            self.unfold_nominees()
+
+    def device_bank_divergence(self) -> List[str]:
+        """Names of device-resident arrays that are NOT bit-identical to
+        the host banks (after dtype canonicalization — the upload path's
+        own truncation). Empty list = the resident-state plane is exact.
+        This is the parity probe the fold test suite and perf_smoke use;
+        it fetches the full banks, so it is a debug/verification API, not
+        a hot-path one."""
+        self._restore_nominees()
+        out: List[str] = []
+        if self._dev_nodes is None:
+            return out
+        for label, dev, host in (
+            ("nodes", self._dev_nodes, self.nodes.arrays()),
+            ("eps", self._dev_eps, self.eps.arrays()),
+            ("pats", self._dev_pats, self.pats.arrays()),
+        ):
+            for k, h in host.items():
+                d = dev.get(k)
+                if d is None:
+                    out.append(f"{label}.{k}:missing")
+                    continue
+                dn = np.asarray(d)
+                if dn.shape != h.shape or not np.array_equal(
+                    dn, np.asarray(h).astype(dn.dtype)
+                ):
+                    out.append(f"{label}.{k}")
+        return out
 
     def node_name_of_row(self, row: int) -> Optional[str]:
         if 0 <= row < len(self.name_of_row):
             return self.name_of_row[row]
         return None
+
+
+def _nbytes(v) -> int:
+    a = np.asarray(v)
+    return a.nbytes

@@ -1033,6 +1033,20 @@ class SigBank:
             self.dirty_sig_rows.add(sig)
         return sig
 
+    def prepare_row(self, pod: Pod) -> int:
+        """Intern a pod's signature WITHOUT taking a reference — the
+        device-fold planner (commit/fold.py) needs the row index at commit
+        time, BEFORE the commit deltas reach the mirror's sync(). The later
+        apply_delta/apply_adds_bulk intern of the same pod is a guaranteed
+        hit on this row (content-keyed, grow-only vocab), and a freshly
+        allocated row with zero refs is never freed by _unref (no holder
+        can release it), so pre-interning is safe. New rows land in
+        dirty_sig_rows so their metadata ships via the normal dirty-row
+        patch while the COUNTS arrive by device fold. Raises
+        SigOverflow/KeySlotOverflow exactly like _intern (the caller skips
+        the fold and falls back to the host scatter path)."""
+        return self._intern(pod)
+
     def _unref(self, sig: int, n: int) -> None:
         self._refs[sig] -= n
         if self._refs[sig] <= 0:

@@ -409,6 +409,18 @@ class PatternBank:
             self.dirty_pattern_rows.add(row)
         return row
 
+    def prepare_pod_rows(self, pod: Pod) -> List[int]:
+        """Intern one pod's term patterns WITHOUT taking references — the
+        device-fold planner's counterpart of SigBank.prepare_row: the
+        returned rows are where the later apply_delta will count this pod,
+        so the device fold can scatter the counts ahead of the host sync.
+        Raises PatternOverflow/KeySlotOverflow like _intern (caller skips
+        the fold for the batch)."""
+        return [
+            self._intern(kind, topo, sel, nss, w)
+            for kind, topo, sel, nss, w in self._pod_patterns(pod)
+        ]
+
     def _unref(self, row: int, n: int) -> None:
         self._refs[row] -= n
         if self._refs[row] <= 0:

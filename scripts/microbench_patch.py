@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""A/B microbench: dirty-row scatter-patch vs donated device fold.
+
+Measures the two transports for one commit batch's bank update at the
+ladder's row buckets:
+
+  A (scatter) — the mirror's legacy patch path: gather the dirty rows'
+    host slices (requested/nonzero_req/pod_count + signature counts),
+    ship them, and `.at[idx].set(...)` into the banks — per-row bytes
+    proportional to R + S.
+  B (fold)    — the resident-state plane: ship only the per-commit
+    control vectors and `.at[rows].add(...)` with BUFFER DONATION —
+    banks updated in place, nothing row-shaped crosses the wire.
+
+Timing discipline matches the other microbenches: trials interleave
+A/B/A/B (drift hits both alike), and each trial runs a DATA-DEPENDENT
+CHAIN — every call consumes the previous call's output bank, so async
+dispatch can't overlap what we're trying to time — closed with one
+block_until_ready.
+
+Run: python scripts/microbench_patch.py [n_nodes] [sig_slots]
+Smoke (tier-1, via tests/test_fold_plane.py): main(smoke=True) — tiny
+shapes, asserts A/B produce bit-identical banks and returns the table.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", ""))
+
+import numpy as np
+
+
+def _mk_banks(n, r, s, jnp):
+    return {
+        "requested": jnp.asarray(np.zeros((n, r), np.int64)),
+        "nonzero_req": jnp.asarray(np.zeros((n, 2), np.int64)),
+        "pod_count": jnp.asarray(np.zeros(n, np.int32)),
+        "counts": jnp.asarray(np.zeros((n, s), np.int16)),
+    }
+
+
+def _mk_batch(rng, rows_b, n, r, s):
+    """One commit batch's control data at row bucket rows_b."""
+    rows = rng.integers(0, n, rows_b).astype(np.int32)
+    req = rng.integers(0, 1000, (rows_b, r)).astype(np.int64)
+    nz = rng.integers(0, 1000, (rows_b, 2)).astype(np.int64)
+    cnt = np.ones(rows_b, np.int32)
+    sig = rng.integers(0, s, rows_b).astype(np.int32)
+    return rows, req, nz, cnt, sig
+
+
+def main(smoke: bool = False):
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 and not smoke else (64 if smoke else 4096)
+    s = int(sys.argv[2]) if len(sys.argv) > 2 and not smoke else (64 if smoke else 256)
+    r = 8
+    buckets = (16, 64) if smoke else (64, 256, 1024, 4096)
+    trials = 3 if smoke else 10
+    chain = 4 if smoke else 16
+
+    # A: the mirror's row scatter (no donation — the legacy transport)
+    @jax.jit
+    def scatter_patch(bank, idx, updates):
+        out = dict(bank)
+        for k, u in updates.items():
+            out[k] = bank[k].at[idx].set(u)
+        return out
+
+    # B: the fold (donated adds — ops/fold.fold_commit_banks's shape,
+    # inlined here so the bench is self-contained over one bank dict)
+    @partial(jax.jit, donate_argnums=(0,))
+    def fold_patch(bank, rows, req, nz, cnt, sig):
+        return {
+            "requested": bank["requested"].at[rows].add(
+                req.astype(bank["requested"].dtype), mode="drop"),
+            "nonzero_req": bank["nonzero_req"].at[rows].add(
+                nz.astype(bank["nonzero_req"].dtype), mode="drop"),
+            "pod_count": bank["pod_count"].at[rows].add(
+                cnt.astype(bank["pod_count"].dtype), mode="drop"),
+            "counts": bank["counts"].at[rows, sig].add(
+                cnt.astype(bank["counts"].dtype), mode="drop"),
+        }
+
+    rng = np.random.default_rng(0)
+    results = []
+    for rows_b in buckets:
+        rb = min(rows_b, n)
+        batches = [_mk_batch(rng, rb, n, r, s) for _ in range(chain)]
+
+        def host_apply(host, batch):
+            rows, req, nz, cnt, sig = batch
+            np.add.at(host["requested"], rows, req)
+            np.add.at(host["nonzero_req"], rows, nz)
+            np.add.at(host["pod_count"], rows, cnt)
+            np.add.at(host["counts"], (rows, sig), cnt.astype(np.int16))
+
+        def run_scatter():
+            """Host-apply then ship the dirty rows — the legacy cycle."""
+            bank = _mk_banks(n, r, s, jnp)
+            host = {k: np.asarray(v).copy() for k, v in bank.items()}
+            t0 = None
+            for batch in batches:
+                host_apply(host, batch)
+                rows = np.unique(batch[0])
+                idx = jnp.asarray(rows.astype(np.int32))
+                updates = {k: np.ascontiguousarray(h[rows]) for k, h in host.items()}
+                if t0 is None:
+                    t0 = time.perf_counter()
+                bank = scatter_patch(bank, idx, updates)  # chains on bank
+            jax.block_until_ready(bank["requested"])
+            return time.perf_counter() - t0, bank
+
+        def run_fold():
+            bank = _mk_banks(n, r, s, jnp)
+            t0 = time.perf_counter()
+            for batch in batches:
+                bank = fold_patch(bank, *batch)  # chains on donated bank
+            jax.block_until_ready(bank["requested"])
+            return time.perf_counter() - t0, bank
+
+        # parity: the two transports must land bit-identical banks
+        _, bank_a = run_scatter()
+        _, bank_b = run_fold()
+        for k in bank_a:
+            a, b = np.asarray(bank_a[k]), np.asarray(bank_b[k])
+            assert np.array_equal(a, b.astype(a.dtype)), f"A/B diverge on {k}"
+
+        ta, tb = [], []
+        for _ in range(trials):  # interleaved: drift hits both alike
+            ta.append(run_scatter()[0])
+            tb.append(run_fold()[0])
+        med_a = float(np.median(ta)) / chain
+        med_b = float(np.median(tb)) / chain
+        row = {
+            "rows": rb,
+            "scatter_ms": round(med_a * 1e3, 3),
+            "fold_ms": round(med_b * 1e3, 3),
+            "speedup": round(med_a / med_b, 2) if med_b > 0 else None,
+            "scatter_bytes": int(sum(
+                np.asarray(v).nbytes for v in _mk_banks(rb, r, s, np).values()
+            )),
+            "fold_bytes": int(sum(a.nbytes for a in batches[0])),
+        }
+        results.append(row)
+        if not smoke:
+            print(row, flush=True)
+    return {"n_nodes": n, "sig_slots": s, "rows": results}
+
+
+if __name__ == "__main__":
+    import json
+
+    out = main()
+    print(json.dumps(out))

@@ -12,7 +12,12 @@ the two invariants the plane lives by:
     batches (a silent fall-back to the per-pod host loop is a regression
     even when results stay correct);
   * zero compile-spec misses after warmup — no mid-drain XLA stall,
-    including for the arbiter's own programs (both carry variants).
+    including for the arbiter's and the fold's own programs;
+  * resident-state plane engaged: fold coverage > 0, the device banks
+    BIT-IDENTICAL to the host mirror after the drain (the folds, not a
+    re-upload, produced them), zero dropped donations (a silently-copied
+    donation doubles HBM and hides the copy cost), and the resident bank
+    buffer population flat (no leaked bank copies).
 
 Fast (~1 min on CPU) so it runs in tier-1 un-slow-marked, wired through
 tests/test_perf_smoke.py; also runnable standalone:
@@ -90,7 +95,55 @@ def main() -> dict:
     import bench
 
     bench.BATCH = SMOKE_BATCH
-    detail = bench.run_config("tiny_commit_plane_smoke", tiny_commit_plane_config)
+    fold_state = {}
+
+    def inspect(sched):
+        """Resident-state-plane probes against the LIVE scheduler, before
+        it closes: device/host bank parity and the donation ledger."""
+        import jax
+
+        m = sched.mirror
+        sched._commit_pipe.drain()
+        m.sync()
+        m.device_arrays()  # ships any non-folded remainder; folds stay put
+        fold_state["divergence"] = m.device_bank_divergence()
+        fold_state["undonated"] = m.folds_undonated
+        # resident-bank buffer population must stay FLAT across folds: run
+        # a few NO-OP folds (all-padding lanes — every scatter drops) and
+        # demand the live-array census is unchanged. A silently-dropped
+        # donation would allocate a fresh bank copy per fold and the
+        # census would grow. Delta-based so arrays owned by the rest of
+        # the process (other tests in a shared pytest run) cancel out.
+        import gc
+
+        import numpy as np
+
+        from kubernetes_tpu.commit.fold import FoldProgram
+
+        n_cap = m.nodes.capacity
+        width = m.nodes.requested.shape[1]
+        noop = FoldProgram(
+            rows=np.full(16, n_cap, np.int32),
+            req=np.zeros((16, width), np.int64),
+            nz=np.zeros((16, 2), np.int64),
+            cnt=np.zeros(16, np.int32),
+            sig=np.full(16, m.eps.capacity, np.int32),
+            pat_row=np.full(16, n_cap, np.int32),
+            pat_col=np.full(16, m.pats.capacity, np.int32),
+            pat_cnt=np.zeros(16, np.int16),
+            pods=0,
+        )
+        gc.collect()
+        before = len(jax.live_arrays())
+        for _ in range(3):
+            assert m.fold_commit(noop)
+        gc.collect()
+        fold_state["buffer_growth"] = len(jax.live_arrays()) - before
+        fold_state["divergence_after_noop"] = m.device_bank_divergence()
+
+    detail = bench.run_config(
+        "tiny_commit_plane_smoke", tiny_commit_plane_config, inspect=inspect
+    )
     phase = detail["phase_split_s"]
     audit = detail["audit"]
     problems = []
@@ -100,6 +153,29 @@ def main() -> dict:
         problems.append("commit-plane coverage is ZERO (arbiter never committed a batch)")
     if not phase.get("arbiter_place", 0):
         problems.append("arbiter placed no pods")
+    if not phase.get("fold_batches", 0):
+        problems.append(
+            "resident-state fold coverage is ZERO (every commit re-shipped "
+            "its rows host-to-device)"
+        )
+    if fold_state.get("divergence"):
+        problems.append(
+            f"device banks diverged from host mirror: {fold_state['divergence']}"
+        )
+    if fold_state.get("undonated"):
+        problems.append(
+            f"{fold_state['undonated']} fold(s) silently dropped buffer "
+            "donation (bank copied instead of updated in place)"
+        )
+    if fold_state.get("buffer_growth", 0) > 0:
+        problems.append(
+            f"live device-buffer census grew by {fold_state['buffer_growth']} "
+            "across no-op folds — donation is being dropped (bank copies)"
+        )
+    if fold_state.get("divergence_after_noop"):
+        problems.append(
+            f"no-op folds changed the banks: {fold_state['divergence_after_noop']}"
+        )
     if detail["compile"]["misses_after_warmup"]:
         problems.append(
             f"{detail['compile']['misses_after_warmup']} compile-spec "
@@ -122,6 +198,9 @@ if __name__ == "__main__":
         "arbiter_batches": p.get("arbiter_batches", 0),
         "arbiter_place": p.get("arbiter_place", 0),
         "arbiter_defer": p.get("arbiter_defer", 0),
+        "fold_batches": p.get("fold_batches", 0),
+        "fold_pods": p.get("fold_pods", 0),
+        "patch_bytes": d.get("patch_bytes", {}),
         "commit_s": p.get("commit_s"),
         "solve_s": p.get("solve_s"),
         "misses_after_warmup": d["compile"]["misses_after_warmup"],

@@ -1,0 +1,387 @@
+"""Resident-state plane parity suite (ops/fold + commit/fold + the
+TensorMirror fold bookkeeping).
+
+The tentpole's correctness pin: after a seeded drain, the DEVICE banks —
+produced by donated fold scatter-adds, never re-shipped from host for the
+folded rows — must be BIT-IDENTICAL to the host mirror
+(TensorMirror.device_bank_divergence() == []). Scenarios cover every
+composition rule: covered-only commits, mixed covered/oracle/escalated
+batches, preemption victim deletions, gang rollback, mid-drain node
+churn, and a mid-drain signature-bank rebuild (full re-upload while folds
+are outstanding). Plus: a drain with the fold plane ON schedules
+pod-for-pod identically to plane OFF (the fold is transport, never
+policy), the failed-fold correction path, and the A/B microbench smoke.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import (
+    Binder,
+    POD_GROUP_LABEL,
+    POD_GROUP_MIN_AVAILABLE,
+    Scheduler,
+)
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+HOST = "kubernetes.io/hostname"
+ZONE = "zone"
+
+
+def _nodes(n, zones=0, cpu=4000):
+    out = []
+    for i in range(n):
+        labels = {HOST: f"n{i}"}
+        if zones:
+            labels[ZONE] = f"z{i % zones}"
+        out.append(make_node(f"n{i}", cpu_milli=cpu, labels=labels))
+    return out
+
+
+def _anti_pod(name, app, cpu=100):
+    p = make_pod(name, cpu_milli=cpu, labels={"app": app})
+    p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            topology_key=HOST,
+        )
+    ]))
+    return p
+
+
+def _aff_pod(name, app, cpu=100):
+    """Required pod AFFINITY: uncovered by the arbiter → oracle path."""
+    p = make_pod(name, cpu_milli=cpu, labels={"app": app})
+    p.affinity = Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            topology_key=HOST,
+        )
+    ]))
+    return p
+
+
+def _spread_pod(name, app, cpu=50):
+    p = make_pod(name, cpu_milli=cpu, labels={"app": app})
+    p.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": app}),
+    )]
+    return p
+
+
+def _mk_sched(nodes, existing=(), **kw):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(p)
+    binds = []
+    binder = Binder(lambda pod, node: binds.append((pod.key(), node)))
+    kw.setdefault("deterministic", True)
+    sched = Scheduler(cache=cache, queue=PriorityQueue(), binder=binder, **kw)
+    return sched, binds
+
+
+def _drain(sched, rounds=60):
+    total, assignments, deferred = 0, {}, 0
+    for _ in range(rounds):
+        r = sched.schedule_batch()
+        total += r.scheduled
+        deferred += r.deferred
+        assignments.update(r.assignments)
+        if (r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0
+                and r.deferred == 0):
+            active, backoff, unsched = sched.queue.counts()
+            if not (active + backoff + unsched):
+                break
+            time.sleep(0.06)
+            sched.queue.move_all_to_active()
+    sched.wait_for_binds()
+    return total, assignments, deferred
+
+
+def _assert_parity(sched, expect_folds=True):
+    """The suite's core assert: settle everything, ship whatever the host
+    still owes, then demand bit-identity — the FOLDED rows were never
+    shipped, so any fold bug shows up here."""
+    m = sched.mirror
+    sched._commit_pipe.drain()
+    m.sync()
+    m.device_arrays()
+    div = m.device_bank_divergence()
+    assert div == [], f"device banks diverged: {div}"
+    if expect_folds:
+        assert sched.stats.get("fold_batches", 0) > 0, sched.stats
+    assert m.folds_undonated == 0
+
+
+# ---------------------------------------------------------------------------
+# seeded drain parity
+# ---------------------------------------------------------------------------
+
+def test_covered_only_drain_parity_and_zero_usage_bytes():
+    """Plain pods → the bulk fast path folds every batch: the device banks
+    stay exact with ZERO usage-column bytes shipped (the tentpole's
+    acceptance number, asserted at smoke scale)."""
+    sched, _ = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    for i in range(24):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+    n, _, _ = _drain(sched)
+    assert n == 24
+    _assert_parity(sched)
+    assert sched.mirror.bytes_shipped.get("usage", 0) == 0, (
+        sched.mirror.bytes_shipped
+    )
+    assert sched.mirror.bytes_shipped.get("fold", 0) > 0
+    sched.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_mixed_covered_oracle_escalated_drain_parity(seed):
+    """Arbiter-covered (anti/spread), oracle (required affinity), and
+    plain pods in one drain: folded and host-shipped rows interleave on
+    the same banks and must compose exactly."""
+    import random
+
+    rng = random.Random(seed)
+    sched, _ = _mk_sched(_nodes(6, zones=3), enable_preemption=False, batch_size=8)
+    for i in range(24):
+        roll = rng.random()
+        if roll < 0.25:
+            sched.queue.add(_anti_pod(f"a{i}", app=f"g{rng.randrange(3)}"))
+        elif roll < 0.45:
+            sched.queue.add(_spread_pod(f"s{i}", app="web"))
+        elif roll < 0.55:
+            sched.queue.add(_aff_pod(f"f{i}", app="anchor"))
+        else:
+            sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+    n, _, _ = _drain(sched)
+    assert n > 0
+    _assert_parity(sched)
+    sched.close()
+
+
+def test_preemption_drain_parity():
+    """Victim deletions dirty their node rows mid-drain (host-wins path)
+    while the preemptors' commits fold — and outstanding nominations
+    exercise the donated nominee overlay + exact restore."""
+    nodes = _nodes(3, cpu=1000)
+    existing = []
+    for i, nd in enumerate(nodes):
+        v = make_pod(f"victim{i}", cpu_milli=900, node_name=nd.name)
+        v.priority = 0
+        existing.append(v)
+    sched, _ = _mk_sched(
+        nodes, existing=existing, enable_preemption=True, batch_size=8,
+    )
+    for i in range(3):
+        p = make_pod(f"hi{i}", cpu_milli=800)
+        p.priority = 1000
+        sched.queue.add(p)
+    n, _, _ = _drain(sched)
+    assert n == 3
+    _assert_parity(sched)
+    sched.close()
+
+
+def test_gang_rollback_drain_parity():
+    """A gang that rolls back (min-available unmet) plus plain pods that
+    fold: forget_pods pushes removes the host-wins path must reconcile."""
+    sched, _ = _mk_sched(_nodes(4), enable_preemption=False, batch_size=16)
+    for m in range(2):
+        sched.queue.add(make_pod(
+            f"gm{m}", cpu_milli=100,
+            labels={POD_GROUP_LABEL: "g1", POD_GROUP_MIN_AVAILABLE: "4"},
+        ))
+    for i in range(8):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+    n, _, _ = _drain(sched)
+    assert n == 8  # gang rolled back, plain pods landed
+    # gang batches never fold (arbiter skips them) — the plain pods may
+    # have ridden the same batch as the gang, so folds are not guaranteed
+    _assert_parity(sched, expect_folds=False)
+    sched.close()
+
+
+def test_node_churn_mid_drain_parity():
+    """Folds outstanding when nodes arrive AND leave: removed rows are
+    released + reused, new rows encode fresh — all host-wins, composed
+    with the folded rows."""
+    sched, _ = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    for i in range(8):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+    r = sched.schedule_batch()
+    assert r.scheduled == 8
+    # churn between batches: one node out, one in
+    sched.cache.remove_node("n3")
+    sched.cache.add_node(make_node("n9", cpu_milli=4000, labels={HOST: "n9"}))
+    for i in range(8, 16):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+    n, _, _ = _drain(sched)
+    assert n + r.scheduled >= 14  # pods on the removed node may requeue
+    _assert_parity(sched)
+    sched.close()
+
+
+def test_sig_bank_rebuild_mid_drain_parity():
+    """Distinct label sets overflow a deliberately tiny signature bank
+    mid-drain: the rebuild full-re-uploads while folds are outstanding —
+    the stale path must discard the fold bookkeeping cleanly."""
+    sched, _ = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    sched.mirror._min_sigs = 4
+    sched.mirror._rebuild()
+    rebuilds0 = sched.mirror.rebuild_count
+    for i in range(24):
+        # 24 distinct label sets >> 4 signature slots
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=50, labels={"u": f"v{i}"}))
+    n, _, _ = _drain(sched)
+    assert n == 24
+    # the overflow rebuild may land mid-drain or at the settle sync below
+    # (the last batch's deltas can be the ones that overflow) — either
+    # way the fold bookkeeping must compose with the full re-upload
+    _assert_parity(sched, expect_folds=False)
+    assert sched.mirror.rebuild_count > rebuilds0  # the overflow really hit
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# plane ON == plane OFF, pod for pod
+# ---------------------------------------------------------------------------
+
+def test_fold_plane_off_schedules_identically():
+    def run(fold_plane):
+        sched, _ = _mk_sched(
+            _nodes(6, zones=3), enable_preemption=False, batch_size=8,
+            fold_plane=fold_plane,
+        )
+        for i in range(12):
+            if i % 3 == 0:
+                sched.queue.add(_anti_pod(f"a{i}", app="solo"))
+            else:
+                sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+        n, assignments, _ = _drain(sched)
+        stats = dict(sched.stats)
+        sched.close()
+        return n, assignments, stats
+
+    n_on, asg_on, st_on = run(True)
+    n_off, asg_off, st_off = run(False)
+    assert n_on == n_off
+    assert asg_on == asg_off
+    assert st_on.get("fold_batches", 0) > 0
+    assert st_off.get("fold_batches", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# correction + kernel units
+# ---------------------------------------------------------------------------
+
+def test_failed_fold_reships_row_host_wins():
+    """A fold lane whose assume never lands (informer race) leaves a
+    phantom delta on device; note_failed_fold must restore parity via a
+    host-wins re-ship at the next sync."""
+    from kubernetes_tpu.commit.fold import plan_fold
+    from kubernetes_tpu.state.cache import TensorMirror
+
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=4000, labels={HOST: "n0"}))
+    mirror = TensorMirror(cache)
+    mirror.device_arrays()
+    ghost = make_pod("ghost", cpu_milli=500)
+    prog = plan_fold(mirror, [(ghost, mirror.row_of["n0"])], 16, 16)
+    assert prog is not None
+    assert mirror.fold_commit(prog)
+    # the delta landed on device but the assume is never made
+    assert mirror.device_bank_divergence() != []
+    mirror.note_failed_fold("n0")
+    mirror.sync()
+    mirror.device_arrays()
+    assert mirror.device_bank_divergence() == []
+
+
+def test_fold_then_host_overlap_host_wins():
+    """A row receiving both a folded add and an unfolded remove ships host
+    truth — the overwrite must not double-count the folded add."""
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=4000, labels={HOST: "n0"}))
+    from kubernetes_tpu.commit.fold import plan_fold
+    from kubernetes_tpu.state.cache import TensorMirror
+
+    mirror = TensorMirror(cache)
+    mirror.device_arrays()
+    pod = make_pod("p0", cpu_milli=500)
+    prog = plan_fold(mirror, [(pod, mirror.row_of["n0"])], 16, 16)
+    assert mirror.fold_commit(prog)
+    assumed = pod.with_node("n0")
+    cache.assume_pods([assumed], folded=True)
+    mirror.sync()
+    mirror.device_arrays()
+    assert mirror.device_bank_divergence() == []
+    # now an UNFOLDED remove on the same row (bind failure): host wins
+    cache.forget_pod(assumed)
+    mirror.sync()
+    mirror.device_arrays()
+    assert mirror.device_bank_divergence() == []
+    assert int(mirror.nodes.pod_count[mirror.row_of["n0"]]) == 0
+
+
+def test_nominee_overlay_restores_exactly():
+    """fold_nominees/unfold_nominees: donated overlay + exact integer
+    inverse — the resident bank after restore is bit-identical."""
+    from kubernetes_tpu.state.cache import TensorMirror
+
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=4000, labels={HOST: "n0"}))
+    mirror = TensorMirror(cache)
+    mirror.device_arrays()
+    before = np.asarray(mirror._dev_nodes["requested"]).copy()
+    n_cap = mirror.nodes.capacity
+    width = mirror.nodes.requested.shape[1]
+    rows = np.asarray([mirror.row_of["n0"]] + [n_cap] * 15, np.int32)
+    vecs = np.zeros((16, width), np.int64)
+    vecs[0, 0] = 777
+    cnt = np.asarray([1] + [0] * 15, np.int32)
+    overlaid = mirror.fold_nominees(rows, vecs, cnt)
+    assert int(np.asarray(overlaid["requested"])[mirror.row_of["n0"], 0]) == 777
+    mirror.unfold_nominees()
+    after = np.asarray(mirror._dev_nodes["requested"])
+    assert np.array_equal(before, after)
+    assert mirror.device_bank_divergence() == []
+
+
+def test_microbench_patch_smoke():
+    """Tier-1 wiring for scripts/microbench_patch.py: the A/B must run and
+    agree bit-for-bit (the assert inside main); timings are reported, not
+    asserted (CPU CI jitter)."""
+    import os
+    import sys
+
+    scripts = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    )
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import microbench_patch
+
+    out = microbench_patch.main(smoke=True)
+    assert out["rows"], out
+    for row in out["rows"]:
+        assert row["fold_bytes"] > 0 and row["scatter_bytes"] > 0
+        assert row["fold_ms"] >= 0 and row["scatter_ms"] >= 0
