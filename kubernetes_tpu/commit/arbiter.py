@@ -74,6 +74,84 @@ def kinds_covered(present_kinds) -> bool:
     return frozenset(present_kinds) <= ARBITER_COVERED_KINDS
 
 
+def _spread_tables(na, pa, ea, ta, bucket_n, haskey_n, V: int):
+    """Pre-batch DoNotSchedule-spread metadata for the verdict scan —
+    EXACTLY ops/topology.spread_filter's merged per-(term, topology-value)
+    match counts (same helpers), shared by the single-device and the
+    sharded arbiter so the two can never disagree. All outputs are either
+    replicated [TT, V]/[TT]/[U]-shaped tables or the node-major cand_t
+    [TT, N] (sharded on a mesh)."""
+    from ..ops import filters as F
+    from ..ops.topology import (
+        _merge_same_key,
+        _scatter_and,
+        _seg_sum,
+        _sig_cnt_node,
+        match_terms,
+    )
+
+    U = pa["valid"].shape[0]
+    hard = ta["valid"] & (ta["kind"] == SPREAD_HARD)
+    owner = ta["owner"].astype(jnp.int32)
+    sel = F.pod_match_node_selector(na, pa)  # [U, N]
+    all_keys = _scatter_and(haskey_n, ta["owner"], hard, U)
+    cand = sel & all_keys & na["valid"][None, :]
+    m_sig = (
+        match_terms(ta, ea["label_vals"], ea["ns_id"])
+        & ea["valid"][None, :]
+        & hard[:, None]
+    )
+    cnt_node = _sig_cnt_node(m_sig, ea["counts"])  # [TT, N]
+    cand_t = cand[ta["owner"]]  # [TT, N]
+    pair_cnt = _seg_sum(jnp.where(cand_t, cnt_node, 0), bucket_n, V)
+    pair_present = (
+        _seg_sum((cand_t & haskey_n).astype(jnp.int32), bucket_n, V) > 0
+    )
+    merged_cnt0 = _merge_same_key(ta, hard, pair_cnt).astype(jnp.int32)
+    merged_present = (
+        _merge_same_key(ta, hard, pair_present.astype(jnp.int32)) > 0
+    )
+    any_pair_t = jnp.any(merged_present, axis=1)
+    any_pair_u = (
+        jnp.zeros(U + 1, bool)
+        .at[jnp.where(hard, ta["owner"], U)]
+        .max(any_pair_t & hard)[:U]
+    )
+    # batch-spec match per hard term (for commit deltas and the -1
+    # could-fit rule): term ns_ids were compiled to [owner namespace],
+    # so this is exactly "same namespace AND selector matches"
+    m_batch_hard = (
+        match_terms(ta, pa["label_vals"], pa["ns_id"]) & hard[:, None]
+    )  # [TT, U]
+    # terms sharing (owner, topology key) share one merged count table
+    # (metadata.go tpPairToMatchNum): group-sum the per-term matches so
+    # one scatter per commit updates the merged table directly (group
+    # members share bucket_n rows — same topo_slot)
+    same = (
+        hard[:, None]
+        & hard[None, :]
+        & (owner[:, None] == owner[None, :])
+        & (ta["topo_slot"][:, None] == ta["topo_slot"][None, :])
+    )
+    gm = jnp.matmul(
+        same.astype(jnp.float32),
+        m_batch_hard.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(jnp.int32)  # [TT, U]
+    return {
+        "hard": hard,
+        "owner": owner,
+        "cand_t": cand_t,
+        "merged_cnt0": merged_cnt0,
+        "merged_present": merged_present,
+        "any_pair_u": any_pair_u,
+        "m_batch_hard": m_batch_hard,
+        "gm": gm,
+        "self_m": ta["self_match"].astype(jnp.int32),
+        "skew": ta["weight"].astype(jnp.int32),
+    }
+
+
 @partial(jax.jit, static_argnames=("term_kinds", "n_buckets"))
 def arbitrate(
     na: Arrays,   # NodeBank arrays (same dict the solve consumed)
@@ -98,17 +176,8 @@ def arbitrate(
     residual tuple the solve dispatched against (speculative pipelining),
     so the arbiter replays from the state the assignment was computed on.
     """
-    from ..ops import filters as F
     from ..ops.pipeline import _inbatch_tensors, apply_carry
     from ..ops.solver import pop_order
-    from ..ops.topology import (
-        _bucket_of,
-        _merge_same_key,
-        _scatter_and,
-        _seg_sum,
-        _sig_cnt_node,
-        match_terms,
-    )
 
     na = apply_carry(na, carry)
     sig = pb["sig"]
@@ -143,55 +212,11 @@ def arbitrate(
         # EXACTLY ops/topology.spread_filter's metadata (same helpers), so
         # check-time arithmetic below reproduces its skew predicate with
         # the counts advanced by this batch's commits
-        hard = ta["valid"] & (ta["kind"] == SPREAD_HARD)
-        owner = ta["owner"].astype(jnp.int32)
-        sel = F.pod_match_node_selector(na, pa)  # [U, N]
-        all_keys = _scatter_and(haskey_n, ta["owner"], hard, U)
-        cand = sel & all_keys & na["valid"][None, :]
-        m_sig = (
-            match_terms(ta, ea["label_vals"], ea["ns_id"])
-            & ea["valid"][None, :]
-            & hard[:, None]
-        )
-        cnt_node = _sig_cnt_node(m_sig, ea["counts"])  # [TT, N]
-        cand_t = cand[ta["owner"]]  # [TT, N]
-        pair_cnt = _seg_sum(jnp.where(cand_t, cnt_node, 0), bucket_n, V)
-        pair_present = (
-            _seg_sum((cand_t & haskey_n).astype(jnp.int32), bucket_n, V) > 0
-        )
-        merged_cnt0 = _merge_same_key(ta, hard, pair_cnt).astype(jnp.int32)
-        merged_present = (
-            _merge_same_key(ta, hard, pair_present.astype(jnp.int32)) > 0
-        )
-        any_pair_t = jnp.any(merged_present, axis=1)
-        any_pair_u = (
-            jnp.zeros(U + 1, bool)
-            .at[jnp.where(hard, ta["owner"], U)]
-            .max(any_pair_t & hard)[:U]
-        )
-        # batch-spec match per hard term (for commit deltas and the -1
-        # could-fit rule): term ns_ids were compiled to [owner namespace],
-        # so this is exactly "same namespace AND selector matches"
-        m_batch_hard = (
-            match_terms(ta, pa["label_vals"], pa["ns_id"]) & hard[:, None]
-        )  # [TT, U]
-        # terms sharing (owner, topology key) share one merged count table
-        # (metadata.go tpPairToMatchNum): group-sum the per-term matches so
-        # one scatter per commit updates the merged table directly (group
-        # members share bucket_n rows — same topo_slot)
-        same = (
-            hard[:, None]
-            & hard[None, :]
-            & (owner[:, None] == owner[None, :])
-            & (ta["topo_slot"][:, None] == ta["topo_slot"][None, :])
-        )
-        gm = jnp.matmul(
-            same.astype(jnp.float32),
-            m_batch_hard.astype(jnp.float32),
-            precision=jax.lax.Precision.HIGHEST,
-        ).astype(jnp.int32)  # [TT, U]
-        self_m = ta["self_match"].astype(jnp.int32)
-        skew = ta["weight"].astype(jnp.int32)
+        sp = _spread_tables(na, pa, ea, ta, bucket_n, haskey_n, V)
+        hard, owner, cand_t = sp["hard"], sp["owner"], sp["cand_t"]
+        merged_cnt0, merged_present = sp["merged_cnt0"], sp["merged_present"]
+        any_pair_u, m_batch_hard = sp["any_pair_u"], sp["m_batch_hard"]
+        gm, self_m, skew = sp["gm"], sp["self_m"], sp["skew"]
 
     one = jnp.float32(1.0)
 
@@ -284,6 +309,266 @@ def arbitrate(
     _, verdicts = jax.lax.scan(step, carry0, order)
     out = jnp.full((B,), V_NOFIT, jnp.int32)
     return out.at[order].set(verdicts)
+
+
+# ---------------------------------------------------------------------------
+# multi-chip arbiter: the same sequential verdict scan over node-sharded
+# banks (the commit plane's half of the "ship control, not state"
+# discipline on the mesh the paper targets)
+# ---------------------------------------------------------------------------
+
+
+def _arbiter_body_sharded(
+    free0,      # [Nl, R] shard-local residuals
+    count0,     # [Nl]
+    allowed,    # [Nl]
+    assign,     # [B] replicated
+    sig,        # [B]
+    pod_valid,  # [B]
+    order,      # [B]
+    req,        # [U, R] replicated
+    req_any,    # [U]
+    t_anti,     # [TT] replicated
+    t_owner,    # [TT]
+    m_bb,       # [TT, U] replicated (already masked by t_anti)
+    bucket_nl,  # [TT, Nl] shard-local node columns
+    haskey_nl,  # [TT, Nl]
+    pconf,      # [U, U] replicated
+    spread,     # dict of replicated tables + shard-local cand_t, or None
+    *,
+    n_local: int,
+    V: int,
+):
+    """shard_map body: the multi-chip twin of `arbitrate`'s scan. Per-node
+    state (free/count residuals, the cs port table, the bucket/haskey
+    columns) stays SHARD-LOCAL; the [TT, V] anti/spread delta tables are
+    replicated (their updates are pure functions of broadcast commit
+    data). Each step pays exactly ONE packed pmax: the assigned node's
+    owner shard contributes (cap_ok, port-block, per-term bucket/haskey/
+    candidate bits) and every other shard contributes the sentinel —
+    the same few-collective-rounds discipline as parallel.sharded's
+    solver election. Verdicts come out replicated, bit-identical to the
+    single-device scan by construction (same adds, same compares, exact
+    integer broadcasts)."""
+    from ..parallel.mesh import AXIS_NODES
+
+    shard = jax.lax.axis_index(AXIS_NODES)
+    base = (shard * n_local).astype(jnp.int32)
+    U = req.shape[0]
+    TT = t_anti.shape[0]
+    t_rows = jnp.arange(TT, dtype=jnp.int32)
+    have_spread = bool(spread)  # {} when the batch has no hard spread
+    one = jnp.float32(1.0)
+
+    def step(carry, p):
+        free, count, ca, cb, cs, md, mh = carry
+        u = sig[p]
+        n = assign[p]
+        pv = pod_valid[p]
+        is_m1 = n < 0
+        local = (n >= base) & (n < base + n_local)
+        lidx = jnp.where(local, n - base, 0)
+        r_q = req[u]
+        # owner-shard facts, packed into ONE int32 pmax: [cap_ok,
+        # block_p, hk[TT], buck[TT], cand[TT]] — non-owners contribute
+        # the identity of max (0 / 0 / 0 / -1 / 0)
+        cap_ok_l = (
+            local
+            & ((~req_any[u]) | jnp.all(r_q <= free[lidx]))
+            & (count[lidx] + 1 <= allowed[lidx])
+        )
+        block_p_l = local & jnp.any(pconf[u] & (cs[:, lidx] > 0))
+        hk_l = jnp.where(local, haskey_nl[:, lidx], False)
+        buck_l = jnp.where(local, bucket_nl[:, lidx].astype(jnp.int32), -1)
+        if have_spread:
+            cand_l = jnp.where(local, spread["cand_t"][:, lidx], False)
+            packed = jnp.concatenate([
+                jnp.stack([cap_ok_l.astype(jnp.int32), block_p_l.astype(jnp.int32)]),
+                hk_l.astype(jnp.int32), buck_l, cand_l.astype(jnp.int32),
+            ])
+        else:
+            packed = jnp.concatenate([
+                jnp.stack([cap_ok_l.astype(jnp.int32), block_p_l.astype(jnp.int32)]),
+                hk_l.astype(jnp.int32), buck_l,
+            ])
+        packed = jax.lax.pmax(packed, AXIS_NODES)
+        cap_ok = packed[0] > 0
+        block_p = packed[1] > 0
+        hk = packed[2 : 2 + TT] > 0
+        buck = packed[2 + TT : 2 + 2 * TT]
+        buck_c = jnp.maximum(buck, 0)  # -1 only where hk is False
+        own_u = (t_owner == u) & t_anti
+        # required anti-affinity, both directions — replicated tables
+        # indexed by the broadcast bucket (identical math to `arbitrate`)
+        block_a = jnp.any(own_u & hk & (ca[t_rows, buck_c] > 0))
+        block_b = jnp.any(m_bb[:, u] & hk & (cb[t_rows, buck_c] > 0))
+        if have_spread:
+            cand_b = packed[2 + 2 * TT :] > 0
+            hard = spread["hard"]
+            owner = spread["owner"]
+            own_h = hard & (owner == u)
+            cnt = spread["merged_cnt0"] + md  # [TT, V]
+            min_t = jnp.min(
+                jnp.where(spread["merged_present"], cnt, jnp.int32(_BIG)),
+                axis=1,
+            )
+            at_b = jnp.where(
+                spread["merged_present"][t_rows, buck_c],
+                cnt[t_rows, buck_c],
+                0,
+            )
+            skew_ok_t = hk & (at_b + spread["self_m"] - min_t <= spread["skew"])
+            sp_ok = (
+                jnp.all(jnp.where(own_h, skew_ok_t, True))
+                | ~spread["any_pair_u"][u]
+            )
+            couldfit = jnp.any(own_h & (mh > 0))
+        else:
+            sp_ok = jnp.bool_(True)
+            couldfit = jnp.bool_(False)
+        ok = cap_ok & ~block_a & ~block_b & ~block_p & sp_ok
+        commit = pv & ~is_m1 & ok
+        verdict = jnp.where(
+            ~pv,
+            V_NOFIT,
+            jnp.where(
+                is_m1,
+                jnp.where(couldfit, V_DEFER, V_NOFIT),
+                jnp.where(ok, V_PLACE, V_DEFER),
+            ),
+        ).astype(jnp.int32)
+        # shard-local folds: owner only (sentinel n_local/U — dropped)
+        mine = commit & local
+        tgt = jnp.where(mine, lidx, n_local)
+        free = free.at[tgt].add(-(r_q * mine), mode="drop")
+        count = count.at[tgt].add(mine.astype(count.dtype), mode="drop")
+        cs = cs.at[jnp.where(mine, u, U), jnp.where(mine, lidx, 0)].add(
+            one * mine, mode="drop"
+        )
+        # replicated folds: pure functions of the broadcast commit data
+        hkc = hk & commit
+        ca = ca.at[t_rows, jnp.where(m_bb[:, u] & hkc, buck_c, V)].add(
+            one, mode="drop"
+        )
+        cb = cb.at[t_rows, jnp.where(own_u & hkc, buck_c, V)].add(
+            one, mode="drop"
+        )
+        if have_spread:
+            contrib = jnp.where(hard & commit & cand_b, spread["gm"][:, u], 0)
+            md = md.at[t_rows, jnp.where(contrib > 0, buck_c, V)].add(
+                contrib, mode="drop"
+            )
+            mh = mh + jnp.where(
+                commit, spread["m_batch_hard"][:, u], False
+            ).astype(mh.dtype)
+        return (free, count, ca, cb, cs, md, mh), verdict
+
+    carry0 = (
+        free0,
+        count0,
+        jnp.zeros((TT, V), jnp.float32),
+        jnp.zeros((TT, V), jnp.float32),
+        jnp.zeros((U, n_local), jnp.float32),
+        jnp.zeros((TT, V), jnp.int32),
+        jnp.zeros((TT,), jnp.int32),
+    )
+    _, verdicts = jax.lax.scan(step, carry0, order)
+    return verdicts
+
+
+def make_sharded_arbiter(mesh):
+    """Build the mesh-bound verdict pass: full signature parity with
+    `arbitrate` so the driver can route covered sharded batches through it
+    unchanged. The prep (in-batch tensors + spread metadata) runs under
+    GSPMD with the node-major arrays pinned to the mesh's "nodes" axis —
+    the same annotate-and-let-XLA-place recipe as the sharded solve's
+    mask/score stage — and the sequential scan runs under shard_map with
+    one packed broadcast per pod."""
+    from functools import partial as _partial
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_NODES, shard_map
+
+    n_shards = mesh.shape[AXIS_NODES]
+
+    def _c(x, *spec):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+    @_partial(jax.jit, static_argnames=("term_kinds", "n_buckets"))
+    def arbitrate_sharded(
+        na, pa, ea, ta, ids, assign, pb,
+        carry=None, term_kinds=None, n_buckets=None,
+    ):
+        from ..ops.pipeline import _inbatch_tensors, apply_carry
+        from ..ops.solver import pop_order
+
+        na = {k: _c(v, AXIS_NODES) for k, v in na.items()}
+        if carry is not None:
+            carry = tuple(_c(x, AXIS_NODES) for x in carry)
+        na = apply_carry(na, carry)
+        if "counts" in ea:
+            ea = {**ea, "counts": _c(ea["counts"], AXIS_NODES)}
+        sig = pb["sig"]
+        pod_valid = pb["valid"]
+        B = sig.shape[0]
+        N = na["valid"].shape[0]
+        V = n_buckets or N
+        assert N % n_shards == 0, (
+            f"node capacity {N} not divisible by {n_shards} shards"
+        )
+        n_local = N // n_shards
+        order = pop_order(
+            pb["priority"], jnp.arange(B, dtype=jnp.int32), pod_valid
+        )
+        free0 = na["alloc"] - na["requested"]
+        count0 = na["pod_count"].astype(free0.dtype)
+        allowed = na["allowed_pods"].astype(free0.dtype)
+        inb = _inbatch_tensors(na, pa, ta, ids, n_buckets)
+        t_anti = inb["anti"]
+        m_bb = inb["m_bb"] & t_anti[:, None]
+        bucket_n = _c(inb["bucket_n"], None, AXIS_NODES)
+        haskey_n = _c(inb["haskey_n"], None, AXIS_NODES)
+        have_spread = term_kinds is None or "spread_hard" in term_kinds
+        spread = {}
+        spread_specs = {}
+        if have_spread:
+            spread = _spread_tables(na, pa, ea, ta, bucket_n, haskey_n, V)
+            spread = {
+                k: (_c(v, None, AXIS_NODES) if k == "cand_t" else _c(v))
+                for k, v in spread.items()
+            }
+            spread_specs = {
+                k: (P(None, AXIS_NODES) if k == "cand_t" else P())
+                for k in spread
+            }
+        body = shard_map(
+            _partial(_arbiter_body_sharded, n_local=n_local, V=V),
+            mesh=mesh,
+            in_specs=(
+                P(AXIS_NODES),        # free0
+                P(AXIS_NODES),        # count0
+                P(AXIS_NODES),        # allowed
+                P(), P(), P(), P(),   # assign, sig, pod_valid, order
+                P(), P(),             # req, req_any
+                P(), P(), P(),        # t_anti, t_owner, m_bb
+                P(None, AXIS_NODES),  # bucket_n
+                P(None, AXIS_NODES),  # haskey_n
+                P(),                  # pconf
+                spread_specs,         # spread tables (or None)
+            ),
+            out_specs=P(),            # verdicts (replicated)
+        )
+        verdicts = body(
+            free0, count0, allowed, assign, sig, pod_valid, order,
+            pa["req"], pa["req_any"], t_anti,
+            inb["owner"], m_bb, bucket_n, haskey_n, inb["port_conflict"],
+            spread,
+        )
+        out = jnp.full((B,), V_NOFIT, jnp.int32)
+        return out.at[order].set(verdicts)
+
+    return arbitrate_sharded
 
 
 # ---------------------------------------------------------------------------

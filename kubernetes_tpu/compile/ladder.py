@@ -60,6 +60,12 @@ KIND_ARBITER = "arbiter"
 # nominee-overlay variant is the same kind with s=pt=t=0 (it touches only
 # the usage columns — a genuinely different XLA program).
 KIND_FOLD = "fold"
+# tensor-mirror dirty-row scatter (state/cache.TensorMirror._scatter_rows):
+# b = row rung (PATCH_RUNGS quantizer, NOT this ladder's pow-2 buckets),
+# n = the bank's row capacity, config_repr = the update-dict structure.
+# Routed through the plan so a post-warmup scatter compile is a counted
+# miss — these were the invisible mid-drain stalls on preemption drains.
+KIND_PATCH = "patch"
 
 
 @dataclass(frozen=True)
@@ -73,7 +79,14 @@ class SolveSpec:
     n = nodes, v = topology segment buckets (n_buckets static), k = label
     key slots, r = resource slots, s = existing-pod signatures, pt =
     existing-pod term patterns. For KIND_PREEMPT, b is the preemptor
-    bucket and v the victim-slot bucket."""
+    bucket and v the victim-slot bucket.
+
+    `shards` is the node-mesh shard count the program is partitioned
+    over (0 = single-device/replicated). It is part of the program
+    identity: the sharded solve/arbiter/fold are DIFFERENT XLA
+    executables from their replicated twins, so a mesh-configured driver
+    that silently falls back to the replicated pipeline (indivisible
+    node bucket) now reports a real spec miss instead of a phantom hit."""
 
     kind: str = KIND_SOLVE
     b: int = 0
@@ -85,6 +98,7 @@ class SolveSpec:
     r: int = 0
     s: int = 0
     pt: int = 0
+    shards: int = 0
     term_kinds: frozenset = frozenset()
     config_repr: str = "None"  # SolveConfig repr (jit static; opaque here)
     deterministic: bool = False
@@ -94,7 +108,8 @@ class SolveSpec:
     def key(self) -> Tuple:
         return (
             self.kind, self.b, self.u, self.t, self.n, self.v, self.k,
-            self.r, self.s, self.pt, tuple(sorted(self.term_kinds)),
+            self.r, self.s, self.pt, self.shards,
+            tuple(sorted(self.term_kinds)),
             self.config_repr, self.deterministic, self.with_carry,
             self.track_inbatch,
         )
@@ -113,16 +128,18 @@ class SolveSpec:
                 ("d", self.deterministic),
             ) if on
         ) or "-"
+        mesh = f"x{self.shards}" if self.shards else ""
         return (
-            f"{self.kind}[b{self.b}/u{self.u}/t{self.t}/n{self.n}/v{self.v}"
-            f"/k{self.k}/r{self.r}/s{self.s}/p{self.pt}|{kinds}|{flags}]"
+            f"{self.kind}{mesh}[b{self.b}/u{self.u}/t{self.t}/n{self.n}"
+            f"/v{self.v}/k{self.k}/r{self.r}/s{self.s}/p{self.pt}"
+            f"|{kinds}|{flags}]"
         )
 
     def to_dict(self) -> Dict:
         return {
             "kind": self.kind, "b": self.b, "u": self.u, "t": self.t,
             "n": self.n, "v": self.v, "k": self.k, "r": self.r,
-            "s": self.s, "pt": self.pt,
+            "s": self.s, "pt": self.pt, "shards": self.shards,
             "term_kinds": sorted(self.term_kinds),
             "config_repr": self.config_repr,
             "deterministic": self.deterministic,
@@ -137,6 +154,7 @@ class SolveSpec:
             b=int(d.get("b", 0)), u=int(d.get("u", 0)), t=int(d.get("t", 0)),
             n=int(d.get("n", 0)), v=int(d.get("v", 0)), k=int(d.get("k", 0)),
             r=int(d.get("r", 0)), s=int(d.get("s", 0)), pt=int(d.get("pt", 0)),
+            shards=int(d.get("shards", 0)),
             term_kinds=frozenset(d.get("term_kinds", ())),
             config_repr=d.get("config_repr", "None"),
             deterministic=bool(d.get("deterministic", False)),
@@ -162,12 +180,13 @@ class ShapeLadder:
         """Round every padded axis up to its rung; u never exceeds b (a
         batch cannot hold more unique specs than pods).
 
-        KIND_PREEMPT specs pass through UNCHANGED: the preempt call site
-        buckets its own axes (minimum 8, scheduler/preemption.py) and the
-        spec must name the EXACT executed shapes — re-rounding here with
-        this ladder's minimum would collapse distinct kernel signatures
-        onto one key and report a mid-drain compile as a plan hit."""
-        if spec.kind == KIND_PREEMPT:
+        KIND_PREEMPT and KIND_PATCH specs pass through UNCHANGED: those
+        call sites bucket their own axes (minimum 8 preemptor/victim
+        rungs; the mirror's PATCH_RUNGS) and the spec must name the EXACT
+        executed shapes — re-rounding here with this ladder's minimum
+        would collapse distinct kernel signatures onto one key and report
+        a mid-drain compile as a plan hit."""
+        if spec.kind in (KIND_PREEMPT, KIND_PATCH):
             return spec
         m = self.minimum
         b = pow2_bucket(spec.b, m) if spec.b else 0

@@ -31,6 +31,7 @@ from .ladder import (
     KIND_ARBITER,
     KIND_FILTER,
     KIND_FOLD,
+    KIND_PATCH,
     KIND_PREEMPT,
     KIND_SOLVE,
     KIND_SOLVE_GANG,
@@ -167,12 +168,24 @@ class WarmupService:
         process can't reconstruct, zero-size axes)."""
         if spec.kind == KIND_PREEMPT:
             return self._warm_preempt(spec)  # no SolveConfig static
+        if spec.kind == KIND_PATCH:
+            # dirty-row scatters warm at LIVE shapes only — the driver's
+            # warmup drives TensorMirror.warm_patches, which re-declares
+            # the current bank structures; a persisted patch spec from a
+            # previous shape cannot be replayed synthetically, so skip it
+            # (undeclared for persisted sources, by design)
+            return None
         if spec.kind == KIND_FOLD:
             return self._warm_fold(spec)  # no SolveConfig static
         if spec.config_repr != repr(self.sched.solve_config):
             return None  # persisted ladder from a differently-policied run
         if not (spec.b and spec.u and spec.t and spec.n and spec.v):
             return None
+        # the driver keeps _mesh_shards 0 when no mesh is configured —
+        # one source for "this process's shard count" (the spec's own
+        # shards field already encodes the routing decision)
+        if spec.shards and spec.shards != self.sched._mesh_shards:
+            return None  # partitioned for a different mesh: not realizable
 
         import jax
         import numpy as np
@@ -187,6 +200,16 @@ class WarmupService:
         na, ea, xp = self._banks_for(spec, dev)
         if na is None:
             return None
+        use_sharded = spec.shards > 0
+        if self.sched.mesh is not None:
+            # the dispatch-time banks are device-resident with the
+            # mirror's NamedSharding (node-major axes split over "nodes");
+            # the jit cache keys on input shardings, so the warm must
+            # place its banks through the SAME recipe or it compiles a
+            # program the drain never dispatches. This includes shards=0
+            # specs on a MESH driver (the indivisible-bucket fallback):
+            # the replicated pipeline still receives sharded banks there.
+            na, ea, xp = self._shard_banks(na, ea, xp)
         batch = PodBatch(vocab, spec.u)
         tb, aux = compile_batch_terms(vocab, [], capacity=spec.t, b_capacity=spec.u)
         pb = {
@@ -203,10 +226,6 @@ class WarmupService:
             term_kinds=spec.term_kinds,
             n_buckets=spec.v,
         )
-        use_sharded = (
-            self.sched._sharded is not None
-            and spec.n % max(self.sched._mesh_shards, 1) == 0
-        )
         t0 = time.perf_counter()
         if spec.kind == KIND_FILTER:
             out = filter_mask(args[0], args[1], args[2], args[3], args[4],
@@ -222,14 +241,29 @@ class WarmupService:
             carry = None
             if spec.with_carry:
                 # the driver hands the arbiter the SAME residual tuple the
-                # chained solve ran on — mirror its dtypes exactly
+                # chained solve ran on — mirror its dtypes exactly (on a
+                # mesh these are node-sharded outputs of sharded ops, so
+                # the carry built from sharded banks shards identically)
                 f0 = jnp.asarray(na["alloc"]) - jnp.asarray(na["requested"])
                 carry = (
                     f0,
                     jnp.asarray(na["pod_count"]).astype(f0.dtype),
                     jnp.asarray(na["nonzero_req"]).astype(f0.dtype),
                 )
-            out = arbitrate(
+            arb_fn = (
+                self.sched._sharded.arbitrate if use_sharded else arbitrate
+            )
+            if use_sharded:
+                # the dispatch-time assign is the sharded solve's output
+                # (mesh-replicated committed array) — mirror that so the
+                # warmed executable is the dispatched one
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                assign = jax.device_put(
+                    jnp.asarray(assign),
+                    NamedSharding(self.sched.mesh, P()),
+                )
+            out = arb_fn(
                 na, batch.arrays(), ea, tb.arrays(), ids, assign,
                 pb=pb, carry=carry, **arb_statics,
             )
@@ -256,6 +290,17 @@ class WarmupService:
         return time.perf_counter() - t0
 
     # -- templates -------------------------------------------------------------
+
+    def _shard_banks(self, na, ea, xp):
+        """Place template banks exactly the way TensorMirror uploads the
+        live ones on a mesh (node-major axes NamedSharding'd over "nodes",
+        everything else plain) — the same `_to_dev` recipe, so the warmed
+        executable's input shardings equal the dispatched ones."""
+        m = self.sched.mirror
+        na = {k: m._to_dev(v, True) for k, v in na.items()}
+        ea = {k: m._to_dev(v, k == "counts") for k, v in ea.items()}
+        xp = {k: m._to_dev(v, k == "counts") for k, v in xp.items()}
+        return na, ea, xp
 
     def _banks_for(self, spec: SolveSpec, dev):
         """(na, ea, xp) argument dicts at the spec's bank shapes. The live
@@ -312,18 +357,42 @@ class WarmupService:
         drain still needs them). Dtypes mirror the mirror's canonicalized
         uploads (jnp.asarray of the host banks' numpy dtypes), so the jit
         cache entry is the one the driver's dispatch hits. Donating
-        freshly built arrays keeps the warmed program the donated one."""
+        freshly built arrays keeps the warmed program the donated one.
+        Sharded specs place the banks with the mirror's NamedSharding and
+        dispatch through the mirror's CACHED mesh-bound kernels — the
+        very callables the drain folds through."""
         if not (spec.b and spec.n and spec.r):
             return None
         import jax
         import jax.numpy as jnp
         import numpy as np
 
-        from ..ops.fold import fold_commit_banks, fold_usage
+        mirror = self.sched.mirror
+        sharded = spec.shards > 0
+        if sharded:
+            if (
+                spec.shards != self.sched._mesh_shards
+                or spec.n % spec.shards != 0
+            ):
+                return None  # foreign mesh / indivisible: not realizable
+            fold_commit_banks, fold_usage = mirror._fold_fns()
+
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import AXIS_NODES
+
+            sh = NamedSharding(self.sched.mesh, P(AXIS_NODES))
+
+            def bank(a):
+                return jax.device_put(jnp.asarray(a), sh)
+        else:
+            from ..ops.fold import fold_commit_banks, fold_usage
+
+            bank = jnp.asarray
 
         b, n, r = spec.b, spec.n, spec.r
-        req_bank = jnp.asarray(np.zeros((n, r), np.int64))
-        pc_bank = jnp.asarray(np.zeros(n, np.int32))
+        req_bank = bank(np.zeros((n, r), np.int64))
+        pc_bank = bank(np.zeros(n, np.int32))
         rows = np.full(b, n, np.int32)  # all-padding sentinel lanes
         t0 = time.perf_counter()
         if spec.s:  # commit variant (signature + pattern count scatters)
@@ -331,10 +400,10 @@ class WarmupService:
                 return None
             out = fold_commit_banks(
                 req_bank,
-                jnp.asarray(np.zeros((n, 2), np.int64)),
+                bank(np.zeros((n, 2), np.int64)),
                 pc_bank,
-                jnp.asarray(np.zeros((n, spec.s), np.int16)),
-                jnp.asarray(np.zeros((n, spec.pt), np.int16)),
+                bank(np.zeros((n, spec.s), np.int16)),
+                bank(np.zeros((n, spec.pt), np.int16)),
                 rows,
                 np.zeros((b, r), np.int64),
                 np.zeros((b, 2), np.int64),

@@ -151,13 +151,28 @@ mirror_bytes_shipped = registry.register(Counter(
     "scheduler_mirror_bytes_shipped_total",
     "Host-to-device bank bytes shipped by the tensor mirror, by kind "
     "(full = whole-bank upload, rows = dirty node-row scatter, usage = "
-    "usage-column scatter, fold = device-fold control data)",
+    "usage-column scatter, fold = device-fold control data, warm = "
+    "warmup's no-op scatter pre-compiles)",
     label_names=("kind",),
 ))
 fold_batches = registry.register(Counter(
     "scheduler_fold_batches_total",
     "Commit batches whose state deltas were folded into the resident "
     "device banks (no host scatter shipped for their rows)",
+))
+# multi-chip series (kubernetes_tpu/parallel): a mesh-configured driver
+# that cannot shard a batch (node bucket stops dividing the shard count
+# mid-churn) quietly drops to the replicated solve — which is a different,
+# usually unwarmed XLA program AND idles the whole mesh. Zero on a
+# healthy multi-chip drain.
+sharded_fallbacks = registry.register(Counter(
+    "scheduler_sharded_fallbacks_total",
+    "Solve DISPATCHES a mesh-configured driver routed through the "
+    "replicated (single-device) pipeline instead of the sharded one, by "
+    "reason (per dispatch, not per batch: speculative chaining and "
+    "warmup's peeked dispatches each count — zero is the only healthy "
+    "value either way)",
+    label_names=("reason",),
 ))
 
 

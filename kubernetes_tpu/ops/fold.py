@@ -24,6 +24,14 @@ shipped. tests/test_fold_plane.py pins this after seeded drains.
 Padding discipline: control arrays are padded to ladder buckets with
 OUT-OF-BOUNDS sentinel indices (row = N, sig = S, ...) and mode="drop" —
 padded lanes scatter nowhere, so any bucket executes exactly.
+
+Multi-chip: `make_sharded_fold_fns(mesh)` builds the node-sharded twins —
+the banks stay split over the mesh's "nodes" axis (NamedSharding preserved
+through donation), the replicated control arrays are re-based per shard
+(global row → local row, out-of-shard lanes dropped), and no collective is
+needed at all: a node row lives on exactly one shard, so every scatter is
+shard-local. Bit-identical to the single-device kernels by construction
+(same adds, same dtypes, disjoint row ownership).
 """
 
 from __future__ import annotations
@@ -80,3 +88,86 @@ def fold_usage(
         requested.at[rows].add(vecs.astype(requested.dtype), mode="drop"),
         pod_count.at[rows].add(cnt.astype(pod_count.dtype), mode="drop"),
     )
+
+
+_SHARDED_FOLD_CACHE = {}
+
+
+def make_sharded_fold_fns(mesh):
+    """(fold_commit_banks, fold_usage) twins bound to `mesh`: every bank's
+    leading (node) axis stays sharded over the mesh's "nodes" axis and the
+    donated buffers keep their NamedSharding — the sharded pipeline's
+    solve inputs never reshard after a fold. The control arrays arrive
+    replicated; each shard rebases the global node rows onto its own
+    columns and drops foreign lanes (sentinel n_local + mode="drop"), so
+    the whole fold is collective-free. Memoized per mesh: the jitted
+    closures are the program cache the warmup service and the mirror must
+    share."""
+    cached = _SHARDED_FOLD_CACHE.get(mesh)
+    if cached is not None:
+        return cached
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_NODES, shard_map
+
+    def _local_rows(rows, base, n_local):
+        # global row → shard-local row; foreign/sentinel lanes → n_local
+        # (out of bounds, dropped). Sentinel N is foreign to every shard:
+        # for the LAST shard N - base == n_local, already out of bounds.
+        mine = (rows >= base) & (rows < base + n_local)
+        return jnp.where(mine, rows - base, n_local).astype(jnp.int32)
+
+    def _commit_body(
+        requested, nonzero_req, pod_count, sig_counts, pat_counts,
+        rows, req, nz, cnt, sig, pat_row, pat_col, pat_cnt,
+    ):
+        n_local = requested.shape[0]
+        base = (jax.lax.axis_index(AXIS_NODES) * n_local).astype(rows.dtype)
+        lrows = _local_rows(rows, base, n_local)
+        lprow = _local_rows(pat_row, base, n_local)
+        requested = requested.at[lrows].add(
+            req.astype(requested.dtype), mode="drop"
+        )
+        nonzero_req = nonzero_req.at[lrows].add(
+            nz.astype(nonzero_req.dtype), mode="drop"
+        )
+        pod_count = pod_count.at[lrows].add(
+            cnt.astype(pod_count.dtype), mode="drop"
+        )
+        sig_counts = sig_counts.at[lrows, sig].add(
+            cnt.astype(sig_counts.dtype), mode="drop"
+        )
+        pat_counts = pat_counts.at[lprow, pat_col].add(
+            pat_cnt.astype(pat_counts.dtype), mode="drop"
+        )
+        return requested, nonzero_req, pod_count, sig_counts, pat_counts
+
+    def _usage_body(requested, pod_count, rows, vecs, cnt):
+        n_local = requested.shape[0]
+        base = (jax.lax.axis_index(AXIS_NODES) * n_local).astype(rows.dtype)
+        lrows = _local_rows(rows, base, n_local)
+        return (
+            requested.at[lrows].add(vecs.astype(requested.dtype), mode="drop"),
+            pod_count.at[lrows].add(cnt.astype(pod_count.dtype), mode="drop"),
+        )
+
+    nl = P(AXIS_NODES)
+    commit = jax.jit(
+        shard_map(
+            _commit_body, mesh=mesh,
+            in_specs=(nl,) * 5 + (P(),) * 8,
+            out_specs=(nl,) * 5,
+        ),
+        donate_argnums=(0, 1, 2, 3, 4),
+    )
+    usage = jax.jit(
+        shard_map(
+            _usage_body, mesh=mesh,
+            in_specs=(nl, nl, P(), P(), P()),
+            out_specs=(nl, nl),
+        ),
+        donate_argnums=(0, 1),
+    )
+    _SHARDED_FOLD_CACHE[mesh] = (commit, usage)
+    return commit, usage

@@ -32,6 +32,19 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map
+except ImportError:  # 0.4.x keeps it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_04
+
+    def shard_map(f, **kwargs):
+        # 0.4.x's replication checker has no rule for lax.while_loop (the
+        # greedy solver's repair loop) — disable it; every out_spec we
+        # claim replicated is replicated by construction (broadcast
+        # collectives), which newer jax verifies natively.
+        kwargs.setdefault("check_rep", False)
+        return _shard_map_04(f, **kwargs)
+
 AXIS_NODES = "nodes"
 AXIS_PODS = "pods"
 

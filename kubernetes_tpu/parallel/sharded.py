@@ -41,7 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pipeline import SolveConfig, _pod_axis, apply_carry, mask_and_score
 from ..ops.solver import DEFAULT_CHUNK, pop_order, tie_noise
-from .mesh import AXIS_NODES, AXIS_PODS
+from .mesh import AXIS_NODES, AXIS_PODS, shard_map
 
 Arrays = Dict[str, jnp.ndarray]
 
@@ -280,8 +280,16 @@ def _solver_body(
     return jnp.reshape(choices, (B,)).astype(jnp.int32), free_f, count_f, nz_f
 
 
+_PIPELINE_CACHE: Dict[Mesh, object] = {}
+
+
 def make_sharded_pipeline(mesh: Mesh):
     """Build the jitted multi-chip pipeline bound to `mesh`.
+
+    Memoized per mesh (Mesh hashes by device grid + axis names): the
+    jitted closures ARE the XLA program cache, so two schedulers — or a
+    warmup service and the driver it warms — must share one instance or
+    every warm compiles a program the dispatch never finds.
 
     Full signature/result parity with ops.pipeline.solve_pipeline —
     (na, pa, ea, ta, xa, au, ids, key, pb=None, carry=None,
@@ -291,6 +299,9 @@ def make_sharded_pipeline(mesh: Mesh):
     _dispatch_solve through it unchanged, speculative carry included.
     The carry's free/count/nz residuals stay node-SHARDED on device
     between batches (they never cross to the host)."""
+    cached = _PIPELINE_CACHE.get(mesh)
+    if cached is not None:
+        return cached
     n_shards = mesh.shape[AXIS_NODES]
 
     def _c(x: jnp.ndarray, *spec) -> jnp.ndarray:
@@ -370,7 +381,7 @@ def make_sharded_pipeline(mesh: Mesh):
         else:
             inb = None
             in_specs = base_specs
-        solver = jax.shard_map(
+        solver = shard_map(
             partial(
                 _solver_body,
                 deterministic=deterministic,
@@ -464,4 +475,11 @@ def make_sharded_pipeline(mesh: Mesh):
         return assign, score, gang_ok
 
     pipeline.gang = pipeline_gang
+    # the commit plane's mesh twin rides along: full signature parity with
+    # commit.arbiter.arbitrate, so the driver routes covered sharded
+    # batches through `pipeline.arbitrate` exactly as it does replicated
+    from ..commit.arbiter import make_sharded_arbiter
+
+    pipeline.arbitrate = make_sharded_arbiter(mesh)
+    _PIPELINE_CACHE[mesh] = pipeline
     return pipeline

@@ -574,6 +574,13 @@ class Scheduler:
         # hit/miss/compile telemetry, and (when configured) the persistent
         # on-disk ladder a restart re-warms from (kubernetes_tpu/compile)
         self.compile_plan = compile_plan or CompilePlan.default()
+        # the mirror's dirty-row scatters are planned programs too
+        # (KIND_PATCH): their post-warmup compiles were the invisible
+        # mid-drain stalls on preemption/churn drains
+        self.mirror.compile_plan = self.compile_plan
+        # logged on every transition INTO the sharded→replicated fallback
+        # (not per batch — a mid-churn indivisible bucket can persist)
+        self._sharded_fallback_logged = False
         self._warm_svc: Optional[WarmupService] = None
         # growth-event AOT warming arms when warmup() runs — tests that
         # never warm up must not get surprise background compile threads
@@ -672,6 +679,18 @@ class Scheduler:
 
     # -- compile plan --------------------------------------------------------
 
+    def _shards_now(self) -> int:
+        """The node-mesh shard count the NEXT dispatch will partition
+        over: the mesh's "nodes" axis when the bank capacity divides it,
+        else 0 (the replicated fallback — tiny clusters on big meshes).
+        Spec identity and dispatch routing share this one predicate so
+        the plan can never count a fallback compile as a hit."""
+        if self._sharded is None:
+            return 0
+        if self.mirror.nodes.capacity % self._mesh_shards != 0:
+            return 0
+        return self._mesh_shards
+
     def _solve_spec(self, gang: bool, with_carry: bool) -> SolveSpec:
         """This driver's CURRENT solve-program signature: the monotone
         buckets (ladder rungs) + every jit static. One definition so
@@ -688,6 +707,7 @@ class Scheduler:
             r=m.nodes.alloc.shape[1],
             s=m.eps.capacity,
             pt=m.pats.capacity,
+            shards=self._shards_now(),
             term_kinds=getattr(self, "_term_kinds", frozenset()),
             config_repr=repr(self.solve_config),
             deterministic=self.deterministic,
@@ -719,12 +739,12 @@ class Scheduler:
         if nominee:
             return SolveSpec(
                 kind=KIND_FOLD, b=self._nom_bucket, n=m.nodes.capacity,
-                r=r, config_repr="fold",
+                r=r, shards=self._shards_now(), config_repr="fold",
             )
         return SolveSpec(
             kind=KIND_FOLD, b=self._b_bucket, t=self._fp_bucket,
             n=m.nodes.capacity, r=r, s=m.eps.capacity, pt=m.pats.capacity,
-            config_repr="fold",
+            shards=self._shards_now(), config_repr="fold",
         )
 
     def _dispatch_fold(self, pairs: List[Tuple[Pod, int]]) -> bool:
@@ -1006,11 +1026,32 @@ class Scheduler:
                 )
         # tiny clusters on big meshes: capacity buckets guarantee shard
         # divisibility only once capacity >= shard count — fall back to the
-        # single-device pipeline instead of asserting on every batch
-        use_sharded = (
-            self._sharded is not None
-            and int(na_dev["valid"].shape[0]) % self._mesh_shards == 0
-        )
+        # single-device pipeline instead of asserting on every batch.
+        # ONE predicate (_shards_now) decides routing AND spec identity:
+        # na_dev's node axis is the mirror's capacity by construction
+        use_sharded = self._shards_now() > 0
+        if self._sharded is not None and not use_sharded:
+            # the fallback is LEGAL but must be observable: the replicated
+            # solve is a different XLA program (an unwarmed inline compile
+            # on a production mesh) and the whole multi-chip plane sits
+            # idle while it persists — a regression here used to be
+            # completely silent
+            self.stats["sharded_fallbacks"] = (
+                self.stats.get("sharded_fallbacks", 0) + 1
+            )
+            M.sharded_fallbacks.inc("indivisible")
+            if not self._sharded_fallback_logged:
+                self._sharded_fallback_logged = True
+                import logging
+
+                logging.getLogger("kubernetes_tpu.scheduler").warning(
+                    "sharded solve FALLBACK: node capacity %d not divisible "
+                    "by %d mesh shards — dispatching the replicated "
+                    "pipeline until the bucket grows",
+                    self.mirror.nodes.capacity, self._mesh_shards,
+                )
+        elif use_sharded:
+            self._sharded_fallback_logged = False
         t_patch = time.perf_counter()
         self.stats["patch_s"] = self.stats.get("patch_s", 0.0) + (t_patch - t1)
         args = (
@@ -1096,14 +1137,16 @@ class Scheduler:
         # DEVICE (async, results fetched with the assign), replaying the
         # batch in pop order against tracked in-batch state so the host
         # commit loop gets per-pod place/defer verdicts instead of doing
-        # per-pod rechecks itself. Skipped for batches the verdicts could
-        # never be used on (gang, uncovered term kinds, sharded banks).
+        # per-pod rechecks itself. On a mesh the verdict scan runs through
+        # the shard_map'd twin (parallel.sharded pipeline.arbitrate) over
+        # the same node-sharded banks and carry the solve used. Skipped
+        # for batches the verdicts could never be used on (gang,
+        # uncovered term kinds).
         verdict_dev = None
         levels_arr = np.array([_recheck_level(r) for r in reps], np.int8)
         if (
             self.commit_plane
             and not is_gang
-            and not use_sharded
             and kinds_covered(present_kinds)
             # pure RECHECK_NONE batches are the bulk fast path's domain —
             # verdicts would go unused, so don't spend device time on them
@@ -1114,10 +1157,11 @@ class Scheduler:
         ):
             from ..commit.arbiter import arbitrate
 
+            arb_fn = self._sharded.arbitrate if use_sharded else arbitrate
             arb_spec = self._arbiter_spec(with_carry=carry is not None)
             arb_known = self.compile_plan.admit(arb_spec)
             t_arb = time.perf_counter()
-            verdict_dev = arbitrate(
+            verdict_dev = arb_fn(
                 na_dev, batch.arrays(), ea_dev, tb.arrays(), ids,
                 assign, pb=pb, carry=carry,
                 term_kinds=term_kinds, n_buckets=n_buckets,
@@ -1278,8 +1322,6 @@ class Scheduler:
                 # round shares ONE signature (padded scan steps are cheap;
                 # the per-distinct-fails-count compiles were not), then
                 # warm it so the first failed batch doesn't pay the compile
-                from ..state.tensors import _bucket
-
                 self._p_bucket = max(self._p_bucket, _bucket(self.batch_size, 8))
                 self._warm_svc.warm_specs([self._preempt_spec()])
             if self.fold_plane:
@@ -1303,6 +1345,14 @@ class Scheduler:
                     fold_specs.append(replace(nom, b=b))
                     b *= 2
                 self._warm_svc.warm_specs(fold_specs)
+            # dirty-row scatter programs (KIND_PATCH): every bank
+            # structure x row rung the mirror can ship, pre-compiled by
+            # idempotent no-op patches. Post-warmup patches — commit usage
+            # rows, preemption victim deletions, node churn — land on hot
+            # programs; before this, the first patch at each fresh rung
+            # was an inline XLA compile billed to the DRAIN (the
+            # preemption bench's cycle-2 "solve" spike was exactly these).
+            self.mirror.warm_patches()
             if infos:
                 # headroom: compile the next growth rung of each mid-drain-
                 # growable axis in the background while the drain starts —
@@ -1802,6 +1852,20 @@ class Scheduler:
                 )
             except Exception:
                 plans = None  # kernel trouble: scalar path answers instead
+            # the victim axis GROWS mid-drain (nodes accumulate pods as
+            # batches commit): background-warm one victim rung ahead so
+            # the next preemption round lands on a hot kernel instead of
+            # an inline compile — the same headroom discipline the solve's
+            # growth hook applies
+            if self._aot_enabled and self._warm_svc is not None:
+                from dataclasses import replace as _replace
+
+                from ..compile.ladder import next_rung
+
+                p_spec = self._preempt_spec()
+                self._warm_svc.warm_async(
+                    [_replace(p_spec, v=next_rung(p_spec.v, 8))]
+                )
         M.preemption_evaluation_duration.observe(time.perf_counter() - t0)
         any_preempted = False
         any_fits_free = False

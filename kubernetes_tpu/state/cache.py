@@ -46,6 +46,44 @@ DEFAULT_ASSUME_TTL = 30.0  # cache.go durationToExpireAssumedPod (30s default)
 _ROW_SCATTER = None
 _ROW_SCATTER_DONATED = None
 
+# dirty-row scatter row-count rungs: every (structure, rung) pair is one
+# XLA program, so the rung set must be SMALL enough to pre-compile at
+# warmup (TensorMirror.warm_patches) — a pow-2 ladder up to the batch
+# bucket was one inline compile per fresh bucket, and those landed
+# MID-DRAIN (the round-5 preemption config's cycle-2 "solve" spike was
+# these scatters compiling after victim deletions dirtied rows). Bigger
+# patches chunk at the top rung: same bytes, same programs.
+PATCH_RUNGS = (16, 64, 256)
+
+
+def _patch_rung(n: int) -> int:
+    for r in PATCH_RUNGS:
+        if n <= r:
+            return r
+    return PATCH_RUNGS[-1]
+
+
+#: bytes_shipped kinds whose payloads are PREDOMINANTLY node-major bank
+#: slices — on a mesh each shard receives 1/shards of them. Everything
+#: else (fold control arrays) replicates to every shard in full.
+#: Approximate by design: "full"/"rows" also carry the banks' [S]/[PT]-
+#: major metadata arrays (replicated), counted here at 1/shards — they
+#: are small next to the [N, *] matrices, and exact per-kind sub-
+#: accounting would fork the user-facing metric label set.
+NODE_MAJOR_SHIP_KINDS = frozenset({"full", "rows", "usage", "warm"})
+
+
+def per_shard_bytes(shipped: Dict[str, int], shards: int) -> Dict[str, int]:
+    """The per-shard view of a TensorMirror.bytes_shipped ledger: the one
+    split policy bench.py and the multichip dryrun both report (see
+    NODE_MAJOR_SHIP_KINDS for the approximation it makes)."""
+    if not shards:
+        return dict(shipped)
+    return {
+        k: (v // shards if k in NODE_MAJOR_SHIP_KINDS else v)
+        for k, v in shipped.items()
+    }
+
 
 def _row_scatter_fn():
     """One jitted row-scatter over a whole bank dict: a single dispatch
@@ -456,6 +494,13 @@ class TensorMirror:
         # the driver opts patches into buffer donation once it owns the
         # only live reference to the bank dicts (fold plane on)
         self.donate_patches = False
+        # the driver's compile plan (when attached): the dirty-row scatter
+        # programs are admitted as KIND_PATCH specs so a post-warmup
+        # scatter compile is a VISIBLE miss, not a silent mid-drain stall
+        self.compile_plan = None
+        # mesh-bound fold kernels (ops/fold.make_sharded_fold_fns), built
+        # lazily on first fold after set_mesh
+        self._sharded_folds = None
         self._rebuild()
 
     def reserve(self, n_nodes: int, n_pods: int = 0) -> None:
@@ -725,8 +770,11 @@ class TensorMirror:
         (leading axis split over the "nodes" mesh axis). Without this the
         sharded pipeline would reshard replicated inputs on every dispatch.
         Patches preserve the sharding (the jitted row-scatter's output
-        inherits its input's)."""
+        inherits its input's), and commit folds dispatch through the
+        mesh-bound shard_map kernels (ops/fold.make_sharded_fold_fns) so
+        the resident-state plane keeps working on multi-chip meshes."""
         self._mesh = mesh
+        self._sharded_folds = None  # rebuilt for the new mesh on demand
         self._device_stale = True  # next device_arrays re-uploads sharded
 
     def _to_dev(self, v, node_major: bool):
@@ -782,8 +830,6 @@ class TensorMirror:
             self.pats.dirty_pattern_rows.clear()
             return self._dev_nodes, self._dev_eps, self._dev_pats
 
-        import numpy as _np
-
         scatter = (
             _row_scatter_donated_fn() if self.donate_patches
             else _row_scatter_fn()
@@ -816,17 +862,7 @@ class TensorMirror:
                 self._ship("full", sum(_nbytes(v) for v in changed.values()))
             if not rows:
                 return dev
-            cap = next(iter(host.values())).shape[0]
-            # pad the row count to a power-of-two bucket so the jitted
-            # scatter compiles once per bucket, not once per batch (every
-            # fresh shape is a multi-second XLA compile on a remote TPU);
-            # padding repeats row[0] — an idempotent overwrite
-            rb = min(_bucket(len(rows)), cap)
-            padded = list(rows[:rb]) + [rows[0]] * max(rb - len(rows), 0)
-            idx = _np.asarray(padded, _np.int32)
-            updates = {k: _np.ascontiguousarray(h[idx]) for k, h in host.items()}
-            self._ship(kind, idx.nbytes + sum(u.nbytes for u in updates.values()))
-            return scatter(dev, jnp.asarray(idx), updates)
+            return self._scatter_rows(scatter, dev, host, rows, kind)
 
         nrows = sorted(self._pending_node_rows)
         # usage-only rows (post-commit deltas): only 3 node arrays + the
@@ -876,6 +912,154 @@ class TensorMirror:
         self.pats.dirty_pattern_rows.clear()
         return self._dev_nodes, self._dev_eps, self._dev_pats
 
+    def _patch_spec(self, host: Dict, rb: int, cap: int):
+        """The dirty-row scatter's program identity as a compile-plan spec:
+        one XLA program per (update-key structure WITH column widths, row
+        rung, row capacity, donation). The widths matter: a vocab/bank
+        growth widens arrays mid-drain, and the post-growth scatter is a
+        genuinely new program — omitting widths would count it as a
+        phantom HIT while it compiles inline."""
+        from ..compile.ladder import KIND_PATCH, SolveSpec
+
+        structure = ",".join(
+            f"{k}{list(v.shape[1:])}" for k, v in sorted(host.items())
+        )
+        return SolveSpec(
+            kind=KIND_PATCH, b=rb, n=cap,
+            config_repr=(
+                ("don|" if self.donate_patches else "copy|") + structure
+            ),
+        )
+
+    def _scatter_rows(
+        self, scatter, dev: Dict, host: Dict, rows, kind: str,
+        warm: bool = False,
+    ) -> Dict:
+        """Ship `rows` of `host` and scatter them into `dev`, chunked at
+        the PATCH_RUNGS quantizer so the program set stays small enough to
+        pre-compile (warm_patches). Row padding repeats row[0] — an
+        idempotent overwrite. Admitted against the attached compile plan:
+        a scatter compile AFTER warmup is a counted miss (these were the
+        invisible mid-drain stalls of the preemption bench — victim
+        deletions dirtied rows at a fresh bucket and the scatter compiled
+        inline, billed to solve_s). `warm=True` (warm_patches) DECLARES
+        instead of admitting — planned pre-compiles must not inflate the
+        dispatch miss counters."""
+        import jax.numpy as jnp
+        import numpy as _np
+
+        cap = next(iter(host.values())).shape[0]
+        rb = min(_patch_rung(len(rows)), cap)
+        plan = self.compile_plan
+        known = True
+        if plan is not None:
+            spec = self._patch_spec(host, rb, cap)
+            if warm:
+                known = plan.is_declared(spec)
+                plan.declare(spec)
+            else:
+                known = plan.admit(spec)
+        rows = list(rows)
+        first = True
+        dt_compile = 0.0
+        for i in range(0, len(rows), rb):
+            chunk = rows[i : i + rb]
+            padded = chunk + [chunk[0]] * (rb - len(chunk))
+            idx = _np.asarray(padded, _np.int32)
+            updates = {k: _np.ascontiguousarray(h[idx]) for k, h in host.items()}
+            self._ship(kind, idx.nbytes + sum(u.nbytes for u in updates.values()))
+            if first:
+                # only the FIRST chunk can trace+compile (later chunks hit
+                # the fresh cache entry) — attribute just its wall to the
+                # miss, or compile_s would overstate the stall by the
+                # chunk count
+                t0 = time.perf_counter()
+                dev = scatter(dev, jnp.asarray(idx), updates)
+                dt_compile = time.perf_counter() - t0
+                first = False
+            else:
+                dev = scatter(dev, jnp.asarray(idx), updates)
+        if plan is not None and not known:
+            from ..compile.plan import SOURCE_INLINE, SOURCE_WARMUP
+
+            plan.note_compiled(
+                spec, dt_compile,
+                SOURCE_WARMUP if warm
+                else (SOURCE_INLINE if plan.warmed else "warmup"),
+            )
+        return dev
+
+    def warm_patches(self) -> int:
+        """Pre-compile every dirty-row scatter program the mirror can ship
+        (each bank structure x each PATCH_RUNGS rung ≤ its capacity) with
+        idempotent no-op patches — row 0 repeated, host truth re-written
+        over itself. Returns the number of scatter programs executed. The
+        driver calls this at warmup so post-warmup patches (commit usage
+        rows, preemption victim deletions, node churn) land on hot
+        programs; without it the first patch at each fresh rung is an
+        inline XLA compile billed mid-drain."""
+        # like every resident-bank consumer: fold an active nominee
+        # overlay back out first — the no-op scatters below rewrite rows
+        # with HOST truth, which would erase overlay contributions and
+        # leave the later unfold subtracting them into phantom capacity
+        self._restore_nominees()
+        if self._dev_nodes is None or self._device_stale:
+            self.device_arrays()
+        scatter = (
+            _row_scatter_donated_fn() if self.donate_patches
+            else _row_scatter_fn()
+        )
+        host_n = self.nodes.arrays()
+        host_e = self.eps.arrays()
+        host_p = self.pats.arrays()
+        usage_h = {k: host_n[k] for k in ("requested", "nonzero_req", "pod_count")}
+        n = 0
+        # each entry mirrors ONE device_arrays patch call: (dev pytree,
+        # host dict) must match it exactly or the warmed jit signature is
+        # a different program than the one the drain dispatches
+        for label, dev_of, host, sink in (
+            # usage patches pass the FULL nodes dict as dev (3-key host)
+            ("nodes", lambda: self._dev_nodes, host_n, "_dev_nodes"),
+            ("usage", lambda: self._dev_nodes, usage_h, "_dev_nodes"),
+            (
+                "eps_meta",
+                lambda: {k: v for k, v in self._dev_eps.items() if k != "counts"},
+                {k: v for k, v in host_e.items() if k != "counts"},
+                "_dev_eps",
+            ),
+            (
+                "eps_counts",
+                lambda: {"counts": self._dev_eps["counts"]},
+                {"counts": host_e["counts"]},
+                "_dev_eps",
+            ),
+            (
+                "pats_meta",
+                lambda: {k: v for k, v in self._dev_pats.items() if k != "counts"},
+                {k: v for k, v in host_p.items() if k != "counts"},
+                "_dev_pats",
+            ),
+            (
+                "pats_counts",
+                lambda: {"counts": self._dev_pats["counts"]},
+                {"counts": host_p["counts"]},
+                "_dev_pats",
+            ),
+        ):
+            cap = next(iter(host.values())).shape[0]
+            seen = set()
+            for rung in PATCH_RUNGS:
+                rb = min(rung, cap)
+                if rb in seen:
+                    continue  # rungs past capacity collapse onto one program
+                seen.add(rb)
+                out = self._scatter_rows(
+                    scatter, dev_of(), host, [0] * rb, "warm", warm=True
+                )
+                setattr(self, sink, {**getattr(self, sink), **out})
+                n += 1
+        return n
+
     # -- resident-state plane (ops/fold + commit/fold) ----------------------
 
     def _ship(self, kind: str, nbytes: int) -> None:
@@ -890,16 +1074,35 @@ class TensorMirror:
             pass
 
     def can_fold(self) -> bool:
-        """Device banks resident, current-shaped, and single-device: the
-        preconditions for folding commits in place. Sharded banks
-        (set_mesh) keep the host scatter path — the fold's donation
-        contract is per-buffer and the sharded pipeline re-dispatches
-        through its own partitioner."""
-        return (
-            self._dev_nodes is not None
-            and not self._device_stale
-            and getattr(self, "_mesh", None) is None
-        )
+        """Device banks resident and current-shaped: the preconditions for
+        folding commits in place. On a mesh the banks are node-sharded and
+        the fold dispatches through the mesh-bound shard_map kernels
+        (collective-free, sharding preserved through donation) — foldable
+        whenever the node capacity divides the shard count, the same
+        divisibility rule the sharded solve itself lives by."""
+        if self._dev_nodes is None or self._device_stale:
+            return False
+        mesh = getattr(self, "_mesh", None)
+        if mesh is None:
+            return True
+        from ..parallel.mesh import AXIS_NODES
+
+        shards = mesh.shape.get(AXIS_NODES, 0)
+        return shards > 0 and self.nodes.capacity % shards == 0
+
+    def _fold_fns(self):
+        """(fold_commit_banks, fold_usage) for the current residency: the
+        plain donated kernels single-device, the mesh-bound shard_map
+        twins when the banks are node-sharded."""
+        if getattr(self, "_mesh", None) is None:
+            from ..ops.fold import fold_commit_banks, fold_usage
+
+            return fold_commit_banks, fold_usage
+        if self._sharded_folds is None:
+            from ..ops.fold import make_sharded_fold_fns
+
+            self._sharded_folds = make_sharded_fold_fns(self._mesh)
+        return self._sharded_folds
 
     def fold_commit(self, prog) -> bool:
         """Apply a planned commit fold (commit/fold.FoldProgram) to the
@@ -910,7 +1113,7 @@ class TensorMirror:
         self._restore_nominees()
         if not self.can_fold():
             return False
-        from ..ops.fold import fold_commit_banks
+        fold_commit_banks, _ = self._fold_fns()
 
         n, e, p = self._dev_nodes, self._dev_eps, self._dev_pats
         donated = (
@@ -957,7 +1160,7 @@ class TensorMirror:
         overlay paid per dispatch. The overlay is recorded and folded back
         out by unfold_nominees (integer adds invert exactly); every other
         resident-bank consumer restores it defensively first."""
-        from ..ops.fold import fold_usage
+        _, fold_usage = self._fold_fns()
 
         self._restore_nominees()
         n = self._dev_nodes
@@ -976,7 +1179,7 @@ class TensorMirror:
         overlay = self._nominee_overlay
         if overlay is None:
             return
-        from ..ops.fold import fold_usage
+        _, fold_usage = self._fold_fns()
 
         rows, vecs, cnt = overlay
         self._nominee_overlay = None
@@ -999,7 +1202,13 @@ class TensorMirror:
         own truncation). Empty list = the resident-state plane is exact.
         This is the parity probe the fold test suite and perf_smoke use;
         it fetches the full banks, so it is a debug/verification API, not
-        a hot-path one."""
+        a hot-path one. Fetches go through a DEVICE-SIDE COPY: np.asarray
+        on the resident array itself would cache a host view on it
+        (jax.Array._npy_value), and that cached reference silently blocks
+        the NEXT fold's buffer donation — the probe must not perturb what
+        it measures."""
+        import jax.numpy as jnp
+
         self._restore_nominees()
         out: List[str] = []
         if self._dev_nodes is None:
@@ -1014,7 +1223,7 @@ class TensorMirror:
                 if d is None:
                     out.append(f"{label}.{k}:missing")
                     continue
-                dn = np.asarray(d)
+                dn = np.asarray(jnp.array(d, copy=True))
                 if dn.shape != h.shape or not np.array_equal(
                     dn, np.asarray(h).astype(dn.dtype)
                 ):

@@ -22,7 +22,20 @@ the two invariants the plane lives by:
 Fast (~1 min on CPU) so it runs in tier-1 un-slow-marked, wired through
 tests/test_perf_smoke.py; also runnable standalone:
 
-    JAX_PLATFORMS=cpu python scripts/perf_smoke.py
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py            # single-device
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py sharded    # 8-way mesh
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py preempt    # preemption
+
+`main(sharded=True)` runs the SAME workload over a forced 8-virtual-device
+node mesh and additionally asserts the multi-chip acceptance criteria:
+arbiter coverage > 0, fold coverage > 0, `fold_undonated == 0`,
+`patch_bytes.usage == 0`, and ZERO sharded→replicated fallbacks.
+
+`main_preempt()` is the post-preemption shape-routing guard (BENCH_r05
+config 6's cycle-2 solve spike): a tiny preemption drain must finish with
+`misses_after_warmup == 0` AND `warm_stall_batches == 0` — victim-deletion
+row patches, the nominee overlay, and the preempt kernel all land on
+warmed programs.
 """
 
 from __future__ import annotations
@@ -91,7 +104,43 @@ def tiny_commit_plane_config():
     return nodes, pods
 
 
-def main() -> dict:
+def preemption_smoke_config():
+    """(nodes, pending, existing): 8 nodes pre-filled to ~90% CPU with
+    low-priority victims; high-priority pods that can only land by
+    eviction — the bench's preemption config at smoke scale."""
+    import bench
+
+    nodes = [bench.mk_node(i) for i in range(N_NODES)]
+    existing = []
+    for i in range(N_NODES * 7):  # 7 x 4000m of 32 cores per node
+        p = bench.mk_pod(1_000_000 + i, cpu="4000m", mem="1Gi",
+                         labels={"app": f"lowprio-{i % 4}"})
+        p.priority = 0
+        p.node_name = f"node-{i % N_NODES}"
+        existing.append(p)
+    pending = []
+    for i in range(24):
+        p = bench.mk_pod(i, cpu="6000m", mem="2Gi",
+                         labels={"app": f"hiprio-{i % 4}"})
+        p.priority = 1000
+        pending.append(p)
+    return nodes, pending, existing
+
+
+def _mesh8():
+    import jax
+
+    if len(jax.devices()) < 8:
+        raise RuntimeError(
+            "sharded perf_smoke needs 8 devices "
+            "(xla_force_host_platform_device_count)"
+        )
+    from kubernetes_tpu.parallel import node_mesh
+
+    return node_mesh(8)
+
+
+def main(sharded: bool = False) -> dict:
     import bench
 
     bench.BATCH = SMOKE_BATCH
@@ -102,6 +151,12 @@ def main() -> dict:
         it closes: device/host bank parity and the donation ledger."""
         import jax
 
+        # quiesce the background compile-warmup worker FIRST: a growth-rung
+        # warm compiling during the census below allocates device arrays on
+        # its own thread and makes the buffer-growth delta flaky
+        if sched._warm_svc is not None:
+            sched._warm_svc.stop()
+            sched._warm_svc.join()
         m = sched.mirror
         sched._commit_pipe.drain()
         m.sync()
@@ -141,14 +196,28 @@ def main() -> dict:
         fold_state["buffer_growth"] = len(jax.live_arrays()) - before
         fold_state["divergence_after_noop"] = m.device_bank_divergence()
 
+    opts = {}
+    name = "tiny_commit_plane_smoke"
+    if sharded:
+        opts["mesh"] = _mesh8()
+        name = "tiny_commit_plane_smoke_sharded8"
     detail = bench.run_config(
-        "tiny_commit_plane_smoke", tiny_commit_plane_config, inspect=inspect
+        name, tiny_commit_plane_config, opts=opts, inspect=inspect
     )
     phase = detail["phase_split_s"]
     audit = detail["audit"]
     problems = []
     if detail["scheduled"] != N_PODS:
         problems.append(f"scheduled {detail['scheduled']} of {N_PODS} pods")
+    if sharded:
+        # the multi-chip acceptance criteria ride the same assertions as
+        # single-device — plus: the sharded pipeline must never have
+        # silently dropped to the replicated solve
+        if phase.get("sharded_fallbacks", 0):
+            problems.append(
+                f"{phase['sharded_fallbacks']} sharded->replicated "
+                "fallback(s) on a mesh whose shard count divides the bucket"
+            )
     if not phase.get("arbiter_batches", 0):
         problems.append("commit-plane coverage is ZERO (arbiter never committed a batch)")
     if not phase.get("arbiter_place", 0):
@@ -176,6 +245,14 @@ def main() -> dict:
         problems.append(
             f"no-op folds changed the banks: {fold_state['divergence_after_noop']}"
         )
+    if sharded and detail.get("patch_bytes", {}).get("usage", 0) > 4096:
+        # "≈ 0": a covered mesh drain folds its usage deltas in place —
+        # a few stray rows (escalations) are tolerable, a per-batch
+        # re-ship is the regression this guards
+        problems.append(
+            f"usage patch bytes {detail['patch_bytes']['usage']} on a "
+            "covered mesh drain (the resident-state plane is off on-mesh)"
+        )
     if detail["compile"]["misses_after_warmup"]:
         problems.append(
             f"{detail['compile']['misses_after_warmup']} compile-spec "
@@ -188,20 +265,66 @@ def main() -> dict:
     return detail
 
 
+def main_preempt() -> dict:
+    """Preemption-path smoke: the post-preemption cycles must land on
+    warmed programs. BENCH_r05's config 6 spent 2.58 s of 'solve' on its
+    second cycle — which turned out to be the mirror's dirty-row scatter
+    programs compiling inline after victim deletions dirtied rows at a
+    fresh bucket (invisible to the plan: patches were not specs). With
+    KIND_PATCH warming + the preempt victim-rung headroom warm, the whole
+    drain must report zero misses after warmup and zero stall batches."""
+    import bench
+
+    bench.BATCH = SMOKE_BATCH
+    detail = bench.run_config(
+        "tiny_preemption_smoke", preemption_smoke_config,
+        opts={"enable_preemption": True},
+    )
+    phase = detail["phase_split_s"]
+    problems = []
+    if detail["scheduled"] != 24:
+        problems.append(f"scheduled {detail['scheduled']} of 24 pods")
+    if not detail["preempted"]:
+        problems.append("no preemption happened — the config is broken")
+    if detail["compile"]["misses_after_warmup"]:
+        problems.append(
+            f"{detail['compile']['misses_after_warmup']} compile-spec "
+            "miss(es) after warmup on the preemption drain "
+            "(post-preemption shapes missed the warmed rungs)"
+        )
+    if detail["warm_stall_batches"]:
+        problems.append(
+            f"{detail['warm_stall_batches']} stall batch(es) in the "
+            "measured tail — an inline compile (or equivalent) mid-drain"
+        )
+    for k, v in detail["audit"].items():
+        if k.endswith("_violations") and v:
+            problems.append(f"audit: {k}={v}")
+    assert not problems, "; ".join(problems)
+    return detail
+
+
 if __name__ == "__main__":
-    d = main()
+    mode = sys.argv[1] if len(sys.argv) > 1 else ""
+    if mode == "preempt":
+        d = main_preempt()
+    else:
+        d = main(sharded=(mode == "sharded"))
     p = d["phase_split_s"]
     print(json.dumps({
         "config": d["config"],
         "scheduled": d["scheduled"],
         "deferred": d.get("deferred", 0),
+        "preempted": d.get("preempted", 0),
         "arbiter_batches": p.get("arbiter_batches", 0),
         "arbiter_place": p.get("arbiter_place", 0),
         "arbiter_defer": p.get("arbiter_defer", 0),
         "fold_batches": p.get("fold_batches", 0),
         "fold_pods": p.get("fold_pods", 0),
+        "sharded_fallbacks": p.get("sharded_fallbacks", 0),
         "patch_bytes": d.get("patch_bytes", {}),
         "commit_s": p.get("commit_s"),
         "solve_s": p.get("solve_s"),
+        "warm_stall_batches": d.get("warm_stall_batches", 0),
         "misses_after_warmup": d["compile"]["misses_after_warmup"],
     }))

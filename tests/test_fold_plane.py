@@ -86,7 +86,18 @@ def _spread_pod(name, app, cpu=50):
     return p
 
 
-def _mk_sched(nodes, existing=(), **kw):
+def _mesh8():
+    """8-way node mesh or skip (KTPU_TEST_PLATFORM=axon is single-chip)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from kubernetes_tpu.parallel import node_mesh
+
+    return node_mesh(8)
+
+
+def _mk_sched(nodes, existing=(), on_mesh=False, **kw):
     cache = SchedulerCache()
     for n in nodes:
         cache.add_node(n)
@@ -95,6 +106,8 @@ def _mk_sched(nodes, existing=(), **kw):
     binds = []
     binder = Binder(lambda pod, node: binds.append((pod.key(), node)))
     kw.setdefault("deterministic", True)
+    if on_mesh:
+        kw["mesh"] = _mesh8()
     sched = Scheduler(cache=cache, queue=PriorityQueue(), binder=binder, **kw)
     return sched, binds
 
@@ -136,11 +149,16 @@ def _assert_parity(sched, expect_folds=True):
 # seeded drain parity
 # ---------------------------------------------------------------------------
 
-def test_covered_only_drain_parity_and_zero_usage_bytes():
+@pytest.mark.parametrize("on_mesh", [False, True])
+def test_covered_only_drain_parity_and_zero_usage_bytes(on_mesh):
     """Plain pods → the bulk fast path folds every batch: the device banks
     stay exact with ZERO usage-column bytes shipped (the tentpole's
-    acceptance number, asserted at smoke scale)."""
-    sched, _ = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    acceptance number, asserted at smoke scale) — single-device AND on
+    the 8-way node-sharded mesh (the folds dispatch through the
+    shard_map kernels there, donation preserving the NamedSharding)."""
+    sched, _ = _mk_sched(
+        _nodes(4), enable_preemption=False, batch_size=8, on_mesh=on_mesh
+    )
     for i in range(24):
         sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
     n, _, _ = _drain(sched)
@@ -150,18 +168,24 @@ def test_covered_only_drain_parity_and_zero_usage_bytes():
         sched.mirror.bytes_shipped
     )
     assert sched.mirror.bytes_shipped.get("fold", 0) > 0
+    if on_mesh:
+        assert sched.stats.get("sharded_fallbacks", 0) == 0, sched.stats
     sched.close()
 
 
-@pytest.mark.parametrize("seed", [0, 1])
-def test_mixed_covered_oracle_escalated_drain_parity(seed):
+@pytest.mark.parametrize("seed,on_mesh", [(0, False), (1, False), (0, True)])
+def test_mixed_covered_oracle_escalated_drain_parity(seed, on_mesh):
     """Arbiter-covered (anti/spread), oracle (required affinity), and
     plain pods in one drain: folded and host-shipped rows interleave on
-    the same banks and must compose exactly."""
+    the same banks and must compose exactly — on-mesh too (sharded
+    arbiter + sharded folds + host-wins rows on sharded banks)."""
     import random
 
     rng = random.Random(seed)
-    sched, _ = _mk_sched(_nodes(6, zones=3), enable_preemption=False, batch_size=8)
+    sched, _ = _mk_sched(
+        _nodes(6, zones=3), enable_preemption=False, batch_size=8,
+        on_mesh=on_mesh,
+    )
     for i in range(24):
         roll = rng.random()
         if roll < 0.25:
@@ -178,10 +202,13 @@ def test_mixed_covered_oracle_escalated_drain_parity(seed):
     sched.close()
 
 
-def test_preemption_drain_parity():
+@pytest.mark.parametrize("on_mesh", [False, True])
+def test_preemption_drain_parity(on_mesh):
     """Victim deletions dirty their node rows mid-drain (host-wins path)
     while the preemptors' commits fold — and outstanding nominations
-    exercise the donated nominee overlay + exact restore."""
+    exercise the donated nominee overlay + exact restore. On-mesh the
+    overlay folds through the sharded usage kernel and the victim rows
+    re-ship onto sharded banks."""
     nodes = _nodes(3, cpu=1000)
     existing = []
     for i, nd in enumerate(nodes):
@@ -190,6 +217,7 @@ def test_preemption_drain_parity():
         existing.append(v)
     sched, _ = _mk_sched(
         nodes, existing=existing, enable_preemption=True, batch_size=8,
+        on_mesh=on_mesh,
     )
     for i in range(3):
         p = make_pod(f"hi{i}", cpu_milli=800)
@@ -201,10 +229,13 @@ def test_preemption_drain_parity():
     sched.close()
 
 
-def test_gang_rollback_drain_parity():
+@pytest.mark.parametrize("on_mesh", [False, True])
+def test_gang_rollback_drain_parity(on_mesh):
     """A gang that rolls back (min-available unmet) plus plain pods that
     fold: forget_pods pushes removes the host-wins path must reconcile."""
-    sched, _ = _mk_sched(_nodes(4), enable_preemption=False, batch_size=16)
+    sched, _ = _mk_sched(
+        _nodes(4), enable_preemption=False, batch_size=16, on_mesh=on_mesh
+    )
     for m in range(2):
         sched.queue.add(make_pod(
             f"gm{m}", cpu_milli=100,
@@ -220,11 +251,15 @@ def test_gang_rollback_drain_parity():
     sched.close()
 
 
-def test_node_churn_mid_drain_parity():
+@pytest.mark.parametrize("on_mesh", [False, True])
+def test_node_churn_mid_drain_parity(on_mesh):
     """Folds outstanding when nodes arrive AND leave: removed rows are
     released + reused, new rows encode fresh — all host-wins, composed
-    with the folded rows."""
-    sched, _ = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    with the folded rows (on-mesh: host-wins scatters land on the
+    sharded banks without disturbing the folded rows)."""
+    sched, _ = _mk_sched(
+        _nodes(4), enable_preemption=False, batch_size=8, on_mesh=on_mesh
+    )
     for i in range(8):
         sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
     r = sched.schedule_batch()
@@ -265,11 +300,12 @@ def test_sig_bank_rebuild_mid_drain_parity():
 # plane ON == plane OFF, pod for pod
 # ---------------------------------------------------------------------------
 
-def test_fold_plane_off_schedules_identically():
+@pytest.mark.parametrize("on_mesh", [False, True])
+def test_fold_plane_off_schedules_identically(on_mesh):
     def run(fold_plane):
         sched, _ = _mk_sched(
             _nodes(6, zones=3), enable_preemption=False, batch_size=8,
-            fold_plane=fold_plane,
+            fold_plane=fold_plane, on_mesh=on_mesh,
         )
         for i in range(12):
             if i % 3 == 0:

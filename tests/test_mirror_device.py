@@ -66,7 +66,12 @@ def test_vocab_growth_full_reupload_with_folds_outstanding():
     assert fold_rows or True
 
 
-def test_set_mesh_restales_and_disables_folds():
+def test_set_mesh_restales_then_folds_resume_sharded():
+    """set_mesh marks the banks stale (no folds until the sharded
+    re-upload lands) — and AFTER the re-upload the fold plane resumes
+    through the mesh-bound shard_map kernels, banks staying sharded and
+    bit-exact (the round-9 change: sharded banks no longer force the
+    host scatter path)."""
     import jax
     from jax.sharding import Mesh
 
@@ -76,13 +81,19 @@ def test_set_mesh_restales_and_disables_folds():
     assert m.can_fold()
     mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("nodes",))
     m.set_mesh(mesh)
-    assert not m.can_fold()  # sharded banks keep the host scatter path
+    assert not m.can_fold()  # stale: the sharded re-upload must land first
     ghost = make_pod("ghost", cpu_milli=100)
     assert plan_fold(m, [(ghost, 0)], 16, 16) is None or not m.fold_commit(
         plan_fold(m, [(ghost, 0)], 16, 16)
     )
     m.device_arrays()  # sharded full re-upload
     assert m.device_bank_divergence() == []
+    assert m.can_fold()  # resident + current + divisible → sharded folds
+    _fold_one(cache, m, name="p1", node="n1")
+    m.sync()
+    m.device_arrays()
+    assert m.device_bank_divergence() == []
+    assert m.folds_undonated == 0
 
 
 def test_dtype_canonicalization_does_not_defeat_row_patching():

@@ -119,6 +119,7 @@ def test_sharded_chunked_contention_multi_chunk():
     mesh = node_mesh(8)
     from functools import partial
 
+    from kubernetes_tpu.parallel.mesh import shard_map
     from kubernetes_tpu.parallel.sharded import _solver_body
     from jax.sharding import PartitionSpec as P
 
@@ -133,7 +134,7 @@ def test_sharded_chunked_contention_multi_chunk():
             from kubernetes_tpu.ops.solver import tie_noise
 
             noise = tie_noise(key, B, N)
-        solver = jax.shard_map(
+        solver = shard_map(
             partial(_solver_body, deterministic=det, n_local=1),
             mesh=mesh,
             in_specs=(P(None, "nodes"), P(None, "nodes"), P(), P("nodes"),
@@ -258,6 +259,184 @@ def test_driver_over_mesh_gang():
     assert binds_mesh == binds_one, (binds_mesh, binds_one)
     assert r_mesh.scheduled == r_one.scheduled
     assert set(binds_mesh) == {f"default/a{m}" for m in range(4)}
+
+
+def test_sharded_arbiter_verdicts_match_host_and_single_device():
+    """The shard_map'd commit arbiter (commit/arbiter.make_sharded_arbiter,
+    dispatched via pipeline.arbitrate) must produce BIT-IDENTICAL verdicts
+    to both the single-device arbiter and the pure-oracle host walk, on a
+    mixed anti/hard-spread/ports batch — the commit plane's multi-chip
+    parity pin."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        ContainerPort,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        TopologySpreadConstraint,
+    )
+    from kubernetes_tpu.commit import host_arbitrate
+    from kubernetes_tpu.models.generators import make_node, make_pod
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    HOST = "kubernetes.io/hostname"
+    ZONE = "zone"
+
+    def verdicts(mesh_arg):
+        cache = SchedulerCache()
+        for i in range(8):
+            cache.add_node(make_node(
+                f"n{i}", cpu_milli=4000, labels={HOST: f"n{i}", ZONE: f"z{i % 2}"},
+            ))
+        sched = Scheduler(
+            cache=cache, queue=PriorityQueue(), binder=Binder(),
+            deterministic=True, enable_preemption=False, mesh=mesh_arg,
+        )
+        for i in range(6):
+            p = make_pod(f"a{i}", cpu_milli=100, labels={"app": "g"})
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+                PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": "g"}),
+                    topology_key=HOST,
+                )
+            ]))
+            sched.queue.add(p)
+        for i in range(6):
+            p = make_pod(f"s{i}", cpu_milli=50, labels={"app": "web"})
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=1, topology_key=ZONE,
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(match_labels={"app": "web"}),
+            )]
+            sched.queue.add(p)
+        for i in range(4):
+            p = make_pod(f"hp{i}", cpu_milli=50)
+            p.containers[0].ports = [ContainerPort(host_port=8080)]
+            sched.queue.add(p)
+        infos = sched.queue.pop_batch(16)
+        out = sched._finish_solve(sched._dispatch_solve(infos))
+        assert out.verdicts is not None, "arbiter was not dispatched on-mesh"
+        host = host_arbitrate(
+            [i.pod for i in infos], out.assign,
+            sched.mirror.node_name_of_row, sched.cache.snapshot,
+        )
+        return list(out.assign), [int(v) for v in out.verdicts], host
+
+    a_mesh, v_mesh, host_mesh = verdicts(node_mesh(8))
+    a_one, v_one, _ = verdicts(None)
+    assert a_mesh == a_one
+    assert v_mesh == v_one
+    assert v_mesh == host_mesh
+
+
+def test_driver_over_mesh_zero_round_trip_steady_state():
+    """The tentpole's acceptance pin: a covered drain on the 8-way mesh
+    commits EVERY batch through the device arbiter, folds EVERY batch's
+    deltas into the sharded resident banks (no usage bytes shipped), never
+    falls back to the replicated pipeline, keeps device/host bank
+    bit-parity — and schedules pod-for-pod identically to the
+    single-device driver."""
+    import time as _time
+
+    from kubernetes_tpu.api.types import Affinity, LabelSelector, PodAffinityTerm, PodAntiAffinity
+    from kubernetes_tpu.models.generators import make_node, make_pod
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    HOST = "kubernetes.io/hostname"
+
+    def run(mesh_arg):
+        cache = SchedulerCache()
+        for i in range(8):
+            cache.add_node(make_node(f"n{i}", cpu_milli=4000, labels={HOST: f"n{i}"}))
+        binds = {}
+        sched = Scheduler(
+            cache=cache, queue=PriorityQueue(),
+            binder=Binder(lambda p, n: binds.__setitem__(p.key(), n)),
+            deterministic=True, enable_preemption=False, batch_size=8,
+            mesh=mesh_arg,
+        )
+        for i in range(24):
+            if i % 4 == 0:
+                p = make_pod(f"a{i}", cpu_milli=100, labels={"app": f"g{i % 8}"})
+                p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+                    PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"app": p.labels["app"]}
+                        ),
+                        topology_key=HOST,
+                    )
+                ]))
+                sched.queue.add(p)
+            else:
+                sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+        total = 0
+        for _ in range(40):
+            r = sched.schedule_batch()
+            total += r.scheduled
+            if (r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0
+                    and r.deferred == 0):
+                active, backoff, unsched = sched.queue.counts()
+                if not (active + backoff + unsched):
+                    break
+                _time.sleep(0.05)
+                sched.queue.move_all_to_active()
+        sched.wait_for_binds()
+        sched._commit_pipe.drain()
+        sched.mirror.sync()
+        sched.mirror.device_arrays()
+        div = sched.mirror.device_bank_divergence()
+        stats = dict(sched.stats)
+        shipped = dict(sched.mirror.bytes_shipped)
+        undonated = sched.mirror.folds_undonated
+        sched.close()
+        return binds, total, stats, div, shipped, undonated
+
+    b_mesh, n_mesh, st, div, shipped, undonated = run(node_mesh(8))
+    b_one, n_one, _, _, _, _ = run(None)
+    assert n_mesh == n_one == 24
+    assert b_mesh == b_one, (b_mesh, b_one)
+    batches = st["batches"]
+    assert st.get("arbiter_batches", 0) == batches, st
+    assert st.get("fold_batches", 0) == batches, st
+    assert st.get("sharded_fallbacks", 0) == 0, st
+    assert div == [], div
+    assert shipped.get("usage", 0) == 0, shipped
+    assert undonated == 0
+
+
+def test_sharded_fallback_is_observable():
+    """A mesh whose shard count does not divide the node bucket must still
+    schedule correctly (replicated fallback) — but the fallback is now
+    COUNTED (scheduler_sharded_fallbacks_total / stats), never silent."""
+    from kubernetes_tpu.models.generators import make_node, make_pod
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000))
+    binds = {}
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=Binder(lambda p, n: binds.__setitem__(p.key(), n)),
+        deterministic=True, enable_preemption=False, batch_size=8,
+        mesh=node_mesh(8),
+    )
+    # force indivisibility: node capacity 6 % 8 != 0 (capacity buckets are
+    # pow-2/min-16 so fake it via the gate's own divisor)
+    sched._mesh_shards = 7  # 16 % 7 != 0 → every dispatch falls back
+    for i in range(8):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+    r = sched.schedule_batch()
+    sched.wait_for_binds()
+    assert r.scheduled == 8
+    assert sched.stats.get("sharded_fallbacks", 0) >= 1, sched.stats
+    sched.close()
 
 
 @pytest.mark.parametrize("deterministic", [True, False])

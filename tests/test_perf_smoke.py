@@ -33,3 +33,45 @@ def test_perf_smoke_commit_plane(tmp_path, monkeypatch):
     # workload must actually arbitrate (bit-identity is pinned elsewhere;
     # this guards the wiring staying live)
     assert detail["audit"]["hard_spread_skew_violations"] == 0
+
+
+def test_perf_smoke_sharded_mesh(tmp_path, monkeypatch):
+    """Multi-chip acceptance, tier-1-fast: the SAME smoke workload over a
+    forced 8-virtual-device node mesh must reach the zero-round-trip
+    steady state — arbiter coverage > 0, fold coverage > 0, zero dropped
+    donations, `patch_bytes.usage ≈ 0`, zero sharded→replicated
+    fallbacks, zero compile misses after warmup."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan_sh"))
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main(sharded=True)
+    phase = detail["phase_split_s"]
+    assert phase["arbiter_batches"] > 0
+    assert phase["fold_batches"] > 0
+    assert phase.get("sharded_fallbacks", 0) == 0
+    assert detail["fold_undonated"] == 0
+    assert detail["patch_bytes"].get("usage", 0) <= 4096
+    assert detail["compile"]["misses_after_warmup"] == 0
+    assert detail["scheduled"] == perf_smoke.N_PODS
+
+
+def test_perf_smoke_preemption_no_midrain_compiles(tmp_path, monkeypatch):
+    """Post-preemption cycles must land on warmed programs (the BENCH_r05
+    config-6 cycle-2 spike regression guard): zero compile misses after
+    warmup AND zero stall batches across a drain that actually evicts."""
+    monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan_pre"))
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main_preempt()
+    assert detail["preempted"] > 0
+    assert detail["compile"]["misses_after_warmup"] == 0
+    assert detail["warm_stall_batches"] == 0
+    assert detail["scheduled"] == 24
