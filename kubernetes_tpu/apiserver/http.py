@@ -169,7 +169,10 @@ _CLUSTER_SCOPED = {"nodes", "leases", "priorityclasses", "namespaces",
 
 
 def _parse_selector(vals) -> Optional[Dict[str, str]]:
-    """k8s wire selector syntax: "k1=v1,k2=v2" (equality only)."""
+    """k8s wire FIELD-selector syntax: "k1=v1,k2=v2" (equality only — the
+    reference's field selectors are equality-based, fields/selector.go).
+    Label selectors go through _parse_label_selector, which speaks the
+    full set-based grammar."""
     if not vals or not vals[0]:
         return None
     out: Dict[str, str] = {}
@@ -178,6 +181,18 @@ def _parse_selector(vals) -> Optional[Dict[str, str]]:
             k, _, v = part.partition("=")
             out[k.strip()] = v.strip()
     return out or None
+
+
+def _parse_label_selector(vals):
+    """Full k8s label-selector wire grammar (labels.Parse): equality,
+    `in (a,b)` / `notin (a,b)` set ops, `k` / `!k` existence — parsed to
+    a typed LabelSelector the store's matcher (and watch filtering)
+    evaluates via the in-process match_label_selector."""
+    from .store import parse_wire_label_selector
+
+    if not vals:
+        return None
+    return parse_wire_label_selector(vals[0])
 
 
 def _status(code: int, reason: str, message: str) -> bytes:
@@ -312,9 +327,13 @@ class _Handler(BaseHTTPRequestHandler):
             return self._serve_watch(kind, to_k8s, q, ns=ns_scope)
         if not self._auth("list", kind, ns_scope):
             return
+        try:
+            sel = _parse_label_selector(q.get("labelSelector"))
+        except ValueError as e:
+            return self._send_json(400, _status(400, "BadRequest", str(e)))
         items, rv = self.store.list(
             kind,
-            label_selector=_parse_selector(q.get("labelSelector")),
+            label_selector=sel,
             field_selector=_parse_selector(q.get("fieldSelector")),
         )
         if ns_scope is not None:
@@ -333,12 +352,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             since = int((q.get("resourceVersion") or ["0"])[0] or 0)
             timeout = float((q.get("timeoutSeconds") or ["300"])[0])
+            sel = _parse_label_selector(q.get("labelSelector"))
         except ValueError as e:
             return self._send_json(400, _status(400, "BadRequest", str(e)))
         try:
             watcher = self.store.watch(
                 kind, since,
-                label_selector=_parse_selector(q.get("labelSelector")),
+                label_selector=sel,
                 field_selector=_parse_selector(q.get("fieldSelector")),
             )
         except GoneError as e:

@@ -107,14 +107,119 @@ def _field_of(obj: Any, path: str) -> str:
     return ""
 
 
-def _matches(obj: Any, label_selector: Optional[Dict[str, str]],
+import re as _re
+
+#: `k in (a,b)` / `k notin (a,b)` — whitespace after the op is optional
+#: ("env in(prod)" is legal k8s; the lexer tokenizes '(' separately)
+_SET_REQ_RE = _re.compile(r"^(\S+?)\s+(in|notin)\s*\((.*)\)$")
+#: a plausible label key (qualified-name characters only) — guards every
+#: branch against swallowing unsupported syntax like `k>v` as a literal
+#: never-matching key
+_KEY_RE = _re.compile(r"^[A-Za-z0-9]([A-Za-z0-9._/-]*[A-Za-z0-9])?$")
+
+
+def _is_key(s: str) -> bool:
+    return bool(s) and _KEY_RE.match(s) is not None
+
+
+def parse_wire_label_selector(text: Optional[str]):
+    """k8s wire label-selector syntax (labels.Parse,
+    staging/src/k8s.io/apimachinery/pkg/labels/selector.go) → a typed
+    LabelSelector evaluated by the in-process matcher
+    (api.selectors.match_label_selector — it already implements every op;
+    only this parser was missing). Full grammar:
+
+        k=v | k==v | k!=v | k in (a,b) | k notin (a,b) | k | !k
+
+    comma-separated, ANDed. `!=`/`notin` match when the key is ABSENT or
+    the value differs (labels.Requirement NotIn semantics). Returns None
+    for an empty/missing selector (no filtering); a requirement this
+    grammar cannot parse (Gt/Lt's `k>v`, typo'd set syntax) raises
+    ValueError — the HTTP layer turns that into 400 BadRequest, exactly
+    like the reference apiserver. Silently skipping would over-match
+    (no filter where the client asked for one); silently keeping the
+    raw token as an Exists key would under-match. Both are worse than
+    an error."""
+    if not text or not text.strip():
+        return None
+    from ..api.types import LabelSelector, LabelSelectorRequirement
+
+    # split on top-level commas only: set values live inside parentheses
+    parts, depth, cur = [], 0, []
+    for ch in text:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(depth - 1, 0)
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    match_labels: Dict[str, str] = {}
+    exprs = []
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        m = _SET_REQ_RE.match(part)
+        if m:
+            values = [v.strip() for v in m.group(3).split(",") if v.strip()]
+            if not _is_key(m.group(1)) or not values:
+                raise ValueError(
+                    f"unparseable label-selector requirement {part!r}"
+                )
+            exprs.append(LabelSelectorRequirement(
+                key=m.group(1),
+                operator="In" if m.group(2) == "in" else "NotIn",
+                values=values,
+            ))
+        elif "!=" in part:
+            k, _, v = part.partition("!=")
+            if not _is_key(k.strip()):
+                raise ValueError(
+                    f"unparseable label-selector requirement {part!r}"
+                )
+            exprs.append(LabelSelectorRequirement(
+                key=k.strip(), operator="NotIn", values=[v.strip()]
+            ))
+        elif "=" in part:
+            k, _, v = part.partition("==" if "==" in part else "=")
+            if not _is_key(k.strip()):
+                raise ValueError(
+                    f"unparseable label-selector requirement {part!r}"
+                )
+            match_labels[k.strip()] = v.strip()
+        elif part.startswith("!") and _is_key(part[1:].strip()):
+            exprs.append(LabelSelectorRequirement(
+                key=part[1:].strip(), operator="DoesNotExist"
+            ))
+        elif _is_key(part):
+            exprs.append(LabelSelectorRequirement(key=part, operator="Exists"))
+        else:
+            raise ValueError(f"unparseable label-selector requirement {part!r}")
+    if not match_labels and not exprs:
+        return None
+    return LabelSelector(match_labels=match_labels, match_expressions=exprs)
+
+
+def _matches(obj: Any, label_selector,
              field_selector: Optional[Dict[str, str]]) -> bool:
-    """labels.Set.AsSelector + fields.Set matching (equality only — the
-    reference's field selectors are equality-based too)."""
+    """Label matching accepts BOTH selector shapes: the in-process
+    informers' equality dict (labels.Set.AsSelector) and a typed
+    LabelSelector from the wire parser above (set-based ops included).
+    Field selectors stay equality-only — the reference's are too."""
     if label_selector:
         labels = getattr(obj, "labels", None) or {}
-        for k, v in label_selector.items():
-            if labels.get(k) != v:
+        if isinstance(label_selector, dict):
+            for k, v in label_selector.items():
+                if labels.get(k) != v:
+                    return False
+        else:
+            from ..api.selectors import match_label_selector
+
+            if not match_label_selector(label_selector, labels):
                 return False
     if field_selector:
         for path, v in field_selector.items():

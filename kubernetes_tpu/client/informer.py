@@ -10,6 +10,16 @@ client-go equivalents (SURVEY §2.4 item 3):
 This is the scheduler's ONLY ingestion path in standalone mode: the
 watch → EventHandlers → cache/queue → TensorMirror dirty-row patch chain
 (SURVEY §3.3) starts here.
+
+The pod-ingest plane (kubernetes_tpu/ingest) rides this thread by
+design: `PriorityQueue.add/update` run inside the handler dispatch
+below, so a pending pod's tensor row is ENCODED HERE — on the informer
+thread, once per distinct spec — and the scheduling loop's dispatch
+reduces to an index pop (the reference's own scaling move: the informer
+does the decode/index work, scheduleOne only pops keys). Handlers
+therefore stay cheap-but-not-free; the reflector's recover-and-restart
+discipline below already tolerates a slow or raising handler without
+killing replication for the kind.
 """
 
 from __future__ import annotations

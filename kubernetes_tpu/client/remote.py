@@ -214,9 +214,15 @@ class RemoteAPIServer:
         for name, sel in (("labelSelector", label_selector),
                           ("fieldSelector", field_selector)):
             if sel:
-                parts.append(
-                    f"{name}=" + quote(",".join(f"{k}={v}" for k, v in sel.items()))
+                # dict = equality pairs (the informer path); str = a raw
+                # wire selector passed through verbatim — the set-based
+                # grammar (`k in (a,b)`, `notin`, `k`, `!k`) the server's
+                # _parse_label_selector speaks
+                wire = (
+                    sel if isinstance(sel, str)
+                    else ",".join(f"{k}={v}" for k, v in sel.items())
                 )
+                parts.append(f"{name}=" + quote(wire))
         return ("&" + "&".join(parts)) if parts else ""
 
     def list(self, kind: str, label_selector=None, field_selector=None) -> Tuple[List[Any], int]:

@@ -66,6 +66,16 @@ KIND_FOLD = "fold"
 # Routed through the plan so a post-warmup scatter compile is a counted
 # miss — these were the invisible mid-drain stalls on preemption drains.
 KIND_PATCH = "patch"
+# pod-ingest plane (kubernetes_tpu/ingest): the device-resident staged
+# pod bank's programs. Two variants distinguished by config_repr:
+#   "gather|..." — the index-only dispatch prologue (u = index-vector
+#     rung, s = slab row capacity, k/r = encoding widths);
+#   "patch|..."  — the staging uploader's dirty-row scatter (b = row
+#     rung from ingest.bank.STAGE_RUNGS, s = slab capacity, structure in
+#     config_repr exactly like KIND_PATCH).
+# Both call sites bucket their own axes, so specs pass canonicalize
+# unchanged (same contract as KIND_PREEMPT/KIND_PATCH).
+KIND_STAGE = "stage"
 
 
 @dataclass(frozen=True)
@@ -180,13 +190,14 @@ class ShapeLadder:
         """Round every padded axis up to its rung; u never exceeds b (a
         batch cannot hold more unique specs than pods).
 
-        KIND_PREEMPT and KIND_PATCH specs pass through UNCHANGED: those
-        call sites bucket their own axes (minimum 8 preemptor/victim
-        rungs; the mirror's PATCH_RUNGS) and the spec must name the EXACT
-        executed shapes — re-rounding here with this ladder's minimum
-        would collapse distinct kernel signatures onto one key and report
-        a mid-drain compile as a plan hit."""
-        if spec.kind in (KIND_PREEMPT, KIND_PATCH):
+        KIND_PREEMPT, KIND_PATCH, and KIND_STAGE specs pass through
+        UNCHANGED: those call sites bucket their own axes (minimum 8
+        preemptor/victim rungs; the mirror's PATCH_RUNGS; the ingest
+        plane's STAGE_RUNGS and monotone u-rung) and the spec must name
+        the EXACT executed shapes — re-rounding here with this ladder's
+        minimum would collapse distinct kernel signatures onto one key
+        and report a mid-drain compile as a plan hit."""
+        if spec.kind in (KIND_PREEMPT, KIND_PATCH, KIND_STAGE):
             return spec
         m = self.minimum
         b = pow2_bucket(spec.b, m) if spec.b else 0

@@ -35,6 +35,7 @@ from .ladder import (
     KIND_PREEMPT,
     KIND_SOLVE,
     KIND_SOLVE_GANG,
+    KIND_STAGE,
     SolveSpec,
 )
 from .plan import CompilePlan, SOURCE_PERSISTED, SOURCE_WARMUP
@@ -177,6 +178,8 @@ class WarmupService:
             return None
         if spec.kind == KIND_FOLD:
             return self._warm_fold(spec)  # no SolveConfig static
+        if spec.kind == KIND_STAGE:
+            return self._warm_stage(spec)  # no SolveConfig static
         if spec.config_repr != repr(self.sched.solve_config):
             return None  # persisted ladder from a differently-policied run
         if not (spec.b and spec.u and spec.t and spec.n and spec.v):
@@ -419,6 +422,48 @@ class WarmupService:
                 np.zeros((b, r), np.int64), np.zeros(b, np.int32),
             )
         jax.block_until_ready(out[0])
+        return time.perf_counter() - t0
+
+    def _warm_stage(self, spec: SolveSpec) -> Optional[float]:
+        """ingest/gather.gather_stage at the spec's shapes (u = index
+        rung, s = slab capacity, k/r = encoding widths). Synthetic slab —
+        a PodBatch at the spec's capacity, placed through the mirror's
+        `_to_dev(node_major=False)` recipe exactly like StageBank uploads
+        the live one — so the warmed executable's input placements equal
+        the dispatched ones. Row-scatter ("patch|...") specs warm at LIVE
+        shapes only, via StageBank.warm (the KIND_PATCH contract): a
+        persisted one from a previous shape is skipped, undeclared for
+        persisted sources by the caller."""
+        if not spec.config_repr.startswith("gather"):
+            return None
+        if not (spec.u and spec.s and spec.k and spec.r):
+            return None
+        import jax
+        import numpy as np
+
+        from ..ingest.gather import gather_stage
+        from ..state.tensors import EncodingConfig, PodBatch, Vocab
+
+        mirror = self.sched.mirror
+        vocab = mirror.vocab
+        if (spec.k, spec.r) != (
+            vocab.config.key_slots, vocab.config.resource_slots
+        ):
+            vocab = Vocab(EncodingConfig(key_slots=spec.k, resource_slots=spec.r))
+            if (
+                vocab.config.key_slots != spec.k
+                or vocab.config.resource_slots != spec.r
+            ):
+                return None
+        place = lambda v: mirror._to_dev(v, False)  # noqa: E731
+        bank = {k: place(v) for k, v in PodBatch(vocab, spec.s).arrays().items()}
+        empty = {k: place(v) for k, v in PodBatch(vocab, 1).arrays().items()}
+        idx = np.zeros(spec.u, np.int32)
+        keep = np.zeros(spec.u, bool)
+        fb = np.zeros(spec.u, bool)
+        t0 = time.perf_counter()
+        out = gather_stage(bank, idx, keep, empty, fb)
+        jax.block_until_ready(out["valid"])
         return time.perf_counter() - t0
 
     def _warm_preempt(self, spec: SolveSpec) -> Optional[float]:

@@ -1,0 +1,39 @@
+"""Pod-ingest plane: enqueue-time pod encoding + device-resident pod banks.
+
+The input-stream counterpart of the resident-state plane (PR 3): PRs 2-4
+made the node/commit side of the cycle device-resident, but every batch
+the driver thread still re-encoded each pod into its tensor row and
+uploaded the padded pod-side arrays per dispatch — `encode_s` + the pod
+half of the upload were front-half walls the commit pipeline's worker
+could never hide. This package moves batch construction off the per-batch
+critical path and off the wire:
+
+* `stage`  — `PodStage`: a host-side slab of encoded pod-spec rows (the
+  exact `state/tensors.PodBatch` layout), content-interned by `spec_key`
+  and refcounted by queue entries. Rows are encoded ONCE, when the
+  informer/queue admits the pod (on the informer thread), not per batch
+  on the driver thread; the queue entry carries a ready (row, generation)
+  pair instead of re-deriving the row at pop time.
+* `bank`   — `StageBank`: the slab's device-resident twin, patched by
+  dirty staged rows (batched, off-thread, double-buffered against the
+  drain — the same discipline as the speculative fetch chain) through
+  `compile/` as KIND_STAGE specs so staging never compiles mid-drain.
+* `gather` — the index-only dispatch prologue: a jitted gather that
+  reconstructs the batch's pod arrays FROM the resident bank on device;
+  dispatch ships an int32 index vector + the small per-batch control
+  scalars instead of the full pod-array set (`patch_bytes.pods` drops
+  from the whole padded PodBatch to KB-scale on a covered drain).
+
+Coverage is per batch: every popped pod must hold a valid staged row
+whose generation matches (updates/deletes between enqueue and pop, slab
+rebuilds, and width growth all invalidate). Anything else takes the
+legacy host-built dispatch unchanged, observable via
+`scheduler_ingest_batches_total{path}` — the plane is transport, never
+policy, and placements are bit-identical either way (pinned by
+tests/test_ingest_plane.py).
+"""
+
+from .bank import STAGE_RUNGS, StageBank
+from .stage import PodStage
+
+__all__ = ["PodStage", "StageBank", "STAGE_RUNGS"]

@@ -1,0 +1,314 @@
+"""Device-resident staged pod bank: the PodStage slab's on-device twin.
+
+The staging analogue of TensorMirror's dirty-row discipline (state/cache):
+the slab uploads ONCE, then only the rows admissions touched since the
+last flush cross the wire — batched, off the driver thread (a background
+uploader drains the dirty set while the drain runs), chunked at
+STAGE_RUNGS so the scatter program set stays small enough to pre-compile.
+Every program (the row scatters AND the index-gather prologue) is routed
+through the compile plan as a KIND_STAGE spec: staging never compiles
+mid-drain, and a post-warmup compile is a counted miss.
+
+Double-buffering falls out of JAX's functional updates: a scatter builds
+NEW arrays and swaps the dict reference under the slab lock, so a solve
+dispatched against the previous dict keeps its buffers immutable while
+the uploader patches the next one (the scatters here are deliberately
+NOT donated, unlike the mirror's — in-flight dispatches hold references).
+
+On a mesh the bank places through the mirror's `_to_dev` recipe with
+node_major=False — pod-major arrays are replicated, exactly like the
+legacy per-batch upload — so warmed executables match dispatched ones.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..compile.ladder import KIND_STAGE, SolveSpec
+from .stage import PodStage
+
+#: dirty-row scatter rungs (same quantizer idea as the mirror's
+#: PATCH_RUNGS): each (structure, rung) pair is one XLA program, warmed
+#: up-front; bigger flushes chunk at the top rung.
+STAGE_RUNGS = (16, 64, 256)
+
+_STAGE_SCATTER = None
+
+
+def _stage_rung(n: int) -> int:
+    for r in STAGE_RUNGS:
+        if n <= r:
+            return r
+    return STAGE_RUNGS[-1]
+
+
+def _scatter_fn():
+    """Row scatter over the whole staged-bank dict (compiled once per
+    (row-rung, structure) pair). NOT donated: in-flight solve dispatches
+    still reference the previous buffers (see module docstring)."""
+    global _STAGE_SCATTER
+    if _STAGE_SCATTER is None:
+        import jax
+
+        @jax.jit
+        def scatter(dev, idx, updates):
+            out = dict(dev)
+            for k, u in updates.items():
+                out[k] = dev[k].at[idx].set(u)
+            return out
+
+        _STAGE_SCATTER = scatter
+    return _STAGE_SCATTER
+
+
+class StageBank:
+    """Keeps a device copy of a PodStage slab patched from its dirty rows.
+
+    Shares the stage's RLock for all slab-coupled state (device dict swap,
+    dirty drain) so the driver's covered-dispatch prologue — validate rows,
+    flush, capture gather arguments — is atomic against admissions and
+    slab rebuilds.
+    """
+
+    def __init__(
+        self,
+        stage: PodStage,
+        place_fn: Optional[Callable] = None,
+        ship_fn: Optional[Callable[[str, int], None]] = None,
+    ):
+        self.stage = stage
+        self._lock = stage._lock
+        self._place = place_fn
+        self._ship = ship_fn or (lambda kind, n: None)
+        self.compile_plan = None  # attached by the driver
+        self._dev: Optional[Dict] = None
+        self._empty_dev: Optional[Dict] = None
+        self._dev_generation = -1
+        # slab generation the scatter rungs were last warmed at: a slab
+        # rebuild (capacity growth) changes every scatter program's row-
+        # capacity axis, so the uploader re-warms before the first
+        # post-growth flush needs them
+        self._warmed_generation = -1
+        self.stats: Dict[str, int] = {
+            "full_uploads": 0,
+            "flush_rows": 0,  # rows shipped by the background worker
+            "sync_rows": 0,  # rows the DRIVER had to flush at dispatch
+        }
+        # background uploader (started by the driver at warmup; without it
+        # every flush is a synchronous dispatch-time one — correct, slower)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        stage.on_dirty = self._wake.set
+
+    # -- placement -----------------------------------------------------------
+
+    def _to_dev(self, v):
+        if self._place is not None:
+            return self._place(v)
+        import jax.numpy as jnp
+
+        return jnp.asarray(v)
+
+    # -- upload path ---------------------------------------------------------
+
+    def _flush_locked(self, sync: bool = False) -> None:
+        """Ship the slab's dirty rows into the device dict (stage lock
+        held). Full upload on first use or after a slab rebuild."""
+        stage = self.stage
+        if self._dev is None or self._dev_generation != stage.generation:
+            host = stage.batch.arrays()
+            self._dev = {k: self._to_dev(v) for k, v in host.items()}
+            self._empty_dev = {
+                k: self._to_dev(v) for k, v in stage.empty_rows.items()
+            }
+            self._ship("stage", sum(np.asarray(v).nbytes for v in host.values()))
+            self.stats["full_uploads"] += 1
+            stage.dirty_rows.clear()
+            self._dev_generation = stage.generation
+            return
+        if not stage.dirty_rows:
+            return
+        rows = sorted(stage.dirty_rows)
+        stage.dirty_rows.clear()
+        self.stats["sync_rows" if sync else "flush_rows"] += len(rows)
+        host = stage.batch.arrays()
+        self._dev = self._scatter_rows(self._dev, host, rows, warm=False)
+
+    def _patch_spec(self, host: Dict, rb: int) -> SolveSpec:
+        """Derived entirely from the HOST dict being scattered (not live
+        stage state): synthetic warms run against capacity snapshots that
+        may differ from the slab mid-rebuild."""
+        structure = ",".join(
+            f"{k}{list(v.shape[1:])}" for k, v in sorted(host.items())
+        )
+        return SolveSpec(
+            kind=KIND_STAGE, b=rb, s=next(iter(host.values())).shape[0],
+            k=host["label_vals"].shape[1], r=host["req"].shape[1],
+            config_repr="patch|" + structure,
+        )
+
+    def _scatter_rows(self, dev, host, rows: List[int], warm: bool) -> Dict:
+        """Chunked row scatter at STAGE_RUNGS, plan-admitted (the mirror's
+        _scatter_rows discipline; `warm=True` declares instead of admitting
+        so planned pre-compiles don't inflate the miss counters)."""
+        import jax.numpy as jnp
+
+        scatter = _scatter_fn()
+        cap = next(iter(host.values())).shape[0]
+        rb = min(_stage_rung(len(rows)), cap)
+        plan = self.compile_plan
+        known = True
+        if plan is not None:
+            spec = self._patch_spec(host, rb)
+            if warm:
+                known = plan.is_declared(spec)
+                plan.declare(spec)
+            else:
+                known = plan.admit(spec)
+        dt_compile = 0.0
+        first = True
+        for i in range(0, len(rows), rb):
+            chunk = rows[i : i + rb]
+            padded = chunk + [chunk[0]] * (rb - len(chunk))
+            idx = np.asarray(padded, np.int32)
+            updates = {k: np.ascontiguousarray(h[idx]) for k, h in host.items()}
+            self._ship(
+                "warm" if warm else "stage",
+                idx.nbytes + sum(u.nbytes for u in updates.values()),
+            )
+            if first:
+                t0 = time.perf_counter()
+                dev = scatter(dev, jnp.asarray(idx), updates)
+                dt_compile = time.perf_counter() - t0
+                first = False
+            else:
+                dev = scatter(dev, jnp.asarray(idx), updates)
+        if plan is not None and not known:
+            from ..compile.plan import SOURCE_INLINE, SOURCE_WARMUP
+
+            plan.note_compiled(
+                spec, dt_compile,
+                SOURCE_WARMUP if warm
+                else (SOURCE_INLINE if plan.warmed else "warmup"),
+            )
+        return dev
+
+    # -- background uploader -------------------------------------------------
+
+    def start(self) -> None:
+        """Arm the off-thread uploader (idempotent). Driver calls this at
+        warmup so tests that never warm don't get surprise threads."""
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._stop.clear()
+        self._worker = threading.Thread(
+            target=self._drain, name="ingest-upload", daemon=True
+        )
+        self._worker.start()
+
+    def _drain(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.05)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            need_warm = False
+            with self._lock:
+                if self._dev is None:
+                    # the first-ever upload stays with the driver
+                    # (warmup), where the compile plan can account it
+                    continue
+                if self._warmed_generation != self.stage.generation:
+                    need_warm = True  # warmed OUTSIDE the lock, below
+                elif self.stage.dirty_rows or (
+                    self._dev_generation != self.stage.generation
+                ):
+                    self._flush_locked(sync=False)
+            if need_warm:
+                # slab rebuilt (growth): the scatter programs' row-
+                # capacity axis changed — pre-compile the rungs against
+                # SYNTHETIC shape-twins, holding no lock (the compiles
+                # take seconds; admissions and dispatches must not block
+                # on them), before any flush admits the new programs
+                self._warm_synthetic()
+
+    def _warm_synthetic(self) -> None:
+        """Pre-compile the scatter rungs at the slab's CURRENT shapes
+        against throwaway zero banks — jit caches key on shapes/dtypes/
+        placement, not buffers, so the later real flush hits the same
+        executables. No lock held across the compiles; the generation is
+        re-checked before recording the warm so a rebuild racing this
+        pass simply warms again next tick."""
+        with self._lock:
+            gen = self.stage.generation
+            host = {
+                k: np.zeros_like(v)
+                for k, v in self.stage.batch.arrays().items()
+            }
+        dev = {k: self._to_dev(v) for k, v in host.items()}
+        cap = next(iter(host.values())).shape[0]
+        seen = set()
+        for rung in STAGE_RUNGS:
+            rb = min(rung, cap)
+            if rb in seen:
+                continue
+            seen.add(rb)
+            dev = self._scatter_rows(dev, host, [0] * rb, warm=True)
+        with self._lock:
+            if self.stage.generation == gen:
+                self._warmed_generation = gen
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout=5)
+
+    # -- dispatch-side API ---------------------------------------------------
+
+    def current_arrays(self, sync: bool = True):
+        """(bank_dev, empty_dev) with every dirty row flushed — the
+        covered dispatch's gather inputs. Caller holds the stage lock (or
+        relies on this RLock acquire) so the capture is atomic against
+        admissions/rebuilds; the returned dicts are immutable snapshots."""
+        with self._lock:
+            self._flush_locked(sync=sync)
+            return self._dev, self._empty_dev
+
+    def gather_spec(self, u: int, capacity: Optional[int] = None) -> SolveSpec:
+        """The index-gather prologue's XLA signature: u = index-vector
+        rung, s = slab capacity, k/r = encoding widths."""
+        return SolveSpec(
+            kind=KIND_STAGE, u=u, s=capacity or self.stage.capacity,
+            k=self.stage.key_capacity, r=self.stage.resource_capacity,
+            config_repr="gather",
+        )
+
+    def warm(self) -> int:
+        """Pre-compile the staging scatter programs (each rung ≤ capacity)
+        with idempotent no-op patches, after ensuring the bank is resident
+        — the KIND_PATCH warm_patches discipline applied to staging. The
+        gather prologue itself warms through WarmupService (KIND_STAGE
+        gather specs at the live + headroom shapes)."""
+        n = 0
+        with self._lock:
+            self._flush_locked(sync=True)
+            host = self.stage.batch.arrays()
+            seen = set()
+            for rung in STAGE_RUNGS:
+                rb = min(rung, self.stage.capacity)
+                if rb in seen:
+                    continue
+                seen.add(rb)
+                self._dev = self._scatter_rows(
+                    self._dev, host, [0] * rb, warm=True
+                )
+                n += 1
+            self._warmed_generation = self.stage.generation
+        return n

@@ -1,0 +1,36 @@
+"""Index-only dispatch prologue: rebuild a batch's pod arrays ON DEVICE.
+
+One jitted gather reconstructs the exact per-batch PodBatch array dict the
+solve/gang/arbiter programs consume, from the resident staged bank and an
+int32 index vector — the only pod-side payload a covered dispatch ships.
+Padding rows reproduce an untouched PodBatch row bit-for-bit (`empty` is
+the slab's 1-row zero-state: -1 pads on selector/term slots, zeros
+elsewhere), so the downstream programs see EXACTLY what the legacy
+host-built upload would have produced — placements are bit-identical by
+construction, which the parity suite pins.
+
+`fallback` is uploaded host-side (a [U] bool, bytes not KB): the
+effective per-spec fallback is staged-row overflow OR batch term-table
+overflow, and the term half only exists at dispatch time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def gather_stage(bank, idx, keep, empty, fallback):
+    """bank: staged slab dict ([S, ...]); idx: [U] int32 slab rows;
+    keep: [U] bool (True for real batch specs, False for padding);
+    empty: 1-row PodBatch dict (the padding template); fallback: [U] bool
+    (host-computed effective fallback). Returns the batch's pod-array
+    dict, [U, ...]."""
+    out = {}
+    for k, v in bank.items():
+        g = v[idx]
+        cond = keep.reshape((-1,) + (1,) * (g.ndim - 1))
+        out[k] = jnp.where(cond, g, empty[k])
+    out["fallback"] = fallback
+    return out

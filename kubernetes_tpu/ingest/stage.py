@@ -1,0 +1,206 @@
+"""Host-side pod staging slab: enqueue-time encoding into ready rows.
+
+A `PodStage` is a `state/tensors.PodBatch` used as a SLAB with a row
+allocator instead of a per-batch scratch buffer: rows are content-interned
+by `spec_key` (replicas of one controller share ONE row, exactly like the
+dispatch-time dedup and SigBank's `_encode_key` memo) and refcounted by
+the queue entries that hold them. The expensive `set_pod` encode runs once
+per distinct spec at ADMISSION time — on the informer thread — so the
+driver's dispatch reduces to validating (row, generation) pairs and
+shipping an index vector.
+
+Generation discipline
+---------------------
+Every allocation and free stamps the row with a fresh value from one
+monotone counter, and a slab rebuild (width growth, capacity growth)
+restarts nothing — the counter keeps climbing, so ANY (row, gen) pair
+issued before the event mismatches afterwards. A queue entry whose pair
+went stale (its pod was updated/deleted between enqueue and pop, or the
+slab rebuilt under it) is re-staged at dispatch time (counted) or falls
+back to the legacy in-batch encode; correctness never depends on a row
+being live.
+
+Thread safety: one RLock around all bookkeeping. The driver's covered
+dispatch holds it across validate → flush → gather-argument capture
+(StageBank.prologue): device arrays are functional, so once the argument
+dict is captured the lock can drop — a concurrent admission can neither
+rewrite a captured device buffer nor swap the slab under the window.
+Lock order where both are held: queue lock → stage lock (the queue
+acquires rows under its own lock; the stage never calls into the queue).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..state.tensors import KeySlotOverflow, PodBatch, spec_key
+
+#: slab capacity floor and hard ceiling (pow-2 rungs in between). The slab
+#: holds one row per DISTINCT pending spec — workload-bounded like
+#: SigBank's signatures, not pod-count-bounded — so the ceiling is a
+#: safety valve, not a sizing concern.
+MIN_CAPACITY = 256
+MAX_CAPACITY = 16384
+
+
+class PodStage:
+    """Content-interned, refcounted slab of encoded pod rows."""
+
+    def __init__(self, vocab, capacity: int = MIN_CAPACITY):
+        self.vocab = vocab
+        self._lock = threading.RLock()
+        self._next_gen = 1
+        # bank wake-up hook (StageBank sets it): called after a fresh row
+        # is staged so the background uploader can batch it out
+        self.on_dirty: Optional[callable] = None
+        # bumped on every rebuild; the device twin (bank.StageBank) keys
+        # its full-upload decision on it
+        self.generation = 0
+        # staleness counters (stale rows seen, dispatch-time restages)
+        # live on the DRIVER's stats (ingest_stale_rows/ingest_restaged)
+        # — the slab only counts what it owns
+        self.stats: Dict[str, int] = {
+            "staged": 0,  # fresh rows encoded (once per distinct spec)
+            "hits": 0,  # acquire served by an existing row
+            "overflows": 0,  # slab-full growth events
+            "rebuilds": 0,  # width-growth / capacity-growth rebuilds
+        }
+        self._build(max(capacity, MIN_CAPACITY))
+
+    # -- slab lifecycle ------------------------------------------------------
+
+    def _build(self, capacity: int) -> None:
+        self.capacity = capacity
+        self.batch = PodBatch(self.vocab, capacity)
+        self.key_capacity = self.batch.key_capacity
+        self.resource_capacity = self.batch.req.shape[1]
+        self.row_of: Dict[tuple, int] = {}
+        self._key_of_row: Dict[int, tuple] = {}
+        self.refs = np.zeros(capacity, np.int64)
+        self.row_gen = np.zeros(capacity, np.int64)  # 0 never issued
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self.dirty_rows: set = set()
+        self.generation += 1
+        # the legacy PodBatch's zero-state per array, for gather padding:
+        # padding rows of the index dispatch must reproduce EXACTLY what
+        # an untouched PodBatch row holds (-1 pads on selector/term slots,
+        # zeros elsewhere) or the device programs stop being bit-identical
+        self.empty_rows = PodBatch(self.vocab, 1).arrays()
+
+    def _rebuild(self, capacity: Optional[int] = None) -> None:
+        self.stats["rebuilds"] += 1
+        self._build(capacity or self.capacity)
+
+    def current_for(self, vocab) -> bool:
+        """Do the slab's array widths still match the vocab's config? A
+        key-slot or resource-slot growth (mirror rebuild territory) makes
+        every staged row the wrong SHAPE — the slab must rebuild."""
+        return (
+            vocab is self.vocab
+            and self.key_capacity == vocab.config.key_slots
+            and self.resource_capacity == vocab.config.resource_slots
+        )
+
+    def ensure_current(self) -> bool:
+        """Rebuild if the vocab widths grew. Returns True when a rebuild
+        happened (every outstanding (row, gen) pair is now stale)."""
+        with self._lock:
+            if self.current_for(self.vocab):
+                return False
+            self._rebuild()
+            return True
+
+    # -- row acquisition -----------------------------------------------------
+
+    def acquire(self, pod) -> Optional[Tuple[int, int]]:
+        """Intern `pod`'s spec row (+1 ref). Returns (row, gen), or None
+        when the pod cannot be staged right now (encode overflow mid-vocab-
+        growth) — the caller schedules it via the legacy path and retries
+        staging on the next admission. Slab-capacity overflow GROWS the
+        slab (pow-2 rung, through compile/'s KIND_STAGE headroom warming)
+        rather than failing: the rebuild invalidates outstanding rows
+        (one legacy batch at worst, counted) and staging resumes covered."""
+        with self._lock:
+            if not self.current_for(self.vocab):
+                self._rebuild()
+            key = spec_key(pod)
+            row = self.row_of.get(key)
+            if row is not None:
+                self.refs[row] += 1
+                self.stats["hits"] += 1
+                return row, int(self.row_gen[row])
+            if not self._free:
+                self.stats["overflows"] += 1
+                if self.capacity >= MAX_CAPACITY:
+                    return None  # safety valve: legacy path absorbs it
+                self._rebuild(self.capacity * 2)
+            row = self._free.pop()
+            try:
+                self.batch.set_pod(row, pod)
+            except KeySlotOverflow:
+                # vocab grew mid-encode: widths changed under us — rebuild
+                # (fresh widths) and let the caller's next admission stage
+                self._free.append(row)
+                self._rebuild()
+                return None
+            self.row_of[key] = row
+            self._key_of_row[row] = key
+            self.refs[row] = 1
+            gen = self._next_gen
+            self._next_gen += 1
+            self.row_gen[row] = gen
+            self.dirty_rows.add(row)
+            self.stats["staged"] += 1
+            cb = self.on_dirty
+            if cb is not None:
+                cb()  # Event.set — safe under the lock
+            return row, gen
+
+    def ensure_row(self, pod) -> Optional[Tuple[int, int]]:
+        """Intern `pod`'s spec row WITHOUT taking a reference — the
+        dispatch-time restage path (a popped entry whose staged pair went
+        stale, or a pod admitted before the plane attached). Same contract
+        as SigBank.prepare_row: a fresh zero-ref row is never freed by
+        release() (no holder can release it), so it stays valid through
+        the dispatch and lingers until a slab rebuild reclaims it —
+        bounded by slab capacity. Returns (row, gen) or None exactly like
+        acquire()."""
+        with self._lock:
+            pair = self.acquire(pod)
+            if pair is None:
+                return None
+            row, gen = pair
+            # undo acquire's ref without triggering the free path: a
+            # fresh row drops to 0 (lingers, by contract); an existing
+            # row returns to its holders' count
+            self.refs[row] -= 1
+            if self.refs[row] < 0:
+                self.refs[row] = 0
+            return pair
+
+    def release(self, row: int, gen: int) -> None:
+        """Drop one reference. Frees the row (generation bump) at zero —
+        a later acquire of the same spec re-encodes. Stale pairs are
+        ignored (the row they named is already gone)."""
+        with self._lock:
+            if not (0 <= row < self.capacity) or self.row_gen[row] != gen:
+                return
+            self.refs[row] -= 1
+            if self.refs[row] <= 0:
+                self.refs[row] = 0
+                key = self._key_of_row.pop(row, None)
+                if key is not None:
+                    self.row_of.pop(key, None)
+                self.batch.valid[row] = False
+                self.row_gen[row] = self._next_gen
+                self._next_gen += 1
+                self._free.append(row)
+                # freed host rows are never gathered (no live (row, gen)
+                # names them), so the device twin needs no update
+
+    def valid_pair(self, row: int, gen: int) -> bool:
+        with self._lock:
+            return 0 <= row < self.capacity and self.row_gen[row] == gen

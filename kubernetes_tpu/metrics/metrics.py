@@ -160,6 +160,18 @@ fold_batches = registry.register(Counter(
     "Commit batches whose state deltas were folded into the resident "
     "device banks (no host scatter shipped for their rows)",
 ))
+# pod-ingest plane (kubernetes_tpu/ingest): which pod-array transport a
+# dispatch used — index = gathered from the device-resident staged bank
+# (ships an int32 index vector, the covered steady state), legacy = the
+# host-built PodBatch upload (stale staged rows, slab overflow/rebuild),
+# off = the plane is disabled. Per DISPATCH, like sharded_fallbacks.
+ingest_batches = registry.register(Counter(
+    "scheduler_ingest_batches_total",
+    "Solve dispatches by pod-array transport path (index = device-"
+    "resident staged bank gather, legacy = host-built upload with the "
+    "plane on, off = ingest plane disabled)",
+    label_names=("path",),
+))
 # multi-chip series (kubernetes_tpu/parallel): a mesh-configured driver
 # that cannot shard a batch (node bucket stops dividing the shard count
 # mid-churn) quietly drops to the replicated solve — which is a different,

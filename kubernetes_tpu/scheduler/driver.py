@@ -515,6 +515,7 @@ class Scheduler:
         compile_plan: Optional[CompilePlan] = None,
         commit_plane: bool = True,
         fold_plane: bool = True,
+        ingest_plane: bool = True,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -652,6 +653,29 @@ class Scheduler:
         # rungs, so each stays one XLA signature as it grows
         self._fp_bucket = 16
         self._nom_bucket = 16
+        # pod-ingest plane (kubernetes_tpu/ingest): pod rows are encoded
+        # at ADMISSION on the informer thread into a content-interned
+        # slab, a device-resident staged bank is patched off-thread, and
+        # a covered dispatch ships an int32 index vector instead of the
+        # full pod-array upload (the input-stream counterpart of the fold
+        # plane's output-stream move). Transport-only — placements are
+        # bit-identical either way. KTPU_INGEST_PLANE=0 kill switch.
+        self.ingest_plane = ingest_plane and _os.environ.get(
+            "KTPU_INGEST_PLANE", "1"
+        ) != "0"
+        self.stage = None
+        self.stage_bank = None
+        if self.ingest_plane:
+            from ..ingest import PodStage, StageBank
+
+            self.stage = PodStage(self.mirror.vocab)
+            self.stage_bank = StageBank(
+                self.stage,
+                place_fn=lambda v: self.mirror._to_dev(v, False),
+                ship_fn=self.mirror._ship,
+            )
+            self.stage_bank.compile_plan = self.compile_plan
+            self.queue.attach_stage(self.stage)
         self._commit_pipe = CommitPipeline()
         self._columnar = ColumnarApply(self.cache, self.queue)
         # defer-to-next-batch escalation: a pod deferred this many times
@@ -828,11 +852,128 @@ class Scheduler:
             # the commit fold grows with the banks it scatters into
             # (sig/pattern capacity, pattern-triple rung)
             specs += lad.growth_specs(self._fold_spec())
+        if (
+            self.ingest_plane
+            and self.stage_bank is not None
+            and spec.kind == KIND_SOLVE
+        ):
+            specs = specs + self._stage_growth_specs()
         # with the fold plane on, the resident bank buffers get DONATED
         # (folds + row patches): a background warm holding this dispatch's
         # snapshot would read deleted arrays — hand it nothing and let it
         # build shape-exact synthetic banks instead
         self._warm_svc.warm_async(specs, None if self.fold_plane else dev)
+
+    # -- pod-ingest plane (kubernetes_tpu/ingest) ----------------------------
+
+    def _stage_growth_specs(self) -> List[SolveSpec]:
+        """The index-gather's headroom set: the next unique-spec rung and
+        the doubled staging slab (its growth mode on overflow). ONE
+        definition shared by warmup and the dispatch-time growth hook so
+        warmed and dispatched shapes can never diverge."""
+        from ..compile.ladder import next_rung
+        from ..ingest.stage import MAX_CAPACITY
+
+        out: List[SolveSpec] = []
+        if self._u_bucket < self._b_bucket:
+            out.append(self.stage_bank.gather_spec(next_rung(self._u_bucket)))
+        if self.stage.capacity * 2 <= MAX_CAPACITY:
+            out.append(self.stage_bank.gather_spec(
+                self._u_bucket, capacity=self.stage.capacity * 2
+            ))
+        return out
+
+    def _stage_prologue(self, reps, rep_infos):
+        """Resolve every rep's staged row and gather the batch's pod
+        arrays from the device-resident staged bank (the index-only
+        dispatch). Returns (pa_dev, fallback_host) or None when the batch
+        cannot be covered (a stale rep that cannot re-stage: slab at its
+        ceiling, vocab width growth mid-resolve) — the caller then builds
+        the legacy host PodBatch, counted. Row resolution, flush, and
+        gather-ARGUMENT capture run under the slab lock (concurrent
+        admissions/rebuilds cannot swap rows mid-window); the gather
+        dispatch itself runs after release — the captured device dicts
+        are immutable (functional updates, no donation), and an unwarmed
+        rung's inline compile must not stall informer admissions."""
+        from ..ingest.gather import gather_stage
+
+        stage, bank = self.stage, self.stage_bank
+        t0 = time.perf_counter()
+        with stage._lock:
+            stage.ensure_current()
+            # any rebuild DURING resolution (ensure_row hitting a full
+            # slab grows it, swapping every array) invalidates the rows
+            # already collected AND the row_gen reference below — detect
+            # it by generation and bail to the legacy path ("one legacy
+            # batch at worst", the slab-growth contract)
+            gen0 = stage.generation
+            rows: List[int] = []
+            stale = 0
+            row_gen = stage.row_gen
+            for pod, pi in zip(reps, rep_infos):
+                if (
+                    pi.pod is pod
+                    and 0 <= pi.staged_row < stage.capacity
+                    and row_gen[pi.staged_row] == pi.staged_gen
+                ):
+                    rows.append(pi.staged_row)
+                    continue
+                # stale entry (updated/deleted between enqueue and pop,
+                # slab rebuilt, or admitted before the plane attached):
+                # re-stage from the CAPTURED pod object — the legacy
+                # per-spec encode cost, paid once, then covered again
+                stale += 1
+                pair = stage.ensure_row(pod)
+                if pair is None:
+                    self.stats["ingest_stale_rows"] = (
+                        self.stats.get("ingest_stale_rows", 0) + stale
+                    )
+                    return None
+                rows.append(pair[0])
+                self.stats["ingest_restaged"] = (
+                    self.stats.get("ingest_restaged", 0) + 1
+                )
+            if stale:
+                self.stats["ingest_stale_rows"] = (
+                    self.stats.get("ingest_stale_rows", 0) + stale
+                )
+            if stage.generation != gen0:
+                return None  # slab rebuilt mid-resolve: rows are garbage
+            u = self._u_bucket
+            idx = np.zeros(u, np.int32)
+            idx[: len(rows)] = rows
+            keep = np.zeros(u, bool)
+            keep[: len(rows)] = True
+            fb = np.zeros(u, bool)
+            fb[: len(rows)] = stage.batch.fallback[np.asarray(rows, np.int64)]
+            was_sync = bank.stats["sync_rows"]
+            bank_dev, empty_dev = bank.current_arrays(sync=True)
+            if bank.stats["sync_rows"] != was_sync:
+                # rows the background uploader had not shipped yet: the
+                # driver flushed them inline — observable, because a drain
+                # that pays this every batch has lost the off-thread win
+                self.stats["stage_sync_flushes"] = (
+                    self.stats.get("stage_sync_flushes", 0) + 1
+                )
+            # spec captured under the lock too: it names the slab shapes
+            # this dispatch's captured bank actually has
+            spec = bank.gather_spec(u)
+        # gather OUTSIDE the slab lock: the captured device dicts are
+        # immutable (functional updates), and an unwarmed rung's inline
+        # XLA compile here must not stall informer-thread admissions
+        known = self.compile_plan.admit(spec)
+        t_g = time.perf_counter()
+        pa_dev = gather_stage(bank_dev, idx, keep, empty_dev, fb)
+        if not known:
+            self.compile_plan.note_compiled(
+                spec, time.perf_counter() - t_g,
+                SOURCE_INLINE if self.compile_plan.warmed else "warmup",
+            )
+        self.mirror._ship("pods", idx.nbytes + keep.nbytes + fb.nbytes)
+        self.stats["stage_s"] = self.stats.get("stage_s", 0.0) + (
+            time.perf_counter() - t0
+        )
+        return pa_dev, fb
 
     # -- device solve --------------------------------------------------------
 
@@ -869,29 +1010,49 @@ class Scheduler:
         # device work scales with distinct specs, not batch size
         sig_list: List[int] = []
         reps: List[Pod] = []
+        rep_infos: List[PodInfo] = []  # first queue entry of each spec
         spec_index: Dict[str, int] = {}
-        for p in pods:
+        for pi in infos:
+            p = pi.pod
             k = _spec_key(p, selectors.get(id(p)) if selectors else None)
             u = spec_index.get(k)
             if u is None:
                 u = len(reps)
                 spec_index[k] = u
                 reps.append(p)
+                rep_infos.append(pi)
             sig_list.append(u)
         self._u_bucket = max(self._u_bucket, _bucket(len(reps)))
         while True:
             try:
-                batch = PodBatch(vocab, self._u_bucket)
-                for i, p in enumerate(reps):
-                    batch.set_pod(i, p)
+                # INGEST PLANE covered path: every rep resolves to a valid
+                # staged row → the pod arrays are gathered from the
+                # device-resident staged bank; the dispatch ships only the
+                # index vector (+ tiny control arrays). Stale/unstageable
+                # reps fall back to the legacy host-built PodBatch, counted.
+                batch = None
+                pa_dev = None
+                staged = (
+                    self._stage_prologue(reps, rep_infos)
+                    if self.ingest_plane and self.stage is not None
+                    else None
+                )
+                if staged is not None:
+                    pa_dev, fallback_arr = staged
+                else:
+                    batch = PodBatch(vocab, self._u_bucket)
+                    for i, p in enumerate(reps):
+                        batch.set_pod(i, p)
+                    fallback_arr = batch.fallback
                 tb, aux = compile_batch_terms(
-                    vocab, reps, spread_selectors=selectors, b_capacity=batch.capacity
+                    vocab, reps, spread_selectors=selectors,
+                    b_capacity=self._u_bucket,
                 )
                 self._t_bucket = max(self._t_bucket, tb.capacity)
                 if tb.capacity < self._t_bucket:
                     tb, aux = compile_batch_terms(
                         vocab, reps, spread_selectors=selectors,
-                        capacity=self._t_bucket, b_capacity=batch.capacity,
+                        capacity=self._t_bucket, b_capacity=self._u_bucket,
                     )
                 break
             except KeySlotOverflow:
@@ -915,11 +1076,37 @@ class Scheduler:
 
         # term-table overflow: truncated/dropped terms under- or over-match on
         # device — route the affected pods through the scalar oracle instead
-        # (ADVICE r1: overflow_owners was recorded but never consumed)
+        # (ADVICE r1: overflow_owners was recorded but never consumed).
+        # On the covered ingest path this patches only the HOST fallback
+        # vector (the device copy of `fallback` is consumed by no kernel —
+        # it rides the dict for signature stability).
         for owner in tb.overflow_owners:
             if 0 <= owner < len(reps):
-                batch.fallback[owner] = True
+                fallback_arr[owner] = True
         existing_overflow = bool(self.mirror.pats.overflow_rows)
+        # pod-side wire ledger (patch_bytes.pods): what THIS dispatch ships
+        # for its pod arrays — the full padded PodBatch on the legacy path,
+        # the index/control vectors on the covered path (KB-scale). The
+        # [B]-axis pb control arrays below ship on both.
+        pa_arrays = pa_dev if pa_dev is not None else batch.arrays()
+        if pa_dev is None:
+            self.mirror._ship(
+                "pods",
+                sum(int(np.asarray(v).nbytes) for v in pa_arrays.values()),
+            )
+            if self.ingest_plane:
+                # only a plane that COULD have covered counts as legacy —
+                # a plane-off run must not read like a regressed fallback
+                self.stats["ingest_legacy_batches"] = (
+                    self.stats.get("ingest_legacy_batches", 0) + 1
+                )
+            M.ingest_batches.inc("legacy" if self.ingest_plane else "off")
+        else:
+            self.stats["ingest_index_batches"] = (
+                self.stats.get("ingest_index_batches", 0) + 1
+            )
+            M.ingest_batches.inc("index")
+        self.mirror._ship("pods", sum(int(a.nbytes) for a in pb.values()))
         t1 = time.perf_counter()
         self.stats["encode_s"] += t1 - t0
 
@@ -1056,7 +1243,7 @@ class Scheduler:
         self.stats["patch_s"] = self.stats.get("patch_s", 0.0) + (t_patch - t1)
         args = (
             na_dev,
-            batch.arrays(),
+            pa_arrays,
             ea_dev,
             tb.arrays(),
             xp_dev,
@@ -1162,7 +1349,7 @@ class Scheduler:
             arb_known = self.compile_plan.admit(arb_spec)
             t_arb = time.perf_counter()
             verdict_dev = arb_fn(
-                na_dev, batch.arrays(), ea_dev, tb.arrays(), ids,
+                na_dev, pa_arrays, ea_dev, tb.arrays(), ids,
                 assign, pb=pb, carry=carry,
                 term_kinds=term_kinds, n_buckets=n_buckets,
             )
@@ -1186,7 +1373,8 @@ class Scheduler:
         return dict(
             infos=infos,
             pods=pods,
-            batch=batch,
+            batch=batch,  # None on the covered ingest path
+            fallback_arr=fallback_arr,
             aux=aux,
             levels=levels_arr,
             sig_arr=np.asarray(sig_list, np.int32),
@@ -1227,10 +1415,9 @@ class Scheduler:
         dt = time.perf_counter() - t0
         self.stats["fetch_s"] = self.stats.get("fetch_s", 0.0) + dt
         self.stats["solve_s"] += dt
-        batch = disp["batch"]
         return SolveOutput(
             assign=np.asarray(assign)[:n],
-            fallback=np.asarray(batch.fallback)[sig_arr],
+            fallback=np.asarray(disp["fallback_arr"])[sig_arr],
             score=ScoreRows(disp["score_dev"], sig_arr),
             has_anti=np.asarray(disp["aux"]["has_anti"])[sig_arr],
             existing_overflow=disp["existing_overflow"],
@@ -1271,6 +1458,11 @@ class Scheduler:
         saved = dict(self.stats)
         plan = self.compile_plan
         try:
+            # FULL-QUEUE census (not just the peeked batch): pre-size the
+            # signature/pattern banks for the whole backlog and stage any
+            # entries admitted before the ingest plane attached — both
+            # one-pass setup costs that kill mid-drain rebuild stalls
+            self._warmup_census()
             self.mirror.sync()
             if plan.cache is not None:
                 plan.cache.enable_xla_cache()
@@ -1353,6 +1545,20 @@ class Scheduler:
             # was an inline XLA compile billed to the DRAIN (the
             # preemption bench's cycle-2 "solve" spike was exactly these).
             self.mirror.warm_patches()
+            if self.ingest_plane and self.stage_bank is not None:
+                # staged-pod-bank programs: the row-scatter rungs (no-op
+                # patches, the warm_patches discipline) plus the index-
+                # gather prologue at the live AND headroom shapes (the
+                # same _stage_growth_specs the dispatch-time growth hook
+                # warms) so mid-drain growth lands on hot programs. The
+                # background uploader arms here — tests that never warm
+                # get no surprise threads.
+                self.stage_bank.start()
+                self.stage_bank.warm()
+                self._warm_svc.warm_specs(
+                    [self.stage_bank.gather_spec(self._u_bucket)]
+                    + self._stage_growth_specs()
+                )
             if infos:
                 # headroom: compile the next growth rung of each mid-drain-
                 # growable axis in the background while the drain starts —
@@ -1390,6 +1596,29 @@ class Scheduler:
             # about real scheduling work only
             self.stats = saved
         return len(infos)
+
+    def _warmup_census(self) -> None:
+        """Walk the FULL pending queue (active + backoff + unschedulable,
+        not just the peeked batch) and (a) pre-size the signature/pattern
+        banks so committing the backlog cannot overflow them mid-drain —
+        the gang bench's `mirror_rebuilds: 1` root cause was exactly this:
+        1k distinct gang label sets interning into a 256-slot SigBank as
+        commits landed, overflowing at pod ~256·64 and forcing a rebuild +
+        solve recompile mid-drain — and (b) stage every entry the ingest
+        plane will pop (entries enqueued before the plane attached, e.g. a
+        pre-loaded bench queue, stage here instead of on the drain's
+        critical path). One pass of memoized key builds: setup cost."""
+        infos = self.queue.pending_infos()
+        if not infos:
+            return
+        # sizing lives with the banks (TensorMirror.census_reserve — it
+        # mirrors SigBank/PatternBank's own interning identity)
+        self.mirror.census_reserve(info.pod for info in infos)
+        if self.stage is not None:
+            # staging under the QUEUE lock (queue.stage_pending): an
+            # unlocked acquire here would race the informer's delete/
+            # update release+acquire pairs and pin orphaned slab rows
+            self.queue.stage_pending()
 
     def _pod_meta(self, pod: Pod):
         """Predicate metadata for the oracle paths, backed by a per-batch
@@ -3012,6 +3241,8 @@ class Scheduler:
         self.flush_speculative()
         self.wait_for_binds()
         self._commit_pipe.close()
+        if self.stage_bank is not None:
+            self.stage_bank.close()
         if self._warm_svc is not None:
             self._warm_svc.stop()
             self._warm_svc.join()

@@ -515,6 +515,58 @@ class TensorMirror:
         if _node_bucket(self._min_nodes) > self.nodes.capacity:
             self._rebuild()
 
+    def reserve_signatures(self, n_sigs: int, n_pats: int = 0) -> bool:
+        """Pre-size the signature/pattern banks for a KNOWN workload —
+        the driver's warmup census walks the full pending queue and calls
+        this so committing the backlog cannot overflow the banks mid-
+        drain (each overflow is a full rebuild + solve recompile: the
+        gang bench's `mirror_rebuilds: 1`). A growth here rebuilds once,
+        at SETUP time; like the constructor's build — and unlike a
+        mid-drain overflow rebuild — it is excluded from rebuild_count,
+        which stays the mid-drain stall counter the bench asserts on.
+        Returns True when a rebuild happened (device arrays re-upload)."""
+        grew = False
+        if n_sigs > self.eps.capacity:
+            self._min_sigs = max(self._min_sigs, n_sigs)
+            grew = True
+        if n_pats > self.pats.capacity:
+            self._min_pats = max(self._min_pats, n_pats)
+            grew = True
+        if grew:
+            rc = self.rebuild_count
+            self._rebuild()
+            self.rebuild_count = rc
+        return grew
+
+    def census_reserve(self, pods) -> bool:
+        """Count the distinct signatures/patterns committing `pods` would
+        intern and pre-size the banks for them (reserve_signatures) —
+        the warmup census. Lives HERE, next to the banks whose interning
+        identity it must mirror: SigBank keys by (label row, namespace,
+        deleting) — pending pods are never deleting, so the (labels, ns)
+        tuple below is that identity without touching the interner —
+        and PatternBank keys by its own _key over _pod_patterns."""
+        sigs: Set[tuple] = set()
+        pats: Set[tuple] = set()
+        seen_aff: Set[tuple] = set()
+        for p in pods:
+            sigs.add((tuple(sorted(p.labels.items())), p.namespace))
+            if p.affinity is not None:
+                sk = (p.namespace, repr(p.affinity))
+                if sk not in seen_aff:
+                    seen_aff.add(sk)
+                    for args in self.pats._pod_patterns(p):
+                        pats.add(self.pats._key(*args))
+        # the backlog interns ALONGSIDE whatever the existing cluster
+        # already holds; modest headroom on top (growth past it is still
+        # covered by the ladder's s*4/pt*4 headroom warming)
+        n_sigs = len(self.eps._sig_of) + len(sigs)
+        n_pats = len(self.pats._row_of) + len(pats)
+        return self.reserve_signatures(
+            n_sigs + max(8, n_sigs // 8),
+            n_pats + max(8, n_pats // 8) if pats else 0,
+        )
+
     def _rebuild(self) -> None:
         self.rebuild_count += 1
         snap = self.cache.snapshot

@@ -41,6 +41,14 @@ class PodInfo:
     timestamp: float = 0.0  # time added to the queue
     attempts: int = 0
     seq: int = 0  # monotonic enqueue sequence (tie-break within priority)
+    # pod-ingest plane (kubernetes_tpu/ingest): the entry's READY staged
+    # row — encoded at admission on the informer thread, consumed by the
+    # driver's index-only dispatch. (-1, -1) = not staged; a generation
+    # mismatch at pop time means the row went stale (update/delete between
+    # enqueue and pop, slab rebuild) and the pod re-stages or falls back
+    # to the legacy in-batch encode, counted.
+    staged_row: int = -1
+    staged_gen: int = -1
 
 
 class _ActiveEntry:
@@ -95,6 +103,100 @@ class PriorityQueue:
         # conservative — safe)
         self.nomination_adds = 0
         self.closed = False
+        # pod-ingest plane: when a PodStage is attached, admissions encode
+        # the pod's tensor row HERE (the informer thread) instead of on
+        # the driver thread per batch; entries carry the ready (row, gen)
+        self._stage = None
+
+    # -- pod-ingest staging (kubernetes_tpu/ingest) --------------------------
+
+    def attach_stage(self, stage) -> None:
+        """Install the ingest plane's staging slab. Entries added before
+        the attach are staged lazily (warmup census / dispatch restage).
+        Lock order: queue lock → stage lock, always."""
+        with self._lock:
+            self._stage = stage
+
+    def _stage_acquire(self, info: PodInfo) -> None:
+        if self._stage is None:
+            return
+        pair = self._stage.acquire(info.pod)
+        if pair is None:
+            info.staged_row, info.staged_gen = -1, -1
+        else:
+            info.staged_row, info.staged_gen = pair
+
+    def _stage_release(self, info: Optional[PodInfo]) -> None:
+        if self._stage is None or info is None or info.staged_row < 0:
+            return
+        self._stage.release(info.staged_row, info.staged_gen)
+        info.staged_row, info.staged_gen = -1, -1
+
+    def _stage_swap(self, info: PodInfo, new: Pod) -> None:
+        """Update an entry's pod and re-stage it, acquiring the NEW row
+        before releasing the old: a content-identical update (status-only
+        patch) is then an intern HIT on the same row — no re-encode, no
+        generation churn — while a real spec change lands a different
+        row and the old one frees (the staleness tag, by design)."""
+        old_row, old_gen = info.staged_row, info.staged_gen
+        info.pod = new
+        self._stage_acquire(info)
+        if self._stage is not None and old_row >= 0:
+            self._stage.release(old_row, old_gen)
+
+    def _stage_acquire_if_stale(self, info: PodInfo) -> None:
+        """Re-acquire on the RE-ADD paths when the entry's pair is missing
+        OR no longer valid (its row was freed/rebuilt while the entry was
+        popped) — without this, a once-stale entry would re-stage at
+        every subsequent dispatch, double-counting one staleness event."""
+        if self._stage is None:
+            return
+        if info.staged_row >= 0 and self._stage.valid_pair(
+            info.staged_row, info.staged_gen
+        ):
+            return
+        info.staged_row, info.staged_gen = -1, -1
+        self._stage_acquire(info)
+
+    def stage_pending(self) -> int:
+        """Stage every pending entry that lacks a valid pair — the warmup
+        census's staging half, under the QUEUE lock so it cannot race the
+        informer's delete()/update() release/acquire pairs (an unlocked
+        acquire into a concurrently-deleted entry would pin its slab row
+        forever). Returns the number of entries (re-)staged."""
+        n = 0
+        with self._lock:
+            if self._stage is None:
+                return 0
+            for k in self._pending_keys_locked():
+                info = self._infos.get(k)
+                if info is None:
+                    continue
+                before = info.staged_row
+                self._stage_acquire_if_stale(info)
+                if info.staged_row >= 0 and info.staged_row != before:
+                    n += 1
+        return n
+
+    def _pending_keys_locked(self) -> Set[str]:
+        """Keys of every entry currently PENDING (active + backoff +
+        unschedulable). Lock held by the caller — the one definition the
+        census walk and the staging walk both use."""
+        keys = set(self._in_active)
+        keys.update(k for _, _, k in self._backoff)
+        keys.update(self._unschedulable)
+        return keys
+
+    def pending_infos(self) -> List[PodInfo]:
+        """Every pending entry — the warmup census walks this to pre-size
+        the signature/pattern banks and to stage the whole backlog, not
+        just the peeked batch."""
+        with self._lock:
+            return [
+                self._infos[k]
+                for k in self._pending_keys_locked()
+                if k in self._infos
+            ]
 
     # -- internals -----------------------------------------------------------
 
@@ -148,8 +250,22 @@ class PriorityQueue:
     def add(self, pod: Pod) -> None:
         """Add: new pending pod → activeQ."""
         self._warm_memos(pod)
+        # stage OUTSIDE the queue lock (same reason _warm_memos is): the
+        # row encode is the admission path's heavy half, and holding the
+        # queue lock through it would stall the driver's pops during
+        # admission bursts. The acquired ref keeps the row live until the
+        # pair attaches below; a racing delete of the same key releases
+        # the OLD entry's pair, never this one.
+        stage = self._stage
+        pair = stage.acquire(pod) if stage is not None else None
         with self._lock:
             info = PodInfo(pod=pod, timestamp=self._now(), seq=next(self._seq))
+            if pair is not None:
+                info.staged_row, info.staged_gen = pair
+            # attach-new-then-release-old: an identical re-add lands on
+            # the same row as an intern hit (no re-encode, no generation
+            # churn); real content changes free the old row normally
+            self._stage_release(self._infos.get(pod.key()))
             self._unschedulable.pop(pod.key(), None)
             self._push_active(info)
             self._update_nominated(pod)
@@ -216,6 +332,7 @@ class PriorityQueue:
         of its own batch and must re-solve against the committed state."""
         with self._lock:
             for info in infos:
+                self._stage_acquire_if_stale(info)
                 self._unschedulable.pop(info.pod.key(), None)
                 self._push_active(info)
 
@@ -263,6 +380,7 @@ class PriorityQueue:
         of unschedulableQ (wait for an event)."""
         with self._lock:
             key = info.pod.key()
+            self._stage_acquire_if_stale(info)
             self._attempts[key] = self._attempts.get(key, 0) + 1
             self._last_failure[key] = self._now()
             cycle = pod_scheduling_cycle if pod_scheduling_cycle is not None else self._scheduling_cycle
@@ -317,6 +435,11 @@ class PriorityQueue:
     def delete(self, pod: Pod) -> None:
         with self._lock:
             key = pod.key()
+            # ingest plane: the entry's staged row loses this holder; if it
+            # was the last, the row frees and any popped-but-undispatched
+            # copy of the entry sees the generation mismatch (the
+            # delete-between-enqueue-and-pop staleness, by design)
+            self._stage_release(self._infos.get(key))
             self._infos.pop(key, None)
             self._unschedulable.pop(key, None)
             self._in_active.discard(key)  # lazily skipped on pop
@@ -337,10 +460,10 @@ class PriorityQueue:
             key = new.key()
             if key in self._unschedulable:
                 info = self._unschedulable.pop(key)
-                info.pod = new
+                self._stage_swap(info, new)
                 self._push_active(info)
             elif key in self._infos:
-                self._infos[key].pod = new
+                self._stage_swap(self._infos[key], new)
             else:
                 self.add(new)
             self._update_nominated(new)

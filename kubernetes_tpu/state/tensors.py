@@ -35,6 +35,7 @@ int64 resource quantities, bool masks.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -141,37 +142,48 @@ class Vocab:
         self.proto_tcp = self.strings.intern("TCP")
         self._dense: Dict[int, Dict[int, int]] = {}
         self._zone_dense: Dict[int, int] = {}
+        # slot/dense assignment is a read-modify-write (len → insert): with
+        # the pod-ingest plane, encodes run on the INFORMER thread too
+        # (stage.acquire → set_pod) concurrently with the driver thread's
+        # batch/node encodes — unlocked, two new keys could be assigned
+        # the SAME slot, silently corrupting label matching forever. The
+        # string interner has its own lock already; reads (peek/lookup)
+        # stay lock-free (single dict .get, GIL-atomic).
+        self._slot_lock = threading.Lock()
 
     def zone_dense_of(self, zone_id: int) -> int:
-        idx = self._zone_dense.get(zone_id)
-        if idx is None:
-            idx = len(self._zone_dense)
-            self._zone_dense[zone_id] = idx
-        return idx
+        with self._slot_lock:
+            idx = self._zone_dense.get(zone_id)
+            if idx is None:
+                idx = len(self._zone_dense)
+                self._zone_dense[zone_id] = idx
+            return idx
 
     # -- label keys → dense slots -------------------------------------------
     def slot_of_key(self, key: str) -> int:
-        s = self.key_slot.get(key)
-        if s is None:
-            s = len(self.key_slot)
-            if s >= self.config.key_slots:
-                # grow bucket: next power of two; callers re-encode banks
-                self.config.key_slots *= 2
-            self.key_slot[key] = s
-        return s
+        with self._slot_lock:
+            s = self.key_slot.get(key)
+            if s is None:
+                s = len(self.key_slot)
+                if s >= self.config.key_slots:
+                    # grow bucket: next power of two; callers re-encode banks
+                    self.config.key_slots *= 2
+                self.key_slot[key] = s
+            return s
 
     def peek_slot(self, key: str) -> int:
         """-1 when the key has never been seen (matches nothing)."""
         return self.key_slot.get(key, -1)
 
     def slot_of_resource(self, name: str) -> int:
-        s = self.resource_slot.get(name)
-        if s is None:
-            s = len(self.resource_slot)
-            if s >= self.config.resource_slots:
-                self.config.resource_slots *= 2
-            self.resource_slot[name] = s
-        return s
+        with self._slot_lock:
+            s = self.resource_slot.get(name)
+            if s is None:
+                s = len(self.resource_slot)
+                if s >= self.config.resource_slots:
+                    self.config.resource_slots *= 2
+                self.resource_slot[name] = s
+            return s
 
     def id(self, s: str) -> int:
         return self.strings.intern(s)
@@ -181,12 +193,13 @@ class Vocab:
     # value id) pair gets a dense index in [0, N_values_of_slot). Stable and
     # grow-only like everything else.
     def dense_of(self, slot: int, val_id: int) -> int:
-        table = self._dense.setdefault(slot, {})
-        idx = table.get(val_id)
-        if idx is None:
-            idx = len(table)
-            table[val_id] = idx
-        return idx
+        with self._slot_lock:
+            table = self._dense.setdefault(slot, {})
+            idx = table.get(val_id)
+            if idx is None:
+                idx = len(table)
+                table[val_id] = idx
+            return idx
 
     def dense_size(self, slot: int) -> int:
         """Distinct dense values assigned for a key slot (upper bound on its

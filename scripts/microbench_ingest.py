@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""A/B microbench: index-only dispatch vs host-built pod-array dispatch.
+
+Measures the two pod-side transports for one solve dispatch's batch
+construction (the ingest plane's tentpole claim):
+
+  A (host-built) — the legacy per-batch path: `PodBatch.set_pod` per
+    unique spec on the driver thread, then the whole padded array dict
+    crosses the host→device wire (uploaded per dispatch).
+  B (index)      — the ingest plane: rows staged ONCE into the resident
+    bank (enqueue-time cost, off this measurement), per dispatch only an
+    int32 index vector + two [U] bool control vectors ship and a jitted
+    gather (ingest/gather.gather_stage) rebuilds the batch on device.
+
+Timing discipline matches the other microbenches: trials interleave
+A/B/A/B (drift hits both alike), each trial's device outputs are closed
+with block_until_ready, and the reported numbers are per-dispatch host
+wall + shipped bytes. The B path must be STRICTLY cheaper on both at
+every bucket, with BIT-IDENTICAL device content (every array of the
+gathered dict equals the host-built one, padding included) — asserted in
+smoke mode, printed standalone.
+
+Run: python scripts/microbench_ingest.py [u_real]
+Smoke (tier-1, via tests/test_ingest_plane.py): main(smoke=True).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def _mk_pods(n):
+    """n distinct pod SPECS with realistic encode weight: labels,
+    tolerations, node selectors, a spread/anti slice."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        Toleration,
+        TopologySpreadConstraint,
+    )
+    from kubernetes_tpu.models.generators import make_pod
+
+    pods = []
+    for i in range(n):
+        p = make_pod(f"spec-{i}", cpu_milli=100 + i, labels={"app": f"a{i}"})
+        p.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                    value="batch", effect="NoSchedule")]
+        p.node_selector = {"instance-type": "small"}
+        if i % 8 == 0:
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+                PodAffinityTerm(
+                    label_selector=LabelSelector(match_labels={"app": p.labels["app"]}),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ]))
+        elif i % 8 == 1:
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=1, topology_key="zone",
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector=LabelSelector(match_labels={"app": p.labels["app"]}),
+            )]
+        pods.append(p)
+    return pods
+
+
+def main(smoke: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.ingest import PodStage, StageBank
+    from kubernetes_tpu.ingest.gather import gather_stage
+    from kubernetes_tpu.state.tensors import PodBatch, Vocab, _bucket
+
+    u_real = int(sys.argv[1]) if len(sys.argv) > 1 and not smoke else (
+        24 if smoke else 256
+    )
+    trials = 3 if smoke else 10
+    vocab = Vocab()
+    pods = _mk_pods(u_real)
+    u = _bucket(u_real)
+
+    # B's one-time staging (enqueue-time in the real system): encode every
+    # spec into the slab and upload the bank ONCE, before any trial
+    stage = PodStage(vocab, capacity=max(256, u))
+    bank = StageBank(stage)
+    rows = []
+    for p in pods:
+        pair = stage.acquire(p)
+        assert pair is not None
+        rows.append(pair[0])
+    bank_dev, empty_dev = bank.current_arrays()
+    idx_host = np.zeros(u, np.int32)
+    idx_host[:u_real] = rows
+
+    def run_a():
+        """Host-built: encode + upload the full padded dict."""
+        batch = PodBatch(vocab, u)
+        for i, p in enumerate(pods):
+            batch.set_pod(i, p)
+        host = batch.arrays()
+        nbytes = sum(int(np.asarray(v).nbytes) for v in host.values())
+        dev = {k: jnp.asarray(v) for k, v in host.items()}
+        return dev, nbytes
+
+    def run_b():
+        """Index-only: ship idx + control vectors, gather on device."""
+        idx = idx_host.copy()
+        keep = np.zeros(u, bool)
+        keep[:u_real] = True
+        fb = np.zeros(u, bool)
+        fb[:u_real] = stage.batch.fallback[np.asarray(rows, np.int64)]
+        nbytes = idx.nbytes + keep.nbytes + fb.nbytes
+        dev = gather_stage(bank_dev, idx, keep, empty_dev, fb)
+        return dev, nbytes
+
+    # warm both jit paths + pin bit-identity before timing
+    dev_a, bytes_a = run_a()
+    dev_b, bytes_b = run_b()
+    jax.block_until_ready((dev_a, dev_b))
+    mismatches = [
+        k for k in dev_a
+        if not np.array_equal(np.asarray(dev_a[k]), np.asarray(dev_b[k]))
+    ]
+    assert not mismatches, f"index dispatch diverged on: {mismatches}"
+
+    t_a = t_b = 0.0
+    for _ in range(trials):  # interleaved: drift hits both alike
+        t0 = time.perf_counter()
+        out, _ = run_a()
+        jax.block_until_ready(out["req"])
+        t_a += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out, _ = run_b()
+        jax.block_until_ready(out["req"])
+        t_b += time.perf_counter() - t0
+    t_a /= trials
+    t_b /= trials
+    result = {
+        "u_real": u_real,
+        "u_bucket": u,
+        "host_built_s": round(t_a, 6),
+        "index_s": round(t_b, 6),
+        "speedup": round(t_a / t_b, 2) if t_b > 0 else float("inf"),
+        "host_built_bytes": bytes_a,
+        "index_bytes": bytes_b,
+        "bytes_ratio": round(bytes_a / bytes_b, 1),
+        "bit_identical": True,
+    }
+    if smoke:
+        assert t_b < t_a, (
+            f"index dispatch not cheaper: {t_b:.6f}s vs {t_a:.6f}s"
+        )
+        assert bytes_b < bytes_a
+    else:
+        print(result)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,16 @@ config 6's cycle-2 solve spike): a tiny preemption drain must finish with
 `misses_after_warmup == 0` AND `warm_stall_batches == 0` — victim-deletion
 row patches, the nominee overlay, and the preempt kernel all land on
 warmed programs.
+
+`main_ingest()` (mode `ingest`) guards the pod-ingest plane
+(kubernetes_tpu/ingest): on a quiet drain every dispatch must take the
+index-only path (staged coverage > 0, ZERO stale-row fallbacks), the
+pod-side wire ledger (`patch_bytes.pods`) must stay KB-scale (vs the
+full padded pod-array upload the legacy path ships per dispatch), the
+gang smoke drain must finish with `mirror_rebuilds == 0` (the warmup
+census pre-sizes SigBank from the FULL queue), and `misses_after_warmup`
+must stay 0 — the staging scatters and the index-gather are planned
+programs, never mid-drain compiles.
 """
 
 from __future__ import annotations
@@ -101,6 +111,25 @@ def tiny_commit_plane_config():
         else:
             p = bench.mk_pod(i, cpu="100m", mem="64Mi")
         pods.append(p)
+    return nodes, pods
+
+
+N_UNIQ = 288  # > the SigBank's 256-slot default: overflows mid-drain
+# unless the warmup census pre-sizes from the FULL queue
+
+
+def ingest_smoke_config():
+    """(nodes, pods): the mixed commit-plane workload PLUS N_UNIQ pods
+    with pairwise-distinct label sets — more distinct signatures than the
+    SigBank's 256-slot default, so the drain rebuilds the mirror mid-way
+    (the gang bench's `mirror_rebuilds: 1` failure mode at smoke scale)
+    unless the warmup census walked the whole queue and pre-sized it."""
+    import bench
+
+    nodes, pods = tiny_commit_plane_config()
+    for i in range(N_UNIQ):
+        pods.append(bench.mk_pod(10_000 + i, cpu="50m", mem="32Mi",
+                                 labels={"uniq": f"u{i}"}))
     return nodes, pods
 
 
@@ -304,10 +333,75 @@ def main_preempt() -> dict:
     return detail
 
 
+def main_ingest() -> dict:
+    """Pod-ingest-plane smoke: the mixed commit-plane workload (bulk,
+    anti-affinity, hard spread — every dispatch flavor) plus a distinct-
+    signature slice that overflows the SigBank default unless the warmup
+    census pre-sized it. Must drain with the INDEX path covering every
+    dispatch, only KB-scale pod-side bytes on the wire, zero stale-row
+    fallbacks, and zero mid-drain mirror rebuilds."""
+    import bench
+
+    bench.BATCH = SMOKE_BATCH
+    state = {}
+
+    def inspect(sched):
+        state["stats"] = dict(sched.stats)
+        state["stage"] = dict(sched.stage.stats) if sched.stage else None
+        state["bank"] = dict(sched.stage_bank.stats) if sched.stage_bank else None
+
+    detail = bench.run_config(
+        "tiny_ingest_smoke", ingest_smoke_config, inspect=inspect
+    )
+    phase = detail["phase_split_s"]
+    problems = []
+    want = N_PODS + N_UNIQ
+    if detail["scheduled"] != want:
+        problems.append(f"scheduled {detail['scheduled']} of {want} pods")
+    if not phase.get("ingest_index_batches", 0):
+        problems.append(
+            "ingest coverage is ZERO (no dispatch took the index-only path)"
+        )
+    if phase.get("ingest_legacy_batches", 0):
+        problems.append(
+            f"{phase['ingest_legacy_batches']} legacy host-built dispatch(es) "
+            "on a quiet drain (the plane fell back)"
+        )
+    if phase.get("ingest_stale_rows", 0):
+        problems.append(
+            f"{phase['ingest_stale_rows']} stale staged row(s) on a quiet "
+            "drain (no update/delete happened — bookkeeping bug)"
+        )
+    pods_bytes = detail.get("patch_bytes", {}).get("pods", 0)
+    if not 0 < pods_bytes <= 64 * 1024:
+        problems.append(
+            f"patch_bytes.pods = {pods_bytes} — expected KB-scale index "
+            "vectors (the full pod-array upload is the legacy path)"
+        )
+    if detail["compile"]["misses_after_warmup"]:
+        problems.append(
+            f"{detail['compile']['misses_after_warmup']} compile-spec "
+            "miss(es) after warmup — staging/gather compiled mid-drain"
+        )
+    if detail.get("mirror_rebuilds", 0):
+        problems.append(
+            f"mirror_rebuilds = {detail['mirror_rebuilds']} — the warmup "
+            "census failed to pre-size the signature/pattern banks"
+        )
+    for k, v in detail["audit"].items():
+        if k.endswith("_violations") and v:
+            problems.append(f"audit: {k}={v}")
+    assert not problems, "; ".join(problems)
+    detail["ingest_state"] = state
+    return detail
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
     if mode == "preempt":
         d = main_preempt()
+    elif mode == "ingest":
+        d = main_ingest()
     else:
         d = main(sharded=(mode == "sharded"))
     p = d["phase_split_s"]
@@ -316,6 +410,8 @@ if __name__ == "__main__":
         "scheduled": d["scheduled"],
         "deferred": d.get("deferred", 0),
         "preempted": d.get("preempted", 0),
+        "ingest_index_batches": p.get("ingest_index_batches", 0),
+        "ingest_legacy_batches": p.get("ingest_legacy_batches", 0),
         "arbiter_batches": p.get("arbiter_batches", 0),
         "arbiter_place": p.get("arbiter_place", 0),
         "arbiter_defer": p.get("arbiter_defer", 0),

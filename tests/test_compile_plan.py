@@ -194,10 +194,19 @@ def test_warmup_service_synthetic_growth_banks():
         sched.queue.add(make_pod(f"p{i}", cpu_milli=300, mem=2**20))
     assert sched.warmup() == 4
     svc = sched._warm_svc
+    # quiesce the background headroom worker first: warmup queues these
+    # very growth specs on it, and whether it has finished them by now is
+    # a timing race — warm_specs skips already-done specs, so the count
+    # below would flake. Force a deterministic FOREGROUND execution.
+    svc.stop()
+    svc.join()
     spec = sched._solve_spec(gang=False, with_carry=False)
     growth = sched.compile_plan.ladder.growth_specs(spec)
     sig_specs = [g for g in growth if g.s != spec.s or g.pt != spec.pt]
     assert sig_specs
+    with svc._lock:
+        for g in sig_specs:
+            svc._done.discard(svc.plan.canonicalize(g).key())
     warmed = svc.warm_specs(sig_specs)
     assert warmed == len(sig_specs)
     for g in sig_specs:

@@ -1,0 +1,441 @@
+"""Pod-ingest plane parity suite (kubernetes_tpu/ingest + the driver's
+index-only dispatch).
+
+The tentpole's correctness pin: a drain with the ingest plane ON must
+schedule pod-for-pod identically to plane OFF (the plane is transport,
+never policy) across mixed/anti/churn/preemption/gang drains, while
+covering every quiet dispatch with the index path. Plus the staleness
+contract — update + delete between enqueue and pop re-stage or fall back
+(counted), slab overflow grows through the ladder, a mirror rebuild
+(vocab width growth) bumps the slab generation and the plane self-heals —
+the warmup census pin (mid-drain SigBank overflow rebuilds are dead), and
+the interleaved A/B microbench smoke.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, POD_GROUP_LABEL, Scheduler
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+HOST = "kubernetes.io/hostname"
+ZONE = "zone"
+
+
+def _nodes(n, zones=0, cpu=4000):
+    out = []
+    for i in range(n):
+        labels = {HOST: f"n{i}"}
+        if zones:
+            labels[ZONE] = f"z{i % zones}"
+        out.append(make_node(f"n{i}", cpu_milli=cpu, labels=labels))
+    return out
+
+
+def _anti_pod(name, app, cpu=100):
+    p = make_pod(name, cpu_milli=cpu, labels={"app": app})
+    p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            topology_key=HOST,
+        )
+    ]))
+    return p
+
+
+def _spread_pod(name, app, cpu=50):
+    p = make_pod(name, cpu_milli=cpu, labels={"app": app})
+    p.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": app}),
+    )]
+    return p
+
+
+def _mk_sched(nodes, existing=(), **kw):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(p)
+    kw.setdefault("deterministic", True)
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=Binder(lambda pod, node: None), **kw
+    )
+    return sched
+
+
+def _drain(sched, rounds=60):
+    total, assignments = 0, {}
+    for _ in range(rounds):
+        r = sched.schedule_batch()
+        total += r.scheduled
+        assignments.update(r.assignments)
+        if (r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0
+                and r.deferred == 0):
+            active, backoff, unsched = sched.queue.counts()
+            if not (active + backoff + unsched):
+                break
+            time.sleep(0.06)
+            sched.queue.move_all_to_active()
+    sched.wait_for_binds()
+    return total, assignments
+
+
+# ---------------------------------------------------------------------------
+# plane ON == OFF pod-for-pod
+# ---------------------------------------------------------------------------
+
+def _enqueue_scenario(sched, scenario):
+    q = sched.queue
+    if scenario == "mixed":
+        import random
+
+        rng = random.Random(0)
+        for i in range(24):
+            roll = rng.random()
+            if roll < 0.25:
+                q.add(_anti_pod(f"a{i}", app=f"g{rng.randrange(3)}"))
+            elif roll < 0.5:
+                q.add(_spread_pod(f"s{i}", app=f"sp{rng.randrange(2)}"))
+            else:
+                q.add(make_pod(f"p{i}", cpu_milli=100 + 10 * (i % 3)))
+    elif scenario == "anti":
+        for i in range(12):
+            q.add(_anti_pod(f"a{i}", app=f"g{i % 4}"))
+    elif scenario == "gang":
+        for g in range(2):
+            for m in range(6):
+                q.add(make_pod(
+                    f"g{g}m{m}", cpu_milli=100,
+                    labels={POD_GROUP_LABEL: f"gang-{g}"},
+                ))
+        for i in range(6):
+            q.add(make_pod(f"p{i}", cpu_milli=100))
+    else:
+        raise AssertionError(scenario)
+
+
+@pytest.mark.parametrize("scenario", ["mixed", "anti", "gang"])
+def test_drain_parity_plane_on_vs_off(scenario):
+    results = {}
+    for ingest in (True, False):
+        sched = _mk_sched(
+            _nodes(6, zones=3), enable_preemption=False, batch_size=8,
+            ingest_plane=ingest,
+        )
+        _enqueue_scenario(sched, scenario)
+        sched.warmup()
+        n, assigns = _drain(sched)
+        results[ingest] = (n, assigns)
+        if ingest:
+            assert sched.stats.get("ingest_index_batches", 0) > 0, sched.stats
+        sched.close()
+    assert results[True] == results[False]
+
+
+def test_preemption_drain_parity_plane_on_vs_off():
+    results = {}
+    for ingest in (True, False):
+        nodes = _nodes(3, cpu=1000)
+        existing = []
+        for i, nd in enumerate(nodes):
+            v = make_pod(f"victim{i}", cpu_milli=900, node_name=nd.name)
+            v.priority = 0
+            existing.append(v)
+        sched = _mk_sched(
+            nodes, existing=existing, enable_preemption=True, batch_size=8,
+            ingest_plane=ingest,
+        )
+        for i in range(3):
+            p = make_pod(f"hi{i}", cpu_milli=800)
+            p.priority = 1000
+            sched.queue.add(p)
+        sched.warmup()
+        n, assigns = _drain(sched)
+        results[ingest] = (n, assigns)
+        sched.close()
+    assert results[True][0] == 3
+    assert results[True] == results[False]
+
+
+def test_node_churn_drain_parity_plane_on_vs_off():
+    """Nodes added/removed mid-drain: row remaps + bank rebuilds on the
+    node side must not perturb the pod-side plane (and vice versa)."""
+    results = {}
+    for ingest in (True, False):
+        sched = _mk_sched(
+            _nodes(4), enable_preemption=False, batch_size=8,
+            ingest_plane=ingest,
+        )
+        for i in range(8):
+            sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+        sched.warmup()
+        r1 = sched.schedule_batch()
+        sched.cache.remove_node("n3")
+        sched.cache.add_node(make_node("n9", cpu_milli=4000,
+                                       labels={HOST: "n9"}))
+        for i in range(8, 16):
+            sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+        n, assigns = _drain(sched)
+        results[ingest] = (r1.scheduled + n, sorted(assigns))
+        sched.close()
+    assert results[True] == results[False]
+
+
+# ---------------------------------------------------------------------------
+# staleness: update + delete between enqueue and pop
+# ---------------------------------------------------------------------------
+
+def test_update_between_enqueue_and_pop_uses_new_content():
+    """An update that changes placement-relevant spec MUST be what the
+    solve sees — the stale staged row (old content) is invalidated and
+    the entry re-stages on the informer path."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    q = sched.queue
+    blocked = make_pod("u0", cpu_milli=100)
+    blocked.node_selector = {"no-such-label": "x"}  # fits nowhere
+    q.add(blocked)
+    fixed = make_pod("u0", cpu_milli=100)  # same key, selector gone
+    q.update(blocked, fixed)
+    sched.warmup()
+    n, assigns = _drain(sched)
+    assert n == 1 and "default/u0" in assigns
+    sched.close()
+
+
+def test_delete_between_pop_and_dispatch_counts_stale_and_restages():
+    """queue.delete releases the entry's staged row; a popped copy still
+    in flight sees the generation mismatch, counts the staleness, and
+    re-stages from the captured pod object — the dispatch stays covered
+    and the placement is unaffected."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    q = sched.queue
+    lone = make_pod("lone", cpu_milli=100, labels={"only": "holder"})
+    q.add(lone)
+    sched.warmup()
+    infos = q.pop_batch(8)
+    assert len(infos) == 1 and infos[0].staged_row >= 0
+    row, gen = infos[0].staged_row, infos[0].staged_gen
+    q.delete(lone)  # last holder: the row frees, generation bumps
+    assert not sched.stage.valid_pair(row, gen)
+    out = sched._device_solve(infos)
+    assert int(out.assign[0]) >= 0
+    assert sched.stats.get("ingest_stale_rows", 0) >= 1
+    assert sched.stats.get("ingest_restaged", 0) >= 1
+    assert sched.stats.get("ingest_index_batches", 0) >= 1  # still covered
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# slab overflow + width growth
+# ---------------------------------------------------------------------------
+
+def test_slab_overflow_grows_capacity_and_invalidates(monkeypatch):
+    from kubernetes_tpu.ingest import stage as stage_mod
+    from kubernetes_tpu.state.tensors import Vocab
+
+    monkeypatch.setattr(stage_mod, "MIN_CAPACITY", 4)
+    st = stage_mod.PodStage(Vocab(), capacity=4)
+    pairs = [st.acquire(make_pod(f"d{i}", cpu_milli=100 + i)) for i in range(4)]
+    assert all(p is not None for p in pairs)
+    # 5th distinct spec: slab full → grows to the next rung, every
+    # outstanding pair goes stale (generation bump), staging resumes
+    p5 = st.acquire(make_pod("d4", cpu_milli=999))
+    assert p5 is not None and st.capacity == 8
+    assert st.stats["overflows"] == 1 and st.stats["rebuilds"] == 1
+    assert all(not st.valid_pair(r, g) for r, g in pairs)
+
+
+def test_slab_ceiling_falls_back_to_legacy_dispatch(monkeypatch):
+    """When a rep cannot be staged at all, the whole batch takes the
+    legacy host-built dispatch — counted, never wrong."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    for i in range(6):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100 + i))
+    sched.warmup()
+    # poison every pair + refuse restage: the covered path must bail
+    monkeypatch.setattr(sched.stage, "ensure_row", lambda pod: None)
+    for info in sched.queue.pending_infos():
+        info.staged_row = -1
+    n, _ = _drain(sched)
+    assert n == 6
+    assert sched.stats.get("ingest_legacy_batches", 0) >= 1, sched.stats
+    assert sched.stats.get("ingest_stale_rows", 0) >= 1
+    sched.close()
+
+
+def test_prologue_bails_when_slab_rebuilds_mid_resolve(monkeypatch):
+    """A slab rebuild DURING row resolution (a stale rep's restage hits a
+    full slab and grows it) invalidates the rows already collected — the
+    prologue must detect the generation change and fall back to the
+    legacy path rather than gather garbage rows from the rebuilt slab."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    for i in range(4):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100 + i))
+    sched.warmup()
+    infos = sched.queue.pop_batch(8)
+    assert len(infos) == 4
+    infos[-1].staged_row = -1  # one stale rep, resolved AFTER the others
+    real_ensure = sched.stage.ensure_row
+
+    def growing_ensure(pod):
+        sched.stage._rebuild(sched.stage.capacity * 2)
+        return real_ensure(pod)
+
+    monkeypatch.setattr(sched.stage, "ensure_row", growing_ensure)
+    reps = [pi.pod for pi in infos]
+    assert sched._stage_prologue(reps, infos) is None
+    # self-heal: the next dispatch restages everything into the new slab
+    monkeypatch.setattr(sched.stage, "ensure_row", real_ensure)
+    out = sched._device_solve(infos)
+    assert all(int(a) >= 0 for a in out.assign[: len(infos)])
+    sched.close()
+
+
+def test_mirror_rebuild_width_growth_bumps_generation_and_self_heals():
+    """A vocab key-slot growth (mirror rebuild territory) changes the
+    slab's array WIDTHS: every staged row is the wrong shape, the slab
+    rebuilds (generation bump), stale entries re-stage at dispatch, and
+    the plane returns to covered dispatches."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    q = sched.queue
+    for i in range(4):
+        q.add(make_pod(f"p{i}", cpu_milli=100))
+    sched.warmup()
+    gen0 = sched.stage.generation
+    n1, _ = _drain(sched)
+    # a node with more distinct label keys than the vocab's K=64 width
+    wide = make_node("wide", cpu_milli=4000,
+                     labels={f"k{j}": "v" for j in range(70)})
+    sched.cache.add_node(wide)
+    for i in range(4, 8):
+        q.add(make_pod(f"p{i}", cpu_milli=100))
+    n2, _ = _drain(sched)
+    assert n1 + n2 == 8
+    assert sched.stage.generation > gen0  # slab rebuilt at the new width
+    assert sched.stage.key_capacity == sched.mirror.vocab.config.key_slots
+    assert sched.stats.get("ingest_index_batches", 0) >= 2  # covered again
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# warmup census (satellite: the gang config's mirror_rebuilds root cause)
+# ---------------------------------------------------------------------------
+
+def _census_workload(sched, n=340):
+    # 340 > 256 by enough that the overflow crosses DURING the drain
+    # (sync N interns batch N-1's commits, so the count lags one batch)
+    for i in range(n):
+        sched.queue.add(make_pod(f"u{i}", cpu_milli=10,
+                                 labels={"uniq": f"u{i}"}))
+
+
+def test_warmup_census_presizes_sigbank_no_midrain_rebuild():
+    """More distinct pending label sets than the SigBank's 256-slot
+    default: WITHOUT the census the bank overflows as commits intern
+    signatures mid-drain (a rebuild + recompile — the gang bench's
+    mirror_rebuilds: 1); the census walks the full queue at warmup and
+    pre-sizes it, so the drain must finish with rebuild_count == 0."""
+    sched = _mk_sched(_nodes(8, cpu=16000), enable_preemption=False,
+                      batch_size=64)
+    _census_workload(sched)
+    sched.warmup()
+    assert sched.mirror.eps.capacity >= 340  # census sized it up front
+    n, _ = _drain(sched)
+    assert n == 340
+    assert sched.mirror.rebuild_count == 0, (
+        f"mid-drain mirror rebuild(s): {sched.mirror.rebuild_count}"
+    )
+    sched.close()
+
+
+def test_without_census_the_same_workload_rebuilds(monkeypatch):
+    """Control for the census pin: no-op the census and the identical
+    drain MUST rebuild mid-way — proving the census is what kills it."""
+    sched = _mk_sched(_nodes(8, cpu=16000), enable_preemption=False,
+                      batch_size=64)
+    monkeypatch.setattr(sched, "_warmup_census", lambda: None)
+    _census_workload(sched)
+    sched.warmup()
+    n, _ = _drain(sched)
+    assert n == 340
+    assert sched.mirror.rebuild_count >= 1
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# wire accounting + microbench smoke
+# ---------------------------------------------------------------------------
+
+def test_pods_ledger_index_vs_legacy_bytes():
+    """patch_bytes.pods: the covered path ships KB-scale index/control
+    vectors where the legacy path ships the full padded pod arrays —
+    both measured on the SAME ledger so the claim is a byte count."""
+    sizes = {}
+    for ingest in (True, False):
+        sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=16,
+                          ingest_plane=ingest)
+        for i in range(32):
+            sched.queue.add(make_pod(f"p{i}", cpu_milli=100,
+                                     labels={"app": f"a{i % 8}"}))
+        sched.warmup()
+        before = sched.mirror.bytes_shipped.get("pods", 0)
+        n, _ = _drain(sched)
+        assert n == 32
+        sizes[ingest] = sched.mirror.bytes_shipped.get("pods", 0) - before
+        sched.close()
+    assert sizes[True] * 10 < sizes[False], sizes
+
+
+def test_microbench_ingest_smoke():
+    scripts = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    )
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import microbench_ingest
+
+    result = microbench_ingest.main(smoke=True)
+    assert result["bit_identical"]
+    assert result["index_s"] < result["host_built_s"]
+    assert result["index_bytes"] < result["host_built_bytes"]
+
+
+def test_background_uploader_drains_dirty_rows():
+    """Rows staged while the drain runs are shipped by the off-thread
+    uploader — the driver's dispatch should not have to flush them
+    synchronously every batch."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    for i in range(8):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+    sched.warmup()  # arms the uploader + full-uploads the backlog
+    # stage fresh specs AFTER the bank upload: dirty rows appear
+    for i in range(8, 16):
+        sched.queue.add(make_pod(f"q{i}", cpu_milli=100 + i))
+    deadline = time.time() + 5
+    while sched.stage.dirty_rows and time.time() < deadline:
+        time.sleep(0.02)
+    assert not sched.stage.dirty_rows, "uploader never drained"
+    assert sched.stage_bank.stats["flush_rows"] > 0
+    n, _ = _drain(sched)
+    assert n == 16
+    sched.close()

@@ -75,3 +75,26 @@ def test_perf_smoke_preemption_no_midrain_compiles(tmp_path, monkeypatch):
     assert detail["compile"]["misses_after_warmup"] == 0
     assert detail["warm_stall_batches"] == 0
     assert detail["scheduled"] == 24
+
+
+def test_perf_smoke_ingest_plane(tmp_path, monkeypatch):
+    """Pod-ingest-plane acceptance, tier-1-fast: on a quiet drain every
+    dispatch takes the index-only path (coverage > 0, zero stale-row
+    fallbacks, zero legacy dispatches), `patch_bytes.pods` stays KB-scale
+    (index vectors, not the padded pod-array upload), the warmup census
+    keeps `mirror_rebuilds == 0` across a distinct-signature overflow
+    workload, and no program compiles mid-drain."""
+    monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan_ing"))
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main_ingest()  # raises AssertionError on regression
+    phase = detail["phase_split_s"]
+    assert phase["ingest_index_batches"] > 0
+    assert phase.get("ingest_legacy_batches", 0) == 0
+    assert phase.get("ingest_stale_rows", 0) == 0
+    assert 0 < detail["patch_bytes"]["pods"] <= 64 * 1024
+    assert detail["mirror_rebuilds"] == 0
+    assert detail["compile"]["misses_after_warmup"] == 0
+    assert detail["scheduled"] == perf_smoke.N_PODS + perf_smoke.N_UNIQ
