@@ -1,0 +1,28 @@
+"""Static invariant analysis + runtime lock-order auditing.
+
+The repo's three hard-won invariant families — planned XLA compiles,
+donation-safe device residency, and lock-guarded shared state — are
+enforced here as machine-checked rules instead of review lore:
+
+  scripts/ktpu_lint.py        CLI over the checker registry (``--check``
+                              gates preflight and tier-1)
+  analysis/core.py            walk/annotation/baseline infrastructure
+  analysis/checkers.py        the KTPU001..KTPU005 rules
+  analysis/lockorder.py       runtime lock-order/race harness
+                              (KTPU_LOCK_AUDIT=1)
+
+Each rule is the static twin of a runtime guarantee the benches already
+assert (see INVARIANTS.md for the rule → historical-bug cross-reference).
+"""
+
+from .core import (  # noqa: F401
+    AnalysisConfig,
+    Baseline,
+    ModuleInfo,
+    Violation,
+    iter_python_files,
+    load_module,
+    run_checkers,
+    scan_paths,
+)
+from .checkers import ALL_CHECKERS, repo_config  # noqa: F401

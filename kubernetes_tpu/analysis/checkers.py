@@ -1,0 +1,668 @@
+"""The KTPU rule set. Each checker: (ModuleInfo, AnalysisConfig) -> [Violation].
+
+Rules and the bugs they are the static twin of (full cross-reference in
+INVARIANTS.md):
+
+  KTPU001 no-unplanned-jit        PR 4's invisible mid-drain patch-program
+                                  compiles; PR 2's post-commit term-kind miss
+  KTPU002 donation-safety         PR 4's np.asarray on a sharded resident
+                                  array caching _npy_value → blocked donation
+  KTPU003 guarded-by              PR 5's unlocked vocab-slot interning once
+                                  encodes moved to the informer thread
+  KTPU004 hot-path-host-sync      every PERF round's silent device→host
+                                  round-trip on the dispatch/arbiter/fold path
+  KTPU005 shadowed-module-import  the seed UnboundLocalError (shadowed
+                                  _bucket import broke warmup)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    AnalysisConfig,
+    ModuleInfo,
+    Violation,
+    dotted_name,
+    normalize_expr,
+)
+
+# ---------------------------------------------------------------------------
+# KTPU001 — no-unplanned-jit
+# ---------------------------------------------------------------------------
+
+_JIT_ATTRS = {"jit", "pjit", "shard_map"}
+_JIT_NAMES = {"jit", "pjit", "shard_map"}
+
+
+def _jit_refs(mod: ModuleInfo):
+    """Every Name/Attribute reference to a jit-constructing callable.
+    Import statements don't produce Name nodes, so importing is free —
+    only *construction* (calls, decorators, partial(...) args) is seen."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Attribute) and node.attr in _JIT_ATTRS:
+            yield node, ast.unparse(node)
+        elif isinstance(node, ast.Name) and node.id in _JIT_NAMES:
+            # skip the Name inside `jax.jit`-style chains (the Attribute
+            # already reported) — a bare Name ref only counts when it is
+            # not the .value of a reported Attribute
+            parent = mod.parents.get(node)
+            if isinstance(parent, ast.Attribute) and parent.attr in _JIT_ATTRS:
+                continue
+            yield node, node.id
+
+
+def check_ktpu001(mod: ModuleInfo, config: AnalysisConfig) -> List[Violation]:
+    if config.is_jit_allowed_module(mod.relpath):
+        return []
+    out: List[Violation] = []
+    for node, text in _jit_refs(mod):
+        if mod.allowed(node, "KTPU001"):
+            continue
+        admitted = False
+        for fn in mod.enclosing_functions(node):
+            if mod.node_marks(fn, "admitted"):
+                admitted = True
+                break
+            # factory bodies that route through the compile plan are
+            # self-evidently planned: they reference a KIND_* spec or
+            # call plan.admit/declare in the same scope
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Name) and sub.id.startswith("KIND_"):
+                    admitted = True
+                    break
+                if isinstance(sub, ast.Attribute) and sub.attr.startswith("KIND_"):
+                    admitted = True
+                    break
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("admit", "declare")
+                ):
+                    admitted = True
+                    break
+            if admitted:
+                break
+        if admitted:
+            continue
+        scope = mod.qualname(node)
+        out.append(
+            Violation(
+                rule="KTPU001",
+                path=mod.relpath,
+                line=node.lineno,
+                scope=scope,
+                detail=text,
+                message=(
+                    f"`{text}` constructed outside compile/ or an ops/ "
+                    "kernel factory, with no KIND_* spec or plan.admit in "
+                    "scope — this program is invisible to the compile plan "
+                    "and will compile mid-drain. Route it through a "
+                    "SolveSpec, or mark the factory "
+                    "`# ktpu: admitted(KIND_X)` naming the spec kind that "
+                    "covers it."
+                ),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KTPU002 — donation-safety
+# ---------------------------------------------------------------------------
+
+def _donated_positions_from_call(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """(positions) when `call` is jax.jit/partial(jax.jit, ...) carrying
+    donate_argnums."""
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                pos = tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                )
+                return pos or ()
+            return ()  # dynamic: positions unknown — treat all as donated
+    return None
+
+
+def _collect_donating(mod: ModuleInfo) -> Dict[str, Optional[Tuple[int, ...]]]:
+    """name -> donated positional indices (None = all args suspect).
+    Sources: @partial(jax.jit, donate_argnums=...) decorations,
+    `f = jax.jit(g, donate_argnums=...)` bindings, and explicit
+    `# ktpu: donates(i, j)` def annotations."""
+    donating: Dict[str, Optional[Tuple[int, ...]]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for mark in mod.node_marks(node, "donates"):
+                pos = tuple(int(a) for a in mark.args if a.lstrip("-").isdigit())
+                donating[node.name] = pos or None
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    pos = _donated_positions_from_call(dec)
+                    if pos is not None:
+                        donating[node.name] = pos or None
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donated_positions_from_call(node.value)
+            if pos is not None:
+                for tgt in node.targets:
+                    nm = dotted_name(tgt)
+                    if nm:
+                        donating[nm.split(".")[-1]] = pos or None
+    return donating
+
+
+def _scope_body(mod: ModuleInfo, node: ast.AST) -> ast.AST:
+    fn = mod.enclosing_function(node)
+    return fn if fn is not None else mod.tree
+
+
+def check_ktpu002_donation(mod: ModuleInfo, config: AnalysisConfig) -> List[Violation]:
+    """A name passed through a donated argument position may not be read
+    again in the same scope (the buffer is deleted); rebinding it (the
+    idiomatic `banks = fold(banks, ...)`) ends the taint."""
+    donating = _collect_donating(mod)
+    if not donating:
+        return []
+    out: List[Violation] = []
+    for call in ast.walk(mod.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        callee = dotted_name(call.func)
+        if callee is None:
+            continue
+        positions = donating.get(callee.split(".")[-1], "absent")
+        if positions == "absent":
+            continue
+        if mod.allowed(call, "KTPU002"):
+            continue
+        donated_args: List[str] = []
+        for i, arg in enumerate(call.args):
+            if positions is not None and i not in positions:
+                continue
+            nm = dotted_name(arg)
+            if nm is not None:
+                donated_args.append(nm)
+        if not donated_args:
+            continue
+        scope = _scope_body(mod, call)
+        end = getattr(call, "end_lineno", call.lineno)
+        for nm in donated_args:
+            # first rebind of the exact name after (or at) the call —
+            # `x = f(x)` rebinds on the call line itself
+            rebind = None
+            for sub in ast.walk(scope):
+                if (
+                    isinstance(sub, (ast.Name, ast.Attribute))
+                    and isinstance(getattr(sub, "ctx", None), ast.Store)
+                    and dotted_name(sub) == nm
+                    and sub.lineno >= call.lineno
+                ):
+                    rebind = min(rebind or sub.lineno, sub.lineno)
+            for sub in ast.walk(scope):
+                if not isinstance(sub, (ast.Name, ast.Attribute)):
+                    continue
+                if not isinstance(getattr(sub, "ctx", None), ast.Load):
+                    continue
+                if dotted_name(sub) != nm:
+                    continue
+                if sub.lineno <= end:
+                    continue
+                if rebind is not None and sub.lineno > rebind:
+                    continue
+                if mod.allowed(sub, "KTPU002"):
+                    continue
+                out.append(
+                    Violation(
+                        rule="KTPU002",
+                        path=mod.relpath,
+                        line=sub.lineno,
+                        scope=mod.qualname(sub),
+                        detail=f"use-after-donate:{nm}->{callee}",
+                        message=(
+                            f"`{nm}` was donated to `{callee}` (its buffer "
+                            "is deleted on dispatch) and is read again "
+                            "here — rebind the result to the same name or "
+                            "stop reading the stale reference."
+                        ),
+                    )
+                )
+                break  # one report per donated name per call
+    return out
+
+
+_FORCING_FUNCS = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get",
+}
+_ALWAYS_FORCING_ATTRS = {"block_until_ready"}
+_VALUE_FORCING_ATTRS = {"item", "tolist"}
+_SCALAR_FORCING = {"float", "int"}
+
+
+def _forcing_target(call: ast.Call) -> Optional[Tuple[ast.AST, str, bool]]:
+    """(target expr, callee text, always_forcing) when `call` is a
+    device→host forcing construct."""
+    f = call.func
+    nm = dotted_name(f)
+    if nm in _FORCING_FUNCS and call.args:
+        return call.args[0], nm, nm == "jax.device_get"
+    if isinstance(f, ast.Name) and f.id in _SCALAR_FORCING and call.args:
+        return call.args[0], f.id, False
+    if isinstance(f, ast.Attribute):
+        if f.attr in _ALWAYS_FORCING_ATTRS:
+            return f.value, f.attr, True
+        if f.attr in _VALUE_FORCING_ATTRS:
+            return f.value, f.attr, False
+    return None
+
+
+#: reading these never forces a transfer — shape/dtype probes are free
+_METADATA_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes", "sharding"}
+
+
+def _through_metadata(mod: ModuleInfo, node: ast.AST, stop: ast.AST) -> bool:
+    """True when `node` is only reached via .shape/.dtype/... within the
+    expression rooted at `stop` (e.g. int(na_dev["x"].shape[1]))."""
+    if node is stop:
+        return False
+    cur = mod.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Attribute) and cur.attr in _METADATA_ATTRS:
+            return True
+        if cur is stop:
+            return False
+        cur = mod.parents.get(cur)
+    return False
+
+
+def _device_like_subtree(mod: ModuleInfo, config: AnalysisConfig, node: ast.AST) -> Optional[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            nm = dotted_name(sub)
+            if nm and config.device_like(nm) and not _through_metadata(mod, sub, node):
+                return nm
+    return None
+
+
+def _sync_exempt(mod: ModuleInfo, config: AnalysisConfig, call: ast.Call) -> bool:
+    if mod.allowed(call, "KTPU002") or mod.marks(call.lineno, "host-sync-ok"):
+        return True
+    for fn in mod.enclosing_functions(call):
+        qn = mod.qualname(fn)
+        if qn in config.sync_allowlist or fn.name in config.sync_allowlist:
+            return True
+        if mod.node_marks(fn, "host-sync-ok"):
+            return True
+    return False
+
+
+def check_ktpu002_sync(mod: ModuleInfo, config: AnalysisConfig) -> List[Violation]:
+    """In resident-surface modules, host-forcing calls on device-resident
+    values are only legal at designated sync points: np.asarray on a
+    sharded resident array caches `_npy_value` inside the jax Array and
+    silently blocks the NEXT fold's donation (PR 4)."""
+    if not config.is_surface_module(mod.relpath):
+        return []
+    out: List[Violation] = []
+    for call in ast.walk(mod.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        hit = _forcing_target(call)
+        if hit is None:
+            continue
+        target, callee, always = hit
+        devname = _device_like_subtree(mod, config, target)
+        if devname is None and not always:
+            continue
+        if _sync_exempt(mod, config, call):
+            continue
+        out.append(
+            Violation(
+                rule="KTPU002",
+                path=mod.relpath,
+                line=call.lineno,
+                scope=mod.qualname(call),
+                detail=f"host-sync:{callee}({devname or '...'})",
+                message=(
+                    f"`{callee}` forces a device→host sync on "
+                    f"`{devname or 'a device value'}` outside the sync-point "
+                    "allowlist — on a resident/sharded array this caches "
+                    "_npy_value and blocks later donation. Fetch via a "
+                    "device-side copy at a declared sync point, or mark the "
+                    "line `# ktpu: host-sync-ok <why>`."
+                ),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KTPU003 — guarded-by
+# ---------------------------------------------------------------------------
+
+_CTOR_NAMES = {"__init__", "__post_init__"}
+
+
+def _declared_attrs(
+    mod: ModuleInfo, cls: ast.ClassDef, kind: str
+) -> Dict[str, Tuple[str, int]]:
+    """attr -> (normalized lock expr / confinement tag, declaring line)
+    from `kind` annotations on class-body fields or `self.X = ...`
+    assignments."""
+    declared: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(cls):
+        if mod.enclosing_class(node) is not cls and node is not cls:
+            continue
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        marks = list(mod.marks(node.lineno, kind))
+        # a standalone comment line above also declares (long assignments);
+        # trailing comments of the PREVIOUS statement do not leak down
+        if node.lineno > 1 and mod.lines[node.lineno - 2].lstrip().startswith("#"):
+            marks += mod.marks(node.lineno - 1, kind)
+        if not marks:
+            continue
+        arg = normalize_expr(marks[0].args[0]) if marks[0].args else "self._lock"
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):  # class-body field
+                declared[tgt.id] = (arg, node.lineno)
+            elif (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                declared[tgt.attr] = (arg, node.lineno)
+    return declared
+
+
+def _method_exempt(mod: ModuleInfo, fn, lock: str) -> bool:
+    if fn.name in _CTOR_NAMES:
+        return True
+    if fn.name.endswith("_locked"):  # repo convention: caller holds the lock
+        return True
+    for mark in mod.node_marks(fn, "holds"):
+        if not mark.args or any(normalize_expr(a) == lock for a in mark.args):
+            return True
+    return False
+
+
+def _method_confined(mod: ModuleInfo, fn, tag: str) -> bool:
+    if fn.name in _CTOR_NAMES:
+        return True
+    for mark in mod.node_marks(fn, "confined"):
+        if not mark.args or any(normalize_expr(a) == tag for a in mark.args):
+            return True
+    return False
+
+
+def check_ktpu003(mod: ModuleInfo, config: AnalysisConfig) -> List[Violation]:
+    out: List[Violation] = []
+    for cls in ast.walk(mod.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded = _declared_attrs(mod, cls, "guarded-by")
+        confined = _declared_attrs(mod, cls, "confined")
+        if not guarded and not confined:
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not (isinstance(node.value, ast.Name) and node.value.id == "self"):
+                continue
+            if mod.enclosing_class(node) is not cls:
+                continue
+            if node.attr in guarded:
+                lock, decl_line = guarded[node.attr]
+                if node.lineno == decl_line:  # the declaring assignment itself
+                    continue
+                fns = mod.enclosing_functions(node)
+                if not fns:
+                    continue
+                if any(_method_exempt(mod, fn, lock) for fn in fns):
+                    continue
+                if lock in {normalize_expr(w) for w in mod.with_locks_around(node)}:
+                    continue
+                if mod.allowed(node, "KTPU003"):
+                    continue
+                out.append(
+                    Violation(
+                        rule="KTPU003",
+                        path=mod.relpath,
+                        line=node.lineno,
+                        scope=mod.qualname(node),
+                        detail=f"unguarded:{cls.name}.{node.attr}",
+                        message=(
+                            f"`self.{node.attr}` is declared "
+                            f"`# ktpu: guarded-by({lock})` but is accessed here "
+                            f"outside a `with {lock}:` block (and the method is "
+                            "not marked `# ktpu: holds(...)` / `*_locked`). "
+                            "Unlocked cross-thread access is how vocab-slot "
+                            "interning silently corrupted label matching (PR 5)."
+                        ),
+                    )
+                )
+            elif node.attr in confined:
+                tag, decl_line = confined[node.attr]
+                if node.lineno == decl_line:
+                    continue
+                fns = mod.enclosing_functions(node)
+                if not fns:
+                    continue
+                if any(_method_confined(mod, fn, tag) for fn in fns):
+                    continue
+                if mod.allowed(node, "KTPU003"):
+                    continue
+                out.append(
+                    Violation(
+                        rule="KTPU003",
+                        path=mod.relpath,
+                        line=node.lineno,
+                        scope=mod.qualname(node),
+                        detail=f"unconfined:{cls.name}.{node.attr}",
+                        message=(
+                            f"`self.{node.attr}` is declared "
+                            f"`# ktpu: confined({tag})` — single-thread state "
+                            "with NO lock — but this method does not carry "
+                            f"the matching `# ktpu: confined({tag})` mark. "
+                            "Either the access runs on another thread (a "
+                            "race: add a real lock) or the method belongs to "
+                            "the confined context (mark it)."
+                        ),
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KTPU004 — hot-path-host-sync
+# ---------------------------------------------------------------------------
+
+def check_ktpu004(mod: ModuleInfo, config: AnalysisConfig) -> List[Violation]:
+    """Inside functions marked `# ktpu: hot-path` (driver dispatch, the
+    arbiter, the fold planners), NO device→host forcing is legal — a
+    single hidden round-trip serializes the whole pipelined drain."""
+    out: List[Violation] = []
+    for call in ast.walk(mod.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        hit = _forcing_target(call)
+        if hit is None:
+            continue
+        target, callee, always = hit
+        hot = None
+        for fn in mod.enclosing_functions(call):
+            if mod.node_marks(fn, "hot-path"):
+                hot = fn
+                break
+        if hot is None:
+            continue
+        devname = _device_like_subtree(mod, config, target)
+        if devname is None and not always:
+            continue  # host→host asarray etc. is fine even on hot paths
+        if mod.allowed(call, "KTPU004") or mod.marks(call.lineno, "host-sync-ok"):
+            continue
+        out.append(
+            Violation(
+                rule="KTPU004",
+                path=mod.relpath,
+                line=call.lineno,
+                scope=mod.qualname(call),
+                detail=f"hot-sync:{callee}({devname or '...'})",
+                message=(
+                    f"`{callee}` forces a device→host sync inside hot-path "
+                    f"function `{hot.name}` — dispatch/arbiter/fold code "
+                    "must stay free-running; fetch results at the batch's "
+                    "designated fetch point instead."
+                ),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KTPU005 — shadowed-module-import
+# ---------------------------------------------------------------------------
+
+def _module_level_names(mod: ModuleInfo) -> Set[str]:
+    names: Set[str] = set()
+    body = getattr(mod.tree, "body", [])
+    for node in body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                names.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                names.add(a.asname or a.name)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+    return names
+
+
+def check_ktpu005(mod: ModuleInfo, config: AnalysisConfig) -> List[Violation]:
+    """A function-local import that rebinds a module-level name makes the
+    WHOLE function treat that name as local — any use before the import
+    line raises UnboundLocalError at runtime (the seed `_bucket` bug,
+    which broke warmup for every enable_preemption=False drain)."""
+    module_names = _module_level_names(mod)
+    out: List[Violation] = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # local imports directly inside THIS function (not nested defs)
+        local_imports: List[Tuple[str, int, ast.AST]] = []
+        for node in ast.walk(fn):
+            if mod.enclosing_function(node) is not fn:
+                continue
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local_imports.append(
+                        (a.asname or a.name.split(".")[0], node.lineno, node)
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    local_imports.append((a.asname or a.name, node.lineno, node))
+        for name, line, node in local_imports:
+            if name not in module_names:
+                continue
+            if mod.allowed(node, "KTPU005"):
+                continue
+            early_use = None
+            for sub in ast.walk(fn):
+                if (
+                    isinstance(sub, ast.Name)
+                    and sub.id == name
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.lineno < line
+                    and mod.enclosing_function(sub) is fn
+                ):
+                    early_use = min(early_use or sub.lineno, sub.lineno)
+            if early_use is not None:
+                out.append(
+                    Violation(
+                        rule="KTPU005",
+                        path=mod.relpath,
+                        line=early_use,
+                        scope=mod.qualname(fn) or fn.name,
+                        detail=f"use-before-local-import:{name}",
+                        message=(
+                            f"`{name}` is read here but a local import at "
+                            f"line {line} shadows the module-level binding, "
+                            "making it function-local — this raises "
+                            "UnboundLocalError at runtime (the seed "
+                            "`_bucket` warmup breakage). Rename the local "
+                            "import or move it above every use."
+                        ),
+                    )
+                )
+            else:
+                out.append(
+                    Violation(
+                        rule="KTPU005",
+                        path=mod.relpath,
+                        line=line,
+                        scope=mod.qualname(fn) or fn.name,
+                        detail=f"shadowed-import:{name}",
+                        message=(
+                            f"local import rebinds module-level `{name}` — "
+                            "every use in this function now resolves to the "
+                            "local binding; a use added above this line "
+                            "becomes an UnboundLocalError. Rename the local "
+                            "alias (e.g. `as _{0}`) or drop the redundant "
+                            "import.".format(name)
+                        ),
+                    )
+                )
+    return out
+
+
+ALL_CHECKERS = (
+    check_ktpu001,
+    check_ktpu002_donation,
+    check_ktpu002_sync,
+    check_ktpu003,
+    check_ktpu004,
+    check_ktpu005,
+)
+
+
+def repo_config() -> AnalysisConfig:
+    """The tree's canonical policy: where jit construction is the module's
+    job, which modules hold resident banks, and the designated sync
+    points the resident-state plane documents."""
+    return AnalysisConfig(
+        jit_allowed_prefixes=(
+            "kubernetes_tpu/compile/",
+            "kubernetes_tpu/ops/",
+            # the version-shim module whose whole purpose is wrapping
+            # shard_map for jax 0.4.x/0.5.x — constructions inside it are
+            # the factories' raw material, admitted at their call sites
+            "kubernetes_tpu/parallel/mesh.py",
+        ),
+        surface_prefixes=(
+            "kubernetes_tpu/state/cache.py",
+            "kubernetes_tpu/ingest/",
+            "kubernetes_tpu/commit/",
+            "kubernetes_tpu/scheduler/driver.py",
+            "kubernetes_tpu/parallel/sharded.py",
+        ),
+        sync_allowlist=(
+            # the mirror's parity probe fetches via a device-side copy —
+            # THE designed sync point of the resident-state plane
+            "TensorMirror.device_bank_divergence",
+            # the batch's one designated solve-result fetch
+            "Scheduler._finish_solve",
+            # host-rank score rows bulk-fetch (Score plugins / extenders)
+            "ScoreRows.prefetch",
+        ),
+    )

@@ -1,0 +1,372 @@
+"""ktpu-lint infrastructure: module loading, annotations, baseline.
+
+The checkers (analysis/checkers.py) are pure functions over a
+``ModuleInfo`` — parsed AST + source lines + the ``# ktpu:`` annotation
+map — and yield ``Violation`` records. This module owns everything rule-
+independent:
+
+* **Annotations** — one comment grammar for the whole toolchain::
+
+      # ktpu: guarded-by(self._lock)      attr assigned here is shared
+      # ktpu: holds(self._lock)           def runs with the lock held
+      # ktpu: confined(driver)            attr/def belongs to ONE thread
+      # ktpu: hot-path                    def is dispatch/arbiter/fold code
+      # ktpu: admitted(KIND_FOLD)         jit here is a planned program
+      # ktpu: donates(0, 1)               def donates these positional args
+      # ktpu: host-sync-ok <reason>       deliberate device→host sync point
+      # ktpu: allow(KTPU001) <reason>     suppress a rule on this line
+
+  Multiple markers may share a line, separated by ``;``.
+
+* **Baseline** — pre-existing violations are checked in with a
+  justification; the tree-wide scan fails closed only when the violation
+  SET GROWS. Fingerprints are line-number-free (rule | path | scope |
+  detail) so unrelated edits don't churn the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: every rule the registry knows; checkers register against these ids
+RULES = {
+    "KTPU001": "no-unplanned-jit",
+    "KTPU002": "donation-safety",
+    "KTPU003": "guarded-by",
+    "KTPU004": "hot-path-host-sync",
+    "KTPU005": "shadowed-module-import",
+}
+
+_MARKER_RE = re.compile(r"#\s*ktpu:\s*(.+?)\s*$")
+_ITEM_RE = re.compile(
+    r"(?P<kind>guarded-by|holds|confined|hot-path|admitted|donates|host-sync-ok|allow)"
+    r"\s*(?:\((?P<args>[^)]*)\))?\s*(?P<trail>[^;]*)"
+)
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str  # "KTPU001"
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    scope: str  # dotted qualname of enclosing class/function ("" = module)
+    detail: str  # short, stable description (part of the fingerprint)
+    message: str  # full human message
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline."""
+        return f"{self.rule}|{self.path}|{self.scope}|{self.detail}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: {self.rule} "
+            f"[{RULES.get(self.rule, '?')}] {self.message}"
+        )
+
+
+@dataclass
+class Annotation:
+    kind: str  # guarded-by | holds | confined | hot-path | admitted | donates | host-sync-ok | allow
+    args: Tuple[str, ...] = ()
+    reason: str = ""
+
+
+def parse_annotations(lines: Sequence[str]) -> Dict[int, List[Annotation]]:
+    """Line (1-based) → parsed ``# ktpu:`` markers on that line."""
+    out: Dict[int, List[Annotation]] = {}
+    for i, raw in enumerate(lines, start=1):
+        if "ktpu:" not in raw:
+            continue
+        m = _MARKER_RE.search(raw)
+        if m is None:
+            continue
+        items: List[Annotation] = []
+        for part in m.group(1).split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            im = _ITEM_RE.match(part)
+            if im is None:
+                continue
+            args = tuple(
+                a.strip() for a in (im.group("args") or "").split(",") if a.strip()
+            )
+            items.append(
+                Annotation(
+                    kind=im.group("kind"),
+                    args=args,
+                    reason=(im.group("trail") or "").strip(),
+                )
+            )
+        if items:
+            out[i] = items
+    return out
+
+
+@dataclass
+class ModuleInfo:
+    """Everything a checker needs about one source file."""
+
+    path: str  # absolute
+    relpath: str  # repo-relative posix path (fingerprint stable)
+    source: str
+    lines: List[str]
+    tree: ast.AST
+    annotations: Dict[int, List[Annotation]]
+    #: ast node -> parent node (lexical), for with-block / scope walks
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    # -- annotation helpers --------------------------------------------------
+
+    def marks(self, line: int, kind: str) -> List[Annotation]:
+        return [a for a in self.annotations.get(line, []) if a.kind == kind]
+
+    def node_marks(self, node: ast.AST, kind: str) -> List[Annotation]:
+        """Markers on any line the node's header spans (its lineno, plus —
+        for defs — the decorator lines and the contiguous comment block
+        immediately above, where a standalone marker reads naturally)."""
+        lines = {getattr(node, "lineno", 0)}
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            for dec in node.decorator_list:
+                lines.add(dec.lineno)
+            first = min(lines - {0}) if lines - {0} else 0
+            ln = first - 1
+            while ln >= 1 and self.lines[ln - 1].lstrip().startswith("#"):
+                lines.add(ln)
+                ln -= 1
+        out: List[Annotation] = []
+        for ln in lines:
+            out.extend(self.annotations.get(ln, []) or [])
+        return [a for a in out if a.kind == kind]
+
+    def allowed(self, node: ast.AST, rule: str) -> bool:
+        """``# ktpu: allow(KTPUxxx)`` on the node's line (or the line
+        above, for statements too long to carry a trailing comment)."""
+        ln = getattr(node, "lineno", 0)
+        for probe in (ln, ln - 1):
+            for a in self.marks(probe, "allow"):
+                if rule in a.args or not a.args:
+                    return True
+        return False
+
+    # -- scope helpers -------------------------------------------------------
+
+    def qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts))
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_functions(self, node: ast.AST):
+        """All enclosing function defs, innermost first."""
+        out = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur)
+            cur = self.parents.get(cur)
+        return out
+
+    def enclosing_class(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def with_locks_around(self, node: ast.AST) -> Set[str]:
+        """Normalized source of every ``with X:`` context expression
+        lexically enclosing the node."""
+        out: Set[str] = set()
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    out.add(normalize_expr(ast.unparse(item.context_expr)))
+            cur = self.parents.get(cur)
+        return out
+
+
+def normalize_expr(s: str) -> str:
+    return re.sub(r"\s+", "", s)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class AnalysisConfig:
+    """Per-rule policy knobs. `repo_config()` (checkers.py) builds the
+    tree's canonical instance; tests build narrow ones for fixtures."""
+
+    # KTPU001: modules (relpath prefixes) where jit construction is the
+    # module's JOB (kernel factories, the compile plan, the shard_map shim)
+    jit_allowed_prefixes: Tuple[str, ...] = ()
+    # KTPU002b/KTPU004: modules holding mirror-resident / sharded banks
+    surface_prefixes: Tuple[str, ...] = ()
+    # KTPU002b: designated sync points — "Class.method" or "function"
+    sync_allowlist: Tuple[str, ...] = ()
+    # KTPU002b/KTPU004: name components that mark device-resident values
+    # (this repo's convention: device twins always carry `dev` — _dev,
+    # _dev_nodes, na_dev, score_dev, ... — or say device/resident outright;
+    # host-side banks are named nodes/eps/pats/batch/bank and never match)
+    device_name_re: str = r"(^|_)dev(_|$)|device|resident"
+
+    def is_jit_allowed_module(self, relpath: str) -> bool:
+        return any(relpath.startswith(p) for p in self.jit_allowed_prefixes)
+
+    def is_surface_module(self, relpath: str) -> bool:
+        return any(relpath.startswith(p) for p in self.surface_prefixes)
+
+    def device_like(self, dotted: str) -> bool:
+        pat = re.compile(self.device_name_re)
+        return any(pat.search(part) for part in dotted.split("."))
+
+
+# ---------------------------------------------------------------------------
+# walking + running
+# ---------------------------------------------------------------------------
+
+def iter_python_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in ("__pycache__", ".git")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def load_module(path: str, repo_root: str) -> ModuleInfo:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+    return ModuleInfo(
+        path=path,
+        relpath=rel,
+        source=source,
+        lines=source.splitlines(),
+        tree=ast.parse(source, filename=path),
+        annotations=parse_annotations(source.splitlines()),
+    )
+
+
+Checker = Callable[[ModuleInfo, AnalysisConfig], List[Violation]]
+
+
+def run_checkers(
+    mod: ModuleInfo,
+    config: AnalysisConfig,
+    checkers: Sequence[Checker],
+    rules: Optional[Set[str]] = None,
+) -> List[Violation]:
+    out: List[Violation] = []
+    for chk in checkers:
+        for v in chk(mod, config):
+            if rules and v.rule not in rules:
+                continue
+            out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def scan_paths(
+    paths: Sequence[str],
+    repo_root: str,
+    config: AnalysisConfig,
+    checkers: Sequence[Checker],
+    rules: Optional[Set[str]] = None,
+) -> List[Violation]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(iter_python_files(p))
+        else:
+            files.append(p)
+    out: List[Violation] = []
+    for f in files:
+        out.extend(run_checkers(load_module(f, repo_root), config, checkers, rules))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+class Baseline:
+    """Line-oriented fingerprint set. Grammar per line::
+
+        <fingerprint>  # <justification>
+
+    '#'-only and blank lines are comments. ``--check`` fails on any
+    violation whose fingerprint is absent (the set GREW); fingerprints
+    with no live violation are reported as stale (ratchet down) but do
+    not fail."""
+
+    def __init__(self, entries: Optional[Dict[str, str]] = None):
+        self.entries: Dict[str, str] = entries or {}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        entries: Dict[str, str] = {}
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as f:
+                for raw in f:
+                    line = raw.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    fp, _, justification = line.partition("#")
+                    fp = fp.strip()
+                    if fp:
+                        entries[fp] = justification.strip()
+        return cls(entries)
+
+    def save(self, path: str, violations: Sequence[Violation]) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(
+                "# ktpu-lint baseline — pre-existing violations, each with a\n"
+                "# justification. The tree scan fails only when a violation\n"
+                "# NOT listed here appears (the set grew). Regenerate with\n"
+                "#   python scripts/ktpu_lint.py --update-baseline\n"
+                "# which preserves justifications for surviving entries.\n"
+            )
+            for v in sorted({x.fingerprint() for x in violations}):
+                note = self.entries.get(v, "JUSTIFY ME")
+                f.write(f"{v}  # {note}\n")
+
+    def missing(self, violations: Sequence[Violation]) -> List[Violation]:
+        return [v for v in violations if v.fingerprint() not in self.entries]
+
+    def stale(self, violations: Sequence[Violation]) -> List[str]:
+        live = {v.fingerprint() for v in violations}
+        return sorted(fp for fp in self.entries if fp not in live)

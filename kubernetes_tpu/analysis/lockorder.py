@@ -1,0 +1,276 @@
+"""Runtime lock-order/race harness — the dynamic complement of KTPU003.
+
+The static guarded-by pass proves accesses sit under the RIGHT lock; it
+cannot see the ORDER two threads take two locks in. This harness can:
+with ``KTPU_LOCK_AUDIT=1`` every lock the package constructs through the
+``audited_*`` factories is wrapped, each acquisition while other locks
+are held records a directed edge (held → acquired) with the acquiring
+thread and call site, and ``assert_acyclic()`` fails the test run when
+the edge graph contains a cycle — the ABBA pattern that deadlocks the
+informer / uploader / commit-worker / warmup thread quartet.
+
+Zero overhead when the env var is unset: the factories return plain
+``threading`` primitives.
+
+The audited wrappers deliberately key edges by lock NAME (one name per
+lock ROLE — "queue", "stage", "cache", ...), not instance: the invariant
+worth enforcing is a global ordering between roles, exactly like
+kube-scheduler's documented cache→queue ordering. Reentrant acquisition
+of the SAME instance records nothing; two instances of one role nested
+inside each other DO record a self-edge — nesting peers of a role is an
+ordering hazard unless some global order exists.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+ENV_FLAG = "KTPU_LOCK_AUDIT"
+
+
+def audit_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0", "false", "False")
+
+
+class LockOrderViolation(AssertionError):
+    """Raised by assert_acyclic(): carries the offending cycle(s)."""
+
+    def __init__(self, cycles: List[List[str]], registry: "LockOrderRegistry"):
+        self.cycles = cycles
+        lines = ["lock-order cycle(s) detected (potential ABBA deadlock):"]
+        for cyc in cycles:
+            lines.append("  cycle: " + " -> ".join(cyc + [cyc[0]]))
+            for a, b in zip(cyc, cyc[1:] + [cyc[0]]):
+                w = registry.edges.get((a, b))
+                if w:
+                    lines.append(
+                        f"    {a} -> {b}: thread={w['thread']} at {w['site']}"
+                    )
+        super().__init__("\n".join(lines))
+
+
+class LockOrderRegistry:
+    """Process-global edge graph. Thread-safe via one internal lock (a
+    plain lock — the registry itself is outside the audited world)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._held = threading.local()  # per-thread [(name, inst_id), ...]
+        # (from_name, to_name) -> first witness {thread, site}
+        self.edges: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self.threads_seen: set = set()
+        self.acquisitions = 0
+
+    # -- held bookkeeping ----------------------------------------------------
+
+    def _stack(self) -> List[Tuple[str, int]]:
+        if not hasattr(self._held, "locks"):
+            self._held.locks = []
+        return self._held.locks
+
+    @staticmethod
+    def _site() -> str:
+        for frame in reversed(traceback.extract_stack(limit=16)):
+            if "lockorder" not in (frame.filename or ""):
+                return f"{os.path.basename(frame.filename)}:{frame.lineno} in {frame.name}"
+        return "?"
+
+    def note_acquired(self, name: str, inst_id: int) -> None:
+        held = self._stack()
+        tname = threading.current_thread().name
+        with self._mu:
+            self.acquisitions += 1
+            self.threads_seen.add(tname)
+            if any(i == inst_id for _, i in held):
+                pass  # reentrant: no new edge, no new held entry depth
+            else:
+                site = None
+                for hname, hinst in held:
+                    if hinst == inst_id:
+                        continue
+                    key = (hname, name)
+                    if key not in self.edges:
+                        site = site or self._site()
+                        self.edges[key] = {"thread": tname, "site": site}
+        held.append((name, inst_id))
+
+    def note_released(self, name: str, inst_id: int) -> None:
+        held = self._stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == (name, inst_id):
+                del held[i]
+                return
+
+    # -- analysis ------------------------------------------------------------
+
+    def find_cycles(self) -> List[List[str]]:
+        graph: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            graph.setdefault(a, []).append(b)
+        cycles: List[List[str]] = []
+        seen_cycles: set = set()
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(u: str) -> None:
+            color[u] = GRAY
+            stack.append(u)
+            for v in graph.get(u, ()):  # noqa: B023
+                if color.get(v, WHITE) == WHITE:
+                    dfs(v)
+                elif color.get(v) == GRAY:
+                    cyc = stack[stack.index(v):]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        cycles.append(list(cyc))
+            stack.pop()
+            color[u] = BLACK
+
+        for node in sorted(graph):
+            if color.get(node, WHITE) == WHITE:
+                dfs(node)
+        return cycles
+
+    def assert_acyclic(self) -> None:
+        cycles = self.find_cycles()
+        if cycles:
+            raise LockOrderViolation(cycles, self)
+
+    def report(self) -> Dict:
+        with self._mu:
+            return {
+                "edges": {
+                    f"{a} -> {b}": dict(w) for (a, b), w in sorted(self.edges.items())
+                },
+                "threads": sorted(self.threads_seen),
+                "acquisitions": self.acquisitions,
+                "cycles": self.find_cycles(),
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self.edges.clear()
+            self.threads_seen.clear()
+            self.acquisitions = 0
+
+
+REGISTRY = LockOrderRegistry()
+
+
+# ---------------------------------------------------------------------------
+# audited primitives
+# ---------------------------------------------------------------------------
+
+class _AuditedBase:
+    def __init__(self, name: str, inner):
+        self._name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            REGISTRY.note_acquired(self._name, id(self))
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        REGISTRY.note_released(self._name, id(self))
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class AuditedLock(_AuditedBase):
+    def __init__(self, name: str):
+        super().__init__(name, threading.Lock())
+
+
+class AuditedRLock(_AuditedBase):
+    def __init__(self, name: str):
+        super().__init__(name, threading.RLock())
+
+    def locked(self) -> bool:  # RLock has no .locked() before 3.12
+        raise NotImplementedError
+
+
+class AuditedCondition:
+    """threading.Condition twin over an audited lock. wait() drops the
+    lock from the held set for its duration — a blocked waiter holds
+    nothing and must not contribute ordering edges."""
+
+    def __init__(self, name: str, lock: Optional[_AuditedBase] = None):
+        # default inner is an RLock, matching threading.Condition() — the
+        # audited and unaudited worlds must have identical reentrancy
+        # semantics or enabling the audit changes what deadlocks
+        self._alock = lock or AuditedRLock(name)
+        # built directly over the audited lock's raw inner primitive so
+        # Condition's __init__-time method bindings (_is_owned,
+        # _release_save, ...) refer to the lock actually being held
+        self._cond = threading.Condition(self._alock._inner)
+        self._name = name
+
+    def acquire(self, *a, **kw):
+        ok = self._alock._inner.acquire(*a, **kw)
+        if ok:
+            REGISTRY.note_acquired(self._name, id(self))
+        return ok
+
+    def release(self):
+        self._alock._inner.release()
+        REGISTRY.note_released(self._name, id(self))
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None):
+        REGISTRY.note_released(self._name, id(self))
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            REGISTRY.note_acquired(self._name, id(self))
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        REGISTRY.note_released(self._name, id(self))
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            REGISTRY.note_acquired(self._name, id(self))
+
+    def notify(self, n: int = 1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# construction-site factories (the package's lock sites call these)
+# ---------------------------------------------------------------------------
+
+def audited_lock(name: str) -> threading.Lock:
+    """A Lock, audited iff KTPU_LOCK_AUDIT is set at construction time."""
+    return AuditedLock(name) if audit_enabled() else threading.Lock()
+
+
+def audited_rlock(name: str) -> threading.RLock:
+    return AuditedRLock(name) if audit_enabled() else threading.RLock()
+
+
+def audited_condition(name: str) -> threading.Condition:
+    return AuditedCondition(name) if audit_enabled() else threading.Condition()

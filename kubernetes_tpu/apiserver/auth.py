@@ -31,16 +31,15 @@ subresource authorizes as resource "pods/binding", verb "create"
 
 from __future__ import annotations
 
-import threading
-from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Dict, Iterable, Optional, Tuple
 
+from ..analysis.lockorder import audited_lock
 from ..api.types import (
     ClusterRole,
     ClusterRoleBinding,
     PolicyRule,
     Role,
-    RoleBinding,
     RoleRef,
     Subject,
 )
@@ -78,7 +77,7 @@ class TokenAuthenticator:
 
     def __init__(self, tokens: Optional[Dict[str, UserInfo]] = None):
         self._tokens: Dict[str, UserInfo] = dict(tokens or {})
-        self._lock = threading.Lock()
+        self._lock = audited_lock("apiserver-auth")
 
     def add(self, token: str, user: UserInfo) -> None:
         with self._lock:

@@ -32,8 +32,9 @@ import base64
 import json
 import os
 import pickle
-import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
+
+from ..analysis.lockorder import audited_lock
 
 
 def _codecs():
@@ -64,7 +65,7 @@ class WAL:
         self.snap_path = path + ".snap"
         self.compact_every = compact_every
         self.fsync = fsync
-        self._lock = threading.Lock()
+        self._lock = audited_lock("apiserver-persist")
         self._f = None
         self._entries_since_snap = 0
 

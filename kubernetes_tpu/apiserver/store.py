@@ -25,10 +25,11 @@ from __future__ import annotations
 import copy
 import itertools
 import queue
-import threading
 from collections import deque
 from dataclasses import dataclass
-from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from ..analysis.lockorder import audited_lock
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -231,7 +232,7 @@ def _matches(obj: Any, label_selector,
 class FakeAPIServer:
     def __init__(self, history_window: int = HISTORY_WINDOW, admission=None,
                  wal=None):
-        self._lock = threading.Lock()
+        self._lock = audited_lock("apiserver-store")
         self._objects: Dict[str, Dict[str, Any]] = {}
         self._history: Dict[str, Deque[WatchEvent]] = {}
         self._watchers: Dict[str, List[Watcher]] = {}

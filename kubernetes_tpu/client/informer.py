@@ -28,6 +28,7 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from ..analysis.lockorder import audited_lock
 from ..apiserver.store import ADDED, DELETED, MODIFIED, FakeAPIServer, GoneError, _key_of
 
 logger = logging.getLogger("kubernetes_tpu.informer")
@@ -47,7 +48,7 @@ class Informer:
         self.label_selector = label_selector
         self.field_selector = field_selector
         self._store: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = audited_lock("informer-store")
         self._handlers: List[Dict[str, Callable]] = []
         self._stop = threading.Event()
         self._synced = threading.Event()

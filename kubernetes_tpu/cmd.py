@@ -144,7 +144,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _configurator(args):
-    from .config import Configurator, load_component_config, parse_policy
+    from .config import Configurator, load_component_config
     from .utils.featuregate import FeatureGate
 
     fg = FeatureGate()

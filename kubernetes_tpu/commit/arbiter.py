@@ -45,7 +45,6 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..state.terms import SPREAD_HARD
 
@@ -152,6 +151,9 @@ def _spread_tables(na, pa, ea, ta, bucket_n, haskey_n, V: int):
     }
 
 
+# ktpu: admitted(KIND_ARBITER) dispatched by the driver only after
+# _arbiter_spec admission; both carry variants warmed in lockstep with the
+# solve ladder (compile/warmup)
 @partial(jax.jit, static_argnames=("term_kinds", "n_buckets"))
 def arbitrate(
     na: Arrays,   # NodeBank arrays (same dict the solve consumed)
@@ -476,6 +478,9 @@ def _arbiter_body_sharded(
     return verdicts
 
 
+# ktpu: admitted(KIND_ARBITER) memoized per mesh; the driver admits every
+# dispatch as a SolveSpec(kind=KIND_ARBITER, shards=...) and warmup realizes
+# the same memoized instance, so programs built here are never unplanned
 def make_sharded_arbiter(mesh):
     """Build the mesh-bound verdict pass: full signature parity with
     `arbitrate` so the driver can route covered sharded batches through it

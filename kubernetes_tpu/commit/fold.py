@@ -63,6 +63,7 @@ class FoldProgram:
         )
 
 
+# ktpu: hot-path fold planning runs between solve fetch and commit submit
 def plan_fold(
     mirror,
     pairs: Sequence[Tuple[object, int]],

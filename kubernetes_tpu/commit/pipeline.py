@@ -24,10 +24,11 @@ path instead of dying silently on the worker.
 
 from __future__ import annotations
 
-import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional
+
+from ..analysis.lockorder import audited_lock
 
 
 class CommitPipeline:
@@ -35,9 +36,11 @@ class CommitPipeline:
         self._pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="commit-apply"
         )
-        self._inflight: Optional[Future] = None
-        self._lock = threading.Lock()
-        self.stats: Dict[str, float] = {
+        self._lock = audited_lock("commit-pipeline")
+        self._inflight: Optional[Future] = None  # ktpu: guarded-by(self._lock)
+        # mutated by BOTH the worker (_run's apply_s) and the caller
+        # (submit/drain) — KTPU003 found the worker-side writes unlocked
+        self.stats: Dict[str, float] = {  # ktpu: guarded-by(self._lock)
             "submitted": 0,
             "drain_wait_s": 0.0,  # host time actually BLOCKED on an apply
             "apply_s": 0.0,  # worker wall inside submitted closures
@@ -56,7 +59,8 @@ class CommitPipeline:
         try:
             fn()
         finally:
-            self.stats["apply_s"] += time.perf_counter() - t0
+            with self._lock:
+                self.stats["apply_s"] += time.perf_counter() - t0
 
     def drain(self) -> None:
         """Wait for the in-flight apply (no-op when idle). Re-raises the
@@ -69,7 +73,8 @@ class CommitPipeline:
         try:
             f.result()
         finally:
-            self.stats["drain_wait_s"] += time.perf_counter() - t0
+            with self._lock:
+                self.stats["drain_wait_s"] += time.perf_counter() - t0
 
     def close(self) -> None:
         try:

@@ -24,9 +24,9 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..analysis.lockorder import audited_lock
 from .ladder import SolveSpec
 
 logger = logging.getLogger("kubernetes_tpu.compile")
@@ -84,7 +84,7 @@ class PersistentCompileCache:
     def __init__(self, path: str, serializer=None):
         self.path = path
         self.serializer = serializer
-        self._lock = threading.Lock()
+        self._lock = audited_lock("compile-persist")
         self.enabled_xla_cache = False
 
     # -- construction --------------------------------------------------------

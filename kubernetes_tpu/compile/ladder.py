@@ -12,8 +12,8 @@ never disagree about what shapes exist.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
-from typing import Dict, Iterable, List, Optional, Tuple
+from dataclasses import dataclass, replace
+from typing import Dict, List, Tuple
 
 
 def pow2_bucket(n: int, minimum: int = 16) -> int:

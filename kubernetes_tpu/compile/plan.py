@@ -14,10 +14,10 @@ stall the warmup should have paid.
 from __future__ import annotations
 
 import logging
-import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
+from ..analysis.lockorder import audited_lock
 from .cache import PersistentCompileCache
 from .ladder import ShapeLadder, SolveSpec
 
@@ -39,7 +39,7 @@ class CompilePlan:
     ):
         self.ladder = ladder or ShapeLadder()
         self.cache = cache
-        self._lock = threading.Lock()
+        self._lock = audited_lock("compile-plan")
         # spec key -> {"spec", "compile_s", "source", "count"}
         self._records: Dict[Tuple, Dict] = {}
         self.warmed = False

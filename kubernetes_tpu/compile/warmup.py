@@ -27,13 +27,13 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..analysis.lockorder import audited_lock
 from .ladder import (
     KIND_ARBITER,
     KIND_FILTER,
     KIND_FOLD,
     KIND_PATCH,
     KIND_PREEMPT,
-    KIND_SOLVE,
     KIND_SOLVE_GANG,
     KIND_STAGE,
     SolveSpec,
@@ -49,7 +49,7 @@ class WarmupService:
     def __init__(self, scheduler, plan: Optional[CompilePlan] = None):
         self.sched = scheduler
         self.plan = plan if plan is not None else scheduler.compile_plan
-        self._lock = threading.Lock()
+        self._lock = audited_lock("warmup")
         self._done: set = set()
         self._pending: List[Tuple[SolveSpec, Optional[Tuple]]] = []
         self._worker: Optional[threading.Thread] = None

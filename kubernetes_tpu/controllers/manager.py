@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from ..client.informer import Informer
 from .cronjob import CronJobController

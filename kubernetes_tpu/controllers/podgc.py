@@ -16,7 +16,6 @@ gcCheckPeriod=20s; the period is the manager's knob here):
 from __future__ import annotations
 
 import logging
-from typing import Optional
 
 logger = logging.getLogger("kubernetes_tpu.controllers.podgc")
 

@@ -7,7 +7,6 @@ from __future__ import annotations
 
 import itertools
 import time
-from typing import List
 
 from ..api.types import Pod, _new_uid
 

@@ -14,20 +14,20 @@ this in-process store does not need.
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Hashable, Optional
+
+from ..analysis.lockorder import audited_condition
 
 
 class WorkQueue:
     def __init__(self):
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
-        self._queue: deque = deque()
-        self._queued: set = set()
-        self._processing: set = set()
-        self._dirty: set = set()
-        self._shutdown = False
+        self._cond = audited_condition("workqueue")
+        self._queue: deque = deque()  # ktpu: guarded-by(self._cond)
+        self._queued: set = set()  # ktpu: guarded-by(self._cond)
+        self._processing: set = set()  # ktpu: guarded-by(self._cond)
+        self._dirty: set = set()  # ktpu: guarded-by(self._cond)
+        self._shutdown = False  # ktpu: guarded-by(self._cond)
 
     def add(self, item: Hashable) -> None:
         with self._cond:
@@ -70,5 +70,5 @@ class WorkQueue:
             self._cond.notify_all()
 
     def __len__(self) -> int:
-        with self._lock:
+        with self._cond:
             return len(self._queue)

@@ -33,6 +33,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis.lockorder import audited_lock
 from ..api.types import Node, Pod
 from ..oracle import Snapshot
 from ..oracle.predicates import compute_predicate_metadata, pod_fits_on_node
@@ -75,7 +76,7 @@ class ExtenderServer:
         self.priority_weights = tuple(priority_weights) if priority_weights else None
         self.rtcr = rtcr
         self._mirror: Optional[TensorMirror] = None
-        self._mirror_lock = threading.Lock()
+        self._mirror_lock = audited_lock("extender-mirror")
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
 

@@ -19,6 +19,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..analysis.lockorder import audited_rlock
 from ..api.types import Pod
 
 MAX_NODE_SCORE = 10
@@ -64,7 +65,7 @@ class CycleState:
     shared across a pod's plugin invocations."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = audited_rlock("cycle-state")
         self._data: Dict[str, Any] = {}
 
     def read(self, key: str) -> Any:

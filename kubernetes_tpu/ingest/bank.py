@@ -46,6 +46,8 @@ def _stage_rung(n: int) -> int:
     return STAGE_RUNGS[-1]
 
 
+# ktpu: admitted(KIND_STAGE) every dispatch goes through _scatter_rows,
+# which admits/declares the (rung, structure) pair as a KIND_STAGE spec
 def _scatter_fn():
     """Row scatter over the whole staged-bank dict (compiled once per
     (row-rung, structure) pair). NOT donated: in-flight solve dispatches
@@ -85,14 +87,15 @@ class StageBank:
         self._place = place_fn
         self._ship = ship_fn or (lambda kind, n: None)
         self.compile_plan = None  # attached by the driver
-        self._dev: Optional[Dict] = None
-        self._empty_dev: Optional[Dict] = None
-        self._dev_generation = -1
+        self._dev: Optional[Dict] = None  # ktpu: guarded-by(self._lock)
+        self._empty_dev: Optional[Dict] = None  # ktpu: guarded-by(self._lock)
+        self._dev_generation = -1  # ktpu: guarded-by(self._lock)
         # slab generation the scatter rungs were last warmed at: a slab
         # rebuild (capacity growth) changes every scatter program's row-
         # capacity axis, so the uploader re-warms before the first
         # post-growth flush needs them
-        self._warmed_generation = -1
+        self._warmed_generation = -1  # ktpu: guarded-by(self._lock)
+        # ktpu: guarded-by(self._lock)
         self.stats: Dict[str, int] = {
             "full_uploads": 0,
             "flush_rows": 0,  # rows shipped by the background worker

@@ -20,6 +20,10 @@ import jax
 import jax.numpy as jnp
 
 
+# ktpu: admitted(KIND_STAGE) every dispatch site (driver._stage_prologue,
+# WarmupService._warm_stage) admits the (u, slab-structure) pair through
+# compile_plan.admit as a KIND_STAGE spec before calling — the program is
+# planned even though the jit wrapper lives here
 @jax.jit
 def gather_stage(bank, idx, keep, empty, fallback):
     """bank: staged slab dict ([S, ...]); idx: [U] int32 slab rows;

@@ -31,11 +31,11 @@ acquires rows under its own lock; the stage never calls into the queue).
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.lockorder import audited_rlock
 from ..state.tensors import KeySlotOverflow, PodBatch, spec_key
 
 #: slab capacity floor and hard ceiling (pow-2 rungs in between). The slab
@@ -51,7 +51,7 @@ class PodStage:
 
     def __init__(self, vocab, capacity: int = MIN_CAPACITY):
         self.vocab = vocab
-        self._lock = threading.RLock()
+        self._lock = audited_rlock("stage")
         self._next_gen = 1
         # bank wake-up hook (StageBank sets it): called after a fresh row
         # is staged so the background uploader can batch it out
@@ -72,17 +72,19 @@ class PodStage:
 
     # -- slab lifecycle ------------------------------------------------------
 
+    # ktpu: holds(self._lock) callers: __init__ (pre-concurrency) and the
+    # locked acquire/ensure_current/_rebuild paths
     def _build(self, capacity: int) -> None:
         self.capacity = capacity
-        self.batch = PodBatch(self.vocab, capacity)
+        self.batch = PodBatch(self.vocab, capacity)  # ktpu: guarded-by(self._lock)
         self.key_capacity = self.batch.key_capacity
         self.resource_capacity = self.batch.req.shape[1]
-        self.row_of: Dict[tuple, int] = {}
-        self._key_of_row: Dict[int, tuple] = {}
-        self.refs = np.zeros(capacity, np.int64)
-        self.row_gen = np.zeros(capacity, np.int64)  # 0 never issued
-        self._free: List[int] = list(range(capacity - 1, -1, -1))
-        self.dirty_rows: set = set()
+        self.row_of: Dict[tuple, int] = {}  # ktpu: guarded-by(self._lock)
+        self._key_of_row: Dict[int, tuple] = {}  # ktpu: guarded-by(self._lock)
+        self.refs = np.zeros(capacity, np.int64)  # ktpu: guarded-by(self._lock)
+        self.row_gen = np.zeros(capacity, np.int64)  # ktpu: guarded-by(self._lock) gen 0 = never issued
+        self._free: List[int] = list(range(capacity - 1, -1, -1))  # ktpu: guarded-by(self._lock)
+        self.dirty_rows: set = set()  # ktpu: guarded-by(self._lock)
         self.generation += 1
         # the legacy PodBatch's zero-state per array, for gather padding:
         # padding rows of the index dispatch must reproduce EXACTLY what
@@ -90,6 +92,7 @@ class PodStage:
         # zeros elsewhere) or the device programs stop being bit-identical
         self.empty_rows = PodBatch(self.vocab, 1).arrays()
 
+    # ktpu: holds(self._lock) called from acquire/ensure_current only
     def _rebuild(self, capacity: Optional[int] = None) -> None:
         self.stats["rebuilds"] += 1
         self._build(capacity or self.capacity)

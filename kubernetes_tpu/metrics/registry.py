@@ -10,9 +10,10 @@ Thread-safe; lock granularity is per-metric.
 from __future__ import annotations
 
 import random as _random
-import threading
 from bisect import bisect_left
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..analysis.lockorder import audited_lock
 
 _DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
@@ -33,7 +34,7 @@ class _Metric:
         self.name = name
         self.help = help_
         self.label_names = tuple(label_names)
-        self._lock = threading.Lock()
+        self._lock = audited_lock("metric")
 
     def expose(self) -> List[str]:
         raise NotImplementedError
@@ -230,7 +231,7 @@ class Registry:
     """legacyregistry equivalent: register + text exposition."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = audited_lock("metrics-registry")
         self._metrics: Dict[str, _Metric] = {}
 
     def register(self, metric: _Metric) -> _Metric:

@@ -28,7 +28,6 @@ import jax.numpy as jnp
 from ..state.tensors import (
     EFFECT_NO_EXECUTE,
     EFFECT_NO_SCHEDULE,
-    EFFECT_PAD,
     OP_DOES_NOT_EXIST,
     OP_EXISTS,
     OP_GT,
@@ -38,7 +37,6 @@ from ..state.tensors import (
     OP_NAME_NOT_IN,
     OP_NEVER,
     OP_NOT_IN,
-    OP_PAD,
     TOL_EXISTS,
 )
 

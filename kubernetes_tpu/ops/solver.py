@@ -29,7 +29,7 @@ id; a second scan pass drops groups that did not fully fit.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -20,8 +20,7 @@ tests/test_topology_parity.py.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Dict, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -35,14 +34,7 @@ from ..state.terms import (
     SPREAD_HARD,
     SPREAD_SOFT,
 )
-from ..state.tensors import (
-    OP_DOES_NOT_EXIST,
-    OP_EXISTS,
-    OP_IN,
-    OP_NEVER,
-    OP_NOT_IN,
-    OP_PAD,
-)
+from ..state.tensors import OP_DOES_NOT_EXIST, OP_EXISTS, OP_IN, OP_NEVER, OP_NOT_IN
 
 Arrays = Dict[str, jnp.ndarray]
 

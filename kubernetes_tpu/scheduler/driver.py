@@ -22,7 +22,6 @@ kept: binds run on a thread pool while the next batch solves on device.
 
 from __future__ import annotations
 
-import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -49,7 +48,7 @@ from ..compile.ladder import (
     KIND_SOLVE_GANG,
 )
 from ..compile.plan import SOURCE_INLINE, SOURCE_PERSISTED
-from ..framework.interface import CycleState, Framework, Status
+from ..framework.interface import CycleState, Framework
 from ..api.selectors import match_label_selector
 from ..oracle.predicates import (
     compute_predicate_metadata,
@@ -60,7 +59,6 @@ from ..oracle.predicates import (
     pod_matches_all_term_properties,
     pod_matches_term,
 )
-from ..oracle.priorities import _pod_resource_limits, _pod_scoring_request
 from ..state.cache import SchedulerCache, TensorMirror
 from ..state.queue import PodInfo, PriorityQueue
 from ..state.tensors import KeySlotOverflow, PodBatch, _bucket, spec_key
@@ -117,7 +115,6 @@ class ScoreRows:
         plugins / prioritize extenders) must bulk-fetch instead. The index
         count is padded to a power-of-two bucket (repeating the first index)
         so the jitted gather compiles once per bucket, not per batch."""
-        from ..state.tensors import _bucket
         from ..ops.pipeline import gather_score_rows
 
         import jax.numpy as jnp
@@ -383,6 +380,8 @@ _spec_key = spec_key
 _NOM_FOLD = None
 
 
+# ktpu: admitted(KIND_FOLD) dispatched only through mirror.fold_nominees,
+# which admits a KIND_FOLD nominee spec; warmed at pow-2 rungs at startup
 def _nominee_fold_fn():
     """Jitted overlay of out-of-batch nominees' requests onto the node
     bank's usage columns — podFitsOnNode's pass-1 nominee accounting
@@ -771,6 +770,7 @@ class Scheduler:
             shards=self._shards_now(), config_repr="fold",
         )
 
+    # ktpu: hot-path
     def _dispatch_fold(self, pairs: List[Tuple[Pod, int]]) -> bool:
         """Fold a committed batch's state deltas into the resident device
         banks (the resident-state plane's hot path). `pairs` is the FINAL
@@ -812,7 +812,7 @@ class Scheduler:
         the can_disrupt-filtered pool the runtime sees; it becomes the
         monotone `_pv_bucket` floor passed to batch_preempt_device so the
         executed v_cap equals the warmed one."""
-        from ..state.tensors import _bucket, _node_bucket
+        from ..state.tensors import _node_bucket
 
         snap = self.cache.snapshot
         v_max = max((len(ni.pods) for ni in snap.node_infos.values()), default=1)
@@ -883,6 +883,7 @@ class Scheduler:
             ))
         return out
 
+    # ktpu: hot-path index-only dispatch prologue: no device→host syncs
     def _stage_prologue(self, reps, rep_infos):
         """Resolve every rep's staged row and gather the batch's pod
         arrays from the device-resident staged bank (the index-only
@@ -977,9 +978,12 @@ class Scheduler:
 
     # -- device solve --------------------------------------------------------
 
+    # ktpu: hot-path
     def _device_solve(self, infos: List[PodInfo]) -> SolveOutput:
         return self._finish_solve(self._dispatch_solve(infos))
 
+    # ktpu: hot-path the covered dispatch: results are fetched ONLY by
+    # _finish_solve (the designated sync point)
     def _dispatch_solve(
         self, infos: List[PodInfo], carry=None, allow_rebuild: bool = True
     ) -> Dict:
@@ -2060,8 +2064,6 @@ class Scheduler:
             and not any(e.supports_preemption() for e in self.extenders)
         ):
             try:
-                from ..state.tensors import _bucket
-
                 self._p_bucket = max(self._p_bucket, _bucket(len(fails), 8))
                 plans = preemption_mod.batch_preempt_device(
                     [i.pod for i in fails],

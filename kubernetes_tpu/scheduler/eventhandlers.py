@@ -14,7 +14,7 @@ from typing import Optional
 
 from ..api.types import Node, Pod
 from ..state.cache import SchedulerCache
-from ..state.queue import PodInfo, PriorityQueue
+from ..state.queue import PriorityQueue
 
 
 def _assigned(pod: Pod) -> bool:

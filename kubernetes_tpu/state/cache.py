@@ -19,17 +19,16 @@ cycle the way UpdateNodeInfoSnapshot walks its generation-ordered dirty list
 
 from __future__ import annotations
 
-import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..analysis.lockorder import audited_rlock
 from ..api.types import Node, Pod
 from ..oracle.nodeinfo import NodeInfo, Snapshot, pod_has_affinity_constraints
 from .tensors import (
-    EncodingConfig,
     ImageTable,
     KeySlotOverflow,
     NodeBank,
@@ -85,6 +84,9 @@ def per_shard_bytes(shipped: Dict[str, int], shards: int) -> Dict[str, int]:
     }
 
 
+# ktpu: admitted(KIND_PATCH) dispatched only via TensorMirror._scatter_rows,
+# which admits each (rung, structure) pair as a KIND_PATCH spec; warmed by
+# TensorMirror.warm_patches at startup
 def _row_scatter_fn():
     """One jitted row-scatter over a whole bank dict: a single dispatch
     updates every array's dirty rows (compiled once per (row-bucket,
@@ -104,6 +106,8 @@ def _row_scatter_fn():
     return _ROW_SCATTER
 
 
+# ktpu: admitted(KIND_PATCH) same spec family as _row_scatter_fn (the
+# donated twin shares rungs/structure; donation is not part of the spec key)
 def _row_scatter_donated_fn():
     """The same row-scatter with the resident bank DONATED: updated arrays
     scatter in place and untouched arrays alias straight through — the
@@ -139,7 +143,7 @@ class SchedulerCache:
     """cache.go schedulerCache: node name → NodeInfo, pod key → state."""
 
     def __init__(self, ttl: float = DEFAULT_ASSUME_TTL, now: Callable[[], float] = time.monotonic):
-        self._lock = threading.RLock()
+        self._lock = audited_rlock("cache")
         self._ttl = ttl
         self._now = now
         self.snapshot = Snapshot()
@@ -469,8 +473,12 @@ class TensorMirror:
         # device_arrays() must NOT re-ship them. A row appearing in BOTH a
         # folded and a pending set ships anyway — the host scatter is a
         # full-value overwrite, so host always wins on overlap.
-        self._folded_usage_rows: Set[int] = set()
-        self._folded_pat_rows: Set[int] = set()
+        # the fold bookkeeping is DRIVER-THREAD-CONFINED: folds dispatch on
+        # the driver thread before the commit worker sees the batch, and
+        # sync() drains the pipeline first — declared confined so an
+        # access from an unmarked method trips KTPU003 immediately
+        self._folded_usage_rows: Set[int] = set()  # ktpu: confined(driver)
+        self._folded_pat_rows: Set[int] = set()  # ktpu: confined(driver)
         # device-fold generation tag: how many folds the resident banks
         # carry beyond `device_generation` (the host sync generation the
         # last full/row upload reflected). Purely observational — the row
@@ -482,12 +490,13 @@ class TensorMirror:
         # (integer adds are exactly invertible). Every resident-bank
         # consumer calls _restore_nominees() first, so a caller that died
         # between fold and unfold cannot leave the banks corrupted.
-        self._nominee_overlay = None
+        self._nominee_overlay = None  # ktpu: confined(driver)
         # fold lanes whose cache assume was REJECTED after dispatch (the
         # informer race): their node rows must re-ship from host. Appended
-        # by the commit worker (list.append is atomic); drained by sync(),
-        # which the driver only runs after the commit pipeline settles.
-        self._failed_fold_names: List[str] = []
+        # by the commit worker; drained by sync(). Cross-thread by design,
+        # so it takes the cache lock on BOTH sides (KTPU003 discipline —
+        # the old GIL-atomic-append argument was true but unverifiable).
+        self._failed_fold_names: List[str] = []  # ktpu: guarded-by(cache._lock)
         # host→device traffic ledger, by kind (full|rows|usage|fold) —
         # also exported as scheduler_mirror_bytes_shipped_total
         self.bytes_shipped: Dict[str, int] = {}
@@ -567,6 +576,7 @@ class TensorMirror:
             n_pats + max(8, n_pats // 8) if pats else 0,
         )
 
+    # ktpu: confined(driver) driver-thread only: constructor/reserve/sync
     def _rebuild(self) -> None:
         self.rebuild_count += 1
         snap = self.cache.snapshot
@@ -614,6 +624,9 @@ class TensorMirror:
         self._pending_pat_rows.clear()
         self._folded_usage_rows.clear()
         self._folded_pat_rows.clear()
+        # rebuild runs pre-concurrency (__init__/reserve at setup) or
+        # inside sync()'s cache-lock block:
+        # ktpu: allow(KTPU003) no concurrent writer can exist here
         self._failed_fold_names.clear()
         self._nominee_overlay = None  # donated buffers are gone with the banks
         self.eps.dirty_sig_rows.clear()
@@ -655,6 +668,9 @@ class TensorMirror:
         )
         self._pending_node_rows.add(node_row)
 
+    # ktpu: confined(driver) the mirror's one sync entry point — driver
+    # thread only (commit-worker writes arrive via note_failed_fold's
+    # locked list, drained here under the same lock)
     def sync(self) -> bool:
         """Apply dirty nodes (and ONLY their pods) plus single-pod deltas
         (O(1) each — no per-node re-count). Returns True if a full rebuild
@@ -841,6 +857,8 @@ class TensorMirror:
             )
         return jnp.asarray(v)
 
+    # ktpu: confined(driver) driver-thread dispatch prologue; the commit
+    # worker and uploader never call it (mirror confinement contract)
     def device_arrays(self):
         """(nodes, eps, pats) as DEVICE-resident dicts, patched with only
         the rows sync() touched since the last call — MINUS the rows a
@@ -1156,6 +1174,7 @@ class TensorMirror:
             self._sharded_folds = make_sharded_fold_fns(self._mesh)
         return self._sharded_folds
 
+    # ktpu: hot-path
     def fold_commit(self, prog) -> bool:
         """Apply a planned commit fold (commit/fold.FoldProgram) to the
         resident banks with buffer donation. Returns False when the banks
@@ -1201,10 +1220,14 @@ class TensorMirror:
         """A fold lane's cache assume was rejected AFTER the fold
         dispatched (informer race): the device row carries a delta the
         host never applied. Queue the row for a host-wins re-ship at the
-        next sync. Callers (the commit worker) run strictly before the
-        driver's next pipeline drain → sync, so the plain append is safe."""
-        self._failed_fold_names.append(node_name)
+        next sync. Called from the COMMIT WORKER — the one mirror entry
+        point off the driver thread — so it serializes on the cache lock
+        (reentrant: the worker already holds it inside assume paths)."""
+        cache = self.cache
+        with cache._lock:
+            self._failed_fold_names.append(node_name)
 
+    # ktpu: hot-path; confined(driver) dispatch path
     def fold_nominees(self, rows: np.ndarray, vecs: np.ndarray, cnt: np.ndarray):
         """Overlay out-of-batch nominees' requests onto the resident usage
         columns IN PLACE (donation) — the nominee accounting of
@@ -1226,6 +1249,7 @@ class TensorMirror:
         self._ship("fold", rows.nbytes + vecs.nbytes + cnt.nbytes)
         return self._dev_nodes
 
+    # ktpu: hot-path; confined(driver) dispatch path
     def unfold_nominees(self) -> None:
         """Fold the nominee overlay back out (exact integer inverse)."""
         overlay = self._nominee_overlay
@@ -1244,6 +1268,7 @@ class TensorMirror:
         self._dev_nodes = {**n, "requested": req_d, "pod_count": pc_d}
         self._ship("fold", rows.nbytes + vecs.nbytes + cnt.nbytes)
 
+    # ktpu: confined(driver) driver-thread dispatch path
     def _restore_nominees(self) -> None:
         if self._nominee_overlay is not None:
             self.unfold_nominees()

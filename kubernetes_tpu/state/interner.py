@@ -13,15 +13,16 @@ tensors patched incrementally across events never need re-encoding.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Iterable, List, Optional
+
+from ..analysis.lockorder import audited_lock
 
 ABSENT = 0
 
 
 class StringInterner:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = audited_lock("interner")
         self._to_id: Dict[str, int] = {}
         self._from_id: List[Optional[str]] = [None]  # index 0 = ABSENT
 

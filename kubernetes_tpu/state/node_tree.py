@@ -13,16 +13,16 @@ zone-interleaved order for those paths.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional
 
+from ..analysis.lockorder import audited_lock
 from ..api.types import Node
 from ..oracle.nodeinfo import get_zone_key
 
 
 class NodeTree:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = audited_lock("node-tree")
         self._tree: Dict[str, List[str]] = {}  # zone key -> node names
         self._zones: List[str] = []  # insertion-ordered zone keys
         self._zone_index = 0

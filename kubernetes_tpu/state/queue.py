@@ -21,11 +21,11 @@ from __future__ import annotations
 
 import heapq
 import itertools
-import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
+from ..analysis.lockorder import audited_condition
 from ..api.types import Pod
 
 INITIAL_BACKOFF = 1.0  # pod_backoff.go initialDuration
@@ -81,32 +81,34 @@ def _entry_key(e) -> str:
 
 class PriorityQueue:
     def __init__(self, now: Callable[[], float] = time.monotonic, less=None):
-        self._lock = threading.Condition()
+        # lock role "queue": first in the queue → stage ordering (the
+        # informer's admission path holds queue then acquires stage rows)
+        self._lock = audited_condition("queue")
         self._now = now
         self._seq = itertools.count()
         self._less = less  # QueueSort plugin comparator (PodInfo, PodInfo) -> bool
-        self._active: List[_ActiveEntry] = []
-        self._backoff: List[Tuple[float, int, str]] = []  # (expiry, seq, key)
-        self._unschedulable: Dict[str, PodInfo] = {}
-        self._infos: Dict[str, PodInfo] = {}
-        self._in_active: Set[str] = set()
-        self._attempts: Dict[str, int] = {}  # backoff attempt counts
-        self._last_failure: Dict[str, float] = {}
+        self._active: List[_ActiveEntry] = []  # ktpu: guarded-by(self._lock)
+        self._backoff: List[Tuple[float, int, str]] = []  # ktpu: guarded-by(self._lock)
+        self._unschedulable: Dict[str, PodInfo] = {}  # ktpu: guarded-by(self._lock)
+        self._infos: Dict[str, PodInfo] = {}  # ktpu: guarded-by(self._lock)
+        self._in_active: Set[str] = set()  # ktpu: guarded-by(self._lock)
+        self._attempts: Dict[str, int] = {}  # ktpu: guarded-by(self._lock)
+        self._last_failure: Dict[str, float] = {}  # ktpu: guarded-by(self._lock)
         self._last_move_request_cycle = -1
         self._scheduling_cycle = 0
-        self.nominated: Dict[str, str] = {}  # pod key → nominated node
-        self._nominated_by_node: Dict[str, Set[str]] = {}
+        self.nominated: Dict[str, str] = {}  # ktpu: guarded-by(self._lock)
+        self._nominated_by_node: Dict[str, Set[str]] = {}  # ktpu: guarded-by(self._lock)
         # bumped whenever a NOMINATION IS ADDED (never on clears): the
         # driver folds outstanding nominations into the device mask at
         # dispatch, and a speculated solve is consumable only if no
         # nomination appeared since (clears only make the mask
         # conservative — safe)
-        self.nomination_adds = 0
+        self.nomination_adds = 0  # ktpu: guarded-by(self._lock)
         self.closed = False
         # pod-ingest plane: when a PodStage is attached, admissions encode
         # the pod's tensor row HERE (the informer thread) instead of on
         # the driver thread per batch; entries carry the ready (row, gen)
-        self._stage = None
+        self._stage = None  # ktpu: guarded-by(self._lock)
 
     # -- pod-ingest staging (kubernetes_tpu/ingest) --------------------------
 
@@ -117,6 +119,7 @@ class PriorityQueue:
         with self._lock:
             self._stage = stage
 
+    # ktpu: holds(self._lock) called from locked admission/re-add paths
     def _stage_acquire(self, info: PodInfo) -> None:
         if self._stage is None:
             return
@@ -126,12 +129,14 @@ class PriorityQueue:
         else:
             info.staged_row, info.staged_gen = pair
 
+    # ktpu: holds(self._lock) called from locked delete/re-add paths
     def _stage_release(self, info: Optional[PodInfo]) -> None:
         if self._stage is None or info is None or info.staged_row < 0:
             return
         self._stage.release(info.staged_row, info.staged_gen)
         info.staged_row, info.staged_gen = -1, -1
 
+    # ktpu: holds(self._lock) called from locked update path
     def _stage_swap(self, info: PodInfo, new: Pod) -> None:
         """Update an entry's pod and re-stage it, acquiring the NEW row
         before releasing the old: a content-identical update (status-only
@@ -144,6 +149,7 @@ class PriorityQueue:
         if self._stage is not None and old_row >= 0:
             self._stage.release(old_row, old_gen)
 
+    # ktpu: holds(self._lock) called from locked re-add/census paths
     def _stage_acquire_if_stale(self, info: PodInfo) -> None:
         """Re-acquire on the RE-ADD paths when the entry's pair is missing
         OR no longer valid (its row was freed/rebuilt while the entry was
@@ -213,6 +219,7 @@ class PriorityQueue:
                 self._active = [_ActiveEntry(i, less) for i in entries]
             heapq.heapify(self._active)
 
+    # ktpu: holds(self._lock) every caller is a locked public method
     def _push_active(self, info: PodInfo) -> None:
         key = info.pod.key()
         self._infos[key] = info
@@ -227,6 +234,7 @@ class PriorityQueue:
         self._in_active.add(key)
         self._lock.notify()
 
+    # ktpu: holds(self._lock) every caller is a locked public method
     def _backoff_duration(self, key: str) -> float:
         attempts = self._attempts.get(key, 0)
         d = INITIAL_BACKOFF * (2 ** max(attempts - 1, 0))
@@ -256,7 +264,9 @@ class PriorityQueue:
         # admission bursts. The acquired ref keeps the row live until the
         # pair attaches below; a racing delete of the same key releases
         # the OLD entry's pair, never this one.
-        stage = self._stage
+        # _stage is attach-once before traffic; the acquired ref makes any
+        # race with a concurrent delete benign (doc above)
+        stage = self._stage  # ktpu: allow(KTPU003) attach-once reference read
         pair = stage.acquire(pod) if stage is not None else None
         with self._lock:
             info = PodInfo(pod=pod, timestamp=self._now(), seq=next(self._seq))
@@ -475,6 +485,7 @@ class PriorityQueue:
 
     # -- nominated pods (preemption nominees) --------------------------------
 
+    # ktpu: holds(self._lock) every caller is a locked public method
     def _update_nominated(self, pod: Pod) -> None:
         key = pod.key()
         self._remove_nominated(key)
@@ -484,6 +495,7 @@ class PriorityQueue:
             self._nominated_by_node.setdefault(node, set()).add(key)
             self.nomination_adds += 1
 
+    # ktpu: holds(self._lock) every caller is a locked public method
     def _remove_nominated(self, key: str) -> None:
         node = self.nominated.pop(key, None)
         if node:

@@ -35,12 +35,12 @@ int64 resource quantities, bool masks.
 
 from __future__ import annotations
 
-import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
+from ..analysis.lockorder import audited_lock
 from ..api.types import (
     Node,
     NodeSelectorRequirement,
@@ -131,7 +131,8 @@ class Vocab:
     def __init__(self, config: Optional[EncodingConfig] = None):
         self.config = config or EncodingConfig()
         self.strings = StringInterner()
-        self.key_slot: Dict[str, int] = {}
+        self.key_slot: Dict[str, int] = {}  # ktpu: guarded-by(self._slot_lock)
+        # ktpu: guarded-by(self._slot_lock)
         self.resource_slot: Dict[str, int] = {
             RESOURCE_CPU: self.config.CPU,
             RESOURCE_MEMORY: self.config.MEM,
@@ -140,16 +141,17 @@ class Vocab:
         # interned constants used by kernels
         self.wildcard_ip = self.strings.intern(DEFAULT_BIND_ALL_HOST_IP)
         self.proto_tcp = self.strings.intern("TCP")
-        self._dense: Dict[int, Dict[int, int]] = {}
-        self._zone_dense: Dict[int, int] = {}
+        self._dense: Dict[int, Dict[int, int]] = {}  # ktpu: guarded-by(self._slot_lock)
+        self._zone_dense: Dict[int, int] = {}  # ktpu: guarded-by(self._slot_lock)
         # slot/dense assignment is a read-modify-write (len → insert): with
         # the pod-ingest plane, encodes run on the INFORMER thread too
         # (stage.acquire → set_pod) concurrently with the driver thread's
         # batch/node encodes — unlocked, two new keys could be assigned
         # the SAME slot, silently corrupting label matching forever. The
-        # string interner has its own lock already; reads (peek/lookup)
-        # stay lock-free (single dict .get, GIL-atomic).
-        self._slot_lock = threading.Lock()
+        # string interner has its own lock already. Readers take the lock
+        # too (uncontended acquire is ~100ns; KTPU003 keeps the discipline
+        # uniform instead of case-by-case GIL-atomicity arguments).
+        self._slot_lock = audited_lock("vocab-slots")
 
     def zone_dense_of(self, zone_id: int) -> int:
         with self._slot_lock:
@@ -173,7 +175,8 @@ class Vocab:
 
     def peek_slot(self, key: str) -> int:
         """-1 when the key has never been seen (matches nothing)."""
-        return self.key_slot.get(key, -1)
+        with self._slot_lock:
+            return self.key_slot.get(key, -1)
 
     def slot_of_resource(self, name: str) -> int:
         with self._slot_lock:
@@ -206,10 +209,12 @@ class Vocab:
         dense indices). The topology kernels' segment axis only needs this
         many buckets FOR TERMS ON THIS SLOT — zone-keyed terms need ~#zones
         buckets, not one per node row (ops/pipeline n_buckets)."""
-        return len(self._dense.get(slot, ()))
+        with self._slot_lock:
+            return len(self._dense.get(slot, ()))
 
     def zone_count(self) -> int:
-        return len(self._zone_dense)
+        with self._slot_lock:
+            return len(self._zone_dense)
 
 
 def _parse_int_label(v: str) -> Tuple[int, bool]:
@@ -979,13 +984,20 @@ class SigBank:
         self.label_vals = np.zeros((s, c.key_slots), np.int32)
         self.deleting = np.zeros(s, bool)
         self.counts = np.zeros((self.node_capacity, s), np.int16)
-        self._sig_of: Dict[bytes, int] = {}
-        self._key_of_row: Dict[int, bytes] = {}
-        self._encode_cache: Dict[tuple, Tuple[bytes, np.ndarray, int, bool]] = {}
-        self._refs = np.zeros(s, np.int64)
-        self._free = list(range(s - 1, -1, -1))
-        self.dirty_sig_rows: Set[int] = set()
+        # slab bookkeeping is DRIVER-THREAD-CONFINED by the mirror's
+        # contract (sync/fold planning/commit bulk-apply all run on the
+        # driver thread; the commit worker never interns) — declared
+        # confined so any access from a method not carrying the
+        # confined(driver) mark shows up as a KTPU003 violation instead
+        # of a silent refcount race
+        self._sig_of: Dict[bytes, int] = {}  # ktpu: confined(driver)
+        self._key_of_row: Dict[int, bytes] = {}  # ktpu: confined(driver)
+        self._encode_cache: Dict[tuple, Tuple[bytes, np.ndarray, int, bool]] = {}  # ktpu: confined(driver)
+        self._refs = np.zeros(s, np.int64)  # ktpu: confined(driver)
+        self._free = list(range(s - 1, -1, -1))  # ktpu: confined(driver)
+        self.dirty_sig_rows: Set[int] = set()  # ktpu: confined(driver)
 
+    # ktpu: confined(driver) driver-thread slab path (mirror contract)
     def _encode_key(self, pod: Pod) -> Tuple[bytes, np.ndarray, int, bool]:
         # memoized by label CONTENT: replicas share label sets, so a
         # 4096-pod batch needs ~#specs encodes instead of one numpy row
@@ -1030,6 +1042,7 @@ class SigBank:
         pod.__dict__["_sig_enc_memo"] = (self.vocab, self.key_capacity, out)
         return out
 
+    # ktpu: confined(driver) driver-thread slab path (mirror contract)
     def _intern(self, pod: Pod) -> int:
         key, row, ns, deleting = self._encode_key(pod)
         sig = self._sig_of.get(key)
@@ -1046,6 +1059,7 @@ class SigBank:
             self.dirty_sig_rows.add(sig)
         return sig
 
+    # ktpu: confined(driver) commit-fold planning runs on the driver thread
     def prepare_row(self, pod: Pod) -> int:
         """Intern a pod's signature WITHOUT taking a reference — the
         device-fold planner (commit/fold.py) needs the row index at commit
@@ -1060,6 +1074,7 @@ class SigBank:
         the fold and falls back to the host scatter path)."""
         return self._intern(pod)
 
+    # ktpu: confined(driver) driver-thread slab path (mirror contract)
     def _unref(self, sig: int, n: int) -> None:
         self._refs[sig] -= n
         if self._refs[sig] <= 0:
@@ -1071,12 +1086,14 @@ class SigBank:
             self._free.append(sig)
             self.dirty_sig_rows.add(sig)
 
+    # ktpu: confined(driver) called from mirror sync/_release_node_pods
     def release_node(self, node_row: int, held: Dict[int, int]) -> None:
         """Undo a node's contribution: `held` is its {sig: count} map."""
         for sig, n in held.items():
             self.counts[node_row, sig] -= n
             self._unref(sig, n)
 
+    # ktpu: confined(driver) mirror sync's delta walk
     def apply_delta(self, node_row: int, pod, sign: int, held: Dict[int, int]) -> None:
         """O(1) single-pod count change (the mirror's pod-delta path).
         `held` is the node's {sig: count} bookkeeping map. Raises
@@ -1099,6 +1116,7 @@ class SigBank:
         self.counts[node_row, sig] -= 1
         self._unref(sig, 1)
 
+    # ktpu: confined(driver) mirror sync's bulk flush
     def apply_adds_bulk(self, rows: np.ndarray, pods: Sequence, held_maps: Sequence[Dict[int, int]]) -> None:
         """apply_delta(sign=+1) over a whole commit batch: interning stays
         per pod (memoized — ~#specs real encodes), but the count and ref
@@ -1114,6 +1132,7 @@ class SigBank:
         np.add.at(self._refs, sigs, 1)
         np.add.at(self.counts, (rows, sigs), 1)
 
+    # ktpu: confined(driver) mirror sync/rebuild re-count
     def encode_node(self, node_row: int, pods) -> Dict[int, int]:
         """Count a node's pods into signatures → the {sig: count} map the
         caller must keep for the matching release_node. Raises

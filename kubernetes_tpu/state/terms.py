@@ -25,12 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..api.types import (
-    LabelSelector,
-    Pod,
-    PodAffinityTerm,
-    TopologySpreadConstraint,
-)
+from ..api.types import LabelSelector, Pod, PodAffinityTerm
 from ..api.selectors import match_label_selector
 from ..oracle.nodeinfo import Snapshot
 from ..oracle.predicates import (
@@ -47,7 +42,6 @@ from .tensors import (
     OP_IN,
     OP_NEVER,
     OP_NOT_IN,
-    OP_PAD,
     Vocab,
     _bucket,
 )

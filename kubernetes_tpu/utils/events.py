@@ -9,11 +9,12 @@ counts like the events API's series aggregation, and fans out to sinks
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional
+
+from ..analysis.lockorder import audited_lock
 
 EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
@@ -39,7 +40,7 @@ class Event:
 
 class Recorder:
     def __init__(self, capacity: int = 4096, sink: Optional[Callable[[Event], None]] = None):
-        self._lock = threading.Lock()
+        self._lock = audited_lock("event-recorder")
         self._capacity = capacity
         self._events: Deque[Event] = deque()
         self._series: Dict[tuple, Event] = {}

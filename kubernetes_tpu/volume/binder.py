@@ -20,7 +20,6 @@ informer-confirmed PVC updates clear.
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api.types import Pod
@@ -29,12 +28,9 @@ from ..oracle.nodeinfo import (
     LABEL_ZONE_REGION,
     NodeInfo,
 )
+from ..analysis.lockorder import audited_lock
 from .predicates import PVCLister, PVLister, SCLister
-from .types import (
-    VOLUME_BINDING_WAIT,
-    PersistentVolume,
-    label_zones_to_set,
-)
+from .types import PersistentVolume, label_zones_to_set
 
 
 class VolumeBinder:
@@ -51,7 +47,7 @@ class VolumeBinder:
         self.sc_lister = sc_lister or (lambda name: None)
         self.all_pvs = all_pvs or (lambda: [])
         self.bind_fn = bind_fn  # (namespace, claim, pv_name) -> None
-        self._lock = threading.Lock()
+        self._lock = audited_lock("volume-binder")
         # pod key -> [(namespace, claim, pv_name)] tentative matches
         self._assumed: Dict[str, List[Tuple[str, str, str]]] = {}
         self._assumed_pvs: Dict[str, str] = {}  # pv name -> claiming pod key

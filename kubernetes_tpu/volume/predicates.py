@@ -18,7 +18,7 @@ Listers: callables mirroring the cached-informer interfaces
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, List, Optional, Set, Tuple
 
 from ..api.types import Pod, Volume

@@ -10,7 +10,7 @@ csi_volume_predicate.go, volumebinder/volume_binder.go).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict
 
 VOLUME_BINDING_IMMEDIATE = "Immediate"
 VOLUME_BINDING_WAIT = "WaitForFirstConsumer"

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""ktpu-lint: enforce the repo's compile-plan / donation / lock invariants.
+
+    python scripts/ktpu_lint.py                   # report all violations
+    python scripts/ktpu_lint.py --check           # gate: fail if the set GREW
+    python scripts/ktpu_lint.py --update-baseline # re-pin the baseline
+    python scripts/ktpu_lint.py --rule KTPU003 kubernetes_tpu/state
+
+The gate compares against kubernetes_tpu/analysis/baseline.txt: every
+baselined entry carries a human justification; violations not in the
+baseline fail the run (preflight + tier-1 both call --check). Stale
+baseline entries (fixed violations) are reported so the file ratchets
+down — they never fail the gate.
+
+Rules: KTPU001 no-unplanned-jit, KTPU002 donation-safety, KTPU003
+guarded-by, KTPU004 hot-path-host-sync, KTPU005 shadowed-module-import.
+See INVARIANTS.md for the rule ↔ historical-bug cross-reference and the
+annotation grammar (# ktpu: guarded-by/holds/hot-path/admitted/allow/...).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from kubernetes_tpu.analysis import Baseline, scan_paths  # noqa: E402
+from kubernetes_tpu.analysis.checkers import ALL_CHECKERS, repo_config  # noqa: E402
+
+BASELINE_PATH = os.path.join(_REPO, "kubernetes_tpu", "analysis", "baseline.txt")
+DEFAULT_SCAN = os.path.join(_REPO, "kubernetes_tpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: kubernetes_tpu/)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when violations beyond the baseline exist")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current violation set")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="restrict to one or more rule ids (repeatable)")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [DEFAULT_SCAN]
+    rules = set(args.rule) if args.rule else None
+    violations = scan_paths(paths, _REPO, repo_config(), ALL_CHECKERS, rules)
+
+    if args.update_baseline:
+        if rules or args.paths:
+            # a filtered scan sees a SUBSET of violations; saving it would
+            # silently drop every other baselined entry + justification
+            print(
+                "--update-baseline requires a full default scan "
+                "(no --rule, no path arguments): the baseline is rewritten "
+                "from the scan's violation set."
+            )
+            return 2
+        base = Baseline.load(args.baseline)
+        base.save(args.baseline, violations)
+        print(f"baseline updated: {len(violations)} entries -> {args.baseline}")
+        return 0
+
+    if not args.check:
+        for v in violations:
+            print(v.render())
+        print(f"{len(violations)} violation(s)")
+        return 1 if violations else 0
+
+    # --check: fail closed only when the set grows beyond the baseline
+    base = Baseline.load(args.baseline)
+    new = base.missing(violations)
+    stale = base.stale(violations)
+    for fp in stale:
+        print(f"stale baseline entry (violation fixed — remove the line): {fp}")
+    if new:
+        print(f"\n{len(new)} NEW violation(s) beyond the baseline:\n")
+        for v in new:
+            print(v.render())
+            print()
+        print(
+            "Fix the violation, annotate the deliberate exception "
+            "(# ktpu: allow/admitted/host-sync-ok/holds — see INVARIANTS.md), "
+            "or, for a pre-existing condition only, add the fingerprint to "
+            f"{os.path.relpath(args.baseline, _REPO)} with a justification."
+        )
+        return 1
+    n_base = len(violations) - len(new)
+    print(
+        f"ktpu-lint: OK — {len(violations)} violation(s), all baselined "
+        f"({n_base} baseline entries used, {len(stale)} stale)."
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

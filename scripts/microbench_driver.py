@@ -12,7 +12,6 @@ import jax
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-import numpy as np
 
 from bench import CONFIGS, BATCH
 from kubernetes_tpu.scheduler.driver import Binder, Scheduler

@@ -20,10 +20,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
 
 from kubernetes_tpu.models.generators import make_node, make_pod
-from kubernetes_tpu.scheduler.driver import Binder, Scheduler, _spec_key
+from kubernetes_tpu.scheduler.driver import _spec_key
 from kubernetes_tpu.state.cache import SchedulerCache, TensorMirror
 from kubernetes_tpu.state.queue import PriorityQueue
 from kubernetes_tpu.state.tensors import PodBatch, _bucket

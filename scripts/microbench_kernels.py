@@ -5,7 +5,6 @@ dispatch overhead amortize away. CFG env var picks the bench config."""
 import os
 import sys
 import time
-from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -16,7 +15,6 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import jax.numpy as jnp
-import numpy as np
 
 from bench import CONFIGS
 from kubernetes_tpu.oracle import Snapshot

@@ -13,7 +13,6 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/jax_compile_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import jax.numpy as jnp
-import numpy as np
 
 N_NODES = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
 BATCH = int(sys.argv[2]) if len(sys.argv) > 2 else 1024

@@ -15,7 +15,6 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import jax.numpy as jnp
-import numpy as np
 
 from bench import CONFIGS
 from kubernetes_tpu.oracle import Snapshot

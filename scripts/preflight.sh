@@ -7,6 +7,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== preflight: ktpu-lint invariant gate =="
+python scripts/ktpu_lint.py --check
+
+if command -v ruff >/dev/null 2>&1; then
+  echo "== preflight: ruff (pyflakes/unused-import/shadowing) =="
+  ruff check kubernetes_tpu scripts tests bench.py __graft_entry__.py
+else
+  echo "== preflight: ruff not installed — skipping (config in pyproject.toml) =="
+fi
+
 echo "== preflight: full test suite (8-device CPU mesh) =="
 python -m pytest tests/ -q
 

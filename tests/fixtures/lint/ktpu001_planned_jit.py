@@ -1,0 +1,42 @@
+"""MUST-NOT-FLAG KTPU001: plan-admitted jit factories.
+
+Both admission mechanisms: a factory whose scope visibly routes through
+the compile plan (KIND_* spec / plan.admit), and a factory carrying an
+explicit `# ktpu: admitted(...)` mark.
+"""
+
+import jax
+
+KIND_PATCH = "patch"
+
+_A = None
+_B = None
+
+
+def planned_factory(plan, spec_of):
+    """The jit sits in a scope that admits a KIND_* spec — self-evidently
+    planned."""
+    global _A
+    if _A is None:
+
+        @jax.jit
+        def scatter(dev, idx):
+            return {k: v.at[idx].set(0) for k, v in dev.items()}
+
+        _A = scatter
+    plan.admit(spec_of(KIND_PATCH))
+    return _A
+
+
+# ktpu: admitted(KIND_PATCH) dispatched only via the mirror's admitted
+# scatter path; warmed at startup
+def annotated_factory():
+    global _B
+    if _B is None:
+
+        @jax.jit
+        def scatter(dev, idx):
+            return {k: v.at[idx].set(0) for k, v in dev.items()}
+
+        _B = scatter
+    return _B

@@ -1,0 +1,26 @@
+"""MUST-FLAG KTPU001: the invisible mid-drain patch-program compile.
+
+Reproduces PR 4's BENCH_r05 config-6 bug: the mirror's dirty-row scatter
+was jitted in a plain factory with no compile-plan admission, so the
+scatter programs compiled INLINE mid-drain (a 2.58s "solve" spike the
+plan's miss counters never saw).
+"""
+
+import jax
+
+_SCATTER = None
+
+
+def scatter_fn():
+    global _SCATTER
+    if _SCATTER is None:
+
+        @jax.jit  # <- no KIND_* spec, no plan.admit, no admitted() mark
+        def scatter(dev, idx, updates):
+            out = dict(dev)
+            for k, u in updates.items():
+                out[k] = dev[k].at[idx].set(u)
+            return out
+
+        _SCATTER = scatter
+    return _SCATTER

@@ -1,0 +1,27 @@
+"""MUST-FLAG KTPU002 (host-sync): np.asarray on a mirror-resident array.
+
+Reproduces PR 4's donation blocker: np.asarray on a sharded resident
+array caches `_npy_value` INSIDE the jax Array, and that cached host view
+silently blocks the NEXT fold's buffer donation — the probe perturbs
+what it measures. Fetches must go through a device-side copy at a
+declared sync point (`device_bank_divergence` is the allowlisted twin).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class Mirror:
+    def __init__(self, banks):
+        self._dev_nodes = banks
+
+    def bad_probe(self):
+        # <- direct host view of the resident array: cached _npy_value
+        return np.asarray(self._dev_nodes["requested"]).sum()
+
+    def device_bank_divergence(self):
+        # allowlisted sync point: fetches via a device-side COPY
+        return np.asarray(jnp.array(self._dev_nodes["requested"], copy=True))
+
+    def annotated_probe(self):
+        return np.asarray(self._dev_nodes["valid"])  # ktpu: host-sync-ok test-only debug probe

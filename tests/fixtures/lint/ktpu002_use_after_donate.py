@@ -1,0 +1,27 @@
+"""MUST-FLAG KTPU002 (use-after-donate): reading a donated buffer.
+
+The fold plane's contract: a donated argument's buffer is DELETED at
+dispatch. Reading the stale reference afterwards raises (best case) or
+silently reads garbage through a cached view (worst case). The idiomatic
+fix — rebinding the result to the same name — is the must-not-flag twin
+below.
+"""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def fold_counts(counts, rows, deltas):
+    return counts.at[rows].add(deltas)
+
+
+def bad_apply(counts, rows, deltas):
+    out = fold_counts(counts, rows, deltas)
+    return counts.sum() + out.sum()  # <- `counts` was donated above
+
+
+def good_apply(counts, rows, deltas):
+    counts = fold_counts(counts, rows, deltas)  # rebind ends the taint
+    return counts.sum()

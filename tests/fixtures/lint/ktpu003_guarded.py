@@ -1,0 +1,52 @@
+"""MUST-FLAG KTPU003: unlocked access to a guarded-by attribute.
+
+Reproduces PR 5's vocab-slot interning race: slot assignment is a
+read-modify-write (len → insert); once encodes moved to the informer
+thread, an unlocked access could hand two keys the SAME slot, silently
+corrupting label matching forever.
+"""
+
+import threading
+
+
+class SlotTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.slots = {}  # ktpu: guarded-by(self._lock)
+
+    def bad_slot_of(self, key):
+        s = self.slots.get(key)  # <- unlocked read-modify-write
+        if s is None:
+            s = len(self.slots)
+            self.slots[key] = s
+        return s
+
+    def good_slot_of(self, key):
+        with self._lock:
+            s = self.slots.get(key)
+            if s is None:
+                s = len(self.slots)
+                self.slots[key] = s
+            return s
+
+    def _drain_locked(self):
+        return sorted(self.slots)  # caller holds the lock (suffix contract)
+
+    # ktpu: holds(self._lock) called only from good_slot_of's locked block
+    def _helper(self):
+        return len(self.slots)
+
+
+class FoldBook:
+    """confined(): single-thread state with NO lock — accesses must come
+    from methods carrying the matching confined mark."""
+
+    def __init__(self):
+        self.folded_rows = set()  # ktpu: confined(driver)
+
+    def bad_note(self, row):
+        self.folded_rows.add(row)  # <- unmarked method: race or missing mark
+
+    # ktpu: confined(driver) dispatch runs on the driver thread only
+    def good_note(self, row):
+        self.folded_rows.add(row)

@@ -1,0 +1,27 @@
+"""MUST-FLAG KTPU004: device→host forcing inside a hot-path function.
+
+One hidden round-trip in dispatch/arbiter/fold code serializes the whole
+pipelined drain (every PERF round found at least one of these). Results
+belong at the batch's designated fetch point.
+"""
+
+import jax
+import numpy as np
+
+
+# ktpu: hot-path
+def bad_dispatch(solver, na_dev, pa_dev):
+    assign_dev = solver(na_dev, pa_dev)
+    return jax.device_get(assign_dev)  # <- forcing inside the hot path
+
+
+# ktpu: hot-path
+def good_dispatch(solver, na_dev, pa_dev):
+    width = int(na_dev["requested"].shape[1])  # shape probe: free
+    rows = np.asarray([0] * width, np.int32)  # host->host: fine
+    return solver(na_dev, pa_dev), rows  # fetch happens downstream
+
+
+def cold_fetch(assign_dev):
+    """Not hot-path-marked: fetching here is the designated sync point."""
+    return jax.device_get(assign_dev)

@@ -1,0 +1,26 @@
+"""MUST-FLAG KTPU005: the seed `_bucket` UnboundLocalError.
+
+The module imports `_bucket`; a function used it and ALSO re-imported it
+locally further down — Python then treats `_bucket` as function-local
+everywhere in that function, so the early use raised UnboundLocalError
+at runtime. At seed this broke warmup for every enable_preemption=False
+drain.
+"""
+
+from math import floor as _bucket
+
+
+def bad_warm(n):
+    r = _bucket(n)  # <- UnboundLocalError: the import below makes it local
+    from math import ceil as _bucket
+    return _bucket(r)
+
+
+def shadow_only(n):
+    from math import ceil as _bucket  # <- shadows the module-level name
+    return _bucket(n)
+
+
+def good_local_import(n):
+    from math import trunc as _trunc  # fresh name: no shadow, no flag
+    return _trunc(n) + _bucket(n)

@@ -13,7 +13,6 @@ import pytest
 
 pytest.importorskip("jax")
 
-from kubernetes_tpu.api.types import Pod, pod_from_k8s
 from kubernetes_tpu.apiserver import APIServerHTTP, FakeAPIServer
 from kubernetes_tpu.client import Informer, RemoteAPIServer
 from kubernetes_tpu.models.generators import make_node, make_pod

@@ -17,23 +17,19 @@ Three layers:
 
 import time
 
-import numpy as np
 import pytest
 
 pytest.importorskip("jax")
 
 from kubernetes_tpu.api.types import (
     Affinity,
-    Container,
     ContainerPort,
     LabelSelector,
     PodAffinityTerm,
     PodAntiAffinity,
-    Quantity,
-    RESOURCE_CPU,
     TopologySpreadConstraint,
 )
-from kubernetes_tpu.commit import V_DEFER, V_NOFIT, V_PLACE, host_arbitrate
+from kubernetes_tpu.commit import V_DEFER, host_arbitrate
 from kubernetes_tpu.commit.apply import ColumnarApply, GangRollbackRecord
 from kubernetes_tpu.commit.pipeline import CommitPipeline
 from kubernetes_tpu.models.generators import make_node, make_pod

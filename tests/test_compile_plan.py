@@ -5,7 +5,6 @@ warmup service's synthetic-bank growth warming, and the inline-fallback
 miss accounting. All CPU-only tier-1."""
 
 import json
-import os
 
 import pytest
 
@@ -16,9 +15,7 @@ from kubernetes_tpu.compile import (
     PersistentCompileCache,
     ShapeLadder,
     SolveSpec,
-    WarmupService,
 )
-from kubernetes_tpu.compile.cache import _environment_key
 from kubernetes_tpu.compile.ladder import (
     KIND_PREEMPT,
     KIND_SOLVE,

@@ -9,7 +9,6 @@ pytest.importorskip("jax")
 
 from kubernetes_tpu.config import (
     Configurator,
-    KNOWN_PREDICATES,
     Policy,
     PolicyError,
     default_predicates,
@@ -20,7 +19,6 @@ from kubernetes_tpu.config import (
 )
 from kubernetes_tpu.models.generators import make_node, make_pod
 from kubernetes_tpu.state.cache import SchedulerCache
-from kubernetes_tpu.state.queue import PriorityQueue
 from kubernetes_tpu.utils.featuregate import FeatureGate
 
 

@@ -18,17 +18,7 @@ import pytest
 
 pytest.importorskip("jax")
 
-from kubernetes_tpu.api.types import (
-    Container,
-    Node,
-    Pod,
-    Quantity,
-    RESOURCE_CPU,
-    RESOURCE_MEMORY,
-    RESOURCE_PODS,
-    node_to_k8s,
-    pod_to_k8s,
-)
+from kubernetes_tpu.api.types import Node, Pod, node_to_k8s, pod_to_k8s
 from kubernetes_tpu.extender import (
     ExtenderConfig,
     ExtenderServer,

@@ -1,6 +1,5 @@
 """Metrics, events, healthz, tracing."""
 
-import json
 import logging
 import time
 import urllib.request

@@ -4,7 +4,6 @@ Scenario structure mirrors the reference's predicates_test.go /
 priorities *_test.go tables.
 """
 
-import pytest
 
 from kubernetes_tpu.api.quantity import Quantity
 from kubernetes_tpu.api.types import (
@@ -16,7 +15,6 @@ from kubernetes_tpu.api.types import (
     NodeSelector,
     NodeSelectorRequirement,
     NodeSelectorTerm,
-    Pod,
     PodAffinity,
     PodAffinityTerm,
     PodAntiAffinity,

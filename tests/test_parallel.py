@@ -103,7 +103,7 @@ def test_sharded_chunked_contention_multi_chunk():
     across chunk boundaries, in both tie-break modes."""
     import numpy as np
 
-    from kubernetes_tpu.ops.solver import pop_order, solve_greedy
+    from kubernetes_tpu.ops.solver import solve_greedy
 
     rng = np.random.RandomState(5)
     B, N, R = 256, 8, 2

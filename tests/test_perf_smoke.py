@@ -19,11 +19,25 @@ def test_perf_smoke_commit_plane(tmp_path, monkeypatch):
     # hermetic compile-plan persistence: a ladder left by other runs must
     # not pre-warm (or mis-warm) this process's specs
     monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan"))
+    # the mixed smoke drain doubles as the LOCK-ORDER-AUDITED drain
+    # (analysis/lockorder): every package lock constructed during the run
+    # is wrapped, and the acquisition-order graph across the informer /
+    # uploader / commit-apply / warmup threads must stay acyclic
+    monkeypatch.setenv("KTPU_LOCK_AUDIT", "1")
+    from kubernetes_tpu.analysis.lockorder import REGISTRY
+
+    REGISTRY.reset()
     if _SCRIPTS not in sys.path:
         sys.path.insert(0, _SCRIPTS)
     import perf_smoke
 
     detail = perf_smoke.main()  # raises AssertionError on any regression
+    REGISTRY.assert_acyclic()
+    report = REGISTRY.report()
+    assert report["acquisitions"] > 0 and report["edges"], (
+        "lock audit recorded nothing — the audited_* factories are no "
+        "longer wired into the package's lock construction sites"
+    )
     phase = detail["phase_split_s"]
     assert phase["arbiter_batches"] > 0
     assert phase["arbiter_place"] > 0
@@ -66,11 +80,16 @@ def test_perf_smoke_preemption_no_midrain_compiles(tmp_path, monkeypatch):
     config-6 cycle-2 spike regression guard): zero compile misses after
     warmup AND zero stall batches across a drain that actually evicts."""
     monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan_pre"))
+    monkeypatch.setenv("KTPU_LOCK_AUDIT", "1")  # audited preemption drain
+    from kubernetes_tpu.analysis.lockorder import REGISTRY
+
+    REGISTRY.reset()
     if _SCRIPTS not in sys.path:
         sys.path.insert(0, _SCRIPTS)
     import perf_smoke
 
     detail = perf_smoke.main_preempt()
+    REGISTRY.assert_acyclic()
     assert detail["preempted"] > 0
     assert detail["compile"]["misses_after_warmup"] == 0
     assert detail["warm_stall_batches"] == 0

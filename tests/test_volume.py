@@ -7,7 +7,6 @@ pytest.importorskip("jax")
 
 from kubernetes_tpu.api.types import Volume, pod_from_k8s, pod_to_k8s
 from kubernetes_tpu.models.generators import make_node, make_pod
-from kubernetes_tpu.oracle import Snapshot
 from kubernetes_tpu.oracle.nodeinfo import (
     LABEL_ZONE_FAILURE_DOMAIN,
     LABEL_ZONE_REGION,
