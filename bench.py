@@ -92,6 +92,9 @@ ZONES = [f"zone-{i}" for i in range(8)]
 # minimum batches for pods_per_sec_warm to be a real median: below this,
 # warm is reported null ("n/a") — a 1-2 batch drain has no warm regime
 MIN_WARM_BATCHES = 3
+#: live MetricsServer while a BENCH_METRICS_PORT drain is in flight
+#: (perf_smoke's mid-drain scraper polls this for the url); None otherwise
+METRICS_SERVER = None
 
 
 def _n(x: int) -> int:
@@ -456,6 +459,11 @@ def run_config(name, build, opts=None, inspect=None):
             cache.remove_pod(v)
 
         sched.delete_fn = _delete_victim
+    # flight recorder: a fresh timeline per config (the recorder is
+    # process-global; without the reset config N's trace would replay
+    # configs 1..N-1's spans)
+    if sched.obs.enabled:
+        sched.obs.reset()
     # pre-size the device banks: every capacity growth is an XLA recompile
     sched.mirror.reserve(len(nodes), len(pods))
     for p in pods:
@@ -493,6 +501,27 @@ def run_config(name, build, opts=None, inspect=None):
     # percentiles)
     M.pod_scheduling_duration.enable_sampling()
     M.pod_scheduling_duration.reset_samples()
+    # attribution histograms (kubernetes_tpu/obs): queue wait (enqueue →
+    # pop) + attempt (pop → bound) decompose the e2e number above; the
+    # open-loop mode will quote its SLOs from these same reservoirs
+    for h in (M.queue_incoming_wait, M.scheduling_attempt_duration,
+              M.e2e_scheduling_duration):
+        h.enable_sampling()
+        h.reset_samples()
+    # live scrape endpoint behind a flag: BENCH_METRICS_PORT=<port> (0 =
+    # ephemeral) serves /metrics + /healthz + warmup-gated /readyz for
+    # the duration of the drain (perf_smoke scrapes it mid-drain)
+    global METRICS_SERVER
+    msrv = None
+    if os.environ.get("BENCH_METRICS_PORT", "") != "":
+        from kubernetes_tpu.metrics import MetricsServer
+
+        msrv = MetricsServer(
+            port=int(os.environ["BENCH_METRICS_PORT"]),
+            ready_fn=lambda: sched.ready,
+        ).start()
+        METRICS_SERVER = msrv  # perf_smoke's mid-drain scraper reads the url
+        print(f"[bench] metrics on {msrv.url}/metrics", file=sys.stderr, flush=True)
     # the cluster model is millions of long-lived objects; generational GC
     # walking them mid-batch shows up as ~1s commit-loop outliers. Freeze
     # the setup heap out of the collector and keep GC off during the
@@ -550,6 +579,9 @@ def run_config(name, build, opts=None, inspect=None):
         gc.enable()
         gc.unfreeze()
         gc.collect()
+        if msrv is not None:
+            msrv.stop()
+            METRICS_SERVER = None
     steady = sum(batch_times[1:]) or 1e-9
     # steady throughput must be MEASURABLE even when a config drains in
     # few batches (the preemption config used to report 0.0): prefer the
@@ -600,8 +632,33 @@ def run_config(name, build, opts=None, inspect=None):
         pod_p50 = round(pod_p50, 4)
     if pod_p99 is not None:
         pod_p99 = round(pod_p99, 4)
+
+    # per-pod ATTRIBUTION percentiles from the obs histograms' raw
+    # reservoirs: queue wait (enqueue → pop) + attempt (pop → bound)
+    # decompose pod_sched above; e2e (decided → bound incl. bind) is the
+    # reference's E2eSchedulingLatency shape
+    def _pct(hist, q):
+        v = hist.exact_percentile(q)
+        return round(v, 4) if v is not None else None
+
+    attribution = {
+        "queue_wait_p50_s": _pct(M.queue_incoming_wait, 0.5),
+        "queue_wait_p99_s": _pct(M.queue_incoming_wait, 0.99),
+        "attempt_p50_s": _pct(M.scheduling_attempt_duration, 0.5),
+        "attempt_p99_s": _pct(M.scheduling_attempt_duration, 0.99),
+        "e2e_p50_s": _pct(M.e2e_scheduling_duration, 0.5),
+        "e2e_p99_s": _pct(M.e2e_scheduling_duration, 0.99),
+    }
     if inspect is not None:
         inspect(sched)
+    # flight-recorder export (KTPU_TRACE=1 / Scheduler(trace=True)):
+    # outside the timed drain — resolve_pending may block on parked
+    # device spans here, the one place that's allowed
+    if sched.obs.enabled:
+        safe = "".join(c if c.isalnum() else "_" for c in name)
+        trace_path = os.environ.get("BENCH_TRACE_OUT", f"trace_{safe}.json")
+        sched.dump_trace(trace_path)
+        print(f"[bench] trace -> {trace_path}", file=sys.stderr, flush=True)
     # retire the background compile-warmup worker OUTSIDE the timed drain
     # (queued warms drop; an in-flight XLA compile at process exit would
     # otherwise abort the interpreter) and persist the grown ladder
@@ -617,6 +674,13 @@ def run_config(name, build, opts=None, inspect=None):
         deleted=frozenset(deleted_keys),
     )
     audit_s = time.perf_counter() - t_a
+    # a failed audit with the flight recorder armed dumps the black-box
+    # cycle ring next to the trace: the per-batch verdict/byte/fold
+    # deltas are exactly what bisecting a placement violation needs
+    if sched.obs.enabled and any(
+        v for k, v in audit.items() if k.endswith("_violations")
+    ):
+        sched.obs.dump_blackbox("audit-failure")
 
     detail = {
         "config": name,
@@ -638,6 +702,9 @@ def run_config(name, build, opts=None, inspect=None):
         "pod_sched_p50_s": pod_p50,
         "pod_sched_p99_s": pod_p99,
         "pod_sched_p99_bucket_s": pod_p99_bucket,
+        # where the time went per pod (obs histograms, raw reservoirs):
+        # queue_wait + attempt ≈ pod_sched; e2e is decided → bound
+        "pod_latency_attribution": attribution,
         "audit": audit,
         "audit_s": round(audit_s, 3),
         "elapsed_s": round(elapsed, 3),

@@ -655,6 +655,10 @@ def repo_config() -> AnalysisConfig:
             "kubernetes_tpu/commit/",
             "kubernetes_tpu/scheduler/driver.py",
             "kubernetes_tpu/parallel/sharded.py",
+            # the flight recorder parks dispatched array handles for
+            # two-phase device spans — its resolver is the ONLY place in
+            # obs/ allowed to force, and only via the allowlist below
+            "kubernetes_tpu/obs/",
         ),
         sync_allowlist=(
             # the mirror's parity probe fetches via a device-side copy —
@@ -664,5 +668,9 @@ def repo_config() -> AnalysisConfig:
             "Scheduler._finish_solve",
             # host-rank score rows bulk-fetch (Score plugins / extenders)
             "ScoreRows.prefetch",
+            # the flight recorder's off-hot-path resolver of parked
+            # two-phase device spans (export/drain time only; the hot
+            # half, device_begin, never forces)
+            "FlightRecorder.resolve_pending",
         ),
     )

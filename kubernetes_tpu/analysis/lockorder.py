@@ -139,6 +139,17 @@ class LockOrderRegistry:
     def assert_acyclic(self) -> None:
         cycles = self.find_cycles()
         if cycles:
+            # flight-recorder black box: a lock-order cycle is exactly the
+            # "invisible mid-drain" bug class the cycle ring exists for —
+            # dump it before raising. Lazy import + module-level hook so
+            # the diagnostic layer never re-enters the audited lock world
+            # (and obs/ stays import-free of analysis/).
+            try:
+                from ..obs.recorder import blackbox_dump_hook
+
+                blackbox_dump_hook("lock-order-violation")
+            except Exception:
+                pass  # the violation must surface even if the dump cannot
             raise LockOrderViolation(cycles, self)
 
     def report(self) -> Dict:

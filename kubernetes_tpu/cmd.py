@@ -140,6 +140,24 @@ def build_parser() -> argparse.ArgumentParser:
              "(affinity/taints/spread; such pods may be legitimately "
              "unschedulable against the generated nodes)",
     )
+    # observability (kubernetes_tpu/obs)
+    p.add_argument(
+        "--serve-metrics", action="store_true",
+        help="sim: serve /metrics + /healthz + warmup-gated /readyz on "
+             "--metrics-port for the duration of the drain (the extender "
+             "mode always serves them)",
+    )
+    p.add_argument(
+        "--trace", action="store_true",
+        help="enable the flight recorder (equivalent to KTPU_TRACE=1): "
+             "per-thread span rings + per-pod attribution + black box",
+    )
+    p.add_argument(
+        "--trace-out",
+        help="sim: export the flight-recorder timeline to this path as "
+             "Chrome-trace JSON after the drain (open in Perfetto); "
+             "implies --trace",
+    )
     return p
 
 
@@ -241,6 +259,18 @@ def run_sim(args) -> int:
     from .scheduler.eventhandlers import EventHandlers
 
     cfgr, sched, cc = _configurator(args)
+    msrv = None
+    if args.serve_metrics:
+        # scrape endpoint for the sim drain: /metrics + /healthz, with
+        # /readyz gated on warmup (503 until the compile plan is armed —
+        # a scrape-driven harness cannot race a cold scheduler)
+        from .metrics import MetricsServer
+
+        msrv = MetricsServer(
+            host=args.address, port=args.metrics_port,
+            ready_fn=lambda: sched.ready,
+        ).start()
+        print(f"metrics on {msrv.url}/metrics (readyz gated on warmup)")
     api = FakeAPIServer()
     api_http = None
     if args.serve_api:
@@ -452,6 +482,12 @@ def run_sim(args) -> int:
         hollow.stop()
     if api_http is not None:
         api_http.stop()
+    if msrv is not None:
+        msrv.stop()
+    if args.trace_out and sched.obs.enabled:
+        # flight-recorder timeline for this drain (Chrome-trace JSON;
+        # open in Perfetto). Post-drain: resolve_pending may block here.
+        print(f"trace -> {sched.dump_trace(args.trace_out)}")
     return 0 if bound == len(live) else 1
 
 
@@ -459,6 +495,12 @@ def main(argv: Optional[list] = None) -> int:
     import contextlib
 
     args = build_parser().parse_args(argv)
+    if args.trace or args.trace_out:
+        # arm the process-global flight recorder BEFORE any scheduler /
+        # informer construction so admission-path spans are captured too
+        from .obs import RECORDER
+
+        RECORDER.enable(True)
     ctx = contextlib.nullcontext()
     if args.profile_dir and args.mode == "sim":
         import jax

@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..compile.ladder import KIND_STAGE, SolveSpec
+from ..obs import NOOP_SPAN as _NOOP, RECORDER as _REC
 from .stage import PodStage
 
 #: dirty-row scatter rungs (same quantizer idea as the mirror's
@@ -124,15 +125,17 @@ class StageBank:
         held). Full upload on first use or after a slab rebuild."""
         stage = self.stage
         if self._dev is None or self._dev_generation != stage.generation:
-            host = stage.batch.arrays()
-            self._dev = {k: self._to_dev(v) for k, v in host.items()}
-            self._empty_dev = {
-                k: self._to_dev(v) for k, v in stage.empty_rows.items()
-            }
-            self._ship("stage", sum(np.asarray(v).nbytes for v in host.values()))
-            self.stats["full_uploads"] += 1
-            stage.dirty_rows.clear()
-            self._dev_generation = stage.generation
+            with (_REC.span("upload", kind="full", sync=sync)
+                  if _REC.enabled else _NOOP):
+                host = stage.batch.arrays()
+                self._dev = {k: self._to_dev(v) for k, v in host.items()}
+                self._empty_dev = {
+                    k: self._to_dev(v) for k, v in stage.empty_rows.items()
+                }
+                self._ship("stage", sum(np.asarray(v).nbytes for v in host.values()))
+                self.stats["full_uploads"] += 1
+                stage.dirty_rows.clear()
+                self._dev_generation = stage.generation
             return
         if not stage.dirty_rows:
             return
@@ -140,7 +143,11 @@ class StageBank:
         stage.dirty_rows.clear()
         self.stats["sync_rows" if sync else "flush_rows"] += len(rows)
         host = stage.batch.arrays()
-        self._dev = self._scatter_rows(self._dev, host, rows, warm=False)
+        # upload span: recorded on whichever thread ships the rows — the
+        # background uploader in steady state, the driver on a sync flush
+        with (_REC.span("upload", rows=len(rows), sync=sync)
+              if _REC.enabled else _NOOP):
+            self._dev = self._scatter_rows(self._dev, host, rows, warm=False)
 
     def _patch_spec(self, host: Dict, rb: int) -> SolveSpec:
         """Derived entirely from the HOST dict being scattered (not live

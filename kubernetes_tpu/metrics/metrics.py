@@ -85,6 +85,35 @@ pod_scheduling_attempts = registry.register(Histogram(
     "Attempts needed to schedule a pod",
     buckets=(1, 2, 4, 8, 16),
 ))
+# per-pod latency ATTRIBUTION series (kubernetes_tpu/obs): where each
+# pod's time went — queue wait (enqueue → pop, the incoming-pods wait),
+# then the attempt itself (pop → bound/failed, by result). Together with
+# pod_scheduling_duration (enqueue → bound) these decompose the e2e
+# number the bench quotes; observed via observe_many on the bulk paths.
+queue_incoming_wait = registry.register(Histogram(
+    "scheduler_queue_incoming_wait_seconds",
+    "Time a pod spent queued between (re-)admission and being popped "
+    "into a batch (one observation per pop, so deferred/requeued pods "
+    "observe once per round trip)",
+    buckets=_DURATION_BUCKETS + (20.0, 40.0, 80.0, 160.0, 320.0, 640.0,
+                                 1280.0, 2560.0),
+))
+scheduling_attempt_duration = registry.register(Histogram(
+    "scheduler_scheduling_attempt_duration_seconds",
+    "Per-pod attempt latency (pop -> bound or terminal failure) by "
+    "result (scheduled|unschedulable) — the scheduling_attempt_duration"
+    "_seconds shape of the reference's metrics.go",
+    label_names=("result",),
+    buckets=_DURATION_BUCKETS,
+))
+scheduling_stage_duration = registry.register(Histogram(
+    "scheduler_scheduling_stage_duration_seconds",
+    "Per-batch wall of each pipeline stage (sync|encode|dispatch|fetch|"
+    "commit|apply|bind|fold|gather) — the framework_extension_point_"
+    "duration_seconds analogue for the batch pipeline's real stages",
+    label_names=("stage",),
+    buckets=_DURATION_BUCKETS,
+))
 # batch-native additions (no reference counterpart)
 batch_size = registry.register(Histogram(
     "scheduler_batch_size_pods",

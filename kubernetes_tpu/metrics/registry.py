@@ -20,10 +20,21 @@ _DEFAULT_BUCKETS = (
 )
 
 
+# Prometheus text-format escaping (exposition format spec): inside a
+# label value, backslash, double-quote, and newline MUST be escaped —
+# emitting them raw produces a scrape the parser rejects wholesale (one
+# bad label value poisons every series in the response)
+_LABEL_ESC = str.maketrans({"\\": "\\\\", '"': '\\"', "\n": "\\n"})
+# HELP text escapes only backslash and newline (quotes are legal there)
+_HELP_ESC = str.maketrans({"\\": "\\\\", "\n": "\\n"})
+
+
 def _fmt_labels(names: Sequence[str], values: Tuple[str, ...]) -> str:
     if not names:
         return ""
-    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    inner = ",".join(
+        f'{n}="{str(v).translate(_LABEL_ESC)}"' for n, v in zip(names, values)
+    )
     return "{" + inner + "}"
 
 
@@ -35,6 +46,12 @@ class _Metric:
         self.help = help_
         self.label_names = tuple(label_names)
         self._lock = audited_lock("metric")
+
+    def _header(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help.translate(_HELP_ESC)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
 
     def expose(self) -> List[str]:
         raise NotImplementedError
@@ -58,7 +75,7 @@ class Counter(_Metric):
     def expose(self) -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        out = self._header()
         if not items and not self.label_names:
             items = [((), 0.0)]
         for labels, v in items:
@@ -88,7 +105,7 @@ class Gauge(_Metric):
     def expose(self) -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        out = self._header()
         if not items and not self.label_names:
             items = [((), 0.0)]
         for labels, v in items:
@@ -211,7 +228,7 @@ class Histogram(_Metric):
         with self._lock:
             keys = sorted(self._counts)
             snap = {k: (list(self._counts[k]), self._sums[k], self._totals[k]) for k in keys}
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        out = self._header()
         if not snap and not self.label_names:
             snap = {(): ([0] * (len(self.buckets) + 1), 0.0, 0)}
         for labels, (counts, sum_, total) in snap.items():

@@ -4,6 +4,12 @@ server.go:287-333 newMetricsHandler / newHealthzHandler).
 Prometheus scrapes /metrics (text exposition from the module registry);
 healthz answers 200 once the scheduler reports healthy. Runs on a daemon
 thread like the extender server.
+
+/readyz is gated SEPARATELY from /healthz (the reference gates readiness
+on informer sync + WaitForCacheSync): a scheduler whose warmup has not
+completed is alive but must answer 503 to readiness probes, so a
+scrape-driven harness cannot race a cold scheduler into a drain whose
+first batches pay the XLA compiles warmup exists to pre-pay.
 """
 
 from __future__ import annotations
@@ -22,9 +28,13 @@ class MetricsServer:
         port: int = 0,
         registry=None,
         healthy_fn: Optional[Callable[[], bool]] = None,
+        ready_fn: Optional[Callable[[], bool]] = None,
     ):
         self.registry = registry or default_registry
         self.healthy_fn = healthy_fn or (lambda: True)
+        # readiness defaults to health for servers with no warmup notion
+        # (the extender); a scheduler passes lambda: sched.ready
+        self.ready_fn = ready_fn or self.healthy_fn
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
 
@@ -69,7 +79,15 @@ class MetricsServer:
                         server.registry.expose_text().encode(),
                         ctype="text/plain; version=0.0.4",
                     )
-                elif path in ("/healthz", "/readyz", "/livez"):
+                elif path == "/readyz":
+                    # 503 until warmup completes: readiness is a gate, not
+                    # an echo of liveness (newHealthzHandler vs the
+                    # WaitForCacheSync-gated readiness of the reference)
+                    if server.ready_fn():
+                        self._send(b"ok")
+                    else:
+                        self._send(b"not ready", code=503)
+                elif path in ("/healthz", "/livez"):
                     if server.healthy_fn():
                         self._send(b"ok")
                     else:

@@ -1,0 +1,35 @@
+"""Flight recorder: pipeline-wide span tracing, per-pod latency
+attribution hooks, non-forcing device timing, and a crash black box.
+
+The observability contract of the reference scheduler
+(pkg/scheduler/metrics + utiltrace's LogIfLong) extended to the batch
+pipeline: every thread of the drain (informer admission, background
+uploader, driver, commit-apply worker, bind pool, warmup worker) records
+begin/end span records into its own lock-free ring buffer, merged on
+export into Chrome-trace-event JSON a 100k-pod drain renders as an
+inspectable Perfetto timeline.
+
+Everything here is OFF by default — `KTPU_TRACE=1` (or
+``Scheduler(trace=True)``) enables it; the disabled path is a single
+attribute check and a shared no-op singleton (no allocation, no lock).
+"""
+
+from .recorder import (
+    DEVICE_THREAD,
+    FlightRecorder,
+    NOOP_SPAN,
+    RECORDER,
+    TRACE_ENV,
+)
+from .export import export_trace, merge_events, validate_trace
+
+__all__ = [
+    "DEVICE_THREAD",
+    "FlightRecorder",
+    "NOOP_SPAN",
+    "RECORDER",
+    "TRACE_ENV",
+    "export_trace",
+    "merge_events",
+    "validate_trace",
+]

@@ -1,0 +1,119 @@
+"""Chrome-trace-event export: merge the recorder's per-thread rings into
+the JSON object format Perfetto / chrome://tracing load directly.
+
+Every span becomes a complete ("X") event; zero-duration records become
+instants ("i"); thread names ride metadata ("M") events. Timestamps are
+microseconds relative to the recorder's epoch, and the event list is
+sorted by ts — the format contract tests/test_obs.py pins.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+
+def _event(name: str, ts_us: float, dur_us: float, tid: int, args) -> dict:
+    if dur_us <= 0.0:
+        ev = {"name": name, "cat": "ktpu", "ph": "i", "s": "t",
+              "ts": ts_us, "pid": 1, "tid": tid}
+    else:
+        ev = {"name": name, "cat": "ktpu", "ph": "X",
+              "ts": ts_us, "dur": dur_us, "pid": 1, "tid": tid}
+    if args:
+        ev["args"] = {k: (v if isinstance(v, (int, float, bool, str)) else str(v))
+                      for k, v in args.items()}
+    return ev
+
+
+def merge_events(rings, epoch: float) -> List[dict]:
+    """rings: [(tid, thread_name, [(name, t0, dur, args), ...]), ...] →
+    sorted traceEvents (metadata first, then spans by ts)."""
+    meta: List[dict] = []
+    events: List[dict] = []
+    for tid, thread_name, records in rings:
+        meta.append({
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+            "args": {"name": thread_name},
+        })
+        for name, t0, dur, args in records:
+            events.append(
+                _event(name, (t0 - epoch) * 1e6, dur * 1e6, tid, args)
+            )
+    events.sort(key=lambda e: e["ts"])
+    return meta + events
+
+
+def export_trace(recorder, path: Optional[str] = None) -> dict:
+    """Build the trace document from a FlightRecorder (resolving parked
+    device spans first — the allowlisted off-thread resolution point)."""
+    rings = recorder.snapshot_rings()
+    doc = {
+        "traceEvents": merge_events(rings, recorder.epoch),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "kubernetes_tpu flight recorder",
+            "dropped_pending_device_spans": recorder.dropped_pending,
+        },
+    }
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
+def raw_to_trace(raw: dict) -> dict:
+    """Convert a recorder.save_raw() document to the Chrome-trace format
+    (scripts/trace_export.py offline path)."""
+    rings = [
+        (
+            r["tid"],
+            r["thread"],
+            [(s["name"], s["ts"], s["dur"], s.get("args")) for s in r["spans"]],
+        )
+        for r in raw.get("rings", [])
+    ]
+    return {
+        "traceEvents": merge_events(rings, raw.get("epoch", 0.0)),
+        "displayTimeUnit": "ms",
+    }
+
+
+def validate_trace(doc: dict) -> List[str]:
+    """Structural validation of a Chrome-trace document: every event has
+    the required fields, span events carry non-negative durations, and
+    non-metadata events are sorted by ts. Returns problem strings
+    (empty = valid) — shared by tests and perf_smoke's trace mode."""
+    problems: List[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return ["traceEvents missing or empty"]
+    last_ts = None
+    begins = 0
+    ends = 0
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        if ph not in ("X", "M", "i", "B", "E"):
+            problems.append(f"event {i}: unknown ph {ph!r}")
+            continue
+        if ph == "M":
+            if ev.get("name") == "thread_name" and not ev.get("args", {}).get("name"):
+                problems.append(f"event {i}: thread_name metadata without a name")
+            continue
+        for fld in ("name", "ts", "pid", "tid"):
+            if fld not in ev:
+                problems.append(f"event {i}: missing {fld}")
+        if ph == "X" and ev.get("dur", -1.0) < 0:
+            problems.append(f"event {i}: X event with negative dur")
+        if ph == "B":
+            begins += 1
+        if ph == "E":
+            ends += 1
+        ts = ev.get("ts")
+        if last_ts is not None and ts is not None and ts < last_ts:
+            problems.append(f"event {i}: ts goes backwards ({ts} < {last_ts})")
+        if ts is not None:
+            last_ts = ts
+    if begins != ends:
+        problems.append(f"unmatched B/E events ({begins} begins, {ends} ends)")
+    return problems

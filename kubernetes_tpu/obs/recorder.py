@@ -1,0 +1,397 @@
+"""The flight recorder core: per-thread span rings, two-phase device
+spans, and the black-box cycle ring.
+
+Design constraints, in order:
+
+* **Disabled must cost nothing.** Every instrumentation site guards on
+  ``RECORDER.enabled`` (one attribute read) and the ``span()`` call
+  itself returns a shared no-op singleton when disabled — no ring write,
+  no lock, no allocation beyond the transient call frame.
+
+* **Enabled must not serialize threads.** Each thread writes spans only
+  into its OWN fixed-capacity ring (``threading.local``), so the hot
+  paths never contend; the only locked structures are the cold ring
+  registry (touched once per thread lifetime), the device-span pending
+  table (driver thread + export), and the black-box deque (once per
+  batch).
+
+* **Hot paths must not force device syncs.** Device spans are two-phase
+  (KTPU004: dispatch code may not call ``block_until_ready``):
+  ``device_begin`` records the dispatch timestamp and parks the
+  dispatched array handle; the end stamp comes either from
+  ``device_end`` at the batch's designated fetch point (the result was
+  just fetched — stamping is free) or from ``resolve_pending()``, the
+  one audited sync point of this module (checkers.repo_config
+  sync_allowlist), which blocks on abandoned handles off the hot path
+  at export/drain time.
+
+The recorder's internal lock is a PLAIN ``threading.Lock`` on purpose —
+like ``analysis.lockorder.LockOrderRegistry``, the diagnostic layer
+lives outside the audited lock world so a black-box dump fired from
+inside ``LockOrderViolation`` can never feed back into the edge graph
+it is reporting on.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("kubernetes_tpu.obs")
+
+TRACE_ENV = "KTPU_TRACE"
+#: pseudo-thread name device spans are merged under (their time is chip
+#: time, not any host thread's)
+DEVICE_THREAD = "device"
+
+#: spans per thread ring (wraparound drops the oldest); 64k spans cover
+#: a 100k-pod drain's batch-level spans with room for per-pod enqueues
+DEFAULT_RING_CAPACITY = 1 << 16
+#: unresolved device spans parked at once; overflow abandons the oldest
+#: (recorded with zero duration) so parked array handles can never pin
+#: unbounded device memory
+MAX_PENDING_DEVICE = 512
+#: black-box cycle records kept (a bounded ring: the LAST N batches)
+BLACKBOX_CAPACITY = 256
+
+
+def trace_env_enabled() -> bool:
+    return os.environ.get(TRACE_ENV, "") not in ("", "0", "false", "False")
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager — the disabled fast path returns
+    THIS singleton, never a fresh object."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **kw) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    """One live span: records (name, t0, dur, args) into its thread's
+    ring on exit. Args are kept as the dict the call site built — no
+    copying on the hot path; export serializes them."""
+
+    __slots__ = ("_ring", "name", "args", "t0")
+
+    def __init__(self, ring: "_Ring", name: str, args: Optional[dict]):
+        self._ring = ring
+        self.name = name
+        self.args = args
+
+    def set(self, **kw) -> None:
+        """Attach args discovered mid-span (e.g. rows flushed)."""
+        if self.args is None:
+            self.args = kw
+        else:
+            self.args.update(kw)
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._ring.add(self.name, self.t0, time.perf_counter() - self.t0, self.args)
+        return False
+
+
+class _Ring:
+    """Fixed-capacity span ring owned by ONE thread (lock-free by
+    construction: only the owner appends; export snapshots, accepting
+    the bounded raciness of reading a live ring — export runs at
+    quiesce points in practice)."""
+
+    __slots__ = ("tid", "thread_name", "cap", "buf", "n")
+
+    def __init__(self, tid: int, thread_name: str, cap: int):
+        self.tid = tid
+        self.thread_name = thread_name
+        self.cap = cap
+        self.buf: List = [None] * cap
+        self.n = 0  # total spans ever recorded (n - len kept = dropped)
+
+    def add(self, name: str, t0: float, dur: float, args: Optional[dict]) -> None:
+        self.buf[self.n % self.cap] = (name, t0, dur, args)
+        self.n += 1
+
+    def snapshot(self) -> List[Tuple[str, float, float, Optional[dict]]]:
+        """Records in chronological order (oldest kept first)."""
+        n, cap = self.n, self.cap
+        if n <= cap:
+            return [r for r in self.buf[:n] if r is not None]
+        start = n % cap
+        out = self.buf[start:] + self.buf[:start]
+        return [r for r in out if r is not None]
+
+    @property
+    def dropped(self) -> int:
+        return max(self.n - self.cap, 0)
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        capacity: int = DEFAULT_RING_CAPACITY,
+        enabled: Optional[bool] = None,
+        blackbox_capacity: int = BLACKBOX_CAPACITY,
+    ):
+        #: THE flag every instrumentation site guards on. Plain attribute
+        #: read: stale reads during an enable/disable transition only
+        #: gain or lose a span.
+        self.enabled = trace_env_enabled() if enabled is None else bool(enabled)
+        self.capacity = capacity
+        self._mu = threading.Lock()  # cold structures only (see module doc)
+        self._local = threading.local()
+        self._rings: List[_Ring] = []
+        self._device_ring = _Ring(tid=0, thread_name=DEVICE_THREAD, cap=capacity)
+        # token -> [name, t0, handle, args]; insertion-ordered so overflow
+        # abandons the OLDEST parked handle
+        self._pending: Dict[int, List] = {}
+        self._next_token = 1
+        self._epoch = time.perf_counter()
+        self._blackbox: deque = deque(maxlen=blackbox_capacity)
+        self.dropped_pending = 0
+
+    # -- enable / reset ------------------------------------------------------
+
+    def enable(self, on: bool = True) -> None:
+        self.enabled = bool(on)
+
+    def reset(self) -> None:
+        """Drop every recorded span / pending device span / black-box
+        record (tests; a bench starting a fresh measured window)."""
+        with self._mu:
+            self._rings = []
+            self._local = threading.local()
+            self._device_ring = _Ring(0, DEVICE_THREAD, self.capacity)
+            self._pending = {}
+            self._blackbox.clear()
+            self._epoch = time.perf_counter()
+            self.dropped_pending = 0
+
+    # -- host spans ----------------------------------------------------------
+
+    def _ring(self) -> _Ring:
+        ring = getattr(self._local, "ring", None)
+        if ring is None:
+            t = threading.current_thread()
+            ring = _Ring(tid=t.ident or id(t), thread_name=t.name, cap=self.capacity)
+            self._local.ring = ring
+            with self._mu:
+                self._rings.append(ring)
+        return ring
+
+    def span(self, name: str, **args):
+        """Context manager timing one stage on the CURRENT thread. When
+        disabled returns the shared no-op singleton. Hot per-pod sites
+        should guard with ``if rec.enabled:`` so even the kwargs dict is
+        never built."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return _Span(self._ring(), name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        """Zero-duration marker (exported as an instant event)."""
+        if not self.enabled:
+            return
+        self._ring().add(name, time.perf_counter(), 0.0, args or None)
+
+    def record(self, name: str, t0: float, **args) -> None:
+        """Record a span begun at `t0` (perf_counter) and ending NOW —
+        for sites that already time themselves and must not re-indent a
+        long body under a context manager."""
+        if not self.enabled:
+            return
+        self._ring().add(name, t0, time.perf_counter() - t0, args or None)
+
+    # -- two-phase device spans ----------------------------------------------
+
+    def device_begin(self, name: str, handle, **args) -> int:
+        """Phase 1 (hot path, non-forcing): record the dispatch timestamp
+        and park the dispatched array handle. Returns a token for
+        ``device_end``; 0 when disabled."""
+        if not self.enabled:
+            return 0
+        t0 = time.perf_counter()
+        with self._mu:
+            token = self._next_token
+            self._next_token += 1
+            self._pending[token] = [name, t0, handle, args or None]
+            if len(self._pending) > MAX_PENDING_DEVICE:
+                # abandon the oldest parked handle: record it with zero
+                # duration rather than pin device memory indefinitely
+                old_tok = next(iter(self._pending))
+                nm, ot0, _h, oargs = self._pending.pop(old_tok)
+                oargs = dict(oargs or ())
+                oargs["abandoned"] = True
+                self._device_ring.add(nm, ot0, 0.0, oargs)
+                self.dropped_pending += 1
+        return token
+
+    def device_end(self, token: int) -> None:
+        """Phase 2 at the batch's designated fetch point: the caller just
+        fetched the result (jax.device_get returned), so the program is
+        known-complete — stamping 'now' is non-forcing and honest to
+        within the fetch's own wall."""
+        if not token:
+            return
+        t_end = time.perf_counter()
+        with self._mu:
+            rec = self._pending.pop(token, None)
+            if rec is None:
+                return
+            name, t0, _handle, args = rec
+            self._device_ring.add(name, t0, t_end - t0, args)
+
+    # ktpu: host-sync-ok the ONE audited resolver of parked device spans
+    # (checkers.repo_config sync_allowlist) — runs at export/drain time,
+    # never on a hot path
+    def resolve_pending(self) -> int:
+        """Resolve every still-parked device span by blocking on its
+        handle (spans whose batch was abandoned mid-drain — poisoned
+        speculative entries — never reach ``device_end``). Returns the
+        number resolved."""
+        with self._mu:
+            pending, self._pending = self._pending, {}
+        n = 0
+        for name, t0, handle, args in pending.values():
+            args = dict(args or ())
+            args["resolved_late"] = True
+            t_blk = time.perf_counter()
+            try:
+                handle.block_until_ready()
+            except AttributeError:
+                pass  # stub arrays in tests: already "ready"
+            except Exception:
+                args["resolve_error"] = True
+            # dispatch→resolve wall would read as phantom device time for
+            # a program that finished long before export (poisoned
+            # speculative batches): the honest duration is the observed
+            # block wall — ~0 for long-finished programs, the remaining
+            # device wall for ones still executing at resolution
+            dur = time.perf_counter() - t_blk
+            with self._mu:
+                self._device_ring.add(name, t0, dur, args)
+            n += 1
+        return n
+
+    def pending_count(self) -> int:
+        with self._mu:
+            return len(self._pending)
+
+    # -- black box -----------------------------------------------------------
+
+    def record_cycle(self, record: dict) -> None:
+        """Append one per-batch cycle record to the bounded black box."""
+        if not self.enabled:
+            return
+        with self._mu:
+            self._blackbox.append(record)
+
+    def blackbox_snapshot(self) -> List[dict]:
+        with self._mu:
+            return list(self._blackbox)
+
+    def dump_blackbox(self, reason: str, path: Optional[str] = None) -> Optional[str]:
+        """Write the black-box ring to a JSON artifact and log where it
+        landed. Called on audit failure, LockOrderViolation, or an
+        uncaught driver exception — the 'invisible mid-drain' bug class
+        becomes a log artifact instead of a bisection hunt. Returns the
+        path (None when there was nothing to dump)."""
+        records = self.blackbox_snapshot()
+        if not records:
+            return None
+        if path is None:
+            directory = os.environ.get("KTPU_TRACE_DIR", ".")
+            path = os.path.join(
+                directory, f"ktpu_blackbox_{reason}_{os.getpid()}.json"
+            )
+        try:
+            with open(path, "w") as f:
+                json.dump(
+                    {"reason": reason, "cycles": records}, f, default=str
+                )
+        except OSError as e:
+            logger.warning("black-box dump (%s) failed: %s", reason, e)
+            return None
+        logger.warning(
+            "black box dumped: %d cycle record(s) -> %s (reason: %s)",
+            len(records), path, reason,
+        )
+        return path
+
+    # -- export --------------------------------------------------------------
+
+    def snapshot_rings(self) -> List[Tuple[int, str, List]]:
+        """(tid, thread_name, records) per ring, device ring last —
+        raw material for obs.export and scripts/trace_export.py."""
+        self.resolve_pending()
+        with self._mu:
+            rings = list(self._rings)
+        out = [(r.tid, r.thread_name, r.snapshot()) for r in rings]
+        out.append(
+            (
+                self._device_ring.tid,
+                self._device_ring.thread_name,
+                self._device_ring.snapshot(),
+            )
+        )
+        return [(tid, name, recs) for tid, name, recs in out if recs]
+
+    @property
+    def epoch(self) -> float:
+        return self._epoch
+
+    def save_raw(self, path: str) -> str:
+        """JSON dump of the raw rings (the format scripts/trace_export.py
+        converts/validates offline)."""
+        rings = [
+            {
+                "tid": tid,
+                "thread": name,
+                "spans": [
+                    {"name": n, "ts": t0, "dur": dur, "args": args}
+                    for n, t0, dur, args in recs
+                ],
+            }
+            for tid, name, recs in self.snapshot_rings()
+        ]
+        with open(path, "w") as f:
+            json.dump({"epoch": self._epoch, "rings": rings}, f, default=str)
+        return path
+
+    def export(self, path: Optional[str] = None) -> dict:
+        """Merge every ring into a Chrome-trace-event document (see
+        obs.export); write it to `path` when given."""
+        from .export import export_trace
+
+        return export_trace(self, path)
+
+
+#: the process-global recorder every instrumentation site shares — the
+#: informer-thread queue spans, the uploader's flush spans, and the
+#: driver all land in one timeline (KTPU_TRACE read at import time;
+#: Scheduler(trace=True) flips it on explicitly)
+RECORDER = FlightRecorder()
+
+
+def blackbox_dump_hook(reason: str) -> Optional[str]:
+    """Module-level dump entry point for callers that must not hold a
+    recorder reference (analysis.lockorder's violation path)."""
+    return RECORDER.dump_blackbox(reason)

@@ -64,6 +64,7 @@ from ..state.queue import PodInfo, PriorityQueue
 from ..state.tensors import KeySlotOverflow, PodBatch, _bucket, spec_key
 from ..state.terms import compile_batch_terms
 from ..metrics import metrics as M
+from ..obs import RECORDER as OBS
 from ..utils.trace import Trace
 from ..volume.predicates import scheduling_relevant_volumes
 from . import preemption as preemption_mod
@@ -515,6 +516,7 @@ class Scheduler:
         commit_plane: bool = True,
         fold_plane: bool = True,
         ingest_plane: bool = True,
+        trace: Optional[bool] = None,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -682,6 +684,19 @@ class Scheduler:
         # guarantee against pathological repeat conflicts)
         self._defer_counts: Dict[str, int] = {}
         self._defer_escalate = 3
+        # flight recorder (kubernetes_tpu/obs): span timeline + per-pod
+        # attribution + black box, off by default. `trace=True` arms the
+        # process-global recorder (the queue/ingest instrumentation
+        # shares it, so informer/uploader spans land in one timeline);
+        # trace=None defers to the KTPU_TRACE env the recorder read at
+        # import. trace=False leaves the global recorder alone — a
+        # second scheduler must not silence a traced one.
+        if trace:
+            OBS.enable(True)
+        self.obs = OBS
+        # black-box baseline: cumulative counters diffed per batch into
+        # the bounded cycle ring (ktpu: confined(driver))
+        self._bb_prev: Optional[Dict] = None
         # per-phase wall-clock accumulators (the utiltrace/LogIfLong
         # equivalent; bench.py and metrics read these)
         self.stats: Dict[str, float] = {
@@ -699,6 +714,71 @@ class Scheduler:
         """Install the getSelectors equivalent (services/RC/RS/SS listers,
         selector_spreading.go getSelectors) used for SelectorSpread scoring."""
         self._spread_selectors_fn = fn
+
+    # -- observability (kubernetes_tpu/obs) ----------------------------------
+
+    @property
+    def ready(self) -> bool:
+        """Readiness for /readyz: warmup completed (the reference gates
+        readiness on informer sync; ours on the compile plan being armed
+        — before that, the first batches pay inline XLA compiles)."""
+        return bool(self.compile_plan.warmed)
+
+    def dump_trace(self, path: str) -> str:
+        """Export the flight recorder's merged span timeline as
+        Chrome-trace-event JSON (open in Perfetto / chrome://tracing).
+        Resolves parked device spans first — the off-hot-path half of
+        the two-phase device-timing idiom."""
+        self.obs.export(path)
+        return path
+
+    def _bb_counters(self) -> Dict:
+        """Cumulative counters the black box diffs per batch."""
+        s = self.stats
+        return {
+            "scheduled": 0,  # per-batch fields filled by the caller
+            "bytes": dict(self.mirror.bytes_shipped),
+            "fold_batches": s.get("fold_batches", 0),
+            "arbiter_place": s.get("arbiter_place", 0),
+            "arbiter_defer": s.get("arbiter_defer", 0),
+            "ingest_index": s.get("ingest_index_batches", 0),
+            "ingest_legacy": s.get("ingest_legacy_batches", 0),
+            "ingest_stale": s.get("ingest_stale_rows", 0),
+            "sharded_fallbacks": s.get("sharded_fallbacks", 0),
+            "spec_hits": s.get("spec_hits", 0),
+            "spec_misses": s.get("spec_misses", 0),
+            "compile_misses": int(
+                self.compile_plan.stats.get("misses_after_warmup", 0)
+            ),
+        }
+
+    def _bb_record(self, res: "ScheduleResult", cycle: int, pods: int,
+                   wall: float) -> None:
+        """Append one black-box cycle record (counter deltas + verdicts)
+        — the artifact dumped on audit failure / LockOrderViolation /
+        uncaught driver exception."""
+        cur = self._bb_counters()
+        prev = self._bb_prev or cur
+        delta = {}
+        for k, v in cur.items():
+            if k == "bytes":
+                pv = prev.get("bytes", {})
+                delta["bytes"] = {
+                    kind: n - pv.get(kind, 0) for kind, n in v.items()
+                    if n - pv.get(kind, 0)
+                }
+            elif isinstance(v, (int, float)):
+                d = v - prev.get(k, 0)
+                if d:
+                    delta[k] = d
+        self._bb_prev = cur
+        delta.update(
+            cycle=cycle, pods=pods, wall_s=round(wall, 6),
+            scheduled=res.scheduled, unschedulable=res.unschedulable,
+            errors=res.errors, deferred=res.deferred,
+            preempted=res.preempted,
+        )
+        self.obs.record_cycle(delta)
 
     # -- compile plan --------------------------------------------------------
 
@@ -802,6 +882,8 @@ class Scheduler:
         self.stats["fold_pods"] = self.stats.get("fold_pods", 0) + len(pairs)
         self.stats["fold_s"] = self.stats.get("fold_s", 0.0) + dt
         M.fold_batches.inc()
+        M.scheduling_stage_duration.observe(dt, "fold")
+        OBS.record("fold", t0, pods=len(pairs))
         return True
 
     def _preempt_spec(self) -> SolveSpec:
@@ -971,9 +1053,10 @@ class Scheduler:
                 SOURCE_INLINE if self.compile_plan.warmed else "warmup",
             )
         self.mirror._ship("pods", idx.nbytes + keep.nbytes + fb.nbytes)
-        self.stats["stage_s"] = self.stats.get("stage_s", 0.0) + (
-            time.perf_counter() - t0
-        )
+        dt_gather = time.perf_counter() - t0
+        self.stats["stage_s"] = self.stats.get("stage_s", 0.0) + dt_gather
+        M.scheduling_stage_duration.observe(dt_gather, "gather")
+        OBS.record("gather", t0, reps=len(reps), stale=stale)
         return pa_dev, fb
 
     # -- device solve --------------------------------------------------------
@@ -1113,6 +1196,7 @@ class Scheduler:
         self.mirror._ship("pods", sum(int(a.nbytes) for a in pb.values()))
         t1 = time.perf_counter()
         self.stats["encode_s"] += t1 - t0
+        M.scheduling_stage_duration.observe(t1 - t0, "encode")
 
         if self._ids is None:
             self._ids = F.make_ids(vocab)  # interned constants; stable
@@ -1374,7 +1458,35 @@ class Scheduler:
         self._compile_growth_hook(solve_spec, (na_dev, ea_dev, xp_dev))
         self.stats["batch_specs"] = self.stats.get("batch_specs", 0) + len(reps)
         self.stats["solve_s"] += time.perf_counter() - t1
+        M.scheduling_stage_duration.observe(time.perf_counter() - t1, "dispatch")
+        # flight recorder: the host-side dispatch span, plus the two-phase
+        # DEVICE spans — the dispatched handles are parked (non-forcing,
+        # KTPU004) and their end stamps land at _finish_solve's fetch or
+        # via the allowlisted resolver. Rung args make a 100k-pod drain's
+        # timeline filterable by batch shape.
+        tok_solve = tok_arb = 0
+        if OBS.enabled:
+            # from t1, matching the stage="dispatch" histogram above —
+            # t0→t1 is the encode wall (its own stage), carried as an arg
+            OBS.record(
+                "dispatch", t1, cycle=self._cycle, pods=len(pods),
+                reps=len(reps), rung_b=self._b_bucket, rung_u=self._u_bucket,
+                speculative=carry is not None, gang=is_gang,
+                path="index" if pa_dev is not None else "legacy",
+                encode_s=round(t1 - t0, 6),
+            )
+            tok_solve = OBS.device_begin(
+                "solve", assign, cycle=self._cycle, pods=len(pods),
+                rung_b=self._b_bucket, gang=is_gang,
+                speculative=carry is not None,
+            )
+            if verdict_dev is not None:
+                tok_arb = OBS.device_begin(
+                    "arbitrate", verdict_dev, cycle=self._cycle,
+                    pods=len(pods),
+                )
         return dict(
+            obs_tokens=(tok_solve, tok_arb),
             infos=infos,
             pods=pods,
             batch=batch,  # None on the covered ingest path
@@ -1419,6 +1531,16 @@ class Scheduler:
         dt = time.perf_counter() - t0
         self.stats["fetch_s"] = self.stats.get("fetch_s", 0.0) + dt
         self.stats["solve_s"] += dt
+        M.scheduling_stage_duration.observe(dt, "fetch")
+        if OBS.enabled:
+            # the device_get above IS the designated sync point: the solve
+            # (and chained arbiter) programs are complete — stamping their
+            # two-phase device spans now is non-forcing and exact to
+            # within this fetch's wall
+            tok_solve, tok_arb = disp.get("obs_tokens", (0, 0))
+            OBS.device_end(tok_solve)
+            OBS.device_end(tok_arb)
+            OBS.record("fetch", t0, pods=n)
         return SolveOutput(
             assign=np.asarray(assign)[:n],
             fallback=np.asarray(disp["fallback_arr"])[sig_arr],
@@ -1461,6 +1583,7 @@ class Scheduler:
         infos = self.queue.peek_batch(max_pods or self.batch_size)
         saved = dict(self.stats)
         plan = self.compile_plan
+        t_warm = time.perf_counter()
         try:
             # FULL-QUEUE census (not just the peeked batch): pre-size the
             # signature/pattern banks for the whole backlog and stage any
@@ -1599,6 +1722,7 @@ class Scheduler:
             # warmup time is setup time: keep the per-phase accumulators
             # about real scheduling work only
             self.stats = saved
+            OBS.record("warmup", t_warm, pods=len(infos))
         return len(infos)
 
     def _warmup_census(self) -> None:
@@ -1876,6 +2000,9 @@ class Scheduler:
             # queue-add → bound (PodSchedulingDuration), measured on the
             # queue's own clock (it is injectable in tests)
             M.pod_scheduling_duration.observe(max(self.queue.age(info), 0.0))
+            M.scheduling_attempt_duration.observe(
+                self.queue.attempt_age(info), "scheduled"
+            )
             self.cache.finish_binding(assumed)
             self.framework.run_post_bind(state, pod, node_name)
             self.event_fn(pod, "Scheduled", f"bound to {node_name}")
@@ -1895,11 +2022,14 @@ class Scheduler:
         SKIP → default binder."""
         bind = self.binder.bind
         age = self.queue.age
+        attempt_age = self.queue.attempt_age
         events = self.event_fn
+        t_chunk = time.perf_counter()
         binds: List[float] = []
         e2es: List[float] = []
         attempts: List[int] = []
         ages: List[float] = []
+        attempt_ages: List[float] = []
         finished: List[Pod] = []
         for info, assumed, node_name, state, t_decided in items:
             pod = info.pod
@@ -1917,6 +2047,7 @@ class Scheduler:
                 e2es.append(now - t_decided)
                 attempts.append(info.attempts)
                 ages.append(max(age(info), 0.0))
+                attempt_ages.append(attempt_age(info))
                 finished.append(assumed)
                 events(pod, "Scheduled", f"bound to {node_name}")
             except Exception:
@@ -1936,6 +2067,13 @@ class Scheduler:
         M.e2e_scheduling_duration.observe_many(e2es)
         M.pod_scheduling_attempts.observe_many(attempts)
         M.pod_scheduling_duration.observe_many(ages)
+        # per-pod attempt attribution (pop → bound), bulk-observed — with
+        # queue_incoming_wait this decomposes pod_scheduling_duration
+        M.scheduling_attempt_duration.observe_many(attempt_ages, "scheduled")
+        M.scheduling_stage_duration.observe(
+            time.perf_counter() - t_chunk, "bind"
+        )
+        OBS.record("bind", t_chunk, pods=len(items), bound=len(finished))
 
     def _commit(
         self, info: PodInfo, node_name: str, cycle: int,
@@ -1962,6 +2100,11 @@ class Scheduler:
 
     def _fail(self, info: PodInfo, cycle: int, msg: str) -> None:
         self.event_fn(info.pod, "FailedScheduling", msg)
+        # attempt attribution for the failure result (pop → terminal):
+        # observed BEFORE the re-queue resets the entry's clocks
+        M.scheduling_attempt_duration.observe(
+            self.queue.attempt_age(info), "unschedulable"
+        )
         self.queue.add_unschedulable(info, cycle)
 
     def _try_preempt(self, info: PodInfo) -> bool:
@@ -1990,6 +2133,7 @@ class Scheduler:
             ),
         )
         M.preemption_evaluation_duration.observe(time.perf_counter() - t0)
+        OBS.record("preempt", t0, pod=pod.key(), found=node is not None)
         if node is None:
             return False
         # extenders with a preemption verb get to veto/trim the victim set
@@ -2410,8 +2554,14 @@ class Scheduler:
         workers = self._bind_workers
 
         def apply_batch() -> None:
+            # runs on the commit-pipeline worker: the "apply" span lands
+            # in that thread's ring, so the timeline shows the overlap
+            # with the driver's next solve fetch
+            t_apply = time.perf_counter()
             result = columnar.apply(place, folded=folded)
+            OBS.record("apply", t_apply, pods=len(place))
             M.commit_apply_duration.observe(result.seconds)
+            M.scheduling_stage_duration.observe(result.seconds, "apply")
             self.stats["apply_s"] = (
                 self.stats.get("apply_s", 0.0) + result.seconds
             )
@@ -2506,6 +2656,28 @@ class Scheduler:
     # -- main loop -----------------------------------------------------------
 
     def schedule_batch(self, max_pods: Optional[int] = None) -> ScheduleResult:
+        """One batch cycle, wrapped in the flight recorder's cycle span
+        and black-box accounting: an exception escaping the cycle (a
+        driver bug, not a per-pod failure — those are handled inside)
+        dumps the last N cycle records before propagating, turning the
+        invisible-mid-drain class of bug into a log artifact."""
+        if not OBS.enabled:
+            return self._schedule_batch(max_pods)
+        t0 = time.perf_counter()
+        try:
+            with OBS.span("cycle"):
+                res = self._schedule_batch(max_pods)
+        except Exception:
+            self.obs.dump_blackbox("driver-exception")
+            raise
+        self._bb_record(
+            res, self.queue.scheduling_cycle(),
+            res.scheduled + res.unschedulable + res.errors + res.deferred,
+            time.perf_counter() - t0,
+        )
+        return res
+
+    def _schedule_batch(self, max_pods: Optional[int] = None) -> ScheduleResult:
         res = ScheduleResult()
         pending = self._spec_chain.pop(0) if self._spec_chain else None
         if pending is not None:
@@ -2538,6 +2710,8 @@ class Scheduler:
         dt_sync = time.perf_counter() - t_sync
         self.stats["sync_s"] += dt_sync
         M.tensor_sync_duration.observe(dt_sync)
+        M.scheduling_stage_duration.observe(dt_sync, "sync")
+        OBS.record("sync", t_sync)
         trace.step("tensor mirror sync")
         # the snapshot moved (sync) — rebuild the oracle metadata index
         # lazily if this batch needs it
@@ -3154,7 +3328,10 @@ class Scheduler:
                 step = max(1, -(-len(bind_jobs) // self._bind_workers))
                 for i in range(0, len(bind_jobs), step):
                     self._bind_pool.submit(_run_chunk, bind_jobs[i : i + step])
-        self.stats["commit_s"] += time.perf_counter() - t_commit
+        dt_commit = time.perf_counter() - t_commit
+        self.stats["commit_s"] += dt_commit
+        M.scheduling_stage_duration.observe(dt_commit, "commit")
+        OBS.record("commit", t_commit, pods=len(infos) or res.scheduled)
         if self._spec_chain:
             # keep the speculated solves only if this batch went exactly the
             # way the device predicted: every commit on the device's node

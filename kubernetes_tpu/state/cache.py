@@ -1018,6 +1018,8 @@ class TensorMirror:
         import jax.numpy as jnp
         import numpy as _np
 
+        from ..obs import NOOP_SPAN, RECORDER as _rec
+
         cap = next(iter(host.values())).shape[0]
         rb = min(_patch_rung(len(rows)), cap)
         plan = self.compile_plan
@@ -1032,23 +1034,27 @@ class TensorMirror:
         rows = list(rows)
         first = True
         dt_compile = 0.0
-        for i in range(0, len(rows), rb):
-            chunk = rows[i : i + rb]
-            padded = chunk + [chunk[0]] * (rb - len(chunk))
-            idx = _np.asarray(padded, _np.int32)
-            updates = {k: _np.ascontiguousarray(h[idx]) for k, h in host.items()}
-            self._ship(kind, idx.nbytes + sum(u.nbytes for u in updates.values()))
-            if first:
-                # only the FIRST chunk can trace+compile (later chunks hit
-                # the fresh cache entry) — attribute just its wall to the
-                # miss, or compile_s would overstate the stall by the
-                # chunk count
-                t0 = time.perf_counter()
-                dev = scatter(dev, jnp.asarray(idx), updates)
-                dt_compile = time.perf_counter() - t0
-                first = False
-            else:
-                dev = scatter(dev, jnp.asarray(idx), updates)
+        # flight-recorder "patch" span around the chunked scatters, on
+        # whichever thread ships them (driver sync, warmup worker)
+        with (_rec.span("patch", kind=kind, rows=len(rows), warm=warm)
+              if _rec.enabled else NOOP_SPAN):
+            for i in range(0, len(rows), rb):
+                chunk = rows[i : i + rb]
+                padded = chunk + [chunk[0]] * (rb - len(chunk))
+                idx = _np.asarray(padded, _np.int32)
+                updates = {k: _np.ascontiguousarray(h[idx]) for k, h in host.items()}
+                self._ship(kind, idx.nbytes + sum(u.nbytes for u in updates.values()))
+                if first:
+                    # only the FIRST chunk can trace+compile (later chunks
+                    # hit the fresh cache entry) — attribute just its wall
+                    # to the miss, or compile_s would overstate the stall
+                    # by the chunk count
+                    t0 = time.perf_counter()
+                    dev = scatter(dev, jnp.asarray(idx), updates)
+                    dt_compile = time.perf_counter() - t0
+                    first = False
+                else:
+                    dev = scatter(dev, jnp.asarray(idx), updates)
         if plan is not None and not known:
             from ..compile.plan import SOURCE_INLINE, SOURCE_WARMUP
 

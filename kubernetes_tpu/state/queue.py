@@ -27,6 +27,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ..analysis.lockorder import audited_condition
 from ..api.types import Pod
+from ..metrics import metrics as M
+from ..obs import NOOP_SPAN, RECORDER as _REC
 
 INITIAL_BACKOFF = 1.0  # pod_backoff.go initialDuration
 MAX_BACKOFF = 10.0  # pod_backoff.go maxDuration
@@ -41,6 +43,13 @@ class PodInfo:
     timestamp: float = 0.0  # time added to the queue
     attempts: int = 0
     seq: int = 0  # monotonic enqueue sequence (tie-break within priority)
+    # per-pod latency attribution (kubernetes_tpu/obs): enqueue_ts is the
+    # FIRST-admission stamp (survives requeue/unschedulable round-trips;
+    # rebase_timestamps resets it with the rest), pop_ts the last pop —
+    # both on the queue's own clock, read via age()/attempt_age() so
+    # callers never mix clocks
+    enqueue_ts: float = 0.0
+    pop_ts: float = 0.0
     # pod-ingest plane (kubernetes_tpu/ingest): the entry's READY staged
     # row — encoded at admission on the informer thread, consumed by the
     # driver's index-only dispatch. (-1, -1) = not staged; a generation
@@ -267,9 +276,26 @@ class PriorityQueue:
         # _stage is attach-once before traffic; the acquired ref makes any
         # race with a concurrent delete benign (doc above)
         stage = self._stage  # ktpu: allow(KTPU003) attach-once reference read
-        pair = stage.acquire(pod) if stage is not None else None
+        if _REC.enabled:
+            # flight recorder: the admission path's two spans — the row
+            # encode (stage-encode, the heavy half, on THIS thread — the
+            # informer in production) nested inside the enqueue span
+            with _REC.span("enqueue", pod=pod.key()):
+                with (_REC.span("stage-encode", pod=pod.key())
+                      if stage is not None else NOOP_SPAN):
+                    pair = stage.acquire(pod) if stage is not None else None
+        else:
+            pair = stage.acquire(pod) if stage is not None else None
         with self._lock:
-            info = PodInfo(pod=pod, timestamp=self._now(), seq=next(self._seq))
+            now = self._now()
+            prev = self._infos.get(pod.key())
+            info = PodInfo(pod=pod, timestamp=now, seq=next(self._seq))
+            # first-admission stamp survives re-adds of the same key (the
+            # e2e attribution anchor); a re-created pod restarts it
+            info.enqueue_ts = (
+                prev.enqueue_ts if prev is not None and prev.enqueue_ts > 0
+                else now
+            )
             if pair is not None:
                 info.staged_row, info.staged_gen = pair
             # attach-new-then-release-old: an identical re-add lands on
@@ -299,8 +325,10 @@ class PriorityQueue:
             self._in_active.discard(key)
             info = self._infos[key]
             info.attempts += 1
+            info.pop_ts = self._now()
             self._scheduling_cycle += 1
-            return info
+        M.queue_incoming_wait.observe(max(info.pop_ts - info.timestamp, 0.0))
+        return info
 
     def pop_batch(self, max_pods: int) -> List[PodInfo]:
         """Drain up to max_pods from activeQ without blocking — the batch
@@ -310,15 +338,23 @@ class PriorityQueue:
             out = []
             pop = heapq.heappop
             active, in_active, infos = self._active, self._in_active, self._infos
+            now = self._now()
             while active and len(out) < max_pods:
                 key = _entry_key(pop(active))
                 in_active.discard(key)
                 info = infos[key]
                 info.attempts += 1
+                info.pop_ts = now
                 out.append(info)
             if out:
                 self._scheduling_cycle += 1
-            return out
+        if out:
+            # queue-wait attribution: one bulk observe per batch (outside
+            # the queue lock — the histogram has its own)
+            M.queue_incoming_wait.observe_many(
+                [max(now - i.timestamp, 0.0) for i in out]
+            )
+        return out
 
     def rebase_timestamps(self) -> int:
         """Reset every queued entry's enqueue timestamp to NOW. Harnesses
@@ -330,8 +366,10 @@ class PriorityQueue:
             now = self._now()
             for info in self._infos.values():
                 info.timestamp = now
+                info.enqueue_ts = now
             for info in self._unschedulable.values():
                 info.timestamp = now
+                info.enqueue_ts = now
             return len(self._infos) + len(self._unschedulable)
 
     def requeue(self, infos: Sequence[PodInfo]) -> None:
@@ -376,13 +414,18 @@ class PriorityQueue:
             self._active = [e for e in self._active if _entry_key(e) not in taken_keys]
             heapq.heapify(self._active)
             out = []
+            now = self._now()
             for e in sorted(take):
                 key = _entry_key(e)
                 self._in_active.discard(key)
                 info = self._infos[key]
                 info.attempts += 1
+                info.pop_ts = now
                 out.append(info)
-            return out
+        M.queue_incoming_wait.observe_many(
+            [max(now - i.timestamp, 0.0) for i in out]
+        )
+        return out
 
     def add_unschedulable(self, info: PodInfo, pod_scheduling_cycle: Optional[int] = None) -> None:
         """AddUnschedulableIfNotPresent (:353): if a move request arrived
@@ -562,6 +605,15 @@ class PriorityQueue:
         """Seconds since the pod was (re-)queued, on THIS queue's clock —
         callers must not mix their own clock with info.timestamp."""
         return self._now() - info.timestamp
+
+    def attempt_age(self, info: PodInfo) -> float:
+        """Seconds since the entry was last POPPED (this attempt's wall so
+        far), on the queue's clock; 0.0 for a never-popped entry — the
+        scheduling_attempt_duration observation the commit/fail paths
+        record per pod."""
+        if info.pop_ts <= 0.0:
+            return 0.0
+        return max(self._now() - info.pop_ts, 0.0)
 
     def counts(self) -> Tuple[int, int, int]:
         """(active, backoff, unschedulable) — the pending_pods gauge split."""

@@ -25,6 +25,17 @@ tests/test_perf_smoke.py; also runnable standalone:
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py            # single-device
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py sharded    # 8-way mesh
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py preempt    # preemption
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py trace      # flight recorder
+
+`main_trace()` (mode `trace`) guards the flight recorder
+(kubernetes_tpu/obs): a traced drain must export a structurally valid
+Chrome-trace timeline covering every pipeline stage and every thread
+role (informer admission, background uploader, driver, commit-apply
+worker, bind pool, device pseudo-thread), hold `misses_after_warmup ==
+0` with tracing ON, and stay within the per-pod overhead bound vs the
+same scheduler's untraced drain. The mixed mode additionally serves its
+own /metrics and scrapes it once MID-drain, asserting the readiness gate
+and that the new attribution histograms expose and parse.
 
 `main(sharded=True)` runs the SAME workload over a forced 8-virtual-device
 node mesh and additionally asserts the multi-chip acceptance criteria:
@@ -52,6 +63,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -156,6 +168,98 @@ def preemption_smoke_config():
     return nodes, pending, existing
 
 
+def _start_mid_drain_scraper(out: dict):
+    """Background thread: wait for bench's MetricsServer, verify /readyz
+    gates on warmup (503 before, 200 after), then scrape /metrics while
+    the drain is running, keeping the last body that exposes the per-pod
+    attempt histogram. Results land in `out` for main() to assert on."""
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    import bench
+
+    def run():
+        # the server starts after bench's warmup, whose COLD budget is
+        # ~650s (persistent ladder empty) — the wait must outlast it
+        deadline = time.time() + 720
+        url = None
+        while time.time() < deadline and url is None:
+            srv = getattr(bench, "METRICS_SERVER", None)
+            if srv is not None:
+                url = srv.url
+            time.sleep(0.01)
+        if url is None:
+            out["error"] = "metrics server never came up"
+            return
+        while time.time() < deadline:  # readiness gate: 503 until warmed
+            try:
+                with urllib.request.urlopen(f"{url}/readyz", timeout=2) as r:
+                    out["ready_code"] = r.status
+                    break
+            except urllib.error.HTTPError as e:
+                out["not_ready_code"] = e.code
+            except OSError:
+                pass
+            time.sleep(0.02)
+        while time.time() < deadline:  # scrape until drain activity shows
+            try:
+                with urllib.request.urlopen(f"{url}/metrics", timeout=5) as r:
+                    out["text"] = r.read().decode()
+            except OSError:
+                break  # server closed: the drain ended — keep the last body
+            if "scheduler_scheduling_attempt_duration_seconds_bucket" in out.get(
+                "text", ""
+            ):
+                break
+            time.sleep(0.02)
+
+    t = threading.Thread(target=run, name="smoke-scraper", daemon=True)
+    t.start()
+    return t
+
+
+#: one sample line of the Prometheus text exposition format:
+#: name{label="value",...} value  — label values with escaped \" \\ \n only
+_PROM_SAMPLE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*")*\})?'
+    r' (-?[0-9.eE+-]+|\+Inf|-Inf|NaN)$'
+)
+
+NEW_HISTOGRAMS = (
+    "scheduler_queue_incoming_wait_seconds",
+    "scheduler_scheduling_attempt_duration_seconds",
+    "scheduler_scheduling_stage_duration_seconds",
+)
+
+
+def _check_scrape(scrape: dict):
+    """Problems list for the mid-drain /metrics scrape: readiness gate
+    honest, every line parses per the text format, the new attribution
+    histograms expose with full bucket/sum/count families."""
+    problems = []
+    if "error" in scrape:
+        return [scrape["error"]]
+    if scrape.get("ready_code") != 200:
+        problems.append(f"/readyz never answered 200 ({scrape})")
+    text = scrape.get("text", "")
+    if not text:
+        return problems + ["mid-drain /metrics scrape got no body"]
+    for i, line in enumerate(text.splitlines()):
+        if not line or line.startswith("#"):
+            continue
+        if not _PROM_SAMPLE.match(line):
+            problems.append(f"/metrics line {i} unparseable: {line!r}")
+    for h in NEW_HISTOGRAMS:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if f"{h}{suffix}" not in text:
+                problems.append(f"{h}{suffix} missing from mid-drain scrape")
+    return problems
+
+
 def _mesh8():
     import jax
 
@@ -230,9 +334,23 @@ def main(sharded: bool = False) -> dict:
     if sharded:
         opts["mesh"] = _mesh8()
         name = "tiny_commit_plane_smoke_sharded8"
-    detail = bench.run_config(
-        name, tiny_commit_plane_config, opts=opts, inspect=inspect
-    )
+    # observability satellite: the single-device smoke serves its own
+    # /metrics (ephemeral port) and SCRAPES it once mid-drain — the
+    # readiness gate plus the new attribution histograms must expose and
+    # parse while the drain is actually running, not just at rest
+    scrape = {}
+    scraper = None
+    if not sharded:
+        os.environ["BENCH_METRICS_PORT"] = "0"
+        scraper = _start_mid_drain_scraper(scrape)
+    try:
+        detail = bench.run_config(
+            name, tiny_commit_plane_config, opts=opts, inspect=inspect
+        )
+    finally:
+        if scraper is not None:
+            os.environ.pop("BENCH_METRICS_PORT", None)
+            scraper.join(timeout=10)
     phase = detail["phase_split_s"]
     audit = detail["audit"]
     problems = []
@@ -290,8 +408,255 @@ def main(sharded: bool = False) -> dict:
     for k, v in audit.items():
         if k.endswith("_violations") and v:
             problems.append(f"audit: {k}={v}")
+    # per-pod latency attribution (kubernetes_tpu/obs): bench must quote
+    # real p50/p99 from the new histograms' sample reservoirs, not nulls
+    attr = detail.get("pod_latency_attribution") or {}
+    for k in ("queue_wait_p50_s", "queue_wait_p99_s", "attempt_p50_s",
+              "attempt_p99_s", "e2e_p50_s", "e2e_p99_s"):
+        if attr.get(k) is None:
+            problems.append(f"pod_latency_attribution.{k} is null")
+    if scraper is not None:
+        problems += _check_scrape(scrape)
+        detail["metrics_scrape"] = {
+            "ready_code": scrape.get("ready_code"),
+            "not_ready_code": scrape.get("not_ready_code"),
+            "lines": len(scrape.get("text", "").splitlines()),
+        }
     assert not problems, "; ".join(problems)
     return detail
+
+
+#: every pipeline stage the flight recorder must have witnessed in a
+#: traced smoke drain (host rings + the device pseudo-thread)
+REQUIRED_SPANS = (
+    "enqueue", "stage-encode", "upload", "sync", "dispatch", "gather",
+    "solve", "arbitrate", "fold", "commit", "apply", "bind", "fetch",
+    "cycle", "warmup",
+)
+#: thread-name fragments the timeline must span: informer admission,
+#: background uploader, driver (main), commit-apply worker, bind pool,
+#: and the device pseudo-thread
+REQUIRED_THREADS = (
+    "informer", "ingest-upload", "MainThread", "commit-apply", "bind",
+    "device",
+)
+#: traced-vs-untraced per-pod overhead ceiling (2%), plus an absolute
+#: floor so sub-second CPU smoke drains don't fail on scheduler jitter
+TRACE_OVERHEAD_FRAC = 0.02
+TRACE_OVERHEAD_ABS_S = 0.25
+
+
+def _trace_wave(tag: str, n: int):
+    """n pods namespaced by `tag` (labels disjoint across waves so wave
+    B's anti-affinity can't collide with wave A's placements): same mix
+    as tiny_commit_plane_config — 1/8 required anti-affinity, 1/8
+    DoNotSchedule spread, the rest bulk-path."""
+    import bench
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        TopologySpreadConstraint,
+    )
+
+    base = {"a": 0, "b": 100_000, "live": 200_000, "p": 300_000}[tag]
+    pods = []
+    for i in range(n):
+        if i % 8 == 0:
+            p = bench.mk_pod(base + i, cpu="100m", mem="64Mi",
+                             labels={"exclusive": f"{tag}{i % 16}"})
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"exclusive": p.labels["exclusive"]}
+                    ),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ]))
+        elif i % 8 == 1:
+            p = bench.mk_pod(base + i, cpu="100m", mem="64Mi",
+                             labels={"spread": f"{tag}grp{i % 2}"})
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=1,
+                topology_key="failure-domain.beta.kubernetes.io/zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(
+                    match_labels={"spread": p.labels["spread"]}
+                ),
+            )]
+        else:
+            p = bench.mk_pod(base + i, cpu="100m", mem="64Mi",
+                             labels={"wave": tag})
+        pods.append(p)
+    return pods
+
+
+def main_trace() -> dict:
+    """Flight-recorder smoke (KTPU_TRACE equivalent): ONE warmed
+    scheduler drains wave A traced-OFF, then wave B traced-ON with a
+    mid-drain live-arrival wave (so the background uploader ships fresh
+    staged rows off-thread while spans record). Asserts the exported
+    Chrome trace is structurally valid, covers every pipeline stage and
+    every thread role, `misses_after_warmup == 0` held with tracing ON,
+    and the traced per-pod batch wall stayed within the overhead bound
+    of the untraced drain."""
+    import threading
+    import time
+
+    import bench
+    from kubernetes_tpu.obs import RECORDER
+    from kubernetes_tpu.obs.export import validate_trace
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    nodes = [bench.mk_node(i, zone=bench.ZONES[i % 4]) for i in range(N_NODES)]
+    wave_p = _trace_wave("p", 32)  # priming drain (untraced, unmeasured)
+    wave_a = _trace_wave("a", N_PODS)
+    wave_b = _trace_wave("b", N_PODS)
+    wave_live = _trace_wave("live", 16)
+
+    RECORDER.enable(False)
+    RECORDER.reset()
+    cache = SchedulerCache()
+    for node in nodes:
+        cache.add_node(node)
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=Binder(), batch_size=SMOKE_BATCH,
+        enable_preemption=False, spec_depth=2,
+    )
+    sched.mirror.reserve(
+        len(nodes),
+        len(wave_p) + len(wave_a) + len(wave_b) + len(wave_live),
+    )
+
+    def informer_add(pods):
+        """Enqueue on a thread NAMED informer — admission (and the
+        stage-encode) run off the driver thread exactly as in the live
+        informer topology, so their spans land in their own ring."""
+        t = threading.Thread(
+            target=lambda: [queue.add(p) for p in pods], name="informer"
+        )
+        t.start()
+        t.join()
+
+    def drain(inject=None):
+        """(sum of schedule_batch walls, scheduled). `inject()` runs
+        after the first batch — live arrivals mid-drain."""
+        wall = 0.0
+        scheduled = 0
+        injected = inject is None
+        while True:
+            t0 = time.perf_counter()
+            r = sched.schedule_batch()
+            wall += time.perf_counter() - t0
+            scheduled += r.scheduled
+            if not injected:
+                injected = True
+                inject()
+                continue
+            if (r.scheduled == 0 and r.unschedulable == 0
+                    and r.errors == 0 and r.deferred == 0):
+                break
+        sched.wait_for_binds()
+        return wall, scheduled
+
+    problems = []
+    try:
+        # tracing ON for admission + warmup (the KTPU_TRACE=1 production
+        # shape: warmup itself is on the timeline)
+        RECORDER.enable(True)
+        RECORDER.reset()
+        informer_add(wave_p)
+        sched.warmup()
+
+        # priming drain, untraced + unmeasured: the FIRST drain of a fresh
+        # scheduler pays Python/allocator warmth no later drain pays —
+        # measuring it against anything else measures order, not tracing
+        RECORDER.enable(False)
+        drain()
+
+        # untraced baseline on the now-warm scheduler
+        informer_add(wave_b)
+        off_wall, off_n = drain()
+
+        # traced leg, same warmed programs, with a mid-drain live-arrival
+        # wave so the background uploader ships fresh rows while recording
+        RECORDER.enable(True)
+        informer_add(wave_a)
+
+        def inject_live():
+            informer_add(wave_live)
+            # give the background uploader its poll interval: the fresh
+            # staged rows must ship OFF-THREAD (upload spans on the
+            # ingest-upload ring), not via the driver's sync flush.
+            # Outside the batch walls, so not counted as overhead.
+            time.sleep(0.3)
+
+        on_wall, on_n = drain(inject=inject_live)
+        misses = int(
+            sched.compile_plan.stats.get("misses_after_warmup", 0)
+        )
+        doc = RECORDER.export()
+    finally:
+        RECORDER.enable(False)
+        sched.close()
+
+    if off_n != len(wave_b):
+        problems.append(f"untraced drain scheduled {off_n}/{len(wave_b)}")
+    want_on = len(wave_a) + len(wave_live)
+    if on_n != want_on:
+        problems.append(f"traced drain scheduled {on_n}/{want_on}")
+    if misses:
+        problems.append(
+            f"{misses} compile miss(es) after warmup with tracing ON"
+        )
+
+    structural = validate_trace(doc)
+    if structural:
+        problems.append(f"invalid trace: {'; '.join(structural[:5])}")
+    events = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    names = {e["name"] for e in events}
+    missing = [s for s in REQUIRED_SPANS if s not in names]
+    if missing:
+        problems.append(f"stages with NO span recorded: {missing}")
+    threads = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    spanning = [
+        frag for frag in REQUIRED_THREADS
+        if not any(frag in t for t in threads)
+    ]
+    if spanning:
+        problems.append(
+            f"thread roles with NO spans: {spanning} (saw {sorted(threads)})"
+        )
+
+    off_pp = off_wall / max(off_n, 1)
+    on_pp = on_wall / max(on_n, 1)
+    overhead = on_pp / off_pp - 1.0 if off_pp > 0 else 0.0
+    if (on_pp - off_pp) * on_n > TRACE_OVERHEAD_ABS_S and \
+            overhead > TRACE_OVERHEAD_FRAC:
+        problems.append(
+            f"tracing overhead {overhead * 100:.1f}% per pod "
+            f"({on_pp * 1e3:.3f}ms vs {off_pp * 1e3:.3f}ms untraced)"
+        )
+    assert not problems, "; ".join(problems)
+    return {
+        "config": "tiny_trace_smoke",
+        "scheduled": off_n + on_n,
+        "trace_events": len(events),
+        "trace_threads": sorted(threads),
+        "span_names": sorted(names),
+        "overhead_frac": round(overhead, 4),
+        "misses_after_warmup": misses,
+        "phase_split_s": dict(sched.stats),
+        "compile": {"misses_after_warmup": misses},
+    }
 
 
 def main_preempt() -> dict:
@@ -402,6 +767,15 @@ if __name__ == "__main__":
         d = main_preempt()
     elif mode == "ingest":
         d = main_ingest()
+    elif mode == "trace":
+        d = main_trace()
+        print(json.dumps({
+            k: d[k] for k in (
+                "config", "scheduled", "trace_events", "trace_threads",
+                "span_names", "overhead_frac", "misses_after_warmup",
+            )
+        }))
+        sys.exit(0)
     else:
         d = main(sharded=(mode == "sharded"))
     p = d["phase_split_s"]

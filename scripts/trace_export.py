@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Convert / validate flight-recorder traces offline.
+
+Two inputs, auto-detected:
+
+* a RAW rings dump (``FlightRecorder.save_raw()``: ``{"epoch": ...,
+  "rings": [...]}``) — converted to Chrome-trace-event JSON you can load
+  in Perfetto (https://ui.perfetto.dev) or chrome://tracing;
+* an already-exported Chrome-trace document (``{"traceEvents": [...]}``)
+  — passed through (useful with ``--validate`` alone).
+
+``--validate`` runs the structural checks tests/test_obs.py pins (sorted
+ts, matched B/E, non-negative durations) and exits non-zero on problems,
+so a CI step can gate on trace well-formedness.
+
+Usage:
+    python scripts/trace_export.py raw_rings.json -o trace.json
+    python scripts/trace_export.py --validate trace.json
+    python scripts/trace_export.py raw_rings.json -o trace.json --summary
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _summarize(doc: dict) -> str:
+    events = [e for e in doc.get("traceEvents", []) if e.get("ph") != "M"]
+    threads = {
+        e["tid"]: e["args"]["name"]
+        for e in doc.get("traceEvents", [])
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    by_name = Counter(e.get("name", "?") for e in events)
+    wall = Counter()
+    for e in events:
+        wall[e.get("name", "?")] += e.get("dur", 0.0)
+    lines = [
+        f"{len(events)} events across {len(threads)} thread(s): "
+        + ", ".join(sorted(threads.values()))
+    ]
+    for name, n in by_name.most_common():
+        lines.append(f"  {name:<16} x{n:<7} {wall[name] / 1e6:.4f}s total")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("input", help="raw rings dump or Chrome-trace JSON")
+    ap.add_argument("-o", "--output", help="write Chrome-trace JSON here")
+    ap.add_argument(
+        "--validate", action="store_true",
+        help="run structural validation; exit 1 on problems",
+    )
+    ap.add_argument(
+        "--summary", action="store_true",
+        help="print per-span-name counts and total wall",
+    )
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, ".")  # run from a checkout without installing
+    from kubernetes_tpu.obs.export import raw_to_trace, validate_trace
+
+    doc = _load(args.input)
+    if "rings" in doc:  # raw save_raw() dump -> convert
+        doc = raw_to_trace(doc)
+    elif "traceEvents" not in doc:
+        print(
+            f"{args.input}: neither a raw rings dump nor a Chrome trace",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
+        print(f"wrote {len(doc['traceEvents'])} events -> {args.output}")
+
+    if args.summary:
+        print(_summarize(doc))
+
+    if args.validate:
+        problems = validate_trace(doc)
+        if problems:
+            for p in problems:
+                print(f"INVALID: {p}", file=sys.stderr)
+            return 1
+        print(f"valid: {len(doc['traceEvents'])} events")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

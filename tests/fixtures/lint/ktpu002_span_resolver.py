@@ -1,0 +1,35 @@
+"""MUST-FLAG KTPU002 (host-sync): a forcing call inside a span resolver
+that is NOT the sanctioned allowlisted one.
+
+The flight recorder's two-phase device-timing idiom (kubernetes_tpu/obs)
+parks dispatched array handles on the hot path and resolves their
+durations off-thread. The ONE sanctioned resolution point is the
+allowlisted ``Recorder.resolve_pending`` twin below; any other helper
+that blocks on a parked handle re-creates the hot-path sync KTPU004
+exists to forbid — the whole point of parking the handle was to move the
+wait off the dispatch thread, so an un-allowlisted resolver is a
+regression waiting to be inlined back into the driver.
+"""
+
+import time
+
+
+class Recorder:
+    def __init__(self):
+        self._pending = {}
+        self._ring = []
+
+    def eager_resolve(self, token):
+        # <- forcing call in a NON-allowlisted resolver: must flag
+        name, t0, handle_dev, args = self._pending.pop(token)
+        handle_dev.block_until_ready()
+        self._ring.append((name, t0, time.perf_counter() - t0, args))
+
+    def resolve_pending(self):
+        # allowlisted twin of FlightRecorder.resolve_pending: the same
+        # forcing call is sanctioned HERE (and only here) — export/drain
+        # time, never a hot path
+        pending, self._pending = self._pending, {}
+        for name, t0, handle_dev, args in pending.values():
+            handle_dev.block_until_ready()
+            self._ring.append((name, t0, time.perf_counter() - t0, args))

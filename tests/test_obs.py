@@ -1,0 +1,536 @@
+"""Flight-recorder (kubernetes_tpu/obs) coverage: span pairing across
+threads, Chrome-trace export validity, two-phase device spans, ring
+wraparound, the black box, the disabled fast path, per-pod latency
+attribution, Prometheus exposition escaping, and the /readyz warmup gate.
+
+The process-global RECORDER is shared with the package's instrumentation
+sites; every test that arms it restores the disabled state (the
+`recorder_hygiene` fixture) so the rest of the suite keeps the zero-cost
+path.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.obs import (
+    DEVICE_THREAD,
+    FlightRecorder,
+    NOOP_SPAN,
+    RECORDER,
+)
+from kubernetes_tpu.obs.export import raw_to_trace, validate_trace
+
+
+@pytest.fixture
+def recorder_hygiene():
+    yield
+    RECORDER.enable(False)
+    RECORDER.reset()
+
+
+# ---------------------------------------------------------------------------
+# span rings
+# ---------------------------------------------------------------------------
+
+
+def test_span_pairing_across_five_threads():
+    """Every thread writes only its own ring; each begin gets its end
+    (context-manager exit) and the merged export carries one complete
+    event per span plus a thread_name metadata row per thread."""
+    rec = FlightRecorder(enabled=True)
+    n_threads, n_spans = 5, 10
+
+    def worker(i):
+        for j in range(n_spans):
+            with rec.span(f"stage-{i}", j=j):
+                pass
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), name=f"worker-{i}")
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    rings = rec.snapshot_rings()
+    by_name = {name: recs for _, name, recs in rings}
+    assert set(by_name) == {f"worker-{i}" for i in range(n_threads)}
+    for recs in by_name.values():
+        assert len(recs) == n_spans
+        for _name, t0, dur, _args in recs:
+            assert dur >= 0.0
+
+    doc = rec.export()
+    assert validate_trace(doc) == []
+    meta_names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert {f"worker-{i}" for i in range(n_threads)} <= meta_names
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(spans) == n_threads * n_spans
+
+
+def test_chrome_trace_sorted_and_json_round_trips(tmp_path):
+    rec = FlightRecorder(enabled=True)
+    with rec.span("outer", batch=1):
+        with rec.span("inner", pods=32):
+            pass
+    rec.instant("marker", note="x")
+    path = str(tmp_path / "trace.json")
+    doc = rec.export(path)
+    assert validate_trace(doc) == []
+    ts = [e["ts"] for e in doc["traceEvents"] if e.get("ph") != "M"]
+    assert ts == sorted(ts)
+    with open(path) as f:
+        assert validate_trace(json.load(f)) == []
+
+
+def test_raw_dump_converts_offline(tmp_path):
+    """save_raw -> raw_to_trace is the scripts/trace_export.py path."""
+    rec = FlightRecorder(enabled=True)
+    with rec.span("dispatch", pods=4):
+        pass
+    raw_path = str(tmp_path / "raw.json")
+    rec.save_raw(raw_path)
+    with open(raw_path) as f:
+        doc = raw_to_trace(json.load(f))
+    assert validate_trace(doc) == []
+    assert any(e.get("name") == "dispatch" for e in doc["traceEvents"])
+
+
+def test_ring_wraparound_keeps_newest():
+    rec = FlightRecorder(capacity=8, enabled=True)
+    for i in range(20):
+        rec.record(f"s{i}", time.perf_counter())
+    ((tid, name, recs),) = rec.snapshot_rings()
+    assert len(recs) == 8
+    assert [r[0] for r in recs] == [f"s{i}" for i in range(12, 20)]
+    t0s = [r[1] for r in recs]
+    assert t0s == sorted(t0s)
+
+
+def test_span_set_attaches_args_mid_span():
+    rec = FlightRecorder(enabled=True)
+    with rec.span("flush") as sp:
+        sp.set(rows=17)
+    ((_tid, _name, recs),) = rec.snapshot_rings()
+    assert recs[0][3] == {"rows": 17}
+
+
+# ---------------------------------------------------------------------------
+# disabled fast path
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_mode_is_a_shared_noop():
+    rec = FlightRecorder(enabled=False)
+    assert rec.span("x") is NOOP_SPAN
+    assert rec.span("y", a=1) is NOOP_SPAN  # same singleton, no allocation
+    with rec.span("z") as sp:
+        sp.set(rows=1)  # no-op, no error
+    assert rec.device_begin("solve", object()) == 0
+    rec.device_end(0)
+    rec.record("x", time.perf_counter())
+    rec.instant("x")
+    rec.record_cycle({"cycle": 1})
+    assert rec.snapshot_rings() == []  # no ring was ever created
+    assert rec.blackbox_snapshot() == []
+    assert rec.dump_blackbox("nothing") is None
+
+
+def test_global_recorder_disabled_by_default():
+    """The suite (and any un-opted-in production run) must be on the
+    zero-cost path: KTPU_TRACE unset -> RECORDER.enabled False."""
+    if os.environ.get("KTPU_TRACE", "") in ("", "0", "false", "False"):
+        assert RECORDER.enabled is False
+
+
+# ---------------------------------------------------------------------------
+# two-phase device spans
+# ---------------------------------------------------------------------------
+
+
+class _Handle:
+    """Stands in for a dispatched jax.Array: counts forcing calls."""
+
+    def __init__(self):
+        self.forced = 0
+
+    def block_until_ready(self):
+        self.forced += 1
+
+
+def _device_records(rec):
+    for tid, name, recs in rec.snapshot_rings():
+        if name == DEVICE_THREAD:
+            return recs
+    return []
+
+
+def test_device_end_never_forces_the_handle():
+    rec = FlightRecorder(enabled=True)
+    h = _Handle()
+    tok = rec.device_begin("solve", h, pods=32)
+    assert tok > 0
+    rec.device_end(tok)
+    assert h.forced == 0  # phase 2 at the fetch point stamps, not forces
+    recs = _device_records(rec)
+    assert [r[0] for r in recs] == ["solve"]
+    assert rec.pending_count() == 0
+
+
+def test_resolve_pending_blocks_abandoned_handles():
+    rec = FlightRecorder(enabled=True)
+    handles = [_Handle() for _ in range(3)]
+    for i, h in enumerate(handles):
+        rec.device_begin(f"solve-{i}", h)
+    assert rec.pending_count() == 3
+    n = rec.resolve_pending()
+    assert n == 3
+    assert all(h.forced == 1 for h in handles)
+    assert rec.pending_count() == 0
+    assert len(_device_records(rec)) == 3
+
+
+def test_pending_overflow_abandons_oldest(monkeypatch):
+    from kubernetes_tpu.obs import recorder as recorder_mod
+
+    monkeypatch.setattr(recorder_mod, "MAX_PENDING_DEVICE", 4)
+    rec = FlightRecorder(enabled=True)
+    handles = [_Handle() for _ in range(6)]
+    for i, h in enumerate(handles):
+        rec.device_begin(f"d{i}", h)
+    assert rec.pending_count() == 4
+    assert rec.dropped_pending == 2
+    # the two oldest were abandoned: zero duration, flagged, NOT forced
+    # (read the ring directly — snapshot_rings would resolve the rest)
+    abandoned = rec._device_ring.snapshot()
+    assert [r[0] for r in abandoned] == ["d0", "d1"]
+    for _name, _t0, dur, args in abandoned:
+        assert dur == 0.0 and args["abandoned"] is True
+    assert handles[0].forced == 0 and handles[1].forced == 0
+    # export-time resolution picks up the still-parked four
+    recs = _device_records(rec)
+    assert [r[0] for r in recs] == [f"d{i}" for i in range(6)]
+    assert all(h.forced == 1 for h in handles[2:])
+
+
+# ---------------------------------------------------------------------------
+# black box
+# ---------------------------------------------------------------------------
+
+
+def test_blackbox_ring_is_bounded():
+    rec = FlightRecorder(enabled=True, blackbox_capacity=4)
+    for i in range(10):
+        rec.record_cycle({"cycle": i})
+    snap = rec.blackbox_snapshot()
+    assert [r["cycle"] for r in snap] == [6, 7, 8, 9]
+
+
+def test_blackbox_dump_writes_artifact(tmp_path):
+    rec = FlightRecorder(enabled=True)
+    rec.record_cycle({"cycle": 1, "scheduled": 32})
+    path = rec.dump_blackbox("unit-test", str(tmp_path / "bb.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "unit-test"
+    assert doc["cycles"][0]["scheduled"] == 32
+
+
+def test_blackbox_dump_on_driver_exception(tmp_path, monkeypatch, recorder_hygiene):
+    """An exception escaping a traced schedule_batch dumps the last N
+    cycle records before propagating — the 'invisible mid-drain' class
+    of bug becomes a log artifact."""
+    pytest.importorskip("jax")
+    from kubernetes_tpu.models.generators import make_node, make_pod
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    monkeypatch.setenv("KTPU_TRACE_DIR", str(tmp_path))
+    cache = SchedulerCache()
+    for i in range(2):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000, mem=4 * 2**30))
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(), binder=Binder(),
+        deterministic=True, trace=True,
+    )
+    try:
+        for i in range(4):
+            sched.queue.add(make_pod(f"p{i}", cpu_milli=100, mem=2**20))
+        res = sched.schedule_batch()  # a real cycle -> a black-box record
+        assert res.scheduled == 4
+        assert sched.obs.blackbox_snapshot()
+
+        def boom(max_pods=None):
+            raise RuntimeError("injected driver failure")
+
+        monkeypatch.setattr(sched, "_schedule_batch", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            sched.schedule_batch()
+    finally:
+        sched.close()
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("ktpu_blackbox_")]
+    assert len(dumps) == 1 and "driver-exception" in dumps[0]
+    with open(tmp_path / dumps[0]) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "driver-exception"
+    assert doc["cycles"][0]["scheduled"] == 4
+
+
+def test_blackbox_dump_on_lock_order_violation(tmp_path, monkeypatch,
+                                               recorder_hygiene):
+    """A LockOrderViolation dumps the black box before raising — same
+    contract as the driver-exception path, fired from the lock-order
+    harness's assert_acyclic."""
+    monkeypatch.setenv("KTPU_LOCK_AUDIT", "1")
+    monkeypatch.setenv("KTPU_TRACE_DIR", str(tmp_path))
+    from kubernetes_tpu.analysis.lockorder import (
+        REGISTRY,
+        LockOrderViolation,
+        audited_lock,
+    )
+
+    RECORDER.reset()
+    RECORDER.enable(True)
+    RECORDER.record_cycle({"cycle": 7, "scheduled": 12})
+    REGISTRY.reset()
+    try:
+        a, b = audited_lock("obsLockA"), audited_lock("obsLockB")
+
+        def nest(outer, inner):
+            with outer:
+                with inner:
+                    pass
+
+        for outer, inner in ((a, b), (b, a)):
+            t = threading.Thread(target=nest, args=(outer, inner))
+            t.start()
+            t.join()
+        with pytest.raises(LockOrderViolation):
+            REGISTRY.assert_acyclic()
+    finally:
+        REGISTRY.reset()
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("ktpu_blackbox_")]
+    assert len(dumps) == 1 and "lock-order-violation" in dumps[0]
+    with open(tmp_path / dumps[0]) as f:
+        doc = json.load(f)
+    assert doc["cycles"][0]["cycle"] == 7
+
+
+# ---------------------------------------------------------------------------
+# per-pod latency attribution
+# ---------------------------------------------------------------------------
+
+
+def test_attribution_sums_to_e2e():
+    """queue_incoming_wait (enqueue -> pop) + scheduling_attempt_duration
+    (pop -> bound) must reassemble pod_scheduling_duration (enqueue ->
+    bound) — the decomposition bench's attribution block quotes. Deltas
+    against the module histograms so a shared pytest process stays
+    clean."""
+    pytest.importorskip("jax")
+    from kubernetes_tpu.metrics import metrics as M
+    from kubernetes_tpu.models.generators import make_node, make_pod
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    def snap():
+        return (
+            M.queue_incoming_wait.sum(),
+            M.scheduling_attempt_duration.sum("scheduled")
+            + M.scheduling_attempt_duration.sum("unschedulable"),
+            M.pod_scheduling_duration.sum(),
+            M.pod_scheduling_duration.count(),
+        )
+
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu_milli=8000, mem=8 * 2**30))
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(), binder=Binder(),
+        deterministic=True,
+    )
+    n_pods = 16
+    try:
+        wait0, attempt0, e2e0, cnt0 = snap()
+        for i in range(n_pods):
+            sched.queue.add(make_pod(f"p{i}", cpu_milli=100, mem=2**20))
+        res = sched.schedule_batch()
+        sched.wait_for_binds()
+        assert res.scheduled == n_pods
+    finally:
+        sched.close()
+    wait, attempt, e2e, cnt = snap()
+    d_wait, d_attempt, d_e2e = wait - wait0, attempt - attempt0, e2e - e2e0
+    assert cnt - cnt0 == n_pods
+    assert d_e2e > 0
+    # single attempt per pod: wait + attempt ≈ e2e (the observation
+    # points are microseconds apart on the same clock; the drain itself
+    # is the signal, so 5% + a small absolute floor is strict enough)
+    assert abs(d_wait + d_attempt - d_e2e) < max(0.05 * d_e2e, 0.05), (
+        d_wait, d_attempt, d_e2e,
+    )
+
+
+def test_queue_stamps_enqueue_and_pop():
+    from kubernetes_tpu.api.types import Container, Pod, Quantity
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    clock = [100.0]
+    q = PriorityQueue(now=lambda: clock[0])
+    pod = Pod(name="a", namespace="x", containers=[Container(name="c")])
+    q.add(pod)
+    info = q.peek_batch(1)[0]
+    assert info.enqueue_ts == 100.0
+    clock[0] = 103.0
+    (popped,) = q.pop_batch(1)
+    assert popped.pop_ts == 103.0
+    clock[0] = 104.5
+    assert q.attempt_age(popped) == pytest.approx(1.5)
+    # re-add of the SAME key (requeue path) keeps the first-admission
+    # stamp — the e2e anchor survives round trips
+    q.add(pod)
+    info2 = q.peek_batch(1)[0]
+    assert info2.enqueue_ts == 100.0
+    assert info2.timestamp == 104.5
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition escaping (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_label_value_escaping_pins_text_format():
+    import re
+
+    from kubernetes_tpu.metrics.registry import Counter, Registry
+
+    reg = Registry()
+    c = reg.register(Counter("evil_total", "counts evil\nthings \\ ok",
+                             label_names=("pod",)))
+    c.inc('he said "hi"\\here\nand left')
+    text = reg.expose_text()
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith("evil_total{")
+    )
+    assert line == (
+        'evil_total{pod="he said \\"hi\\"\\\\here\\nand left"} 1.0'
+    )
+    # HELP escapes backslash + newline (quotes legal there)
+    help_line = next(l for l in text.splitlines() if l.startswith("# HELP"))
+    assert help_line == "# HELP evil_total counts evil\\nthings \\\\ ok"
+    # the whole exposition stays machine-parseable line by line
+    sample = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*")*\})?'
+        r' (-?[0-9.eE+-]+|\+Inf|-Inf|NaN)$'
+    )
+    for l in text.splitlines():
+        if l and not l.startswith("#"):
+            assert sample.match(l), l
+
+
+# ---------------------------------------------------------------------------
+# /readyz warmup gate (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_readyz_gates_on_warmup_healthz_does_not():
+    from kubernetes_tpu.metrics.serving import MetricsServer
+
+    ready = {"v": False}
+    srv = MetricsServer(port=0, ready_fn=lambda: ready["v"]).start()
+    try:
+        assert _get(f"{srv.url}/healthz") == 200  # alive the whole time
+        assert _get(f"{srv.url}/livez") == 200
+        assert _get(f"{srv.url}/readyz") == 503  # cold: not ready
+        ready["v"] = True
+        assert _get(f"{srv.url}/readyz") == 200  # warmed
+        assert _get(f"{srv.url}/metrics") == 200
+    finally:
+        srv.stop()
+
+
+def test_scheduler_ready_property_tracks_warmup():
+    pytest.importorskip("jax")
+    from kubernetes_tpu.models.generators import make_node, make_pod
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=4000, mem=4 * 2**30))
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(), binder=Binder(),
+        deterministic=True,
+    )
+    try:
+        assert sched.ready is False  # cold: /readyz must answer 503
+        sched.queue.add(make_pod("p0", cpu_milli=100, mem=2**20))
+        sched.warmup()
+        assert sched.ready is True
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler.dump_trace API
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_dump_trace_exports_valid_json(tmp_path, recorder_hygiene):
+    pytest.importorskip("jax")
+    from kubernetes_tpu.models.generators import make_node, make_pod
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    RECORDER.reset()
+    cache = SchedulerCache()
+    for i in range(2):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000, mem=4 * 2**30))
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(), binder=Binder(),
+        deterministic=True, trace=True,
+    )
+    try:
+        for i in range(8):
+            sched.queue.add(make_pod(f"p{i}", cpu_milli=100, mem=2**20))
+        res = sched.schedule_batch()
+        sched.wait_for_binds()
+        assert res.scheduled == 8
+        path = sched.dump_trace(str(tmp_path / "drain.json"))
+    finally:
+        sched.close()
+    with open(path) as f:
+        doc = json.load(f)
+    assert validate_trace(doc) == []
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") != "M"}
+    # the core driver stages of even a cold un-warmed single batch
+    for stage in ("cycle", "sync", "dispatch", "fetch", "commit",
+                  "enqueue", "stage-encode"):
+        assert stage in names, (stage, sorted(names))
+    assert RECORDER.pending_count() == 0  # export resolved parked spans

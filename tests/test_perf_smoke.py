@@ -96,6 +96,25 @@ def test_perf_smoke_preemption_no_midrain_compiles(tmp_path, monkeypatch):
     assert detail["scheduled"] == 24
 
 
+def test_perf_smoke_trace_mode(tmp_path, monkeypatch):
+    """Flight-recorder acceptance, tier-1-fast: a traced smoke drain
+    exports a valid Chrome-trace timeline with spans from the informer,
+    uploader, driver, commit-apply, bind, and device threads for every
+    pipeline stage; `misses_after_warmup == 0` holds with tracing ON;
+    the traced drain stays within the overhead bound of the untraced
+    one (disabled path is a no-op)."""
+    monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan_tr"))
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main_trace()  # raises AssertionError on regression
+    assert detail["misses_after_warmup"] == 0
+    assert detail["trace_events"] > 0
+    for stage in perf_smoke.REQUIRED_SPANS:
+        assert stage in detail["span_names"], stage
+
+
 def test_perf_smoke_ingest_plane(tmp_path, monkeypatch):
     """Pod-ingest-plane acceptance, tier-1-fast: on a quiet drain every
     dispatch takes the index-only path (coverage > 0, zero stale-row

@@ -41,7 +41,10 @@ def fixture_config() -> AnalysisConfig:
     return AnalysisConfig(
         jit_allowed_prefixes=(),
         surface_prefixes=("tests/fixtures/lint/",),
-        sync_allowlist=("Mirror.device_bank_divergence",),
+        sync_allowlist=(
+            "Mirror.device_bank_divergence",
+            "Recorder.resolve_pending",
+        ),
     )
 
 
@@ -88,6 +91,29 @@ def test_ktpu002_flags_host_sync_on_resident():
     assert ("KTPU002", "Mirror.bad_probe") in scopes
     assert ("KTPU002", "Mirror.device_bank_divergence") not in scopes
     assert ("KTPU002", "Mirror.annotated_probe") not in scopes
+
+
+def test_ktpu002_flags_forcing_span_resolver():
+    """The flight recorder's two-phase device-timing idiom: blocking on a
+    parked handle in a NON-allowlisted resolver flags; the sanctioned
+    `resolve_pending` twin (sync_allowlist) does not."""
+    got = scan_fixture("ktpu002_span_resolver.py")
+    scopes = rules_by_scope(got)
+    assert ("KTPU002", "Recorder.eager_resolve") in scopes
+    assert ("KTPU002", "Recorder.resolve_pending") not in scopes
+
+
+def test_ktpu002_obs_resolver_allowlisted_in_tree():
+    """The REAL recorder module is a resident-surface module and its
+    resolver is in the repo allowlist — the tree scan must be clean on
+    obs/ (a forcing call added anywhere else in obs/ would flag)."""
+    cfg = repo_config()
+    assert any("kubernetes_tpu/obs/" in p for p in cfg.surface_prefixes)
+    assert "FlightRecorder.resolve_pending" in cfg.sync_allowlist
+    path = os.path.join(_REPO, "kubernetes_tpu", "obs", "recorder.py")
+    mod = load_module(path, _REPO)
+    got = run_checkers(mod, cfg, ALL_CHECKERS)
+    assert not [v.render() for v in got if v.rule in ("KTPU002", "KTPU004")]
 
 
 def test_ktpu003_flags_unlocked_guarded_access():
