@@ -744,6 +744,11 @@ def run_config(name, build, opts=None, inspect=None):
             "ingest_index": sched.stats.get("ingest_index_batches", 0),
             "ingest_legacy": sched.stats.get("ingest_legacy_batches", 0),
             "ingest_stale_rows": sched.stats.get("ingest_stale_rows", 0),
+            # term-bank plane: index-only vs host-compiled term tables
+            # (per dispatch, like the ingest counters) + staleness events
+            "term_index": sched.stats.get("term_index_batches", 0),
+            "term_legacy": sched.stats.get("term_legacy_batches", 0),
+            "term_stale_rows": sched.stats.get("term_stale_rows", 0),
         },
         # multi-chip: shard count + per-shard bank traffic (node-major
         # kinds split across shards; fold control replicates — the split

@@ -652,6 +652,7 @@ def repo_config() -> AnalysisConfig:
         surface_prefixes=(
             "kubernetes_tpu/state/cache.py",
             "kubernetes_tpu/ingest/",
+            "kubernetes_tpu/terms_plane/",
             "kubernetes_tpu/commit/",
             "kubernetes_tpu/scheduler/driver.py",
             "kubernetes_tpu/parallel/sharded.py",

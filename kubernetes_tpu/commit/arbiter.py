@@ -159,7 +159,7 @@ def arbitrate(
     na: Arrays,   # NodeBank arrays (same dict the solve consumed)
     pa: Arrays,   # PodBatch arrays (unique-spec rows)
     ea: Arrays,   # SigBank arrays (existing-pod signatures, spread counts)
-    ta: Arrays,   # batch TermBank arrays
+    ta: Arrays,   # batch TermBank arrays (host-compiled or term-plane gathered)
     ids: Arrays,  # interned constants (filters.make_ids)
     assign: jnp.ndarray,  # [B] the solve's node row per pod (-1 = no fit)
     pb: Arrays,   # per-pod axis: sig/valid/priority [B]

@@ -76,6 +76,15 @@ KIND_PATCH = "patch"
 # Both call sites bucket their own axes, so specs pass canonicalize
 # unchanged (same contract as KIND_PREEMPT/KIND_PATCH).
 KIND_STAGE = "stage"
+# term-bank plane (kubernetes_tpu/terms_plane): the device-resident term
+# slab's programs — same two-variant shape as KIND_STAGE:
+#   "gather"     — the index-only term dispatch prologue (t = term-index
+#     vector rung, s = slab row capacity);
+#   "patch|..."  — the term uploader's dirty-row scatter (b = row rung
+#     from terms_plane.bank.TERM_RUNGS, s = slab capacity, structure in
+#     config_repr).
+# Call sites bucket their own axes; specs pass canonicalize unchanged.
+KIND_TERM = "terms"
 
 
 @dataclass(frozen=True)
@@ -190,14 +199,15 @@ class ShapeLadder:
         """Round every padded axis up to its rung; u never exceeds b (a
         batch cannot hold more unique specs than pods).
 
-        KIND_PREEMPT, KIND_PATCH, and KIND_STAGE specs pass through
-        UNCHANGED: those call sites bucket their own axes (minimum 8
-        preemptor/victim rungs; the mirror's PATCH_RUNGS; the ingest
-        plane's STAGE_RUNGS and monotone u-rung) and the spec must name
-        the EXACT executed shapes — re-rounding here with this ladder's
-        minimum would collapse distinct kernel signatures onto one key
-        and report a mid-drain compile as a plan hit."""
-        if spec.kind in (KIND_PREEMPT, KIND_PATCH, KIND_STAGE):
+        KIND_PREEMPT, KIND_PATCH, KIND_STAGE, and KIND_TERM specs pass
+        through UNCHANGED: those call sites bucket their own axes
+        (minimum 8 preemptor/victim rungs; the mirror's PATCH_RUNGS; the
+        ingest plane's STAGE_RUNGS and monotone u-rung; the term plane's
+        TERM_RUNGS and monotone t-rung) and the spec must name the EXACT
+        executed shapes — re-rounding here with this ladder's minimum
+        would collapse distinct kernel signatures onto one key and
+        report a mid-drain compile as a plan hit."""
+        if spec.kind in (KIND_PREEMPT, KIND_PATCH, KIND_STAGE, KIND_TERM):
             return spec
         m = self.minimum
         b = pow2_bucket(spec.b, m) if spec.b else 0

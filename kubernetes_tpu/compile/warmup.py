@@ -36,6 +36,7 @@ from .ladder import (
     KIND_PREEMPT,
     KIND_SOLVE_GANG,
     KIND_STAGE,
+    KIND_TERM,
     SolveSpec,
 )
 from .plan import CompilePlan, SOURCE_PERSISTED, SOURCE_WARMUP
@@ -180,6 +181,8 @@ class WarmupService:
             return self._warm_fold(spec)  # no SolveConfig static
         if spec.kind == KIND_STAGE:
             return self._warm_stage(spec)  # no SolveConfig static
+        if spec.kind == KIND_TERM:
+            return self._warm_term(spec)  # no SolveConfig static
         if spec.config_repr != repr(self.sched.solve_config):
             return None  # persisted ladder from a differently-policied run
         if not (spec.b and spec.u and spec.t and spec.n and spec.v):
@@ -463,6 +466,42 @@ class WarmupService:
         fb = np.zeros(spec.u, bool)
         t0 = time.perf_counter()
         out = gather_stage(bank, idx, keep, empty, fb)
+        jax.block_until_ready(out["valid"])
+        return time.perf_counter() - t0
+
+    def _warm_term(self, spec: SolveSpec) -> Optional[float]:
+        """terms_plane/gather.gather_terms at the spec's shapes (t = term
+        index rung, s = slab row capacity). Synthetic slab — a TermBank
+        at the spec's capacity, placed through the mirror's
+        `_to_dev(node_major=False)` recipe exactly like TermBankDevice
+        uploads the live one. Row-scatter ("patch|...") specs warm at
+        LIVE shapes only, via TermBankDevice.warm (the KIND_PATCH
+        contract): a persisted one from a previous shape is skipped,
+        undeclared for persisted sources by the caller."""
+        if not spec.config_repr.startswith("gather"):
+            return None
+        if not (spec.t and spec.s):
+            return None
+        import jax
+        import numpy as np
+
+        from ..state.terms import TermBank
+        from ..terms_plane.gather import gather_terms
+
+        mirror = self.sched.mirror
+        place = lambda v: mirror._to_dev(v, False)  # noqa: E731
+        bank = {
+            k: place(v)
+            for k, v in TermBank(mirror.vocab, spec.s).arrays().items()
+        }
+        empty = {
+            k: place(v) for k, v in TermBank(mirror.vocab, 1).arrays().items()
+        }
+        idx = np.zeros(spec.t, np.int32)
+        owner = np.zeros(spec.t, np.int32)
+        keep = np.zeros(spec.t, bool)
+        t0 = time.perf_counter()
+        out = gather_terms(bank, idx, owner, keep, empty)
         jax.block_until_ready(out["valid"])
         return time.perf_counter() - t0
 

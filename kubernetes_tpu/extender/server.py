@@ -77,6 +77,16 @@ class ExtenderServer:
         self.rtcr = rtcr
         self._mirror: Optional[TensorMirror] = None
         self._mirror_lock = audited_lock("extender-mirror")
+        # per-pod-spec encode memo for /filter: repeated requests for
+        # same-spec pods (every replica of a controller, the common
+        # extender traffic) reuse one PodBatch row + compiled TermBank
+        # instead of re-encoding per HTTP request — the term plane's
+        # interning idea at this seam. Keyed by spec_key; entries are
+        # immutable host arrays; invalidated wholesale when the vocab's
+        # encoding widths grow (the arrays would be the wrong shape).
+        self._enc_cache: Dict = {}  # ktpu: guarded-by(self._mirror_lock)
+        self._enc_cache_widths = None  # ktpu: guarded-by(self._mirror_lock)
+        self.filter_encode_cache = {"hits": 0, "misses": 0}  # ktpu: guarded-by(self._mirror_lock)
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
 
@@ -114,7 +124,7 @@ class ExtenderServer:
 
             from ..ops import filters as F
             from ..ops.pipeline import SolveConfig, filter_mask
-            from ..state.tensors import PodBatch, _bucket
+            from ..state.tensors import PodBatch, _bucket, spec_key
             from ..state.terms import compile_batch_terms
 
             with self._mirror_lock:
@@ -127,12 +137,39 @@ class ExtenderServer:
                 # dispatch cost
                 if bool((mirror.nodes.fallback & mirror.nodes.valid).any()):
                     return None
-                batch = PodBatch(mirror.vocab, _bucket(1))
-                batch.set_pod(0, pod)
-                if batch.fallback[0]:
-                    return None
-                tb, aux = compile_batch_terms(mirror.vocab, [pod], b_capacity=batch.capacity)
-                if tb.overflow_owners:
+                widths = (
+                    mirror.vocab.config.key_slots,
+                    mirror.vocab.config.resource_slots,
+                )
+                if widths != self._enc_cache_widths:
+                    # a vocab width growth makes every cached array the
+                    # wrong shape — drop the memo wholesale
+                    self._enc_cache.clear()
+                    self._enc_cache_widths = widths
+                key = spec_key(pod)
+                cached = self._enc_cache.get(key)
+                if cached is None:
+                    self.filter_encode_cache["misses"] += 1
+                    batch = PodBatch(mirror.vocab, _bucket(1))
+                    batch.set_pod(0, pod)
+                    tb, aux = compile_batch_terms(
+                        mirror.vocab, [pod], b_capacity=batch.capacity
+                    )
+                    cached = (
+                        batch.arrays(), bool(batch.fallback[0]),
+                        tb.arrays(), aux, bool(tb.overflow_owners),
+                    )
+                    if len(self._enc_cache) >= 1024:
+                        self._enc_cache.pop(next(iter(self._enc_cache)))
+                    self._enc_cache[key] = cached
+                else:
+                    self.filter_encode_cache["hits"] += 1
+                    # LRU refresh: re-insert at the back so a hot spec
+                    # (one controller's replicas dominating traffic)
+                    # cannot be the first evicted just for being old
+                    self._enc_cache[key] = self._enc_cache.pop(key)
+                pa_host, pod_fallback, ta_host, aux, term_overflow = cached
+                if pod_fallback or term_overflow:
                     return None
                 if mirror.pats.overflow_rows:
                     return None
@@ -140,8 +177,8 @@ class ExtenderServer:
                 # incremental device-resident banks: only dirty rows cross
                 # the wire (state/cache.py device_arrays)
                 na, ea, xa = mirror.device_arrays()
-                pa = dev(batch.arrays())
-                ta = dev(tb.arrays())
+                pa = dev(pa_host)
+                ta = dev(ta_host)
                 au = dev(aux)
                 ids = F.make_ids(mirror.vocab)
                 cfg = (

@@ -40,11 +40,11 @@ STAGE_RUNGS = (16, 64, 256)
 _STAGE_SCATTER = None
 
 
-def _stage_rung(n: int) -> int:
-    for r in STAGE_RUNGS:
+def _stage_rung(n: int, rungs=STAGE_RUNGS) -> int:
+    for r in rungs:
         if n <= r:
             return r
-    return STAGE_RUNGS[-1]
+    return rungs[-1]
 
 
 # ktpu: admitted(KIND_STAGE) every dispatch goes through _scatter_rows,
@@ -75,7 +75,22 @@ class StageBank:
     dirty drain) so the driver's covered-dispatch prologue — validate rows,
     flush, capture gather arguments — is atomic against admissions and
     slab rebuilds.
+
+    The uploader machinery (full-upload-then-dirty-rows, chunked plan-
+    admitted scatters, off-thread drain, synthetic re-warm after slab
+    growth) is slab-agnostic: any stage exposing `batch` (an encoder with
+    .arrays()), `empty_rows`, `_lock`, `dirty_rows`, `generation`,
+    `capacity`, and an `on_dirty` hook can twin through a subclass — the
+    term-bank plane (kubernetes_tpu/terms_plane/bank.py) does exactly
+    that, overriding only the class attrs below and the two spec
+    builders (`_patch_spec`, `gather_spec`).
     """
+
+    #: worker-thread name, host→device ledger kind, and scatter rungs —
+    #: the subclass knobs (terms_plane.bank overrides all three)
+    THREAD_NAME = "ingest-upload"
+    LEDGER_KIND = "stage"
+    RUNGS = STAGE_RUNGS
 
     def __init__(
         self,
@@ -111,6 +126,9 @@ class StageBank:
 
     # -- placement -----------------------------------------------------------
 
+    def _rung(self, n: int) -> int:
+        return _stage_rung(n, self.RUNGS)
+
     def _to_dev(self, v):
         if self._place is not None:
             return self._place(v)
@@ -132,7 +150,10 @@ class StageBank:
                 self._empty_dev = {
                     k: self._to_dev(v) for k, v in stage.empty_rows.items()
                 }
-                self._ship("stage", sum(np.asarray(v).nbytes for v in host.values()))
+                self._ship(
+                    self.LEDGER_KIND,
+                    sum(np.asarray(v).nbytes for v in host.values()),
+                )
                 self.stats["full_uploads"] += 1
                 stage.dirty_rows.clear()
                 self._dev_generation = stage.generation
@@ -170,7 +191,7 @@ class StageBank:
 
         scatter = _scatter_fn()
         cap = next(iter(host.values())).shape[0]
-        rb = min(_stage_rung(len(rows)), cap)
+        rb = min(self._rung(len(rows)), cap)
         plan = self.compile_plan
         known = True
         if plan is not None:
@@ -188,7 +209,7 @@ class StageBank:
             idx = np.asarray(padded, np.int32)
             updates = {k: np.ascontiguousarray(h[idx]) for k, h in host.items()}
             self._ship(
-                "warm" if warm else "stage",
+                "warm" if warm else self.LEDGER_KIND,
                 idx.nbytes + sum(u.nbytes for u in updates.values()),
             )
             if first:
@@ -217,7 +238,7 @@ class StageBank:
             return
         self._stop.clear()
         self._worker = threading.Thread(
-            target=self._drain, name="ingest-upload", daemon=True
+            target=self._drain, name=self.THREAD_NAME, daemon=True
         )
         self._worker.start()
 
@@ -263,7 +284,7 @@ class StageBank:
         dev = {k: self._to_dev(v) for k, v in host.items()}
         cap = next(iter(host.values())).shape[0]
         seen = set()
-        for rung in STAGE_RUNGS:
+        for rung in self.RUNGS:
             rb = min(rung, cap)
             if rb in seen:
                 continue
@@ -311,7 +332,7 @@ class StageBank:
             self._flush_locked(sync=True)
             host = self.stage.batch.arrays()
             seen = set()
-            for rung in STAGE_RUNGS:
+            for rung in self.RUNGS:
                 rb = min(rung, self.stage.capacity)
                 if rb in seen:
                     continue

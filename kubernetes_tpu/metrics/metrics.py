@@ -181,7 +181,8 @@ mirror_bytes_shipped = registry.register(Counter(
     "Host-to-device bank bytes shipped by the tensor mirror, by kind "
     "(full = whole-bank upload, rows = dirty node-row scatter, usage = "
     "usage-column scatter, fold = device-fold control data, warm = "
-    "warmup's no-op scatter pre-compiles)",
+    "warmup's no-op scatter pre-compiles, pods/terms = per-dispatch "
+    "pod/term payloads, stage/term_bank = staged-slab uploads)",
     label_names=("kind",),
 ))
 fold_batches = registry.register(Counter(
@@ -200,6 +201,23 @@ ingest_batches = registry.register(Counter(
     "resident staged bank gather, legacy = host-built upload with the "
     "plane on, off = ingest plane disabled)",
     label_names=("path",),
+))
+# term-bank plane (kubernetes_tpu/terms_plane): which term-table
+# transport a dispatch used — the TermBank twin of ingest_batches.
+# `terms` joins the mirror_bytes_shipped kind set: the full padded term
+# table on the legacy path vs KB-scale index/owner vectors covered.
+term_batches = registry.register(Counter(
+    "scheduler_term_batches_total",
+    "Solve dispatches by term-table transport path (index = device-"
+    "resident term bank gather, legacy = host-compiled TermBank upload "
+    "with the plane on, off = term plane disabled)",
+    label_names=("path",),
+))
+term_restage = registry.register(Counter(
+    "scheduler_term_restage_total",
+    "Stale interned term entries re-staged at dispatch time (pod "
+    "updated/deleted between enqueue and pop, spreading-selector drift, "
+    "or a term-slab rebuild)",
 ))
 # multi-chip series (kubernetes_tpu/parallel): a mesh-configured driver
 # that cannot shard a batch (node bucket stops dividing the shard count

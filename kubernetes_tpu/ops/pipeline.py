@@ -223,7 +223,9 @@ def solve_pipeline(
     na: Arrays,  # NodeBank arrays
     pa: Arrays,  # PodBatch arrays (one row per unique pod spec)
     ea: Arrays,  # SigBank arrays (existing-pod label signatures + per-node counts)
-    ta: Arrays,  # batch TermBank arrays
+    ta: Arrays,  # batch TermBank arrays (host-compiled, or gathered on
+    # device from the resident term bank — terms_plane/gather; the two
+    # transports are bit-identical by construction)
     xa: Arrays,  # existing-pods TermBank arrays
     au: Arrays,  # compile_batch_terms aux
     ids: Arrays,  # interned constants (filters.make_ids)

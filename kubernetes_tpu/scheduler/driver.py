@@ -62,7 +62,7 @@ from ..oracle.predicates import (
 from ..state.cache import SchedulerCache, TensorMirror
 from ..state.queue import PodInfo, PriorityQueue
 from ..state.tensors import KeySlotOverflow, PodBatch, _bucket, spec_key
-from ..state.terms import compile_batch_terms
+from ..state.terms import compile_batch_terms, count_batch_terms
 from ..metrics import metrics as M
 from ..obs import RECORDER as OBS
 from ..utils.trace import Trace
@@ -215,10 +215,14 @@ def pod_group_min_available(pod: Pod) -> int:
         return 0
 
 
-def _present_term_kinds(tb, etb, aux) -> frozenset:
-    """Host-side scan of the compiled term banks → the jit-static kind set
-    mask_and_score gates its topology kernels on. Exact: a kind absent here
-    means the corresponding kernel part would compute its identity."""
+def _term_kind_names(present, any_sel_spread: bool, etb) -> frozenset:
+    """(batch term-kind ints, sel-spread flag, existing-pods bank) → the
+    jit-static kind set mask_and_score gates its topology kernels on.
+    Exact: a kind absent here means the corresponding kernel part would
+    compute its identity. The batch half takes the PRESENT kind ints
+    directly so both term transports share it — the legacy path scans the
+    compiled bank (_present_term_kinds), the covered index path unions
+    the interned entries' cached kind sets (no bank to scan host-side)."""
     from ..state.terms import (
         AFF_PREF,
         AFF_REQ,
@@ -230,7 +234,6 @@ def _present_term_kinds(tb, etb, aux) -> frozenset:
     )
 
     kinds = set()
-    present = set(np.unique(tb.kind[tb.valid]))
     if SPREAD_HARD in present:
         kinds.add("spread_hard")
     if SPREAD_SOFT in present:
@@ -241,14 +244,21 @@ def _present_term_kinds(tb, etb, aux) -> frozenset:
         kinds.add("anti_req")
     if AFF_PREF in present or ANTI_PREF in present:
         kinds.add("pref")
-    if SEL_SPREAD in present or bool(np.any(aux["n_sel_spread"] > 0)):
+    if SEL_SPREAD in present or any_sel_spread:
         kinds.add("sel_spread")
-    et_present = set(np.unique(etb.kind[etb.valid]))
+    et_present = set(np.unique(etb.kind[etb.valid]).tolist())
     if ANTI_REQ in et_present:
         kinds.add("et_anti")
     if et_present & {AFF_REQ, AFF_PREF, ANTI_PREF}:
         kinds.add("et_score")
     return frozenset(kinds)
+
+
+def _present_term_kinds(tb, etb, aux) -> frozenset:
+    """Host-side scan of the compiled term banks (the legacy transport's
+    half of _term_kind_names)."""
+    present = set(np.unique(tb.kind[tb.valid]).tolist())
+    return _term_kind_names(present, bool(np.any(aux["n_sel_spread"] > 0)), etb)
 
 
 class _BatchConflictIndex:
@@ -516,6 +526,7 @@ class Scheduler:
         commit_plane: bool = True,
         fold_plane: bool = True,
         ingest_plane: bool = True,
+        term_plane: bool = True,
         trace: Optional[bool] = None,
     ):
         self.cache = cache or SchedulerCache()
@@ -677,6 +688,31 @@ class Scheduler:
             )
             self.stage_bank.compile_plan = self.compile_plan
             self.queue.attach_stage(self.stage)
+        # term-bank plane (kubernetes_tpu/terms_plane): the ingest move
+        # applied to topology-coupled structure — each pod's spread/
+        # affinity/anti-affinity terms compile ONCE at admission into a
+        # content-interned slab with a device-resident twin; covered
+        # dispatches gather the per-batch TermBank union from int32
+        # index/owner vectors instead of rebuilding it host-side
+        # (compile_batch_terms) per dispatch. Transport-only — the
+        # gathered table is bit-identical to the host-built one by
+        # construction. KTPU_TERM_PLANE=0 kill switch.
+        self.term_plane = term_plane and _os.environ.get(
+            "KTPU_TERM_PLANE", "1"
+        ) != "0"
+        self.tstage = None
+        self.term_bank = None
+        if self.term_plane:
+            from ..terms_plane import TermBankDevice, TermStage
+
+            self.tstage = TermStage(self.mirror.vocab)
+            self.term_bank = TermBankDevice(
+                self.tstage,
+                place_fn=lambda v: self.mirror._to_dev(v, False),
+                ship_fn=self.mirror._ship,
+            )
+            self.term_bank.compile_plan = self.compile_plan
+            self.queue.attach_term_stage(self.tstage)
         self._commit_pipe = CommitPipeline()
         self._columnar = ColumnarApply(self.cache, self.queue)
         # defer-to-next-batch escalation: a pod deferred this many times
@@ -714,6 +750,11 @@ class Scheduler:
         """Install the getSelectors equivalent (services/RC/RS/SS listers,
         selector_spreading.go getSelectors) used for SelectorSpread scoring."""
         self._spread_selectors_fn = fn
+        if self.tstage is not None:
+            # the term slab interns (spec, selectors) pairs — admission
+            # must consult the same listers the dispatch dedup does, or
+            # every entry would be stale by key mismatch
+            self.tstage.selectors_fn = fn
 
     # -- observability (kubernetes_tpu/obs) ----------------------------------
 
@@ -744,6 +785,9 @@ class Scheduler:
             "ingest_index": s.get("ingest_index_batches", 0),
             "ingest_legacy": s.get("ingest_legacy_batches", 0),
             "ingest_stale": s.get("ingest_stale_rows", 0),
+            "term_index": s.get("term_index_batches", 0),
+            "term_legacy": s.get("term_legacy_batches", 0),
+            "term_stale": s.get("term_stale_rows", 0),
             "sharded_fallbacks": s.get("sharded_fallbacks", 0),
             "spec_hits": s.get("spec_hits", 0),
             "spec_misses": s.get("spec_misses", 0),
@@ -940,6 +984,12 @@ class Scheduler:
             and spec.kind == KIND_SOLVE
         ):
             specs = specs + self._stage_growth_specs()
+        if (
+            self.term_plane
+            and self.term_bank is not None
+            and spec.kind == KIND_SOLVE
+        ):
+            specs = specs + self._term_growth_specs()
         # with the fold plane on, the resident bank buffers get DONATED
         # (folds + row patches): a background warm holding this dispatch's
         # snapshot would read deleted arrays — hand it nothing and let it
@@ -1059,6 +1109,148 @@ class Scheduler:
         OBS.record("gather", t0, reps=len(reps), stale=stale)
         return pa_dev, fb
 
+    # -- term-bank plane (kubernetes_tpu/terms_plane) ------------------------
+
+    def _term_growth_specs(self) -> List[SolveSpec]:
+        """The term gather's headroom set: the next term-bucket rung and
+        the doubled term slab (its growth mode on overflow). ONE
+        definition shared by warmup and the dispatch-time growth hook so
+        warmed and dispatched shapes can never diverge."""
+        from ..compile.ladder import next_rung
+        from ..terms_plane.stage import MAX_CAPACITY
+
+        out = [self.term_bank.gather_spec(next_rung(self._t_bucket))]
+        if self.tstage.capacity * 2 <= MAX_CAPACITY:
+            out.append(self.term_bank.gather_spec(
+                self._t_bucket, capacity=self.tstage.capacity * 2
+            ))
+        return out
+
+    # ktpu: hot-path index-only term dispatch prologue: no device→host syncs
+    def _term_prologue(self, reps, rep_infos, rep_keys, selectors):
+        """Resolve every rep's interned term entry and gather the batch's
+        term table from the device-resident term bank (the index-only
+        term dispatch). Returns the covered-dispatch dict — the gathered
+        `ta` device arrays, the aux arrays rebuilt from the entries'
+        cached bits, the present kind ints, topology slots, and the
+        overflowing rep indices — or None when the batch cannot be
+        covered (a stale entry that cannot re-stage: slab at its ceiling,
+        vocab width growth mid-resolve) — the caller then compiles the
+        legacy host TermBank, counted. Same locking discipline as
+        _stage_prologue: resolve, flush, and gather-ARGUMENT capture run
+        under the slab lock; the gather dispatch itself runs after
+        release."""
+        from ..terms_plane.gather import gather_terms
+
+        ts, bank = self.tstage, self.term_bank
+        t0 = time.perf_counter()
+        u = self._u_bucket
+        self_aff = np.zeros(u, bool)
+        has_aff = np.zeros(u, bool)
+        has_anti = np.zeros(u, bool)
+        n_sel = np.zeros(u, np.int32)
+        with ts._lock:
+            ts.ensure_current()
+            # a slab rebuild DURING resolution (a restage growing a full
+            # slab) invalidates the rows already collected — detect by
+            # generation and bail to the legacy path
+            gen0 = ts.generation
+            idx_rows: List[int] = []
+            owners: List[int] = []
+            kinds: set = set()
+            slots: set = set()
+            overflow: List[int] = []
+            stale = 0
+            for b, (pod, pi) in enumerate(zip(reps, rep_infos)):
+                entry = (
+                    ts.entry_for(pi.term_row, pi.term_gen, rep_keys[b])
+                    if pi.pod is pod and pi.term_row >= 0
+                    else None
+                )
+                if entry is None:
+                    # stale entry (updated/deleted between enqueue and
+                    # pop, slab rebuilt, selector drift, or admitted
+                    # before the plane attached): re-intern from the
+                    # captured pod + this dispatch's getSelectors result
+                    stale += 1
+                    sels = selectors.get(id(pod)) if selectors else None
+                    pair = ts.ensure_entry(pod, sels)
+                    if pair is None:
+                        self.stats["term_stale_rows"] = (
+                            self.stats.get("term_stale_rows", 0) + stale
+                        )
+                        return None
+                    entry = ts._entries[pair[0]]
+                    self.stats["term_restaged"] = (
+                        self.stats.get("term_restaged", 0) + 1
+                    )
+                    # counted here, not on the success path, so the
+                    # metric can't undercount restages performed before
+                    # a bail (slab ceiling, mid-resolve rebuild); the
+                    # registry lock is a leaf — no lock-order edge back
+                    M.term_restage.inc()
+                idx_rows.extend(entry.rows)
+                owners.extend([b] * len(entry.rows))
+                kinds |= entry.kinds
+                slots |= entry.topo_slots
+                if entry.overflow:
+                    overflow.append(b)
+                self_aff[b] = entry.self_aff_match
+                has_aff[b] = entry.has_aff
+                has_anti[b] = entry.has_anti
+                n_sel[b] = entry.n_sel_spread
+            if stale:
+                self.stats["term_stale_rows"] = (
+                    self.stats.get("term_stale_rows", 0) + stale
+                )
+            if ts.generation != gen0:
+                return None  # slab rebuilt mid-resolve: rows are garbage
+            self._t_bucket = max(
+                self._t_bucket, _bucket(max(len(idx_rows), 1))
+            )
+            t = self._t_bucket
+            idx = np.zeros(t, np.int32)
+            idx[: len(idx_rows)] = idx_rows
+            own = np.zeros(t, np.int32)
+            own[: len(idx_rows)] = owners
+            keep = np.zeros(t, bool)
+            keep[: len(idx_rows)] = True
+            was_sync = bank.stats["sync_rows"]
+            bank_dev, empty_dev = bank.current_arrays(sync=True)
+            if bank.stats["sync_rows"] != was_sync:
+                self.stats["term_sync_flushes"] = (
+                    self.stats.get("term_sync_flushes", 0) + 1
+                )
+            spec = bank.gather_spec(t)
+        # gather OUTSIDE the slab lock: the captured device dicts are
+        # immutable (functional updates), and an unwarmed rung's inline
+        # XLA compile here must not stall informer-thread admissions
+        known = self.compile_plan.admit(spec)
+        t_g = time.perf_counter()
+        ta_dev = gather_terms(bank_dev, idx, own, keep, empty_dev)
+        if not known:
+            self.compile_plan.note_compiled(
+                spec, time.perf_counter() - t_g,
+                SOURCE_INLINE if self.compile_plan.warmed else "warmup",
+            )
+        self.mirror._ship("terms", idx.nbytes + own.nbytes + keep.nbytes)
+        dt = time.perf_counter() - t0
+        self.stats["term_gather_s"] = self.stats.get("term_gather_s", 0.0) + dt
+        M.scheduling_stage_duration.observe(dt, "gather")
+        OBS.record("gather", t0, reps=len(reps), stale=stale, plane="terms")
+        return dict(
+            ta=ta_dev,
+            aux={
+                "self_aff_match": self_aff,
+                "has_aff": has_aff,
+                "has_anti": has_anti,
+                "n_sel_spread": n_sel,
+            },
+            kinds=kinds,
+            slots=slots,
+            overflow=overflow,
+        )
+
     # -- device solve --------------------------------------------------------
 
     # ktpu: hot-path
@@ -1098,6 +1290,8 @@ class Scheduler:
         sig_list: List[int] = []
         reps: List[Pod] = []
         rep_infos: List[PodInfo] = []  # first queue entry of each spec
+        rep_keys: List[tuple] = []  # the dedup key doubles as the term-
+        # slab intern key, so entry validity is an equality check
         spec_index: Dict[str, int] = {}
         for pi in infos:
             p = pi.pod
@@ -1108,6 +1302,7 @@ class Scheduler:
                 spec_index[k] = u
                 reps.append(p)
                 rep_infos.append(pi)
+                rep_keys.append(k)
             sig_list.append(u)
         self._u_bucket = max(self._u_bucket, _bucket(len(reps)))
         while True:
@@ -1131,16 +1326,41 @@ class Scheduler:
                     for i, p in enumerate(reps):
                         batch.set_pod(i, p)
                     fallback_arr = batch.fallback
-                tb, aux = compile_batch_terms(
-                    vocab, reps, spread_selectors=selectors,
-                    b_capacity=self._u_bucket,
+                # TERM PLANE covered path: every rep resolves to a live
+                # interned term entry → the batch term table is gathered
+                # from the device-resident term bank; the dispatch ships
+                # only int32 index/owner vectors (+ the [U] aux bits).
+                # Stale/unstageable entries fall back to the legacy host
+                # compile_batch_terms build, counted. The covered path
+                # never encodes terms host-side, so neither the
+                # KeySlotOverflow→mirror-rebuild loop nor the old
+                # compile-then-recompile-at-the-monotone-bucket retry
+                # exists on it.
+                tb = None
+                tp = (
+                    self._term_prologue(reps, rep_infos, rep_keys, selectors)
+                    if self.term_plane and self.tstage is not None
+                    else None
                 )
-                self._t_bucket = max(self._t_bucket, tb.capacity)
-                if tb.capacity < self._t_bucket:
+                if tp is not None:
+                    ta_arrays, aux = tp["ta"], tp["aux"]
+                else:
+                    # size the monotone term bucket BEFORE compiling —
+                    # one compile at the final capacity (this retired the
+                    # double-compile retry that rebuilt the whole bank
+                    # whenever the natural bucket undershot the monotone
+                    # one)
+                    self._t_bucket = max(self._t_bucket, _bucket(
+                        max(count_batch_terms(reps, selectors), 1)
+                    ))
                     tb, aux = compile_batch_terms(
                         vocab, reps, spread_selectors=selectors,
                         capacity=self._t_bucket, b_capacity=self._u_bucket,
                     )
+                    # no-op when the count was exact; self-heals the
+                    # monotone bucket if compile_batch_terms clamped up
+                    self._t_bucket = max(self._t_bucket, tb.capacity)
+                    ta_arrays = tb.arrays()
                 break
             except KeySlotOverflow:
                 if not allow_rebuild:
@@ -1166,8 +1386,10 @@ class Scheduler:
         # (ADVICE r1: overflow_owners was recorded but never consumed).
         # On the covered ingest path this patches only the HOST fallback
         # vector (the device copy of `fallback` is consumed by no kernel —
-        # it rides the dict for signature stability).
-        for owner in tb.overflow_owners:
+        # it rides the dict for signature stability). The covered term
+        # path carries the same flag per interned entry (TermEntry.
+        # overflow), already resolved to rep indices.
+        for owner in (tp["overflow"] if tp is not None else tb.overflow_owners):
             if 0 <= owner < len(reps):
                 fallback_arr[owner] = True
         existing_overflow = bool(self.mirror.pats.overflow_rows)
@@ -1193,6 +1415,26 @@ class Scheduler:
                 self.stats.get("ingest_index_batches", 0) + 1
             )
             M.ingest_batches.inc("index")
+        # term-side wire ledger (patch_bytes.terms): the full padded term
+        # table on the legacy path, the index/owner/keep vectors on the
+        # covered path (shipped in the prologue); the [U] aux bits ship
+        # on both
+        if tb is not None:
+            self.mirror._ship(
+                "terms",
+                sum(int(np.asarray(v).nbytes) for v in ta_arrays.values()),
+            )
+            if self.term_plane:
+                self.stats["term_legacy_batches"] = (
+                    self.stats.get("term_legacy_batches", 0) + 1
+                )
+            M.term_batches.inc("legacy" if self.term_plane else "off")
+        else:
+            self.stats["term_index_batches"] = (
+                self.stats.get("term_index_batches", 0) + 1
+            )
+            M.term_batches.inc("index")
+        self.mirror._ship("terms", sum(int(a.nbytes) for a in aux.values()))
         self.mirror._ship("pods", sum(int(a.nbytes) for a in pb.values()))
         t1 = time.perf_counter()
         self.stats["encode_s"] += t1 - t0
@@ -1212,17 +1454,26 @@ class Scheduler:
         # otherwise compile up to 2^8 variants, while the union costs at
         # most 8 growth compiles and a superset program is still exact
         # (extra kernels compute their term-absent identities)
-        present_kinds = _present_term_kinds(tb, self.mirror.pats, aux)
+        if tp is not None:
+            present_kinds = _term_kind_names(
+                tp["kinds"], bool(np.any(aux["n_sel_spread"] > 0)),
+                self.mirror.pats,
+            )
+        else:
+            present_kinds = _present_term_kinds(tb, self.mirror.pats, aux)
         self._term_kinds = getattr(self, "_term_kinds", frozenset()) | present_kinds
         term_kinds = self._term_kinds
         # topology segment-axis bound (jit static): only the slots named by
         # CURRENT terms matter — zone-keyed terms need ~#zones buckets while
         # a [*, N] table wastes 1000x at 10k nodes (hostname-keyed terms
         # genuinely need ~N and get it). MONOTONE bucket to avoid recompiles.
+        # The covered term path reads the interned entries' cached slot
+        # sets instead of scanning a host bank.
         pats = self.mirror.pats
-        term_slots = set(np.asarray(tb.topo_slot[tb.valid], np.int64).tolist()) | set(
-            np.asarray(pats.bank.topo_slot[pats.valid], np.int64).tolist()
-        )
+        term_slots = (
+            set(tp["slots"]) if tp is not None
+            else set(np.asarray(tb.topo_slot[tb.valid], np.int64).tolist())
+        ) | set(np.asarray(pats.bank.topo_slot[pats.valid], np.int64).tolist())
         needed = [vocab.dense_size(int(sl)) for sl in term_slots if sl >= 0]
         needed.append(vocab.zone_count())  # selector-spread zone blending
         # NOT clamped to node capacity: dense ids are grow-only, so under
@@ -1333,7 +1584,7 @@ class Scheduler:
             na_dev,
             pa_arrays,
             ea_dev,
-            tb.arrays(),
+            ta_arrays,  # host-compiled TermBank dict, or the device gather
             xp_dev,
             aux,
             ids,
@@ -1437,7 +1688,7 @@ class Scheduler:
             arb_known = self.compile_plan.admit(arb_spec)
             t_arb = time.perf_counter()
             verdict_dev = arb_fn(
-                na_dev, pa_arrays, ea_dev, tb.arrays(), ids,
+                na_dev, pa_arrays, ea_dev, ta_arrays, ids,
                 assign, pb=pb, carry=carry,
                 term_kinds=term_kinds, n_buckets=n_buckets,
             )
@@ -1473,6 +1724,7 @@ class Scheduler:
                 reps=len(reps), rung_b=self._b_bucket, rung_u=self._u_bucket,
                 speculative=carry is not None, gang=is_gang,
                 path="index" if pa_dev is not None else "legacy",
+                term_path="index" if tp is not None else "legacy",
                 encode_s=round(t1 - t0, 6),
             )
             tok_solve = OBS.device_begin(
@@ -1657,6 +1909,21 @@ class Scheduler:
                 # an unwarmed rung is a mid-drain inline compile
                 from dataclasses import replace
 
+                # PREDICTIVE pattern-triple rung: an affinity-heavy first
+                # batch interns one triple per (pod, term pattern) pair on
+                # its FIRST commit — more than the default 16-rung when
+                # most pods carry terms — and the async growth warm loses
+                # that race. Size the rung from the peeked batch's own
+                # patterns (the predictive-kind-adoption idea applied to
+                # the fold's t axis) so the foreground warm below compiles
+                # the program the first commit will actually dispatch.
+                if infos:
+                    triples = sum(
+                        len(self.mirror.pats._pod_patterns(pi.pod))
+                        for pi in infos
+                    )
+                    if triples:
+                        self._fp_bucket = max(self._fp_bucket, _bucket(triples))
                 fold_specs = [self._fold_spec()]
                 nom = self._fold_spec(nominee=True)
                 b, cap = 16, _bucket(self.batch_size * 4)
@@ -1685,6 +1952,17 @@ class Scheduler:
                 self._warm_svc.warm_specs(
                     [self.stage_bank.gather_spec(self._u_bucket)]
                     + self._stage_growth_specs()
+                )
+            if self.term_plane and self.term_bank is not None:
+                # term-bank programs, the same discipline: the row-
+                # scatter rungs (no-op patches) plus the term index-
+                # gather at the live AND headroom shapes (next term rung,
+                # doubled slab); the off-thread uploader arms here
+                self.term_bank.start()
+                self.term_bank.warm()
+                self._warm_svc.warm_specs(
+                    [self.term_bank.gather_spec(self._t_bucket)]
+                    + self._term_growth_specs()
                 )
             if infos:
                 # headroom: compile the next growth rung of each mid-drain-
@@ -3422,6 +3700,8 @@ class Scheduler:
         self._commit_pipe.close()
         if self.stage_bank is not None:
             self.stage_bank.close()
+        if self.term_bank is not None:
+            self.term_bank.close()
         if self._warm_svc is not None:
             self._warm_svc.stop()
             self._warm_svc.join()

@@ -58,6 +58,13 @@ class PodInfo:
     # to the legacy in-batch encode, counted.
     staged_row: int = -1
     staged_gen: int = -1
+    # term-bank plane (kubernetes_tpu/terms_plane): the entry's READY
+    # interned term set — same admission-time encode and staleness
+    # contract as (staged_row, staged_gen), for the batch TermBank side
+    # of the dispatch. term_row is a term-slab ENTRY id (one per distinct
+    # (spec, spread-selectors) pair), not a row index.
+    term_row: int = -1
+    term_gen: int = -1
 
 
 class _ActiveEntry:
@@ -118,8 +125,11 @@ class PriorityQueue:
         # the pod's tensor row HERE (the informer thread) instead of on
         # the driver thread per batch; entries carry the ready (row, gen)
         self._stage = None  # ktpu: guarded-by(self._lock)
+        # term-bank plane: the term slab (terms_plane.TermStage) gets the
+        # same admission-time treatment; entries carry (entry id, gen)
+        self._tstage = None  # ktpu: guarded-by(self._lock)
 
-    # -- pod-ingest staging (kubernetes_tpu/ingest) --------------------------
+    # -- admission-time staging (kubernetes_tpu/ingest + terms_plane) --------
 
     def attach_stage(self, stage) -> None:
         """Install the ingest plane's staging slab. Entries added before
@@ -128,22 +138,52 @@ class PriorityQueue:
         with self._lock:
             self._stage = stage
 
+    def attach_term_stage(self, stage) -> None:
+        """Install the term plane's slab (terms_plane.TermStage) — the
+        same contract as attach_stage. Lock order: queue lock → terms
+        lock, always."""
+        with self._lock:
+            self._tstage = stage
+
+    # ktpu: holds(self._lock) the one definition of the attached staging
+    # planes every acquire/release/swap helper iterates
+    def _planes_locked(self):
+        out = []
+        if self._stage is not None:
+            out.append((self._stage, "staged_row", "staged_gen"))
+        if self._tstage is not None:
+            out.append((self._tstage, "term_row", "term_gen"))
+        return out
+
+    @staticmethod
+    def _plane_acquire(stage, info: PodInfo, row_attr: str, gen_attr: str) -> None:
+        """Acquire one plane's pair for `info` and record it — the ONE
+        place the (row, gen) attachment bookkeeping lives (admission and
+        re-add/census paths both route through it)."""
+        pair = stage.acquire(info.pod)
+        if pair is None:
+            setattr(info, row_attr, -1)
+            setattr(info, gen_attr, -1)
+        else:
+            setattr(info, row_attr, pair[0])
+            setattr(info, gen_attr, pair[1])
+
     # ktpu: holds(self._lock) called from locked admission/re-add paths
     def _stage_acquire(self, info: PodInfo) -> None:
-        if self._stage is None:
-            return
-        pair = self._stage.acquire(info.pod)
-        if pair is None:
-            info.staged_row, info.staged_gen = -1, -1
-        else:
-            info.staged_row, info.staged_gen = pair
+        for stage, row_attr, gen_attr in self._planes_locked():
+            self._plane_acquire(stage, info, row_attr, gen_attr)
 
     # ktpu: holds(self._lock) called from locked delete/re-add paths
     def _stage_release(self, info: Optional[PodInfo]) -> None:
-        if self._stage is None or info is None or info.staged_row < 0:
+        if info is None:
             return
-        self._stage.release(info.staged_row, info.staged_gen)
-        info.staged_row, info.staged_gen = -1, -1
+        for stage, row_attr, gen_attr in self._planes_locked():
+            row = getattr(info, row_attr)
+            if row < 0:
+                continue
+            stage.release(row, getattr(info, gen_attr))
+            setattr(info, row_attr, -1)
+            setattr(info, gen_attr, -1)
 
     # ktpu: holds(self._lock) called from locked update path
     def _stage_swap(self, info: PodInfo, new: Pod) -> None:
@@ -152,11 +192,15 @@ class PriorityQueue:
         patch) is then an intern HIT on the same row — no re-encode, no
         generation churn — while a real spec change lands a different
         row and the old one frees (the staleness tag, by design)."""
-        old_row, old_gen = info.staged_row, info.staged_gen
+        old = [
+            (stage, getattr(info, row_attr), getattr(info, gen_attr))
+            for stage, row_attr, gen_attr in self._planes_locked()
+        ]
         info.pod = new
         self._stage_acquire(info)
-        if self._stage is not None and old_row >= 0:
-            self._stage.release(old_row, old_gen)
+        for stage, old_row, old_gen in old:
+            if old_row >= 0:
+                stage.release(old_row, old_gen)
 
     # ktpu: holds(self._lock) called from locked re-add/census paths
     def _stage_acquire_if_stale(self, info: PodInfo) -> None:
@@ -164,32 +208,32 @@ class PriorityQueue:
         OR no longer valid (its row was freed/rebuilt while the entry was
         popped) — without this, a once-stale entry would re-stage at
         every subsequent dispatch, double-counting one staleness event."""
-        if self._stage is None:
-            return
-        if info.staged_row >= 0 and self._stage.valid_pair(
-            info.staged_row, info.staged_gen
-        ):
-            return
-        info.staged_row, info.staged_gen = -1, -1
-        self._stage_acquire(info)
+        for stage, row_attr, gen_attr in self._planes_locked():
+            row = getattr(info, row_attr)
+            if row >= 0 and stage.valid_pair(row, getattr(info, gen_attr)):
+                continue
+            self._plane_acquire(stage, info, row_attr, gen_attr)
 
     def stage_pending(self) -> int:
         """Stage every pending entry that lacks a valid pair — the warmup
         census's staging half, under the QUEUE lock so it cannot race the
         informer's delete()/update() release/acquire pairs (an unlocked
         acquire into a concurrently-deleted entry would pin its slab row
-        forever). Returns the number of entries (re-)staged."""
+        forever). Returns the number of entries (re-)staged, counting
+        each plane (pod rows and term entries) separately."""
         n = 0
         with self._lock:
-            if self._stage is None:
+            if not self._planes_locked():
                 return 0
             for k in self._pending_keys_locked():
                 info = self._infos.get(k)
                 if info is None:
                     continue
-                before = info.staged_row
+                before = (info.staged_row, info.term_row)
                 self._stage_acquire_if_stale(info)
-                if info.staged_row >= 0 and info.staged_row != before:
+                if info.staged_row >= 0 and info.staged_row != before[0]:
+                    n += 1
+                if info.term_row >= 0 and info.term_row != before[1]:
                     n += 1
         return n
 
@@ -273,19 +317,23 @@ class PriorityQueue:
         # admission bursts. The acquired ref keeps the row live until the
         # pair attaches below; a racing delete of the same key releases
         # the OLD entry's pair, never this one.
-        # _stage is attach-once before traffic; the acquired ref makes any
-        # race with a concurrent delete benign (doc above)
+        # _stage/_tstage are attach-once before traffic; the acquired refs
+        # make any race with a concurrent delete benign (doc above)
         stage = self._stage  # ktpu: allow(KTPU003) attach-once reference read
+        tstage = self._tstage  # ktpu: allow(KTPU003) attach-once reference read
         if _REC.enabled:
-            # flight recorder: the admission path's two spans — the row
-            # encode (stage-encode, the heavy half, on THIS thread — the
+            # flight recorder: the admission path's spans — the row/term
+            # encodes (stage-encode, the heavy half, on THIS thread — the
             # informer in production) nested inside the enqueue span
             with _REC.span("enqueue", pod=pod.key()):
                 with (_REC.span("stage-encode", pod=pod.key())
-                      if stage is not None else NOOP_SPAN):
+                      if stage is not None or tstage is not None
+                      else NOOP_SPAN):
                     pair = stage.acquire(pod) if stage is not None else None
+                    tpair = tstage.acquire(pod) if tstage is not None else None
         else:
             pair = stage.acquire(pod) if stage is not None else None
+            tpair = tstage.acquire(pod) if tstage is not None else None
         with self._lock:
             now = self._now()
             prev = self._infos.get(pod.key())
@@ -298,6 +346,8 @@ class PriorityQueue:
             )
             if pair is not None:
                 info.staged_row, info.staged_gen = pair
+            if tpair is not None:
+                info.term_row, info.term_gen = tpair
             # attach-new-then-release-old: an identical re-add lands on
             # the same row as an intern hit (no re-encode, no generation
             # churn); real content changes free the old row normally

@@ -216,6 +216,115 @@ def _term_namespaces(owner_pod: Pod, term: PodAffinityTerm) -> List[str]:
     return list(term.namespaces) if term.namespaces else [owner_pod.namespace]
 
 
+def encode_pod_terms(
+    pod: Pod, selectors: Optional[List[LabelSelector]] = None
+) -> Tuple[List[tuple], Dict[str, int]]:
+    """ONE pod's topology-coupled structure as explicit term-row argument
+    tuples plus its aux bits — the single source both compile_batch_terms
+    (the per-batch host path) and the term slab (terms_plane.stage,
+    enqueue-time interning) encode from. Both paths emit rows in THIS
+    canonical order, so an index-gathered batch term table is
+    bit-identical to a host-compiled one by construction.
+
+    Returns (rows, aux): rows is a list of
+    (kind, topo_key, selector, namespaces, ns_any, weight, self_match)
+    tuples — TermBank.set_row's arguments minus the row/owner — in order:
+    hard spread, soft spread, required affinity, required anti-affinity,
+    preferred affinity, preferred anti-affinity, spreading selectors.
+    aux holds the per-pod scalars of compile_batch_terms's aux arrays."""
+    rows: List[tuple] = []
+    aux = {
+        "self_aff_match": False,
+        "has_aff": False,
+        "has_anti": False,
+        "n_sel_spread": 0,
+    }
+    for c in get_hard_spread_constraints(pod):
+        rows.append((
+            SPREAD_HARD, c.topology_key, c.label_selector, (pod.namespace,),
+            False, c.max_skew,
+            match_label_selector(c.label_selector, pod.labels),
+        ))
+    for c in get_soft_spread_constraints(pod):
+        # the soft-spread priority counts matching pods in ALL namespaces
+        # (even_pods_spread.go quirk, see oracle.priorities)
+        rows.append((
+            SPREAD_SOFT, c.topology_key, c.label_selector, (),
+            True, c.max_skew,
+            match_label_selector(c.label_selector, pod.labels),
+        ))
+    aff_terms = get_pod_affinity_terms(pod.affinity)
+    if aff_terms:
+        aux["has_aff"] = True
+        aux["self_aff_match"] = pod_matches_all_term_properties(pod, pod, aff_terms)
+    for t in aff_terms:
+        rows.append((
+            AFF_REQ, t.topology_key, t.label_selector,
+            tuple(_term_namespaces(pod, t)), False, 0, False,
+        ))
+    anti_terms = get_pod_anti_affinity_terms(pod.affinity)
+    if anti_terms:
+        aux["has_anti"] = True
+    for t in anti_terms:
+        rows.append((
+            ANTI_REQ, t.topology_key, t.label_selector,
+            tuple(_term_namespaces(pod, t)), False, 0, False,
+        ))
+    a = pod.affinity
+    if a is not None and a.pod_affinity is not None:
+        for w in a.pod_affinity.preferred:
+            if w.weight and w.pod_affinity_term.topology_key:
+                t = w.pod_affinity_term
+                rows.append((
+                    AFF_PREF, t.topology_key, t.label_selector,
+                    tuple(_term_namespaces(pod, t)), False, w.weight, False,
+                ))
+    if a is not None and a.pod_anti_affinity is not None:
+        for w in a.pod_anti_affinity.preferred:
+            if w.weight and w.pod_affinity_term.topology_key:
+                t = w.pod_affinity_term
+                rows.append((
+                    ANTI_PREF, t.topology_key, t.label_selector,
+                    tuple(_term_namespaces(pod, t)), False, -w.weight, False,
+                ))
+    for sel in selectors or ():
+        rows.append((SEL_SPREAD, "", sel, (pod.namespace,), False, 0, False))
+        aux["n_sel_spread"] += 1
+    return rows, aux
+
+
+def count_pod_terms(pod: Pod, selectors: Optional[List[LabelSelector]] = None) -> int:
+    """Exact row count encode_pod_terms would produce, without the
+    selector-match work — the driver sizes its monotone term bucket from
+    this BEFORE compiling (which retired the old compile-then-recompile-
+    at-the-bigger-bucket retry)."""
+    n = len(get_hard_spread_constraints(pod)) + len(get_soft_spread_constraints(pod))
+    n += len(get_pod_affinity_terms(pod.affinity))
+    n += len(get_pod_anti_affinity_terms(pod.affinity))
+    a = pod.affinity
+    if a is not None and a.pod_affinity is not None:
+        n += sum(
+            1 for w in a.pod_affinity.preferred
+            if w.weight and w.pod_affinity_term.topology_key
+        )
+    if a is not None and a.pod_anti_affinity is not None:
+        n += sum(
+            1 for w in a.pod_anti_affinity.preferred
+            if w.weight and w.pod_affinity_term.topology_key
+        )
+    return n + len(selectors or ())
+
+
+def count_batch_terms(
+    pods: Sequence[Pod],
+    spread_selectors: Optional[Dict[int, List[LabelSelector]]] = None,
+) -> int:
+    return sum(
+        count_pod_terms(p, (spread_selectors or {}).get(id(p)) or None)
+        for p in pods
+    )
+
+
 def compile_batch_terms(
     vocab: Vocab,
     pods: Sequence[Pod],
@@ -230,70 +339,32 @@ def compile_batch_terms(
       has_aff[b] / has_anti[b]: pod has required (anti-)affinity terms
       n_sel_spread[b]: number of spreading selectors (0 → score 0 rule)
     """
-    n_terms = 0
-    for p in pods:
-        n_terms += len(get_hard_spread_constraints(p)) + len(get_soft_spread_constraints(p))
-        n_terms += len(get_pod_affinity_terms(p.affinity)) + len(get_pod_anti_affinity_terms(p.affinity))
-        if p.affinity is not None and p.affinity.pod_affinity is not None:
-            n_terms += len(p.affinity.pod_affinity.preferred)
-        if p.affinity is not None and p.affinity.pod_anti_affinity is not None:
-            n_terms += len(p.affinity.pod_anti_affinity.preferred)
-        if spread_selectors:
-            n_terms += len(spread_selectors.get(id(p), []) or [])
-    bank = TermBank(vocab, capacity or _bucket(max(n_terms, 1)))
+    encoded = [
+        encode_pod_terms(p, (spread_selectors or {}).get(id(p), []) or [])
+        for p in pods
+    ]
+    n_terms = sum(len(rows) for rows, _ in encoded)
+    # `capacity` is a floor, not a trust: a caller sizing it from
+    # count_pod_terms that drifted out of sync with encode_pod_terms
+    # would otherwise silently push the tail rows into overflow_owners
+    # (scalar-oracle routing — correct but slow); clamping to the exact
+    # count keeps the two walks honest
+    bank = TermBank(vocab, max(capacity or 0, _bucket(max(n_terms, 1))))
     b_count = b_capacity or _bucket(len(pods))
     self_aff_match = np.zeros(b_count, bool)
     has_aff = np.zeros(b_count, bool)
     has_anti = np.zeros(b_count, bool)
     n_sel_spread = np.zeros(b_count, np.int32)
-
-    for b, p in enumerate(pods):
-        for c in get_hard_spread_constraints(p):
+    for b, (rows, a) in enumerate(encoded):
+        for kind, topo, sel, nss, ns_any, weight, sm in rows:
             bank.add(
-                SPREAD_HARD,
-                b,
-                c.topology_key,
-                c.label_selector,
-                namespaces=[p.namespace],
-                weight=c.max_skew,
-                self_match=match_label_selector(c.label_selector, p.labels),
+                kind, b, topo, sel, namespaces=nss, ns_any=ns_any,
+                weight=weight, self_match=sm,
             )
-        for c in get_soft_spread_constraints(p):
-            # the soft-spread priority counts matching pods in ALL namespaces
-            # (even_pods_spread.go quirk, see oracle.priorities)
-            bank.add(
-                SPREAD_SOFT,
-                b,
-                c.topology_key,
-                c.label_selector,
-                ns_any=True,
-                weight=c.max_skew,
-                self_match=match_label_selector(c.label_selector, p.labels),
-            )
-        aff_terms = get_pod_affinity_terms(p.affinity)
-        if aff_terms:
-            has_aff[b] = True
-            self_aff_match[b] = pod_matches_all_term_properties(p, p, aff_terms)
-        for t in aff_terms:
-            bank.add(AFF_REQ, b, t.topology_key, t.label_selector, _term_namespaces(p, t))
-        anti_terms = get_pod_anti_affinity_terms(p.affinity)
-        if anti_terms:
-            has_anti[b] = True
-        for t in anti_terms:
-            bank.add(ANTI_REQ, b, t.topology_key, t.label_selector, _term_namespaces(p, t))
-        if p.affinity is not None and p.affinity.pod_affinity is not None:
-            for w in p.affinity.pod_affinity.preferred:
-                if w.weight and w.pod_affinity_term.topology_key:
-                    t = w.pod_affinity_term
-                    bank.add(AFF_PREF, b, t.topology_key, t.label_selector, _term_namespaces(p, t), weight=w.weight)
-        if p.affinity is not None and p.affinity.pod_anti_affinity is not None:
-            for w in p.affinity.pod_anti_affinity.preferred:
-                if w.weight and w.pod_affinity_term.topology_key:
-                    t = w.pod_affinity_term
-                    bank.add(ANTI_PREF, b, t.topology_key, t.label_selector, _term_namespaces(p, t), weight=-w.weight)
-        for sel in (spread_selectors or {}).get(id(p), []) or []:
-            bank.add(SEL_SPREAD, b, "", sel, namespaces=[p.namespace])
-            n_sel_spread[b] += 1
+        self_aff_match[b] = a["self_aff_match"]
+        has_aff[b] = a["has_aff"]
+        has_anti[b] = a["has_anti"]
+        n_sel_spread[b] = a["n_sel_spread"]
     aux = {
         "self_aff_match": self_aff_match,
         "has_aff": has_aff,

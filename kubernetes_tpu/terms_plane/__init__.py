@@ -1,0 +1,18 @@
+"""Term-bank plane: enqueue-time term interning, a device-resident term
+bank, and index-only term dispatch — the ingest plane's content-interning
+move applied to topology-coupled structure (the InterPodAffinity wall,
+ROADMAP item 1)."""
+
+from .bank import TERM_RUNGS, TermBankDevice
+from .gather import gather_terms
+from .stage import MAX_CAPACITY, MIN_CAPACITY, TermEntry, TermStage
+
+__all__ = [
+    "TERM_RUNGS",
+    "TermBankDevice",
+    "gather_terms",
+    "MAX_CAPACITY",
+    "MIN_CAPACITY",
+    "TermEntry",
+    "TermStage",
+]

@@ -1,0 +1,61 @@
+"""Device-resident term bank: the TermStage slab's on-device twin.
+
+A thin specialization of the ingest plane's slab uploader
+(ingest/bank.StageBank): the slab uploads once, then only the rows fresh
+entries touched cross the wire — batched, off the driver thread
+("terms-upload" worker), chunked at TERM_RUNGS, every program (row
+scatters AND the index-gather prologue) routed through the compile plan
+as a KIND_TERM spec so term staging never compiles mid-drain. Double
+buffering, the synthetic re-warm after slab growth, and the non-donated
+scatter discipline are all inherited — see the StageBank docstring.
+
+On a mesh the bank places through the mirror's `_to_dev` recipe with
+node_major=False (term rows are replicated, exactly like the legacy
+per-batch term upload), so warmed executables match dispatched ones.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..compile.ladder import KIND_TERM, SolveSpec
+from ..ingest.bank import StageBank
+
+#: dirty-row scatter rungs for the term slab (the STAGE_RUNGS idea; term
+#: entries are a few rows each, so fresh-entry bursts are small)
+TERM_RUNGS = (16, 64, 256)
+
+
+class TermBankDevice(StageBank):
+    """Keeps a device copy of a TermStage slab patched from its dirty
+    rows. Shares the slab's RLock (role "terms") for all slab-coupled
+    state, like StageBank shares the pod slab's."""
+
+    THREAD_NAME = "terms-upload"
+    # slab uploads/scatters ledger under their own kind so the
+    # per-dispatch "terms" kind (index/owner vectors vs the legacy
+    # full-table upload) stays a clean A/B — the stage-vs-pods split
+    LEDGER_KIND = "term_bank"
+    RUNGS = TERM_RUNGS
+
+    def _patch_spec(self, host: Dict, rb: int) -> SolveSpec:
+        """The term-row scatter's XLA signature: b = row rung, s = slab
+        row capacity, structure from the HOST dict being scattered (the
+        StageBank contract — synthetic warms may run against capacity
+        snapshots that differ from the live slab mid-rebuild)."""
+        structure = ",".join(
+            f"{k}{list(v.shape[1:])}" for k, v in sorted(host.items())
+        )
+        return SolveSpec(
+            kind=KIND_TERM, b=rb, s=next(iter(host.values())).shape[0],
+            config_repr="patch|" + structure,
+        )
+
+    def gather_spec(self, t: int, capacity: Optional[int] = None) -> SolveSpec:
+        """The index-gather prologue's XLA signature: t = term-index
+        vector rung (the driver's monotone term bucket), s = slab row
+        capacity."""
+        return SolveSpec(
+            kind=KIND_TERM, t=t, s=capacity or self.stage.capacity,
+            config_repr="gather",
+        )

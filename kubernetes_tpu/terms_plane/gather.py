@@ -1,0 +1,42 @@
+"""Index-only term dispatch prologue: rebuild a batch's term table ON
+DEVICE.
+
+One jitted gather reconstructs the exact per-batch TermBank array dict
+the solve/arbiter programs consume, from the resident term slab and two
+int32 vectors — slab row per batch-term lane, owning rep per lane — the
+only term-side payload a covered dispatch ships. Entries are concatenated
+in rep order and each entry's rows sit in the canonical per-pod encode
+order (state/terms.encode_pod_terms), so lane i holds EXACTLY what
+compile_batch_terms would have written at row i; `owner` (the one
+per-batch column) is rewritten from the shipped vector, and padding lanes
+reproduce an untouched TermBank row bit-for-bit (`empty` is the slab's
+1-row zero-state). Placements are therefore bit-identical to the legacy
+host-built path by construction, which the parity suite pins.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+# ktpu: admitted(KIND_TERM) every dispatch site (driver._term_prologue,
+# WarmupService._warm_term) admits the (t, slab-capacity) pair through
+# compile_plan.admit as a KIND_TERM spec before calling — the program is
+# planned even though the jit wrapper lives here
+@jax.jit
+def gather_terms(bank, idx, owner, keep, empty):
+    """bank: term slab dict ([S, ...]); idx: [T] int32 slab rows; owner:
+    [T] int32 owning rep of each lane; keep: [T] bool (True for real term
+    lanes, False for padding); empty: 1-row TermBank dict (the padding
+    template). Returns the batch's term-table dict, [T, ...]."""
+    out = {}
+    for k, v in bank.items():
+        g = v[idx]
+        cond = keep.reshape((-1,) + (1,) * (g.ndim - 1))
+        out[k] = jnp.where(cond, g, empty[k])
+    # the slab stores owner = the row's own index; the batch table owns
+    # rows by rep position — rewrite from the shipped vector (padding
+    # lanes keep the untouched-row owner, 0)
+    out["owner"] = jnp.where(keep, owner, 0).astype(jnp.int32)
+    return out

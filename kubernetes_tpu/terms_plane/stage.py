@@ -1,0 +1,280 @@
+"""Host-side term slab: enqueue-time compilation of a pod's topology terms.
+
+The ingest plane (kubernetes_tpu/ingest) moved the pod-ROW encode to
+admission time; this module does the same for the last host-built
+per-batch structure on the covered path — the batch TermBank that
+`state/terms.compile_batch_terms` rebuilt per dispatch (the inter-pod-
+affinity config's measured wall, PERF round 10). A `TermStage` interns
+each distinct pod spec's term set ONCE, as an ENTRY owning a small list
+of rows in a `state/terms.TermBank` used as a slab, refcounted by the
+queue entries that hold it. Replicas of one controller share one entry;
+a dispatch then ships int32 (row, owner) index vectors and gathers the
+per-batch term-table union on device (terms_plane/gather.py).
+
+Every row is encoded through `state/terms.encode_pod_terms` — the SAME
+helper `compile_batch_terms` writes from, in the same canonical per-pod
+order — so concatenating entries in rep order reproduces the host-built
+table bit-for-bit (the `owner` column, rewritten on device from the
+shipped owner vector, is the only per-batch field).
+
+Generation discipline (the PodStage contract): entry ids are monotone and
+never reused; update/delete between enqueue and pop frees the last
+holder's entry (any popped copy sees the mismatch and re-stages at
+dispatch, counted); a slab rebuild (row-capacity growth, vocab key-width
+growth) drops every entry. Spreading selectors (SelectorSpread's service/
+RC listers) are part of the intern key, so a service change between
+enqueue and dispatch is ordinary staleness, not a wrong answer.
+
+Thread safety: one RLock (role "terms") around all bookkeeping, shared
+with the device twin (bank.TermBankDevice). Lock order where both are
+held: queue lock → terms lock; the slab never calls into the queue.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..analysis.lockorder import audited_rlock
+from ..state.tensors import KeySlotOverflow, _bucket, spec_key
+from ..state.terms import TermBank, encode_pod_terms
+
+#: slab row capacity floor and hard ceiling (pow-2 rungs in between). One
+#: entry per DISTINCT pending (spec, selectors) pair, a handful of rows
+#: each — workload-bounded like the pod slab, so the ceiling is a safety
+#: valve, not a sizing concern.
+MIN_CAPACITY = 256
+MAX_CAPACITY = 16384
+
+_UNSET = object()
+
+
+class TermEntry:
+    """One interned term set: the slab rows it owns (in canonical encode
+    order) plus everything the dispatch needs host-side without touching
+    the row arrays — aux bits, present kinds, topology slots, overflow."""
+
+    __slots__ = (
+        "rows", "gen", "refs", "key", "self_aff_match", "has_aff",
+        "has_anti", "n_sel_spread", "kinds", "topo_slots", "overflow",
+    )
+
+    def __init__(self, rows, gen, key, aux, kinds, topo_slots, overflow):
+        self.rows: Tuple[int, ...] = rows
+        self.gen = gen
+        self.refs = 0
+        self.key = key
+        self.self_aff_match = aux["self_aff_match"]
+        self.has_aff = aux["has_aff"]
+        self.has_anti = aux["has_anti"]
+        self.n_sel_spread = aux["n_sel_spread"]
+        self.kinds: frozenset = kinds
+        self.topo_slots: frozenset = topo_slots
+        self.overflow = overflow
+
+
+class TermStage:
+    """Content-interned, refcounted slab of encoded term rows."""
+
+    def __init__(self, vocab, capacity: int = MIN_CAPACITY):
+        self.vocab = vocab
+        self._lock = audited_rlock("terms")
+        self._next_gen = 1
+        self._next_entry = 0
+        # the SelectorSpread getSelectors hook (driver installs the same
+        # fn it uses at dispatch): consulted at acquire time so the entry
+        # key matches the dispatch-time dedup key
+        self.selectors_fn: Optional[Callable] = None
+        # bank wake-up hook (TermBankDevice sets it)
+        self.on_dirty: Optional[Callable] = None
+        # bumped on every rebuild; the device twin keys its full-upload
+        # decision on it
+        self.generation = 0
+        self.stats: Dict[str, int] = {
+            "staged": 0,  # entries encoded (once per distinct term set)
+            "hits": 0,  # acquire served by an existing entry
+            "overflows": 0,  # slab-full growth events
+            "rebuilds": 0,  # capacity/width rebuilds
+        }
+        self._build(max(capacity, MIN_CAPACITY))
+
+    # -- slab lifecycle ------------------------------------------------------
+
+    # ktpu: holds(self._lock) callers: __init__ (pre-concurrency) and the
+    # locked acquire/ensure_current/_rebuild paths
+    def _build(self, capacity: int) -> None:
+        self.capacity = capacity
+        # encode-guard snapshot, the PodStage discipline: a vocab key-slot
+        # growth means fresh encodes could name slots the node banks can't
+        # index yet — rebuild (all entries stale) and re-encode at the new
+        # width. Unlike the pod slab, NO term array is key-slot-wide, so
+        # this is an encode-guard refresh, not a shape change.
+        self.key_capacity = self.vocab.config.key_slots
+        # the row slab: a TermBank used with explicit free-list allocation
+        # (named `batch` so the device twin's slab-agnostic uploader —
+        # ingest/bank.StageBank — reads it like the pod slab's PodBatch)
+        self.batch = TermBank(self.vocab, capacity)  # ktpu: guarded-by(self._lock)
+        self._free: List[int] = list(range(capacity - 1, -1, -1))  # ktpu: guarded-by(self._lock)
+        self._entry_of: Dict[tuple, int] = {}  # ktpu: guarded-by(self._lock)
+        self._entries: Dict[int, TermEntry] = {}  # ktpu: guarded-by(self._lock)
+        self.dirty_rows: set = set()  # ktpu: guarded-by(self._lock)
+        self.generation += 1
+        # gather padding template: an untouched TermBank row, reproduced
+        # bit-for-bit on the padded lanes of the index dispatch
+        self.empty_rows = TermBank(self.vocab, 1).arrays()
+
+    # ktpu: holds(self._lock) called from acquire/ensure_current only
+    def _rebuild(self, capacity: Optional[int] = None) -> None:
+        self.stats["rebuilds"] += 1
+        self._build(capacity or self.capacity)
+
+    def current_for(self, vocab) -> bool:
+        return vocab is self.vocab and self.key_capacity == vocab.config.key_slots
+
+    def ensure_current(self) -> bool:
+        """Rebuild if the vocab key width grew. Returns True when a
+        rebuild happened (every outstanding (entry, gen) pair is stale)."""
+        with self._lock:
+            if self.current_for(self.vocab):
+                return False
+            self._rebuild()
+            return True
+
+    # -- entry acquisition ---------------------------------------------------
+
+    # ktpu: holds(self._lock) called from the locked acquire/ensure_entry
+    def _encode_entry(self, pod, sels, key) -> Optional[TermEntry]:
+        rows_args, aux = encode_pod_terms(pod, sels)
+        need = len(rows_args)
+        if need > len(self._free):
+            self.stats["overflows"] += 1
+            grown = max(self.capacity * 2, _bucket(need, MIN_CAPACITY))
+            if grown > MAX_CAPACITY:
+                return None  # safety valve: legacy path absorbs it
+            self._rebuild(grown)  # every outstanding pair goes stale
+        bank = self.batch
+        rows: List[int] = []
+        try:
+            for kind, topo, sel, nss, ns_any, weight, sm in rows_args:
+                row = self._free.pop()
+                bank.clear_row(row)
+                bank.overflow_owners.discard(row)
+                bank.set_row(
+                    row, kind, row, topo, sel, namespaces=nss,
+                    ns_any=ns_any, weight=weight, self_match=sm,
+                )
+                rows.append(row)
+        except KeySlotOverflow:
+            # vocab key width grew mid-encode: rebuild at the fresh width
+            # and let the caller's next admission (or dispatch restage)
+            # encode cleanly — the PodStage acquire contract
+            self._rebuild()
+            return None
+        # selector/namespace truncation: the row under/over-matches on
+        # device — the owning pod must route through the scalar oracle
+        # (terms.TermBank.overflow_owners, keyed here by row)
+        overflow = any(r in bank.overflow_owners for r in rows)
+        for r in rows:
+            bank.overflow_owners.discard(r)
+        kinds = frozenset(a[0] for a in rows_args)
+        topo_slots = frozenset(
+            int(bank.topo_slot[r]) for r in rows if bank.topo_slot[r] >= 0
+        )
+        gen = self._next_gen
+        self._next_gen += 1
+        entry = TermEntry(tuple(rows), gen, key, aux, kinds, topo_slots, overflow)
+        eid = self._next_entry
+        self._next_entry += 1
+        self._entry_of[key] = eid
+        self._entries[eid] = entry
+        self.dirty_rows.update(rows)
+        self.stats["staged"] += 1
+        cb = self.on_dirty
+        if cb is not None:
+            cb()  # Event.set — safe under the lock
+        return entry
+
+    # ktpu: holds(self._lock) the shared acquire core
+    def _acquire(self, pod, sels) -> Optional[Tuple[int, int]]:
+        if not self.current_for(self.vocab):
+            self._rebuild()
+        key = spec_key(pod, sels)
+        eid = self._entry_of.get(key)
+        if eid is not None:
+            e = self._entries[eid]
+            e.refs += 1
+            self.stats["hits"] += 1
+            return eid, e.gen
+        e = self._encode_entry(pod, sels, key)
+        if e is None:
+            return None
+        e.refs = 1
+        return self._entry_of[key], e.gen
+
+    def acquire(self, pod) -> Optional[Tuple[int, int]]:
+        """Intern `pod`'s term set (+1 ref). Returns (entry id, gen), or
+        None when the pod cannot be staged right now (encode overflow
+        mid-vocab-growth, slab at its ceiling) — the caller schedules it
+        via the legacy path and retries staging on the next admission."""
+        with self._lock:
+            sels = self.selectors_fn(pod) if self.selectors_fn is not None else None
+            return self._acquire(pod, sels)
+
+    def ensure_entry(self, pod, selectors=_UNSET) -> Optional[Tuple[int, int]]:
+        """Intern WITHOUT taking a reference — the dispatch-time restage
+        path. A fresh zero-ref entry is never freed by release() (no
+        holder can release it), so it stays valid through the dispatch
+        and lingers until a slab rebuild reclaims it — bounded by slab
+        capacity, the PodStage.ensure_row contract. `selectors` overrides
+        the installed selectors_fn (the driver passes its dispatch-time
+        getSelectors result so the entry key matches the batch dedup)."""
+        with self._lock:
+            sels = (
+                (self.selectors_fn(pod) if self.selectors_fn is not None else None)
+                if selectors is _UNSET else selectors
+            )
+            pair = self._acquire(pod, sels)
+            if pair is None:
+                return None
+            eid, gen = pair
+            e = self._entries[eid]
+            e.refs -= 1
+            if e.refs < 0:
+                e.refs = 0
+            return pair
+
+    def release(self, eid: int, gen: int) -> None:
+        """Drop one reference. Frees the entry's rows at zero — a later
+        acquire of the same term set re-encodes. Stale pairs are ignored
+        (the entry they named is already gone)."""
+        with self._lock:
+            e = self._entries.get(eid)
+            if e is None or e.gen != gen:
+                return
+            e.refs -= 1
+            if e.refs <= 0:
+                self._entries.pop(eid, None)
+                self._entry_of.pop(e.key, None)
+                for r in e.rows:
+                    self.batch.valid[r] = False
+                    self._free.append(r)
+                # freed rows are never gathered (no live pair names them),
+                # so the device twin needs no update; content is cleared
+                # at re-allocation
+
+    def valid_pair(self, eid: int, gen: int) -> bool:
+        with self._lock:
+            e = self._entries.get(eid)
+            return e is not None and e.gen == gen
+
+    # ktpu: holds(self._lock) the driver's prologue resolves entries
+    # inside its locked capture window
+    def entry_for(self, eid: int, gen: int, key) -> Optional[TermEntry]:
+        """The dispatch-time validity check: the pair must be live AND
+        the entry's intern key must equal the batch's dedup key for this
+        rep — a spreading-selector change between enqueue and dispatch
+        (service added/removed) makes the entry stale by key mismatch.
+        Caller holds the slab lock (the prologue's resolve window)."""
+        e = self._entries.get(eid)
+        if e is None or e.gen != gen or e.key != key:
+            return None
+        return e

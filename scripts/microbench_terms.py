@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""A/B microbench: index-only term dispatch vs host-compiled TermBank.
+
+Measures the two term-side transports for one solve dispatch's batch
+term-table construction (the term plane's tentpole claim — the
+InterPodAffinity config's remaining per-batch host work, PERF round 10):
+
+  A (host-built) — the legacy per-batch path: `compile_batch_terms`
+    re-walks every rep's spread/affinity/anti terms on the driver
+    thread, then the whole padded term-table dict crosses the
+    host→device wire (uploaded per dispatch).
+  B (index)      — the term plane: term sets interned ONCE into the
+    resident term bank (enqueue-time cost, off this measurement), per
+    dispatch only int32 row/owner vectors + a [T] bool keep vector ship
+    and a jitted gather (terms_plane/gather.gather_terms) rebuilds the
+    batch table on device.
+
+Timing discipline matches the other microbenches: trials interleave
+A/B/A/B (drift hits both alike), each trial's device outputs are closed
+with block_until_ready on a data-dependent output, and the reported
+numbers are per-dispatch host wall + shipped bytes. The B path must be
+STRICTLY cheaper on both at every bucket, with BIT-IDENTICAL device
+content (every array of the gathered dict equals the host-built one,
+padding and the rewritten owner column included) — asserted in smoke
+mode, printed standalone.
+
+Run: python scripts/microbench_terms.py [u_real]
+Smoke (tier-1, via tests/test_terms_plane.py): main(smoke=True).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def _mk_pods(n):
+    """n distinct pod SPECS, every one carrying terms (the affinity-heavy
+    shape the plane exists for): required anti-affinity, hard spread,
+    required affinity + a preference, soft spread."""
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        TopologySpreadConstraint,
+        WeightedPodAffinityTerm,
+    )
+    from kubernetes_tpu.models.generators import make_pod
+
+    pods = []
+    for i in range(n):
+        p = make_pod(f"spec-{i}", cpu_milli=100 + i, labels={"app": f"a{i}"})
+        sel = LabelSelector(match_labels={"app": p.labels["app"]})
+        if i % 4 == 0:
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
+                required=[PodAffinityTerm(
+                    label_selector=sel, topology_key="kubernetes.io/hostname",
+                )]
+            ))
+        elif i % 4 == 1:
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=1, topology_key="zone",
+                when_unsatisfiable="DoNotSchedule", label_selector=sel,
+            )]
+        elif i % 4 == 2:
+            p.affinity = Affinity(pod_affinity=PodAffinity(
+                required=[PodAffinityTerm(label_selector=sel, topology_key="zone")],
+                preferred=[WeightedPodAffinityTerm(
+                    weight=5,
+                    pod_affinity_term=PodAffinityTerm(
+                        label_selector=LabelSelector(match_labels={"app": "x"}),
+                        topology_key="zone",
+                    ),
+                )],
+            ))
+        else:
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=2, topology_key="zone",
+                when_unsatisfiable="ScheduleAnyway", label_selector=sel,
+            )]
+        pods.append(p)
+    return pods
+
+
+def main(smoke: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    from kubernetes_tpu.state.tensors import Vocab, _bucket
+    from kubernetes_tpu.state.terms import compile_batch_terms
+    from kubernetes_tpu.terms_plane import TermBankDevice, TermStage
+    from kubernetes_tpu.terms_plane.gather import gather_terms
+
+    # smoke uses 64 specs: the host path's per-rep term walk needs enough
+    # reps to clear the gather's fixed jit-dispatch cost on CPU (at 24
+    # the two are within scheduler jitter of each other)
+    u_real = int(sys.argv[1]) if len(sys.argv) > 1 and not smoke else (
+        64 if smoke else 256
+    )
+    trials = 3 if smoke else 10
+    vocab = Vocab()
+    pods = _mk_pods(u_real)
+    u = _bucket(u_real)
+
+    # B's one-time staging (enqueue-time in the real system): intern every
+    # spec's term set into the slab and upload the bank ONCE, pre-trial
+    stage = TermStage(vocab, capacity=max(256, 2 * u))
+    bank = TermBankDevice(stage)
+    rows, owners = [], []
+    for b, p in enumerate(pods):
+        pair = stage.acquire(p)
+        assert pair is not None
+        e = stage._entries[pair[0]]
+        rows.extend(e.rows)
+        owners.extend([b] * len(e.rows))
+    t = _bucket(max(len(rows), 1))
+    bank_dev, empty_dev = bank.current_arrays()
+    idx_host = np.zeros(t, np.int32)
+    idx_host[: len(rows)] = rows
+    own_host = np.zeros(t, np.int32)
+    own_host[: len(rows)] = owners
+    keep_host = np.zeros(t, bool)
+    keep_host[: len(rows)] = True
+
+    def run_a():
+        """Host-built: compile_batch_terms + upload the full padded dict."""
+        tb, _aux = compile_batch_terms(vocab, pods, capacity=t, b_capacity=u)
+        host = tb.arrays()
+        nbytes = sum(int(np.asarray(v).nbytes) for v in host.values())
+        dev = {k: jnp.asarray(v) for k, v in host.items()}
+        return dev, nbytes
+
+    def run_b():
+        """Index-only: ship row/owner/keep vectors, gather on device."""
+        idx = idx_host.copy()
+        own = own_host.copy()
+        keep = keep_host.copy()
+        nbytes = idx.nbytes + own.nbytes + keep.nbytes
+        dev = gather_terms(bank_dev, idx, own, keep, empty_dev)
+        return dev, nbytes
+
+    # warm both jit paths + pin bit-identity before timing
+    dev_a, bytes_a = run_a()
+    dev_b, bytes_b = run_b()
+    jax.block_until_ready((dev_a, dev_b))
+    mismatches = [
+        k for k in dev_a
+        if not np.array_equal(np.asarray(dev_a[k]), np.asarray(dev_b[k]))
+    ]
+    assert not mismatches, f"index term dispatch diverged on: {mismatches}"
+
+    t_a = t_b = 0.0
+    for _ in range(trials):  # interleaved: drift hits both alike
+        t0 = time.perf_counter()
+        out, _ = run_a()
+        jax.block_until_ready(out["ex_vals"])
+        t_a += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out, _ = run_b()
+        jax.block_until_ready(out["ex_vals"])
+        t_b += time.perf_counter() - t0
+    t_a /= trials
+    t_b /= trials
+    result = {
+        "u_real": u_real,
+        "t_rows": len(rows),
+        "t_bucket": t,
+        "host_built_s": round(t_a, 6),
+        "index_s": round(t_b, 6),
+        "speedup": round(t_a / t_b, 2) if t_b > 0 else float("inf"),
+        "host_built_bytes": bytes_a,
+        "index_bytes": bytes_b,
+        "bytes_ratio": round(bytes_a / bytes_b, 1),
+        "bit_identical": True,
+    }
+    if smoke:
+        assert t_b < t_a, (
+            f"index term dispatch not cheaper: {t_b:.6f}s vs {t_a:.6f}s"
+        )
+        assert bytes_b < bytes_a
+    else:
+        print(result)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,8 @@ tests/test_perf_smoke.py; also runnable standalone:
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py sharded    # 8-way mesh
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py preempt    # preemption
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py trace      # flight recorder
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py ingest     # pod-ingest plane
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py terms      # term-bank plane
 
 `main_trace()` (mode `trace`) guards the flight recorder
 (kubernetes_tpu/obs): a traced drain must export a structurally valid
@@ -47,6 +49,12 @@ config 6's cycle-2 solve spike): a tiny preemption drain must finish with
 `misses_after_warmup == 0` AND `warm_stall_batches == 0` — victim-deletion
 row patches, the nominee overlay, and the preempt kernel all land on
 warmed programs.
+
+`main_terms()` (mode `terms`) guards the term-bank plane
+(kubernetes_tpu/terms_plane) with an affinity-heavy drain (every pod
+carries terms — the InterPodAffinity wall's shape): term-index coverage
+> 0, ZERO legacy/stale term batches, `patch_bytes.terms` KB-scale,
+`misses_after_warmup == 0`, `mirror_rebuilds == 0`.
 
 `main_ingest()` (mode `ingest`) guards the pod-ingest plane
 (kubernetes_tpu/ingest): on a quiet drain every dispatch must take the
@@ -142,6 +150,73 @@ def ingest_smoke_config():
     for i in range(N_UNIQ):
         pods.append(bench.mk_pod(10_000 + i, cpu="50m", mem="32Mi",
                                  labels={"uniq": f"u{i}"}))
+    return nodes, pods
+
+
+def terms_smoke_config():
+    """(nodes, pods): affinity-heavy — EVERY pod carries terms (required
+    anti-affinity, DoNotSchedule spread, preferred affinity + soft
+    spread), the InterPodAffinity shape (bench config 4) at smoke scale.
+    The term plane must cover every dispatch with the index path."""
+    import bench
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+        PodAntiAffinity,
+        TopologySpreadConstraint,
+        WeightedPodAffinityTerm,
+    )
+
+    nodes = [bench.mk_node(i, zone=bench.ZONES[i % 4]) for i in range(N_NODES)]
+    pods = []
+    for i in range(N_PODS):
+        if i % 3 == 0:
+            p = bench.mk_pod(i, cpu="100m", mem="64Mi",
+                             labels={"exclusive": f"x{i % 16}"})
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"exclusive": p.labels["exclusive"]}
+                    ),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ]))
+        elif i % 3 == 1:
+            p = bench.mk_pod(i, cpu="100m", mem="64Mi",
+                             labels={"spread": f"grp{i % 2}"})
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=1,
+                topology_key="failure-domain.beta.kubernetes.io/zone",
+                when_unsatisfiable="DoNotSchedule",
+                label_selector=LabelSelector(
+                    match_labels={"spread": p.labels["spread"]}
+                ),
+            )]
+        else:
+            p = bench.mk_pod(i, cpu="100m", mem="64Mi",
+                             labels={"soft": f"s{i % 2}"})
+            p.affinity = Affinity(pod_affinity=PodAffinity(preferred=[
+                WeightedPodAffinityTerm(
+                    weight=3,
+                    pod_affinity_term=PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"soft": p.labels["soft"]}
+                        ),
+                        topology_key="failure-domain.beta.kubernetes.io/zone",
+                    ),
+                )
+            ]))
+            p.topology_spread_constraints = [TopologySpreadConstraint(
+                max_skew=2,
+                topology_key="failure-domain.beta.kubernetes.io/zone",
+                when_unsatisfiable="ScheduleAnyway",
+                label_selector=LabelSelector(
+                    match_labels={"soft": p.labels["soft"]}
+                ),
+            )]
+        pods.append(p)
     return nodes, pods
 
 
@@ -761,12 +836,79 @@ def main_ingest() -> dict:
     return detail
 
 
+def main_terms() -> dict:
+    """Term-bank-plane smoke: the affinity-heavy workload (every pod
+    carries spread/affinity/anti terms — the InterPodAffinity wall's
+    shape). Must drain with the term INDEX path covering every dispatch,
+    only KB-scale term bytes on the wire (vs the full padded term-table
+    upload the legacy path ships per dispatch), zero stale-entry
+    fallbacks, zero mid-drain mirror rebuilds, and zero compile misses
+    after warmup — the term scatters and the term gather are planned
+    programs."""
+    import bench
+
+    bench.BATCH = SMOKE_BATCH
+    state = {}
+
+    def inspect(sched):
+        state["stats"] = dict(sched.stats)
+        state["tstage"] = dict(sched.tstage.stats) if sched.tstage else None
+        state["term_bank"] = (
+            dict(sched.term_bank.stats) if sched.term_bank else None
+        )
+
+    detail = bench.run_config(
+        "tiny_terms_smoke", terms_smoke_config, inspect=inspect
+    )
+    phase = detail["phase_split_s"]
+    problems = []
+    if detail["scheduled"] != N_PODS:
+        problems.append(f"scheduled {detail['scheduled']} of {N_PODS} pods")
+    if not phase.get("term_index_batches", 0):
+        problems.append(
+            "term coverage is ZERO (no dispatch took the index-only term path)"
+        )
+    if phase.get("term_legacy_batches", 0):
+        problems.append(
+            f"{phase['term_legacy_batches']} legacy host-compiled term "
+            "table(s) on a quiet drain (the plane fell back)"
+        )
+    if phase.get("term_stale_rows", 0):
+        problems.append(
+            f"{phase['term_stale_rows']} stale term entr(ies) on a quiet "
+            "drain (no update/delete happened — bookkeeping bug)"
+        )
+    term_bytes = detail.get("patch_bytes", {}).get("terms", 0)
+    if not 0 < term_bytes <= 64 * 1024:
+        problems.append(
+            f"patch_bytes.terms = {term_bytes} — expected KB-scale index/"
+            "owner vectors (the full term-table upload is the legacy path)"
+        )
+    if detail["compile"]["misses_after_warmup"]:
+        problems.append(
+            f"{detail['compile']['misses_after_warmup']} compile-spec "
+            "miss(es) after warmup — term staging/gather compiled mid-drain"
+        )
+    if detail.get("mirror_rebuilds", 0):
+        problems.append(
+            f"mirror_rebuilds = {detail['mirror_rebuilds']} mid-drain"
+        )
+    for k, v in detail["audit"].items():
+        if k.endswith("_violations") and v:
+            problems.append(f"audit: {k}={v}")
+    assert not problems, "; ".join(problems)
+    detail["terms_state"] = state
+    return detail
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
     if mode == "preempt":
         d = main_preempt()
     elif mode == "ingest":
         d = main_ingest()
+    elif mode == "terms":
+        d = main_terms()
     elif mode == "trace":
         d = main_trace()
         print(json.dumps({
@@ -786,6 +928,8 @@ if __name__ == "__main__":
         "preempted": d.get("preempted", 0),
         "ingest_index_batches": p.get("ingest_index_batches", 0),
         "ingest_legacy_batches": p.get("ingest_legacy_batches", 0),
+        "term_index_batches": p.get("term_index_batches", 0),
+        "term_legacy_batches": p.get("term_legacy_batches", 0),
         "arbiter_batches": p.get("arbiter_batches", 0),
         "arbiter_place": p.get("arbiter_place", 0),
         "arbiter_defer": p.get("arbiter_defer", 0),

@@ -1,0 +1,40 @@
+"""MUST-FLAG KTPU003: unlocked refcount bookkeeping on a term-slab entry
+map.
+
+The term-bank plane's hazard shape (terms_plane/stage.py): entries are
+refcounted by queue holders on the INFORMER thread while the driver's
+dispatch prologue resolves them — an unlocked release is a lost-update
+race on `refs` that either frees rows a live dispatch is about to gather
+or pins them forever. Same RMW class as PR 5's vocab-slot interning bug.
+"""
+
+import threading
+
+
+class TermSlab:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.entries = {}  # ktpu: guarded-by(self._lock)
+        self.free_rows = []  # ktpu: guarded-by(self._lock)
+
+    def bad_release(self, eid):
+        e = self.entries.get(eid)  # <- unlocked read-modify-write
+        if e is not None:
+            e["refs"] -= 1
+            if e["refs"] <= 0:
+                self.free_rows.extend(e["rows"])
+                del self.entries[eid]
+
+    def good_release(self, eid):
+        with self._lock:
+            e = self.entries.get(eid)
+            if e is not None:
+                e["refs"] -= 1
+                if e["refs"] <= 0:
+                    self.free_rows.extend(e["rows"])
+                    del self.entries[eid]
+
+    # ktpu: holds(self._lock) the prologue resolves entries inside its
+    # locked capture window (the driver's _term_prologue contract)
+    def entry_for(self, eid):
+        return self.entries.get(eid)

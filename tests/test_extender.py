@@ -251,6 +251,37 @@ def test_filter_device_path_matches_oracle():
         srv.stop()
 
 
+def test_filter_device_path_memoizes_same_spec_pods():
+    """Term-plane satellite: /filter used to compile a fresh single-pod
+    PodBatch + TermBank per HTTP request. Repeated requests for
+    SAME-SPEC pods (replicas of one controller — the common extender
+    traffic) must hit the per-spec_key encode memo; a different spec
+    must miss it; and the cached answer must equal the fresh one."""
+    cache = SchedulerCache()
+    for i in range(8):
+        cache.add_node(make_node(
+            f"n{i}", cpu_milli=1000 if i % 2 else 4000, mem=8 * 2**30
+        ))
+    srv = ExtenderServer(cache=cache, device_threshold=4).start()
+    try:
+        names = [f"n{i}" for i in range(8)]
+        answers = []
+        for rep in range(3):  # replicas: same spec, different names
+            pod = make_pod(f"web-{rep}", cpu_milli=2000, mem=0,
+                           labels={"app": "web"})
+            res = _post(srv.url + "/filter",
+                        {"Pod": pod_to_k8s(pod), "NodeNames": names})
+            answers.append(sorted(res["NodeNames"]))
+        assert answers[0] == answers[1] == answers[2] == ["n0", "n2", "n4", "n6"]
+        assert srv.filter_encode_cache["misses"] == 1
+        assert srv.filter_encode_cache["hits"] == 2
+        other = make_pod("db-0", cpu_milli=500, mem=0, labels={"app": "db"})
+        _post(srv.url + "/filter", {"Pod": pod_to_k8s(other), "NodeNames": names})
+        assert srv.filter_encode_cache["misses"] == 2
+    finally:
+        srv.stop()
+
+
 def test_end_to_end_server_as_extender_for_fake_scheduler(server):
     """The fake-kube-scheduler flow end-to-end against ExtenderServer:
     filter → prioritize → bind round trip picking the best feasible node."""

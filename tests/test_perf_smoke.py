@@ -115,6 +115,37 @@ def test_perf_smoke_trace_mode(tmp_path, monkeypatch):
         assert stage in detail["span_names"], stage
 
 
+def test_perf_smoke_term_plane(tmp_path, monkeypatch):
+    """Term-bank-plane acceptance, tier-1-fast: on an affinity-heavy
+    quiet drain every dispatch gathers its term table from the
+    device-resident term bank (coverage > 0, zero stale entries, zero
+    legacy host compiles), `patch_bytes.terms` stays KB-scale (index/
+    owner vectors, not the padded term-table upload), and no program
+    compiles mid-drain."""
+    monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan_term"))
+    # the affinity-heavy drain doubles as a lock-order-audited drain for
+    # the new "terms" lock role (queue → terms nesting on the informer
+    # admission path, terms-upload worker in the mix)
+    monkeypatch.setenv("KTPU_LOCK_AUDIT", "1")
+    from kubernetes_tpu.analysis.lockorder import REGISTRY
+
+    REGISTRY.reset()
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main_terms()  # raises AssertionError on regression
+    REGISTRY.assert_acyclic()
+    phase = detail["phase_split_s"]
+    assert phase["term_index_batches"] > 0
+    assert phase.get("term_legacy_batches", 0) == 0
+    assert phase.get("term_stale_rows", 0) == 0
+    assert 0 < detail["patch_bytes"]["terms"] <= 64 * 1024
+    assert detail["mirror_rebuilds"] == 0
+    assert detail["compile"]["misses_after_warmup"] == 0
+    assert detail["scheduled"] == perf_smoke.N_PODS
+
+
 def test_perf_smoke_ingest_plane(tmp_path, monkeypatch):
     """Pod-ingest-plane acceptance, tier-1-fast: on a quiet drain every
     dispatch takes the index-only path (coverage > 0, zero stale-row

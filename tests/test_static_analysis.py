@@ -137,6 +137,31 @@ def test_ktpu003_confined_requires_matching_mark():
     assert not [v for v in got if v.scope in ("FoldBook.good_note", "FoldBook.__init__")]
 
 
+def test_ktpu003_term_slab_refcount_pair():
+    """The term-bank plane's fixture pair: an unlocked refcount
+    release on the entry map flags (lost-update race between informer
+    holders and the dispatch prologue); the locked twin and the holds()-
+    marked resolve helper pass."""
+    got = scan_fixture("ktpu003_term_slab.py")
+    scopes = rules_by_scope(got)
+    assert ("KTPU003", "TermSlab.bad_release") in scopes
+    assert ("KTPU003", "TermSlab.good_release") not in scopes
+    assert ("KTPU003", "TermSlab.entry_for") not in scopes
+
+
+def test_terms_plane_is_resident_surface_in_tree():
+    """The REAL term plane is a KTPU002 resident-surface module (its
+    device dicts must never be forced outside the designated sync
+    points) and the tree scan must be clean on it."""
+    cfg = repo_config()
+    assert any("kubernetes_tpu/terms_plane/" in p for p in cfg.surface_prefixes)
+    for name in ("stage.py", "bank.py", "gather.py"):
+        path = os.path.join(_REPO, "kubernetes_tpu", "terms_plane", name)
+        mod = load_module(path, _REPO)
+        got = run_checkers(mod, cfg, ALL_CHECKERS)
+        assert not [v.render() for v in got], name
+
+
 def test_ktpu004_flags_hot_path_sync():
     got = scan_fixture("ktpu004_hot_sync.py")
     scopes = rules_by_scope(got)

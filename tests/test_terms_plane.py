@@ -1,0 +1,527 @@
+"""Term-bank plane parity suite (kubernetes_tpu/terms_plane + the
+driver's index-only term dispatch).
+
+The tentpole's correctness pin: a drain with the term plane ON must
+schedule pod-for-pod identically to plane OFF (the plane is transport,
+never policy) across mixed/anti/spread/gang/preemption drains, while
+covering every quiet dispatch with the index path. Plus the staleness
+contract — update + delete between enqueue and pop re-stage or fall back
+(counted), slab overflow grows pow-2 leaving outstanding pairs
+verifiably stale — the term-slab refcount lifecycle (the ingest slab
+suite's mirror), the overflow_owners → scalar-oracle routing regression,
+and the interleaved A/B microbench smoke.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, POD_GROUP_LABEL, Scheduler
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+from kubernetes_tpu.state.tensors import Vocab
+
+HOST = "kubernetes.io/hostname"
+ZONE = "zone"
+
+
+def _nodes(n, zones=0, cpu=4000):
+    out = []
+    for i in range(n):
+        labels = {HOST: f"n{i}"}
+        if zones:
+            labels[ZONE] = f"z{i % zones}"
+        out.append(make_node(f"n{i}", cpu_milli=cpu, labels=labels))
+    return out
+
+
+def _anti_pod(name, app, cpu=100):
+    p = make_pod(name, cpu_milli=cpu, labels={"app": app})
+    p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            topology_key=HOST,
+        )
+    ]))
+    return p
+
+
+def _spread_pod(name, app, cpu=50):
+    p = make_pod(name, cpu_milli=cpu, labels={"app": app})
+    p.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": app}),
+    )]
+    return p
+
+
+def _pref_pod(name, app, cpu=50):
+    p = make_pod(name, cpu_milli=cpu, labels={"app": app})
+    p.affinity = Affinity(pod_affinity=PodAffinity(preferred=[
+        WeightedPodAffinityTerm(weight=3, pod_affinity_term=PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            topology_key=ZONE,
+        ))
+    ]))
+    return p
+
+
+def _mk_sched(nodes, existing=(), **kw):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(p)
+    kw.setdefault("deterministic", True)
+    return Scheduler(
+        cache=cache, queue=PriorityQueue(),
+        binder=Binder(lambda pod, node: None), **kw
+    )
+
+
+def _drain(sched, rounds=60):
+    total, assignments = 0, {}
+    for _ in range(rounds):
+        r = sched.schedule_batch()
+        total += r.scheduled
+        assignments.update(r.assignments)
+        if (r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0
+                and r.deferred == 0):
+            active, backoff, unsched = sched.queue.counts()
+            if not (active + backoff + unsched):
+                break
+            time.sleep(0.06)
+            sched.queue.move_all_to_active()
+    sched.wait_for_binds()
+    return total, assignments
+
+
+# ---------------------------------------------------------------------------
+# plane ON == OFF pod-for-pod
+# ---------------------------------------------------------------------------
+
+def _enqueue_scenario(sched, scenario):
+    q = sched.queue
+    if scenario == "mixed":
+        import random
+
+        rng = random.Random(0)
+        for i in range(24):
+            roll = rng.random()
+            if roll < 0.2:
+                q.add(_anti_pod(f"a{i}", app=f"g{rng.randrange(3)}"))
+            elif roll < 0.4:
+                q.add(_spread_pod(f"s{i}", app=f"sp{rng.randrange(2)}"))
+            elif roll < 0.55:
+                q.add(_pref_pod(f"w{i}", app=f"pp{rng.randrange(2)}"))
+            else:
+                q.add(make_pod(f"p{i}", cpu_milli=100 + 10 * (i % 3)))
+    elif scenario == "anti":
+        for i in range(12):
+            q.add(_anti_pod(f"a{i}", app=f"g{i % 4}"))
+    elif scenario == "spread":
+        for i in range(12):
+            q.add(_spread_pod(f"s{i}", app=f"sp{i % 2}"))
+    elif scenario == "gang":
+        for g in range(2):
+            for m in range(6):
+                q.add(make_pod(
+                    f"g{g}m{m}", cpu_milli=100,
+                    labels={POD_GROUP_LABEL: f"gang-{g}"},
+                ))
+        for i in range(6):
+            q.add(_anti_pod(f"a{i}", app=f"g{i % 2}"))
+    else:
+        raise AssertionError(scenario)
+
+
+@pytest.mark.parametrize("scenario", ["mixed", "anti", "spread", "gang"])
+def test_drain_parity_plane_on_vs_off(scenario):
+    results = {}
+    for terms in (True, False):
+        sched = _mk_sched(
+            _nodes(6, zones=3), enable_preemption=False, batch_size=8,
+            term_plane=terms,
+        )
+        _enqueue_scenario(sched, scenario)
+        sched.warmup()
+        n, assigns = _drain(sched)
+        results[terms] = (n, assigns)
+        if terms:
+            assert sched.stats.get("term_index_batches", 0) > 0, sched.stats
+            assert sched.stats.get("term_legacy_batches", 0) == 0, sched.stats
+        sched.close()
+    assert results[True] == results[False]
+
+
+def test_preemption_drain_parity_plane_on_vs_off():
+    results = {}
+    for terms in (True, False):
+        nodes = _nodes(3, cpu=1000)
+        existing = []
+        for i, nd in enumerate(nodes):
+            v = make_pod(f"victim{i}", cpu_milli=900, node_name=nd.name)
+            v.priority = 0
+            existing.append(v)
+        sched = _mk_sched(
+            nodes, existing=existing, enable_preemption=True, batch_size=8,
+            term_plane=terms,
+        )
+        for i in range(3):
+            p = _anti_pod(f"hi{i}", app="hi", cpu=800)
+            p.priority = 1000
+            sched.queue.add(p)
+        sched.warmup()
+        n, assigns = _drain(sched)
+        results[terms] = (n, assigns)
+        sched.close()
+    assert results[True][0] == 3
+    assert results[True] == results[False]
+
+
+def test_node_churn_drain_parity_plane_on_vs_off():
+    """Node add/remove mid-drain: node-side row remaps and bank rebuilds
+    must not perturb the term plane (and vice versa)."""
+    results = {}
+    for terms in (True, False):
+        sched = _mk_sched(
+            _nodes(4, zones=2), enable_preemption=False, batch_size=8,
+            term_plane=terms,
+        )
+        for i in range(8):
+            sched.queue.add(_spread_pod(f"s{i}", app=f"sp{i % 2}"))
+        sched.warmup()
+        r1 = sched.schedule_batch()
+        sched.cache.remove_node("n3")
+        sched.cache.add_node(make_node(
+            "n9", cpu_milli=4000, labels={HOST: "n9", ZONE: "z1"}
+        ))
+        for i in range(8, 16):
+            sched.queue.add(_anti_pod(f"a{i}", app=f"g{i % 4}"))
+        n, assigns = _drain(sched)
+        results[terms] = (r1.scheduled + n, sorted(assigns))
+        sched.close()
+    assert results[True] == results[False]
+
+
+# ---------------------------------------------------------------------------
+# staleness: update + delete between enqueue and pop
+# ---------------------------------------------------------------------------
+
+def test_update_between_enqueue_and_pop_uses_new_terms():
+    """An update that changes the pod's TERMS must be what the solve sees
+    — the stale interned entry (old terms) is invalidated and the entry
+    re-interns on the informer path."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    q = sched.queue
+    # required affinity to a label NO existing pod carries, and the pod
+    # does not match its own term → infeasible everywhere
+    blocked = make_pod("u0", cpu_milli=100, labels={"app": "u"})
+    blocked.affinity = Affinity(pod_affinity=PodAffinity(required=[
+        PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"anchor": "nowhere"}),
+            topology_key=HOST,
+        )
+    ]))
+    q.add(blocked)
+    fixed = make_pod("u0", cpu_milli=100, labels={"app": "u"})  # terms gone
+    q.update(blocked, fixed)
+    sched.warmup()
+    n, assigns = _drain(sched)
+    assert n == 1 and "default/u0" in assigns
+    sched.close()
+
+
+def test_delete_between_pop_and_dispatch_counts_stale_and_restages():
+    """queue.delete releases the entry's interned terms; a popped copy
+    still in flight sees the generation mismatch, counts the staleness,
+    re-interns from the captured pod object — the dispatch stays covered
+    and the placement is unaffected."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    q = sched.queue
+    lone = _anti_pod("lone", app="only")
+    q.add(lone)
+    sched.warmup()
+    infos = q.pop_batch(8)
+    assert len(infos) == 1 and infos[0].term_row >= 0
+    eid, gen = infos[0].term_row, infos[0].term_gen
+    q.delete(lone)  # last holder: the entry frees
+    assert not sched.tstage.valid_pair(eid, gen)
+    out = sched._device_solve(infos)
+    assert int(out.assign[0]) >= 0
+    assert sched.stats.get("term_stale_rows", 0) >= 1
+    assert sched.stats.get("term_restaged", 0) >= 1
+    assert sched.stats.get("term_index_batches", 0) >= 1  # still covered
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# term-slab refcount lifecycle (the ingest slab suite's mirror)
+# ---------------------------------------------------------------------------
+
+def test_slab_acquire_release_refcount_lifecycle():
+    from kubernetes_tpu.terms_plane import TermStage
+
+    st = TermStage(Vocab())
+    p = _anti_pod("r0", app="x")
+    pair = st.acquire(p)
+    assert pair is not None
+    eid, gen = pair
+    e = st._entries[eid]
+    assert e.refs == 1 and len(e.rows) == 1 and e.has_anti
+    # replica of the same spec: intern HIT on the same entry, +1 ref
+    p2 = _anti_pod("r1", app="x")
+    assert st.acquire(p2) == pair and e.refs == 2
+    free_before = len(st._free)
+    st.release(eid, gen)
+    assert e.refs == 1 and st.valid_pair(eid, gen)
+    st.release(eid, gen)  # last holder: rows free, entry gone
+    assert not st.valid_pair(eid, gen)
+    assert len(st._free) == free_before + 1
+    # stale release is a no-op
+    st.release(eid, gen)
+    # re-acquire re-encodes into a FRESH entry (new id, new gen)
+    pair2 = st.acquire(_anti_pod("r2", app="x"))
+    assert pair2 is not None and pair2 != pair
+
+
+def test_queue_requeue_and_unschedulable_keep_one_reference():
+    """add → pop → requeue / add_unschedulable round-trips must neither
+    leak references nor drop the entry."""
+    sched = _mk_sched(_nodes(2), enable_preemption=False, batch_size=8)
+    q = sched.queue
+    q.add(_anti_pod("rq", app="rq"))
+    info = q.pop_batch(1)[0]
+    eid, gen = info.term_row, info.term_gen
+    entry = sched.tstage._entries[eid]
+    assert entry.refs == 1
+    q.requeue([info])
+    assert (info.term_row, info.term_gen) == (eid, gen) and entry.refs == 1
+    info = q.pop_batch(1)[0]
+    q.add_unschedulable(info)
+    assert (info.term_row, info.term_gen) == (eid, gen) and entry.refs == 1
+    q.delete(info.pod)
+    assert not sched.tstage.valid_pair(eid, gen)
+    sched.close()
+
+
+def test_mid_queue_label_update_bumps_generation():
+    """A label update changes spread self-match (labels are in the intern
+    key): the update must land a DIFFERENT entry and free the old one —
+    the staleness tag for any popped copy."""
+    sched = _mk_sched(_nodes(4, zones=2), enable_preemption=False,
+                      batch_size=8)
+    q = sched.queue
+    old = _spread_pod("lu", app="a")
+    q.add(old)
+    info = next(i for i in q.pending_infos() if i.pod.key() == "default/lu")
+    eid, gen = info.term_row, info.term_gen
+    assert eid >= 0
+    new = _spread_pod("lu", app="b")  # selector + labels change
+    q.update(old, new)
+    assert (info.term_row, info.term_gen) != (eid, gen)
+    assert not sched.tstage.valid_pair(eid, gen)
+    assert sched.tstage.valid_pair(info.term_row, info.term_gen)
+    sched.close()
+
+
+def test_slab_overflow_grows_pow2_and_invalidates(monkeypatch):
+    from kubernetes_tpu.terms_plane import stage as stage_mod
+
+    monkeypatch.setattr(stage_mod, "MIN_CAPACITY", 4)
+    st = stage_mod.TermStage(Vocab(), capacity=4)
+    pairs = [st.acquire(_anti_pod(f"o{i}", app=f"g{i}")) for i in range(4)]
+    assert all(p is not None for p in pairs)
+    # 5th distinct term set: slab full → grows to the next pow-2 rung,
+    # every outstanding pair goes verifiably stale
+    p5 = st.acquire(_anti_pod("o4", app="g4"))
+    assert p5 is not None and st.capacity == 8
+    assert st.stats["overflows"] == 1 and st.stats["rebuilds"] == 1
+    assert all(not st.valid_pair(e, g) for e, g in pairs)
+    assert st.valid_pair(*p5)
+
+
+def test_slab_ceiling_falls_back_to_legacy_dispatch(monkeypatch):
+    """When a rep's terms cannot be staged at all, the batch compiles the
+    legacy host TermBank — counted, never wrong."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    for i in range(6):
+        sched.queue.add(_anti_pod(f"p{i}", app=f"g{i % 2}"))
+    sched.warmup()
+    monkeypatch.setattr(
+        sched.tstage, "ensure_entry",
+        lambda pod, selectors=None: None,
+    )
+    for info in sched.queue.pending_infos():
+        info.term_row = -1
+    n, _ = _drain(sched)
+    assert n == 6
+    assert sched.stats.get("term_legacy_batches", 0) >= 1, sched.stats
+    assert sched.stats.get("term_stale_rows", 0) >= 1
+    sched.close()
+
+
+def test_prologue_bails_when_slab_rebuilds_mid_resolve(monkeypatch):
+    """A slab rebuild DURING entry resolution (a restage growing a full
+    slab) invalidates the rows already collected — the prologue must
+    detect the generation change and fall back to the legacy path rather
+    than gather garbage rows from the rebuilt slab."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    for i in range(4):
+        sched.queue.add(_anti_pod(f"p{i}", app=f"g{i}"))
+    sched.warmup()
+    infos = sched.queue.pop_batch(8)
+    assert len(infos) == 4
+    infos[-1].term_row = -1  # one stale rep, resolved AFTER the others
+    real_ensure = sched.tstage.ensure_entry
+
+    def growing_ensure(pod, selectors=None):
+        sched.tstage._rebuild(sched.tstage.capacity * 2)
+        return real_ensure(pod, selectors)
+
+    monkeypatch.setattr(sched.tstage, "ensure_entry", growing_ensure)
+    reps = [pi.pod for pi in infos]
+    keys = [pi.pod.__dict__.get("_spec_key_memo") for pi in infos]
+    assert sched._term_prologue(reps, infos, keys, None) is None
+    # self-heal: the next dispatch re-interns into the new slab
+    monkeypatch.setattr(sched.tstage, "ensure_entry", real_ensure)
+    out = sched._device_solve(infos)
+    assert all(int(a) >= 0 for a in out.assign[: len(infos)])
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# overflow_owners → scalar-oracle routing (satellite regression)
+# ---------------------------------------------------------------------------
+
+def _overflowing_pod(name):
+    """ml_cap (4) + 1 matchLabels pairs: the compiled selector truncates,
+    so the device row under-matches — the pod MUST route through the
+    scalar oracle (TermBank.overflow_owners / TermEntry.overflow)."""
+    p = make_pod(name, cpu_milli=100, labels={f"k{j}": "v" for j in range(5)})
+    p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(
+            label_selector=LabelSelector(
+                match_labels={f"k{j}": "v" for j in range(5)}
+            ),
+            topology_key=HOST,
+        )
+    ]))
+    return p
+
+
+@pytest.mark.parametrize("terms", [True, False])
+def test_overflowing_terms_pod_reaches_scalar_oracle(terms):
+    """Regression for the overflow routing on BOTH transports: the
+    covered path patches only the host fallback vector — the pod must
+    still reach the oracle (fallback=True in SolveOutput) and schedule
+    correctly."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8,
+                      term_plane=terms)
+    sched.queue.add(_overflowing_pod("ov0"))
+    sched.warmup()
+    infos = sched.queue.pop_batch(8)
+    out = sched._device_solve(infos)
+    assert bool(out.fallback[0]), (
+        "overflowing-terms pod did not route to the scalar oracle "
+        f"(term_plane={terms})"
+    )
+    if terms:
+        assert sched.stats.get("term_index_batches", 0) >= 1
+    # and the full drain still places it through the scalar oracle — a
+    # device pick with fallback set escalates to the FULL oracle
+    # recheck; a -1 would make the oracle place it outright
+    sched.queue.requeue(infos)
+    n, assigns = _drain(sched)
+    assert n == 1 and "default/ov0" in assigns
+    assert (
+        sched.stats.get("oracle_rechecks", 0) >= 1
+        or sched.stats.get("oracle_places", 0) >= 1
+    ), sched.stats
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# kill switch + wire accounting + microbench smoke
+# ---------------------------------------------------------------------------
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("KTPU_TERM_PLANE", "0")
+    sched = _mk_sched(_nodes(2), enable_preemption=False, batch_size=4)
+    assert sched.tstage is None and sched.term_bank is None
+    for i in range(2):
+        sched.queue.add(_anti_pod(f"k{i}", app="k"))
+    sched.warmup()
+    n, _ = _drain(sched)
+    assert n == 2
+    assert sched.stats.get("term_index_batches", 0) == 0
+    sched.close()
+
+
+def test_terms_ledger_index_vs_legacy_bytes():
+    """patch_bytes.terms: the covered path ships KB-scale index/owner
+    vectors where the legacy path ships the full padded term table —
+    both measured on the SAME ledger kind so the claim is a byte count."""
+    sizes = {}
+    for terms in (True, False):
+        sched = _mk_sched(_nodes(4, zones=2), enable_preemption=False,
+                          batch_size=16, term_plane=terms)
+        for i in range(32):
+            sched.queue.add(_anti_pod(f"p{i}", app=f"a{i % 8}"))
+        sched.warmup()
+        before = sched.mirror.bytes_shipped.get("terms", 0)
+        n, _ = _drain(sched)
+        assert n == 32
+        sizes[terms] = sched.mirror.bytes_shipped.get("terms", 0) - before
+        sched.close()
+    assert sizes[True] * 4 < sizes[False], sizes
+
+
+def test_microbench_terms_smoke():
+    scripts = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    )
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import microbench_terms
+
+    result = microbench_terms.main(smoke=True)
+    assert result["bit_identical"]
+    assert result["index_s"] < result["host_built_s"]
+    assert result["index_bytes"] < result["host_built_bytes"]
+
+
+def test_background_uploader_drains_dirty_term_rows():
+    """Entries interned while the drain runs are shipped by the
+    off-thread terms-upload worker — the driver's dispatch should not
+    have to flush them synchronously every batch."""
+    sched = _mk_sched(_nodes(4), enable_preemption=False, batch_size=8)
+    for i in range(8):
+        sched.queue.add(_anti_pod(f"p{i}", app=f"g{i % 2}"))
+    sched.warmup()  # arms the uploader + full-uploads the backlog
+    for i in range(8, 16):
+        sched.queue.add(_anti_pod(f"q{i}", app=f"h{i}"))
+    deadline = time.time() + 5
+    while sched.tstage.dirty_rows and time.time() < deadline:
+        time.sleep(0.02)
+    assert not sched.tstage.dirty_rows, "terms uploader never drained"
+    assert sched.term_bank.stats["flush_rows"] > 0
+    n, _ = _drain(sched)
+    assert n == 16
+    sched.close()
