@@ -244,7 +244,19 @@ class Pod:
     conditions: List[Dict[str, Any]] = field(default_factory=list)
 
     def key(self) -> str:
-        return f"{self.namespace}/{self.name}"
+        # memoized: called several times per pod per batch across the
+        # state machine (assume/finish/forget), the queue, and the
+        # oracle's remove-scan; with_node clones carry it. The memo is
+        # VALIDATED against name/namespace identity — the controllers
+        # clone a template and then rename it (new_child_pod,
+        # StatefulSet._ordinal_pod), so a blind cache would pin every
+        # child to the template's identity.
+        m = self.__dict__.get("_key_memo")
+        if m is not None and m[0] is self.namespace and m[1] is self.name:
+            return m[2]
+        k = f"{self.namespace}/{self.name}"
+        self.__dict__["_key_memo"] = (self.namespace, self.name, k)
+        return k
 
     def with_node(self, node_name: str) -> "Pod":
         """Shallow clone bound to a node — the assume-path equivalent of

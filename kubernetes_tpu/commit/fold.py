@@ -92,16 +92,34 @@ def plan_fold(
     sig = np.full(row_bucket, s_cap, np.int32)
     triples: List[Tuple[int, int]] = []
     vocab = mirror.vocab
+    # ONE DELTA SOURCE (state/columns.py): with the columnar cache
+    # attached, the per-pod request/non-zero vectors GATHER from the same
+    # interned spec rows the host columns scatter by — the device fold
+    # and the host cache advance from literally the same integers
+    # (INVARIANTS.md one-delta-source rule). Without columns, the legacy
+    # per-pod build from the same memoized sources.
+    cols = getattr(mirror.cache, "_columns", None)
+    if cols is not None and cols.vocab is not mirror.vocab:
+        # columns rebuilt on another scheduler's Vocab (attach_columns
+        # re-attach): its spec rows are in a different resource-slot
+        # order — gathering them would scatter wrong-slot matrices into
+        # THIS mirror's banks. Fall back to the per-pod build.
+        cols = None
     try:
+        if cols is not None:
+            req_m, nz_m = cols.delta_mats([p for p, _ in pairs], width)
+            req[:n] = req_m
+            nz[:n] = nz_m
         for i, (pod, row) in enumerate(pairs):
             rows[i] = row
-            for s, v in _req_slot_pairs(vocab, pod):
-                if s >= width:
-                    raise KeySlotOverflow()
-                req[i, s] = v
-            c, m = pod_non_zero_request(pod)
-            nz[i, 0] = c
-            nz[i, 1] = m
+            if cols is None:
+                for s, v in _req_slot_pairs(vocab, pod):
+                    if s >= width:
+                        raise KeySlotOverflow()
+                    req[i, s] = v
+                c, m = pod_non_zero_request(pod)
+                nz[i, 0] = c
+                nz[i, 1] = m
             cnt[i] = 1
             sig[i] = mirror.eps.prepare_row(pod)
             for prow in mirror.pats.prepare_pod_rows(pod):

@@ -61,6 +61,11 @@ class NodeInfo:
         self._nz_cpu = 0
         self._nz_mem = 0
         self._ports: Dict[Tuple[str, str, int], int] = {}
+        # lazy-view generation tag (state/columns.py): when this NodeInfo
+        # is a columnar cache's view, materialization stamps it with the
+        # row's column generation — a reader comparing against
+        # CacheColumns.row_gen can tell exactly how stale a view is
+        self.generation = 0
         for p in self.pods:
             self._account(p, 1)
 

@@ -527,6 +527,7 @@ class Scheduler:
         fold_plane: bool = True,
         ingest_plane: bool = True,
         term_plane: bool = True,
+        columnar_cache: bool = True,
         trace: Optional[bool] = None,
     ):
         self.cache = cache or SchedulerCache()
@@ -660,6 +661,20 @@ class Scheduler:
         # to the resident bank dicts (background warms get synthetic
         # banks), so the mirror's row scatters may donate them too
         self.mirror.donate_patches = self.fold_plane
+        # columnar scheduler cache (state/columns.py): the cache's hot
+        # state moves into contiguous numpy columns patched by vectorized
+        # scatter-adds of the SAME interned per-spec delta rows the fold
+        # plane ships (one delta source), and the per-name NodeInfo
+        # object cache becomes a lazily-materialized, generation-tagged
+        # view — bulk assume/forget on the covered path performs zero
+        # per-pod NodeInfo/Quantity object updates. Transport/bookkeeping
+        # only: placements are bit-identical either way (tests pin this).
+        # KTPU_COLUMNAR_CACHE=0 is the operational kill switch.
+        self.columnar_cache = columnar_cache and _os.environ.get(
+            "KTPU_COLUMNAR_CACHE", "1"
+        ) != "0"
+        if self.columnar_cache:
+            self.cache.attach_columns(self.mirror.vocab)
         # monotone pattern-triple bucket for the commit fold's [T] axis
         # and nominee-row bucket for the overlay fold's [B] axis — ladder
         # rungs, so each stays one XLA signature as it grows
@@ -2654,7 +2669,15 @@ class Scheduler:
         verdicts = out.verdicts
         assign = out.assign
         name_of = self.mirror.name_of_row
-        snap_get = self.cache.snapshot.get
+        # RAW (non-resolving) snapshot reads: this loop needs node
+        # EXISTENCE and the Node object only — never the pod-derived
+        # aggregates — so it must not materialize lazy NodeInfo views on
+        # the commit path (perf_smoke's columnar mode pins zero
+        # materializations); the one pod-derived read below (speculative
+        # host-port staleness) consults the hot port COLUMNS instead.
+        snap_infos = self.cache.snapshot.node_infos
+        cache_cols = self.cache._columns
+        raw_get = dict.get
         place: List[Tuple[PodInfo, str]] = []
         defers: List[Tuple[int, PodInfo]] = []
         escalate: List[Tuple[int, PodInfo]] = []
@@ -2672,7 +2695,7 @@ class Scheduler:
             pod = info.pod
             if v == V_PLACE and row >= 0:
                 node_name = name_of[row] if 0 <= row < len(name_of) else None
-                ni = snap_get(node_name) if node_name is not None else None
+                ni = raw_get(snap_infos, node_name) if node_name is not None else None
                 if ni is None:
                     defers.append((i, info))  # node vanished under the solve
                     continue
@@ -2684,10 +2707,10 @@ class Scheduler:
                 ):
                     defers.append((i, info))
                     continue
-                if (
-                    speculative
-                    and pod.host_ports()
-                    and ni.host_port_conflict(pod)
+                if speculative and pod.host_ports() and (
+                    cache_cols.host_port_conflict(node_name, pod)
+                    if cache_cols is not None
+                    else ni.host_port_conflict(pod)
                 ):
                     defers.append((i, info))
                     continue

@@ -149,6 +149,12 @@ class SchedulerCache:
         self.snapshot = Snapshot()
         self._pod_states: Dict[str, _PodState] = {}
         self._assumed: Set[str] = set()
+        # columnar plane (state/columns.py): attached by the driver under
+        # KTPU_COLUMNAR_CACHE — bulk assume/forget become vectorized
+        # column scatters and the NodeInfo objects a lazy journal-backed
+        # view. None = every legacy path intact (the kill switch).
+        self._columns = None
+        self._deadlines = None
         self.dirty_nodes: Set[str] = set()  # generation-equivalent dirty set
         self.removed_nodes: Set[str] = set()
         # bumped on every snapshot mutation — the driver's speculative
@@ -168,23 +174,112 @@ class SchedulerCache:
 
         self.node_tree = NodeTree()
 
+    # -- columnar plane (state/columns.py) -----------------------------------
+
+    def attach_columns(self, vocab):
+        """Arm the columnar cache: hot columns adopt the current state,
+        `snapshot.node_infos` becomes a lazy view resolved through the
+        columns' journal, and assumed-pod TTLs move to a deadline
+        column. Idempotent; called once by the driver (the
+        KTPU_COLUMNAR_CACHE kill switch simply skips the call)."""
+        from .columns import AssumedDeadlines, CacheColumns, LazyNodeInfos
+
+        with self._lock:
+            if self._columns is not None:
+                if self._columns.vocab is vocab:
+                    return self._columns
+                # a SECOND scheduler over this cache brings its own mirror
+                # Vocab: the interned spec rows are in the OLD vocab's
+                # resource-slot order — silently reusing them would scatter
+                # old-slot matrices into new-slot banks. Materialize every
+                # view and rebuild the columns on the new vocab (the stale
+                # per-pod slot memos are keyed by columns identity, so
+                # they miss harmlessly).
+                self._materialize_view(None)
+                self._columns = None
+            cols = CacheColumns(
+                vocab, self._lock,
+                capacity=max(len(self.snapshot.node_infos), 1),
+            )
+            for name, ni in self.snapshot.node_infos.items():
+                row = cols.add_node_locked(name, ni.node.labels)
+                cols.ingest_node_locked(row, ni)
+            if self._deadlines is None:
+                self._deadlines = AssumedDeadlines(self._lock)
+                for key in self._assumed:
+                    st = self._pod_states[key]
+                    if st.binding_finished and st.deadline is not None:
+                        self._deadlines.set_bulk_locked([key], st.deadline)
+            if not isinstance(self.snapshot.node_infos, LazyNodeInfos):
+                lazy = LazyNodeInfos(self.snapshot.node_infos)
+                lazy._resolve = self._materialize_view
+                self.snapshot.node_infos = lazy
+            self._columns = cols
+            return cols
+
+    def _materialize_view(self, name: Optional[str]) -> None:
+        """LazyNodeInfos resolver: replay the pending column journal into
+        the named NodeInfo view (None = every stale row) before the
+        object leaves the map. Raw dict access below — resolving through
+        the lazy map again would recurse."""
+        cols = self._columns
+        if cols is None or not cols._stale_rows:
+            return
+        with self._lock:
+            raw = self.snapshot.node_infos
+            if name is not None:
+                row = cols.row_of.get(name)
+                if row is None or not cols.row_stale_locked(row):
+                    return
+                ni = dict.get(raw, name)
+                if ni is not None:
+                    cols.materialize_into_locked(name, ni)
+                return
+            for row in sorted(cols._stale_rows):
+                nm = cols.name_of_row[row]
+                ni = dict.get(raw, nm) if nm is not None else None
+                if ni is not None:
+                    cols.materialize_into_locked(nm, ni)
+
+    def _drain_overgrown_locked(self) -> None:
+        """Materialize rows whose lazy-view journal hit JOURNAL_BOUND —
+        the deferral is an optimization, never an unbounded memory leak
+        on a node nothing ever reads."""
+        cols = self._columns
+        raw = self.snapshot.node_infos
+        for row in list(cols._overgrown):
+            nm = cols.name_of_row[row]
+            ni = dict.get(raw, nm) if nm is not None else None
+            if ni is not None:
+                cols.materialize_into_locked(nm, ni)
+            else:
+                cols._overgrown.discard(row)
+
     # -- helpers -------------------------------------------------------------
 
     def _node_info(self, name: str) -> Optional[NodeInfo]:
         return self.snapshot.get(name)
 
     def _add_pod_to_node(self, pod: Pod, folded: bool = False) -> None:
+        # snapshot.get resolves the lazy view first (columnar mode), so
+        # the eager object update below lands in journal order
         ni = self.snapshot.get(pod.node_name)
+        cols = self._columns
         if ni is None:
             # pod on an unknown node: track headlessly (reference keeps an
             # imaginary NodeInfo; it becomes real when the node arrives)
             ni = self.snapshot.add_node(Node(name=pod.node_name))
             ni.node.labels = {}
             ni.add_pod(pod)
+            if cols is not None:
+                row = cols.add_node_locked(pod.node_name, {})
+                cols.apply_one_locked(row, pod, 1)
             self.dirty_nodes.add(pod.node_name)
             self.mutation_count += 1
             return
         ni.add_pod(pod)
+        if cols is not None:
+            cols.apply_one_locked(cols.row_of[pod.node_name], pod, 1)
         self.mutation_count += 1
         # single-pod change: a DELTA, not node dirt — the mirror patches the
         # node row + signature/pattern counts in O(1) instead of re-counting
@@ -197,22 +292,28 @@ class SchedulerCache:
             return
         removed = ni.remove_pod_key(pod.key())
         if removed is not None:
+            cols = self._columns
+            if cols is not None:
+                cols.apply_one_locked(cols.row_of[pod.node_name], removed, -1)
             self.mutation_count += 1
             self._push_delta(pod.node_name, removed, -1)
 
-    def _push_delta(self, name: str, pod: Pod, sign: int, folded: bool = False) -> None:
-        # bounded: with no mirror attached (or one that syncs rarely) the
-        # delta log must not pin every churned Pod forever — past the bound,
-        # collapse it into the node-count-bounded dirty set (a re-encoded
-        # node row ships fully, so collapsed FOLDED deltas stay correct:
-        # host wins the whole row)
+    def _collapse_deltas_locked(self) -> None:
+        """The ONE delta-log bound: with no mirror attached (or one that
+        syncs rarely) the log must not pin every churned Pod forever —
+        past the bound, collapse it into the node-count-bounded dirty
+        set. A re-encoded node row ships fully, so collapsed FOLDED
+        deltas stay correct: host wins the whole row. The scalar path
+        checks per push (_push_delta); the bulk paths append raw in
+        their loops and check once per batch."""
         if len(self.pod_deltas) >= max(1024, 4 * len(self.snapshot.node_infos)):
             for n, _, _, _ in self.pod_deltas:
                 self.dirty_nodes.add(n)
             self.pod_deltas.clear()
-            self.dirty_nodes.add(name)
-            return
+
+    def _push_delta(self, name: str, pod: Pod, sign: int, folded: bool = False) -> None:
         self.pod_deltas.append((name, pod, sign, folded))
+        self._collapse_deltas_locked()
 
     # -- assumed pod state machine (cache.go:270-388) ------------------------
 
@@ -240,6 +341,29 @@ class SchedulerCache:
         with self._lock:
             states = self._pod_states
             assumed = self._assumed
+            cols = self._columns
+            if cols is None:
+                for i, pod in enumerate(pods):
+                    key = pod.key()
+                    if key in states:
+                        rejected.append(i)
+                        continue
+                    states[key] = _PodState(pod=pod, assumed=True)
+                    assumed.add(key)
+                    self._add_pod_to_node(pod, folded)
+                return rejected
+            # COLUMNAR bulk assume: per pod only the state-machine dict
+            # inserts + a journal append survive — the NodeInfo/Quantity
+            # object walk is gone; the columns advance by one vectorized
+            # scatter of the interned per-spec delta rows (the same rows
+            # the fold plane ships to the device banks). The delta pushes
+            # are inlined with one hoisted bound check (the per-pod
+            # _push_delta call + bound recompute was a measurable slice
+            # of the loop at 4096-pod batches).
+            row_of = cols.row_of
+            deltas = self.pod_deltas
+            acc_rows: List[int] = []
+            acc_pods: List[Pod] = []
             for i, pod in enumerate(pods):
                 key = pod.key()
                 if key in states:
@@ -247,7 +371,21 @@ class SchedulerCache:
                     continue
                 states[key] = _PodState(pod=pod, assumed=True)
                 assumed.add(key)
-                self._add_pod_to_node(pod, folded)
+                row = row_of.get(pod.node_name)
+                if row is None:
+                    # unknown node: the eager headless path (creates the
+                    # placeholder NodeInfo and its columns row)
+                    self._add_pod_to_node(pod, folded)
+                    continue
+                acc_rows.append(row)
+                acc_pods.append(pod)
+                deltas.append((pod.node_name, pod, 1, folded))
+            if acc_pods:
+                self._collapse_deltas_locked()
+                cols.assume_bulk_locked(acc_rows, acc_pods)
+                self.mutation_count += len(acc_pods)
+                if cols._overgrown:
+                    self._drain_overgrown_locked()
         return rejected
 
     def finish_binding(self, pod: Pod) -> None:
@@ -258,18 +396,25 @@ class SchedulerCache:
                 return
             st.binding_finished = True
             st.deadline = self._now() + self._ttl
+            if self._deadlines is not None:
+                self._deadlines.set_bulk_locked([pod.key()], st.deadline)
 
     def finish_bindings(self, pods: List[Pod]) -> None:
         """Bulk FinishBinding: one lock + one clock read for a whole bind
         chunk."""
         with self._lock:
             deadline = self._now() + self._ttl
+            done = [] if self._deadlines is not None else None
             for pod in pods:
                 st = self._pod_states.get(pod.key())
                 if st is None or not st.assumed:
                     continue
                 st.binding_finished = True
                 st.deadline = deadline
+                if done is not None:
+                    done.append(pod.key())
+            if done:
+                self._deadlines.set_bulk_locked(done, deadline)
 
     def forget_pod(self, pod: Pod) -> None:
         """ForgetPod: bind failed; undo the assume (cache.go:334)."""
@@ -281,6 +426,8 @@ class SchedulerCache:
             self._remove_pod_from_node(st.pod)
             del self._pod_states[key]
             self._assumed.discard(key)
+            if self._deadlines is not None:
+                self._deadlines.discard_locked(key)
 
     def forget_pods(self, pods: List[Pod]) -> None:
         """Bulk ForgetPod under ONE lock — the gang-rollback counterpart of
@@ -288,14 +435,47 @@ class SchedulerCache:
         with one call). Pods not in the assumed state are skipped, exactly
         like forget_pod."""
         with self._lock:
+            cols = self._columns
+            if cols is None:
+                for pod in pods:
+                    key = pod.key()
+                    st = self._pod_states.get(key)
+                    if st is None or not st.assumed:
+                        continue
+                    self._remove_pod_from_node(st.pod)
+                    del self._pod_states[key]
+                    self._assumed.discard(key)
+                return
+            # COLUMNAR bulk forget: the exact integer inverse of the bulk
+            # assume — one vectorized subtract, journaled removes
+            states = self._pod_states
+            assumed = self._assumed
+            dl = self._deadlines
+            deltas = self.pod_deltas
+            acc_rows: List[int] = []
+            acc_pods: List[Pod] = []
             for pod in pods:
                 key = pod.key()
-                st = self._pod_states.get(key)
+                st = states.get(key)
                 if st is None or not st.assumed:
                     continue
-                self._remove_pod_from_node(st.pod)
-                del self._pod_states[key]
-                self._assumed.discard(key)
+                p = st.pod
+                del states[key]
+                assumed.discard(key)
+                dl.discard_locked(key)
+                row = cols.row_of.get(p.node_name)
+                if row is None:
+                    self._remove_pod_from_node(p)  # node vanished since
+                    continue
+                acc_rows.append(row)
+                acc_pods.append(p)
+                deltas.append((p.node_name, p, -1, False))
+            if acc_pods:
+                self._collapse_deltas_locked()
+                cols.forget_bulk_locked(acc_rows, acc_pods)
+                self.mutation_count += len(acc_pods)
+                if cols._overgrown:
+                    self._drain_overgrown_locked()
 
     # -- informer-confirmed pod events (cache.go:389-520) --------------------
 
@@ -313,6 +493,8 @@ class SchedulerCache:
                 self._add_pod_to_node(pod)
                 self._pod_states[key] = _PodState(pod=pod)
                 self._assumed.discard(key)
+                if self._deadlines is not None:
+                    self._deadlines.discard_locked(key)
                 return
             if st is not None:
                 self.update_pod(st.pod, pod)
@@ -331,6 +513,8 @@ class SchedulerCache:
             key = pod.key()
             st = self._pod_states.pop(key, None)
             self._assumed.discard(key)
+            if self._deadlines is not None:
+                self._deadlines.discard_locked(key)
             if st is not None:
                 self._remove_pod_from_node(st.pod)
 
@@ -355,10 +539,35 @@ class SchedulerCache:
     def cleanup_expired(self) -> List[Pod]:
         """cleanupAssumedPods (cache.go:658): drop assumed pods whose bind
         confirmation never arrived within TTL (self-healing after lost
-        binds). Returns the expired pods so the driver can re-queue them."""
+        binds). Returns the expired pods so the driver can re-queue them.
+
+        Columnar mode: the candidate set comes from ONE vectorized
+        compare over the deadline column (`deadline < now`) instead of a
+        per-pod TTL walk under the cache lock every cycle; each hit is
+        re-validated against the state machine before eviction (a slot
+        whose pod moved on via an informer update is dropped, never
+        re-fired)."""
         with self._lock:
             now = self._now()
             expired = []
+            if self._deadlines is not None:
+                for key in self._deadlines.expired_locked(now):
+                    st = self._pod_states.get(key)
+                    if (
+                        st is None
+                        or not st.assumed
+                        or not st.binding_finished
+                        or st.deadline is None
+                        or now <= st.deadline
+                    ):
+                        self._deadlines.discard_locked(key)
+                        continue
+                    expired.append(st.pod)
+                    self._remove_pod_from_node(st.pod)
+                    del self._pod_states[key]
+                    self._assumed.discard(key)
+                    self._deadlines.discard_locked(key)
+                return expired
             for key in list(self._assumed):
                 st = self._pod_states[key]
                 if st.binding_finished and st.deadline is not None and now > st.deadline:
@@ -379,6 +588,12 @@ class SchedulerCache:
             else:
                 self.node_tree.update_node(ni.node, node)
                 ni.node = node  # was a headless placeholder
+            cols = self._columns
+            if cols is not None:
+                if node.name in cols.row_of:
+                    cols.set_zone_locked(node.name, node.labels)
+                else:
+                    cols.add_node_locked(node.name, node.labels)
             self.dirty_nodes.add(node.name)
             self.removed_nodes.discard(node.name)
             self.mutation_count += 1
@@ -388,12 +603,18 @@ class SchedulerCache:
 
     def remove_node(self, name: str) -> None:
         with self._lock:
+            # the lazy map's pop resolves the view first — the pods list
+            # below must be current before the object leaves the map
             ni = self.snapshot.node_infos.pop(name, None)
             if ni is not None:
                 self.node_tree.remove_node(ni.node)
                 for p in ni.pods:
                     self._pod_states.pop(p.key(), None)
                     self._assumed.discard(p.key())
+                    if self._deadlines is not None:
+                        self._deadlines.discard_locked(p.key())
+            if self._columns is not None:
+                self._columns.remove_node_locked(name)
             self.dirty_nodes.discard(name)
             self.removed_nodes.add(name)
             self.mutation_count += 1
@@ -755,12 +976,29 @@ class TensorMirror:
                 bulk_held: List[Dict[int, int]] = []
                 bulk_folded: List[bool] = []
 
+                cols = cache._columns
+                if cols is not None and cols.vocab is not self.vocab:
+                    # columns rebuilt on another scheduler's Vocab: their
+                    # slot order is not this mirror's — per-pod build
+                    cols = None
+
                 def flush_bulk() -> None:
                     if not bulk_pods:
                         return
                     rows_arr = np.asarray(bulk_rows, np.int64)
                     self.eps.apply_adds_bulk(rows_arr, bulk_pods, bulk_held)
-                    self.nodes.apply_pod_deltas_bulk(rows_arr, bulk_pods)
+                    # columnar plane: the delta matrices gather from the
+                    # SAME interned spec rows the columns (and the fold
+                    # plane) advance by — one delta source, one overflow
+                    # contract (KeySlotOverflow → the rebuild below)
+                    mats = (
+                        cols.delta_mats_locked(
+                            bulk_pods, self.nodes.requested.shape[1]
+                        )
+                        if cols is not None
+                        else None
+                    )
+                    self.nodes.apply_pod_deltas_bulk(rows_arr, bulk_pods, mats=mats)
                     # device-FOLDED adds already live in the resident
                     # banks: their rows go to the folded set (skipped at
                     # upload) instead of the pending set (shipped)
@@ -1311,6 +1549,22 @@ class TensorMirror:
                     dn, np.asarray(h).astype(dn.dtype)
                 ):
                     out.append(f"{label}.{k}")
+        # columnar cross-check (state/columns.py): the cache's hot
+        # columns vs the host bank's usage arrays — ONE vectorized
+        # compare over gathered matrices, replacing the per-node object
+        # walk a host-truth audit used to need. Only meaningful when the
+        # mirror is fully synced (no outstanding deltas/dirt).
+        cache = self.cache
+        cols = getattr(cache, "_columns", None)
+        if cols is not None and cols.vocab is self.vocab:
+            # (vocab-mismatched columns — rebuilt by another scheduler —
+            # are in a different slot order; comparing them here would
+            # false-fire, and the delta paths already fell back)
+            with cache._lock:
+                if not cache.pod_deltas and not cache.dirty_nodes:
+                    out.extend(
+                        cols.usage_divergence_locked(self.row_of, self.nodes)
+                    )
         return out
 
     def node_name_of_row(self, row: int) -> Optional[str]:

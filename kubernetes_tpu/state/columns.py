@@ -1,0 +1,679 @@
+"""Columnar scheduler cache: the hot state of `SchedulerCache` as
+contiguous node-major numpy columns, patched by vectorized scatter-adds.
+
+The last host-Python wall of the covered commit path (PERF rounds 3-4,
+ROADMAP item 2) was the per-pod OBJECT work inside bulk assume/forget:
+every committed pod walked `NodeInfo._account` — Quantity-derived dict
+arithmetic, affinity list upkeep, port tuples — once per pod, under the
+cache lock, on the commit worker. The six device-residency planes
+amortized everything around it; this module removes it:
+
+* `CacheColumns` — the columns every hot read/write touches (per-node
+  `requested` in resource-slot space, non-zero scoring requests, pod
+  count, affinity-carrier count, used-host-port counts, zone/topology
+  pod counts) live in contiguous arrays indexed by a cache-owned node
+  row. Bulk assume/forget becomes ONE gather of memoized per-spec delta
+  rows + a handful of `np.add.at` scatters — O(batch) vectorized, zero
+  per-pod `NodeInfo`/Quantity updates.
+* ONE DELTA SOURCE: the per-spec delta rows (`spec_req`/`spec_nz`,
+  interned content-keyed from the same memoized `_req_slot_pairs` /
+  `pod_non_zero_request` values) feed BOTH the host columns and the
+  fold plane's device control arrays (`commit/fold.plan_fold` gathers
+  them via `delta_mats`), so host and device banks advance from
+  literally the same integers (INVARIANTS.md: one-delta-source rule).
+* LAZY VIEW: the per-name `NodeInfo` object cache is demoted to a
+  generation-tagged view for plugins, extenders, the volume binder,
+  preemption, and API reads. Bulk ops journal `(sign, pod)` per node
+  row instead of mutating objects; the first object read after a
+  columnar write replays the row's journal (`materialize`), bumping the
+  view's generation to the row's column generation. The covered commit
+  path never materializes (pinned by perf_smoke's `columnar` mode).
+* `AssumedDeadlines` — the assumed-pod TTL clock as a column, so
+  `cleanup_expired` is one vectorized compare per cycle instead of a
+  per-pod walk under the cache lock.
+* `LazyNodeInfos` — a dict subclass standing in for
+  `Snapshot.node_infos`: keyed/iterated access stays raw (keys are
+  never stale); value access resolves staleness first.
+
+Thread discipline: the columns share the cache's RLock. Every guarded
+attribute is declared `# ktpu: guarded-by(self._lock)` and accessed
+only from `*_locked` methods (caller — `SchedulerCache` — holds the
+lock) or inside an explicit `with self._lock:` block; ktpu-lint KTPU003
+machine-checks this (fixture pair: tests/fixtures/lint/
+ktpu003_columns.py). `KTPU_COLUMNAR_CACHE=0` is the operational kill
+switch (the driver simply never attaches columns; every legacy path is
+intact).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..api.types import RESOURCE_PODS
+from ..oracle.nodeinfo import (
+    DEFAULT_BIND_ALL_HOST_IP,
+    NodeInfo,
+    pod_has_affinity_constraints,
+    pod_non_zero_request,
+)
+from .tensors import KeySlotOverflow, _bucket, _node_bucket, _req_slot_pairs, _zone_key
+
+#: per-row journal length that forces a materialization right after the
+#: bulk call (SchedulerCache drains `_overgrown`): the lazy view's
+#: deferral must stay an optimization, never an unbounded memory leak on
+#: a node nothing ever reads
+JOURNAL_BOUND = 2048
+
+
+class LazyNodeInfos(dict):
+    """`Snapshot.node_infos` stand-in: value reads resolve lazy-view
+    staleness first; key-only operations (`in`, `len`, iteration) stay
+    raw dict speed — node NAMES are never stale, only the NodeInfo
+    objects behind them. `_resolve(name_or_None)` is the cache's
+    materializer (None = every stale row)."""
+
+    _resolve: Optional[Callable[[Optional[str]], None]] = None
+
+    def __getitem__(self, name):
+        r = self._resolve
+        if r is not None:
+            r(name)
+        return dict.__getitem__(self, name)
+
+    def get(self, name, default=None):
+        r = self._resolve
+        if r is not None:
+            r(name)
+        return dict.get(self, name, default)
+
+    def pop(self, name, *default):
+        # pop hands the OBJECT out (remove_node iterates its pods) — it
+        # must be current before it leaves the map
+        r = self._resolve
+        if r is not None:
+            r(name)
+        return dict.pop(self, name, *default)
+
+    def values(self):
+        r = self._resolve
+        if r is not None:
+            r(None)
+        return dict.values(self)
+
+    def items(self):
+        r = self._resolve
+        if r is not None:
+            r(None)
+        return dict.items(self)
+
+
+class AssumedDeadlines:
+    """The assumed-pod TTL clock as a column: one float64 slot per pod
+    whose binding finished (`+inf` = no deadline armed). cleanup_expired
+    scans `deadline < now` as ONE vectorized compare instead of walking
+    every assumed pod per cycle. Shares the cache's lock."""
+
+    def __init__(self, lock, capacity: int = 64):
+        self._lock = lock
+        cap = _bucket(capacity)
+        self.deadline = np.full(cap, np.inf)  # ktpu: guarded-by(self._lock)
+        self.key_of = [None] * cap  # ktpu: guarded-by(self._lock)
+        self.slot_of: Dict[str, int] = {}  # ktpu: guarded-by(self._lock)
+        self._free = list(range(cap - 1, -1, -1))  # ktpu: guarded-by(self._lock)
+
+    def set_bulk_locked(self, keys: Sequence[str], deadline: float) -> None:
+        for key in keys:
+            slot = self.slot_of.get(key)
+            if slot is None:
+                if not self._free:
+                    self._grow_locked()
+                slot = self._free.pop()
+                self.slot_of[key] = slot
+                self.key_of[slot] = key
+            self.deadline[slot] = deadline
+
+    def _grow_locked(self) -> None:
+        old = self.deadline.shape[0]
+        cap = old * 2
+        dl = np.full(cap, np.inf)
+        dl[:old] = self.deadline
+        self.deadline = dl
+        self.key_of.extend([None] * old)
+        self._free.extend(range(cap - 1, old - 1, -1))
+
+    def discard_locked(self, key: str) -> None:
+        slot = self.slot_of.pop(key, None)
+        if slot is not None:
+            self.deadline[slot] = np.inf
+            self.key_of[slot] = None
+            self._free.append(slot)
+
+    def expired_locked(self, now: float) -> List[str]:
+        idx = np.nonzero(self.deadline < now)[0]
+        return [self.key_of[int(i)] for i in idx]
+
+
+class CacheColumns:
+    """Contiguous hot columns of a `SchedulerCache`, node-major, indexed
+    by a cache-owned row (free-list discipline mirroring the tensor
+    mirror's). All mutation is vectorized over interned per-spec delta
+    rows; the NodeInfo objects behind `Snapshot` become a journal-backed
+    lazy view (see module docstring)."""
+
+    def __init__(self, vocab, lock, capacity: int = 1):
+        self._lock = lock  # THE SchedulerCache RLock, shared
+        self.vocab = vocab
+        cap = _node_bucket(capacity)
+        self.capacity = cap
+        width = vocab.config.resource_slots
+        # --- hot columns (node-major) -----------------------------------
+        self.requested = np.zeros((cap, width), np.int64)  # ktpu: guarded-by(self._lock)
+        self.nonzero_req = np.zeros((cap, 2), np.int64)  # ktpu: guarded-by(self._lock)
+        self.pod_count = np.zeros(cap, np.int32)  # ktpu: guarded-by(self._lock)
+        self.aff_count = np.zeros(cap, np.int32)  # ktpu: guarded-by(self._lock)
+        # used host ports: (proto, ip, port) triples interned to dense
+        # port columns; counts per (node, port column)
+        self.port_counts = np.zeros((cap, 8), np.int16)  # ktpu: guarded-by(self._lock)
+        self._port_col: Dict[Tuple[str, str, int], int] = {}  # ktpu: guarded-by(self._lock)
+        # zone/topology occupancy: dense zone id per node row + pods per
+        # zone (GetZoneKey identity — the multi-host snapshot's spread
+        # column)
+        self.zone_dense = np.full(cap, -1, np.int32)  # ktpu: guarded-by(self._lock)
+        self.zone_pods = np.zeros(8, np.int64)  # ktpu: guarded-by(self._lock)
+        self._zone_ids: Dict[str, int] = {}  # ktpu: guarded-by(self._lock)
+        # --- row bookkeeping --------------------------------------------
+        self.row_of: Dict[str, int] = {}  # ktpu: guarded-by(self._lock)
+        self.name_of_row: List[Optional[str]] = [None] * cap  # ktpu: guarded-by(self._lock)
+        self._free_rows = list(range(cap - 1, -1, -1))  # ktpu: guarded-by(self._lock)
+        # --- interned per-spec delta rows (the ONE delta source) --------
+        self.spec_req = np.zeros((16, width), np.int64)  # ktpu: guarded-by(self._lock)
+        self.spec_nz = np.zeros((16, 2), np.int64)  # ktpu: guarded-by(self._lock)
+        self.spec_aff = np.zeros(16, bool)  # ktpu: guarded-by(self._lock)
+        self.spec_has_ports = np.zeros(16, bool)  # ktpu: guarded-by(self._lock)
+        self._spec_ports: List[Tuple[int, ...]] = [()] * 16  # ktpu: guarded-by(self._lock)
+        self._slot_of: Dict[tuple, int] = {}  # ktpu: guarded-by(self._lock)
+        # --- lazy-view journal + generations ----------------------------
+        # per-row list of (sign, pod) not yet applied to the NodeInfo view
+        self._pending: List[Optional[List[Tuple[int, object]]]] = [None] * cap  # ktpu: guarded-by(self._lock)
+        self._stale_rows: Set[int] = set()  # ktpu: guarded-by(self._lock)
+        # rows whose journal outgrew JOURNAL_BOUND: the cache materializes
+        # them right after the bulk call — a never-read node's journal
+        # must not grow without bound across assume/forget churn
+        self._overgrown: Set[int] = set()  # ktpu: guarded-by(self._lock)
+        self._journal_since_check = 0  # ktpu: guarded-by(self._lock)
+        self.generation = 0  # ktpu: guarded-by(self._lock)
+        self.row_gen = np.zeros(cap, np.int64)  # ktpu: guarded-by(self._lock)
+        self.stats: Dict[str, int] = {  # ktpu: guarded-by(self._lock)
+            "bulk_batches": 0,
+            "bulk_pods": 0,
+            "scalar_pods": 0,
+            "materializations": 0,
+            "materialized_pods": 0,
+            "spec_rows": 0,
+        }
+
+    # -- row management (caller holds the cache lock) ------------------------
+
+    def add_node_locked(self, name: str, labels: Dict[str, str]) -> int:
+        if not self._free_rows:
+            self._grow_nodes_locked()
+        row = self._free_rows.pop()
+        self.row_of[name] = row
+        self.name_of_row[row] = name
+        self.zone_dense[row] = self._zone_dense_locked(labels)
+        return row
+
+    def set_zone_locked(self, name: str, labels: Dict[str, str]) -> None:
+        """Node update: re-derive the zone column, migrating the row's
+        pod occupancy between zone buckets when the labels moved it."""
+        row = self.row_of.get(name)
+        if row is None:
+            return
+        new = self._zone_dense_locked(labels)
+        old = int(self.zone_dense[row])
+        if new == old:
+            return
+        n = int(self.pod_count[row])
+        if old >= 0:
+            self.zone_pods[old] -= n
+        if new >= 0:
+            self.zone_pods[new] += n
+        self.zone_dense[row] = new
+
+    def remove_node_locked(self, name: str) -> None:
+        row = self.row_of.pop(name, None)
+        if row is None:
+            return
+        zd = int(self.zone_dense[row])
+        if zd >= 0:
+            self.zone_pods[zd] -= int(self.pod_count[row])
+        self.requested[row] = 0
+        self.nonzero_req[row] = 0
+        self.pod_count[row] = 0
+        self.aff_count[row] = 0
+        self.port_counts[row] = 0
+        self.zone_dense[row] = -1
+        # a reused row must not inherit the dead node's generation — the
+        # staleness-by-generation contract starts fresh with the row
+        self.row_gen[row] = 0
+        self.name_of_row[row] = None
+        self._pending[row] = None
+        self._stale_rows.discard(row)
+        self._overgrown.discard(row)
+        self._free_rows.append(row)
+
+    def ingest_node_locked(self, row: int, ni: NodeInfo) -> None:
+        """One-time adoption of an already-populated NodeInfo (columns
+        attached to a non-empty cache): columns take the object's own
+        incremental aggregates verbatim — no re-derivation to disagree
+        with."""
+        v = self.vocab
+        for rname, amount in ni.requested().items():
+            if rname == RESOURCE_PODS:
+                # every delta consumer filters the 'pods' pseudo-resource
+                # (_req_slot_pairs, NodeBank.set_node) — the adoption
+                # pass must too, or the slot skews forever
+                continue
+            s = v.slot_of_resource(rname)
+            if s >= self.requested.shape[1]:
+                self._grow_width_locked(s + 1)
+            self.requested[row, s] = amount
+        nz_cpu, nz_mem = ni.non_zero_requested()
+        self.nonzero_req[row, 0] = nz_cpu
+        self.nonzero_req[row, 1] = nz_mem
+        self.pod_count[row] = len(ni.pods)
+        self.aff_count[row] = len(ni.pods_with_affinity())
+        for t, n in ni._ports.items():
+            # intern FIRST: _port_col_locked may reallocate port_counts
+            col = self._port_col_locked(t)
+            self.port_counts[row, col] = n
+        zd = int(self.zone_dense[row])
+        if zd >= 0:
+            self.zone_pods[zd] += len(ni.pods)
+
+    def _grow_nodes_locked(self) -> None:
+        old = self.capacity
+        cap = _node_bucket(old + 1)
+        if cap <= old:
+            cap = old * 2
+
+        def grow(a, fill=0):
+            shape = (cap,) + a.shape[1:]
+            out = np.full(shape, fill, a.dtype) if fill else np.zeros(shape, a.dtype)
+            out[:old] = a
+            return out
+
+        self.requested = grow(self.requested)
+        self.nonzero_req = grow(self.nonzero_req)
+        self.pod_count = grow(self.pod_count)
+        self.aff_count = grow(self.aff_count)
+        self.port_counts = grow(self.port_counts)
+        self.zone_dense = grow(self.zone_dense, fill=-1)
+        self.row_gen = grow(self.row_gen)
+        self.name_of_row.extend([None] * (cap - old))
+        self._pending.extend([None] * (cap - old))
+        self._free_rows.extend(range(cap - 1, old - 1, -1))
+        self.capacity = cap
+
+    def _grow_width_locked(self, width: int) -> None:
+        """Resource-slot growth (extended resources): the requested and
+        spec-row matrices widen in LOCKSTEP — the scatter add relies on
+        their widths matching."""
+        w = _bucket(width, 8)
+        for attr in ("requested", "spec_req"):
+            a = getattr(self, attr)
+            out = np.zeros((a.shape[0], w), np.int64)
+            out[:, : a.shape[1]] = a
+            setattr(self, attr, out)
+
+    def _zone_dense_locked(self, labels: Dict[str, str]) -> int:
+        zk = _zone_key(labels)
+        if not zk:
+            return -1
+        idx = self._zone_ids.get(zk)
+        if idx is None:
+            idx = len(self._zone_ids)
+            self._zone_ids[zk] = idx
+            if idx >= self.zone_pods.shape[0]:
+                out = np.zeros(self.zone_pods.shape[0] * 2, np.int64)
+                out[: self.zone_pods.shape[0]] = self.zone_pods
+                self.zone_pods = out
+        return idx
+
+    def _port_col_locked(self, triple: Tuple[str, str, int]) -> int:
+        col = self._port_col.get(triple)
+        if col is None:
+            col = len(self._port_col)
+            self._port_col[triple] = col
+            if col >= self.port_counts.shape[1]:
+                out = np.zeros(
+                    (self.port_counts.shape[0], self.port_counts.shape[1] * 2),
+                    np.int16,
+                )
+                out[:, : self.port_counts.shape[1]] = self.port_counts
+                self.port_counts = out
+        return col
+
+    # -- per-spec delta rows (the one delta source) --------------------------
+
+    def _slot_for_locked(self, pod) -> int:
+        """Intern the pod's delta row (requested slots, non-zero request,
+        ports, affinity flag) and return its slot. Memoized on the pod
+        object — `with_node` clones carry it, so the fold planner's
+        intern on the original pod is a free hit for the commit clone —
+        and content-keyed underneath so every replica of a controller
+        shares one row."""
+        memo = pod.__dict__.get("_col_slot_memo")
+        if memo is not None and memo[0] is self:
+            return memo[1]
+        pairs = _req_slot_pairs(self.vocab, pod)
+        nz = pod_non_zero_request(pod)
+        ports = tuple(pod.host_ports())
+        aff = pod_has_affinity_constraints(pod)
+        key = (pairs, nz, ports, aff)
+        slot = self._slot_of.get(key)
+        if slot is None:
+            slot = len(self._slot_of)
+            if slot >= self.spec_req.shape[0]:
+                self._grow_specs_locked()
+            for s, v in pairs:
+                if s >= self.spec_req.shape[1]:
+                    self._grow_width_locked(s + 1)
+                self.spec_req[slot, s] = v
+            self.spec_nz[slot, 0] = nz[0]
+            self.spec_nz[slot, 1] = nz[1]
+            self.spec_aff[slot] = aff
+            self.spec_has_ports[slot] = bool(ports)
+            self._spec_ports[slot] = tuple(
+                self._port_col_locked(t) for t in ports
+            )
+            self._slot_of[key] = slot
+            self.stats["spec_rows"] += 1
+        pod.__dict__["_col_slot_memo"] = (self, slot)
+        return slot
+
+    def _grow_specs_locked(self) -> None:
+        old = self.spec_req.shape[0]
+        cap = old * 2
+
+        def grow(a):
+            out = np.zeros((cap,) + a.shape[1:], a.dtype)
+            out[:old] = a
+            return out
+
+        self.spec_req = grow(self.spec_req)
+        self.spec_nz = grow(self.spec_nz)
+        self.spec_aff = grow(self.spec_aff)
+        self.spec_has_ports = grow(self.spec_has_ports)
+        self._spec_ports = self._spec_ports + [()] * (cap - old)
+
+    def delta_mats_locked(
+        self, pods: Sequence, width: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(req[B, width], nz[B, 2]) delta matrices for `pods`, gathered
+        from the interned spec rows — the SAME integers the columns were
+        (or will be) scattered with. Raises KeySlotOverflow when any pod
+        carries a resource slot beyond `width` (the caller's bank is too
+        narrow — exactly the legacy per-pod path's overflow contract)."""
+        n = len(pods)
+        slots = np.empty(n, np.int64)
+        slot_for = self._slot_for_locked
+        for i, pod in enumerate(pods):
+            slots[i] = slot_for(pod)
+        req = self.spec_req[slots]
+        if req.shape[1] > width:
+            if req[:, width:].any():
+                raise KeySlotOverflow()
+            req = req[:, :width]
+        elif req.shape[1] < width:
+            out = np.zeros((n, width), np.int64)
+            out[:, : req.shape[1]] = req
+            req = out
+        return req, self.spec_nz[slots]
+
+    def delta_mats(self, pods: Sequence, width: int):
+        """Locking wrapper of delta_mats_locked for off-cache-lock
+        callers (the fold planner runs on the driver thread)."""
+        with self._lock:
+            return self.delta_mats_locked(pods, width)
+
+    # -- bulk columnar mutation (caller holds the cache lock) ----------------
+
+    def _scatter_locked(self, ridx: np.ndarray, slots: np.ndarray, sign: int) -> None:
+        # forget is the exact integer inverse: subtract.at instead of
+        # negating (a negation copies the whole gathered delta matrix)
+        scatter = np.add.at if sign > 0 else np.subtract.at
+        scatter(self.requested, ridx, self.spec_req[slots])
+        scatter(self.nonzero_req, ridx, self.spec_nz[slots])
+        np.add.at(self.pod_count, ridx, sign)
+        aff = self.spec_aff[slots]
+        if aff.any():
+            np.add.at(self.aff_count, ridx[aff], sign)
+        zd = self.zone_dense[ridx]
+        zm = zd >= 0
+        if zm.any():
+            np.add.at(self.zone_pods, zd[zm], sign)
+        hp = self.spec_has_ports[slots]
+        if hp.any():
+            for i in np.nonzero(hp)[0]:
+                for col in self._spec_ports[int(slots[i])]:
+                    self.port_counts[int(ridx[i]), col] += sign
+
+    def _bulk_locked(self, rows: Sequence[int], pods: Sequence, sign: int) -> None:
+        n = len(pods)
+        if n == 0:
+            return
+        # ONE tight loop per pod: memo-hit slot lookup (inlined — the
+        # method call was a measurable slice at 4096-pod batches) + the
+        # journal append; everything else is vectorized below
+        slots_l: List[int] = []
+        append_slot = slots_l.append
+        slot_for = self._slot_for_locked
+        pend = self._pending
+        add = sign > 0
+        for row, pod in zip(rows, pods):
+            memo = pod.__dict__.get("_col_slot_memo")
+            if memo is not None and memo[0] is self:
+                append_slot(memo[1])
+            else:
+                append_slot(slot_for(pod))
+            ops = pend[row]
+            if ops is None:
+                ops = pend[row] = []
+            # journal encoding: an ADD is the pod itself (the common
+            # case, no tuple alloc); a REMOVE is a 1-tuple wrapper
+            ops.append(pod if add else (pod,))
+        slots = np.asarray(slots_l, np.int64)
+        ridx = np.asarray(rows, np.int64)
+        self._scatter_locked(ridx, slots, sign)
+        self._stale_rows.update(rows)
+        self.generation += 1
+        self.row_gen[ridx] = self.generation
+        # journal bound, amortized: scan the stale set only once per
+        # JOURNAL_BOUND journaled ops instead of checking every append
+        self._journal_since_check += n
+        if self._journal_since_check >= JOURNAL_BOUND:
+            self._journal_since_check = 0
+            for row in self._stale_rows:
+                if len(pend[row]) >= JOURNAL_BOUND:
+                    self._overgrown.add(row)
+        self.stats["bulk_batches"] += 1
+        self.stats["bulk_pods"] += n
+
+    def assume_bulk_locked(self, rows: Sequence[int], pods: Sequence) -> None:
+        """Bulk assume: vectorized column scatter + per-row view journal.
+        ZERO NodeInfo/Quantity object updates — the view catches up on
+        first read (materialize)."""
+        self._bulk_locked(rows, pods, 1)
+
+    def forget_bulk_locked(self, rows: Sequence[int], pods: Sequence) -> None:
+        """Bulk forget (gang rollback / bind failure): exact integer
+        inverse of assume_bulk, journaled the same way."""
+        self._bulk_locked(rows, pods, -1)
+
+    def apply_one_locked(self, row: int, pod, sign: int) -> None:
+        """Scalar twin for the eager object paths (informer events,
+        scalar assume/forget): the object cache was already updated by
+        the caller — the columns advance by the same interned delta row
+        so column truth never forks from object truth."""
+        slot = self._slot_for_locked(pod)
+        self.requested[row] += sign * self.spec_req[slot]
+        self.nonzero_req[row] += sign * self.spec_nz[slot]
+        self.pod_count[row] += sign
+        if self.spec_aff[slot]:
+            self.aff_count[row] += sign
+        zd = int(self.zone_dense[row])
+        if zd >= 0:
+            self.zone_pods[zd] += sign
+        for col in self._spec_ports[slot]:
+            self.port_counts[row, col] += sign
+        self.generation += 1
+        self.row_gen[row] = self.generation
+        self.stats["scalar_pods"] += 1
+
+    # -- lazy view materialization (caller holds the cache lock) -------------
+
+    def row_stale_locked(self, row: int) -> bool:
+        return row in self._stale_rows
+
+    def materialize_into_locked(self, name: str, ni: NodeInfo) -> int:
+        """Replay the row's journal into its NodeInfo view, in journal
+        order (bit-identical pod-list order to the eager path), and tag
+        the view with the row's column generation. Returns the number of
+        ops replayed."""
+        row = self.row_of.get(name)
+        if row is None:
+            return 0
+        ops = self._pending[row]
+        if not ops:
+            return 0
+        self._pending[row] = []
+        self._stale_rows.discard(row)
+        self._overgrown.discard(row)
+        for e in ops:
+            # journal encoding (see _bulk_locked): bare pod = add,
+            # 1-tuple = remove
+            if type(e) is tuple:
+                ni.remove_pod_key(e[0].key())
+            else:
+                ni.add_pod(e)
+        ni.generation = int(self.row_gen[row])
+        self.stats["materializations"] += 1
+        self.stats["materialized_pods"] += len(ops)
+        return len(ops)
+
+    def host_port_conflict(self, name: str, pod) -> bool:
+        """HostPortInfo.CheckConflict over the port COLUMNS — the commit
+        path's staleness probe for ported pods, bit-identical to
+        NodeInfo.host_port_conflict without materializing the lazy view.
+        Takes the lock itself (driver-thread caller)."""
+        with self._lock:
+            row = self.row_of.get(name)
+            if row is None:
+                return False
+            pc = self.port_counts
+            col_of = self._port_col
+            for proto, ip, port in pod.host_ports():
+                if port <= 0:
+                    continue
+                if ip == DEFAULT_BIND_ALL_HOST_IP:
+                    for (uproto, _uip, uport), c in col_of.items():
+                        if uport == port and uproto == proto and pc[row, c] > 0:
+                            return True
+                else:
+                    for cand in (
+                        (proto, ip, port),
+                        (proto, DEFAULT_BIND_ALL_HOST_IP, port),
+                    ):
+                        c = col_of.get(cand)
+                        if c is not None and pc[row, c] > 0:
+                            return True
+            return False
+
+    # -- probes --------------------------------------------------------------
+
+    def usage_divergence_locked(self, mirror_row_of: Dict[str, int], bank) -> List[str]:
+        """Vectorized cross-check of the columns against a mirror
+        NodeBank's HOST usage arrays (requested / nonzero_req /
+        pod_count): the columnar half of the device-divergence probe.
+        Meaningful only when the mirror is fully synced (the caller
+        gates on an empty delta log)."""
+        out: List[str] = []
+        common = [
+            (mrow, self.row_of[nm])
+            for nm, mrow in mirror_row_of.items()
+            if nm in self.row_of
+        ]
+        if len(common) != len(self.row_of):
+            out.append("columns.row_of:node-set-mismatch")
+        if not common:
+            return out
+        midx = np.asarray([c[0] for c in common], np.int64)
+        cidx = np.asarray([c[1] for c in common], np.int64)
+        w = min(self.requested.shape[1], bank.requested.shape[1])
+        if not np.array_equal(self.requested[cidx, :w], bank.requested[midx, :w]):
+            out.append("columns.requested")
+        if self.requested.shape[1] > w and self.requested[cidx, w:].any():
+            out.append("columns.requested:width-overflow")
+        if not np.array_equal(self.nonzero_req[cidx], bank.nonzero_req[midx]):
+            out.append("columns.nonzero_req")
+        if not np.array_equal(
+            self.pod_count[cidx], bank.pod_count[midx].astype(np.int32)
+        ):
+            out.append("columns.pod_count")
+        return out
+
+    def object_divergence(self, node_infos: Dict[str, NodeInfo]) -> List[str]:
+        """Names of nodes whose MATERIALIZED object aggregates disagree
+        with the columns — the parity probe the microbench and the test
+        suite assert empty. Takes the lock itself (debug API). Rows with
+        a pending journal are compared against object + journal by
+        materializing first (via plain replay — callers pass the raw
+        dict, so resolution is explicit here)."""
+        out: List[str] = []
+        with self._lock:
+            # snapshot the slot map under ITS lock (the informer-thread
+            # ingest encode interns new resources concurrently; iterating
+            # the live dict could see a half-assigned slot or raise)
+            with self.vocab._slot_lock:
+                res_slots = dict(self.vocab.resource_slot)
+            for name, ni in node_infos.items():
+                row = self.row_of.get(name)
+                if row is None:
+                    out.append(f"{name}:no-row")
+                    continue
+                if self.row_stale_locked(row):
+                    self.materialize_into_locked(name, ni)
+                req = {}
+                for rname, s in res_slots.items():
+                    if s < self.requested.shape[1] and self.requested[row, s]:
+                        req[rname] = int(self.requested[row, s])
+                want = {
+                    k: v for k, v in ni.requested().items()
+                    if k != RESOURCE_PODS  # columns never track it
+                }
+                if req != want:
+                    out.append(f"{name}:requested")
+                if (
+                    int(self.nonzero_req[row, 0]),
+                    int(self.nonzero_req[row, 1]),
+                ) != ni.non_zero_requested():
+                    out.append(f"{name}:nonzero_req")
+                if int(self.pod_count[row]) != len(ni.pods):
+                    out.append(f"{name}:pod_count")
+                if int(self.aff_count[row]) != len(ni.pods_with_affinity()):
+                    out.append(f"{name}:aff_count")
+                ports = {
+                    t: int(self.port_counts[row, c])
+                    for t, c in self._port_col.items()
+                    if self.port_counts[row, c]
+                }
+                if ports != ni._ports:
+                    out.append(f"{name}:ports")
+        return out
+
+    def stats_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.stats)

@@ -455,24 +455,32 @@ class NodeBank:
         self.nonzero_req[i, 1] += sign * m
         self.pod_count[i] += sign
 
-    def apply_pod_deltas_bulk(self, rows: np.ndarray, pods: Sequence) -> None:
+    def apply_pod_deltas_bulk(
+        self, rows: np.ndarray, pods: Sequence, mats=None
+    ) -> None:
         """apply_pod_delta over a whole commit batch of ADDS as three
         np.add.at scatters (duplicate rows accumulate). The per-pod numpy
         scalar `+=` of the scalar path was ~8us/pod at 4096-pod batches —
         the single biggest slice of mirror sync. Exactness unchanged: the
-        same memoized request values land in the same columns."""
-        n = len(pods)
-        width = self.requested.shape[1]
-        mat = np.zeros((n, width), np.int64)
-        nz = np.zeros((n, 2), np.int64)
-        for i, pod in enumerate(pods):
-            for s, v in _req_slot_pairs(self.vocab, pod):
-                if s >= width:
-                    raise KeySlotOverflow()
-                mat[i, s] = v
-            c, m = pod_non_zero_request(pod)
-            nz[i, 0] = c
-            nz[i, 1] = m
+        same memoized request values land in the same columns. `mats`,
+        when given, is the pre-gathered (req[B, R], nz[B, 2]) pair from
+        the columnar cache's interned spec rows (state/columns.py) — the
+        one-delta-source fast path that skips the per-pod build below."""
+        if mats is not None:
+            mat, nz = mats
+        else:
+            n = len(pods)
+            width = self.requested.shape[1]
+            mat = np.zeros((n, width), np.int64)
+            nz = np.zeros((n, 2), np.int64)
+            for i, pod in enumerate(pods):
+                for s, v in _req_slot_pairs(self.vocab, pod):
+                    if s >= width:
+                        raise KeySlotOverflow()
+                    mat[i, s] = v
+                c, m = pod_non_zero_request(pod)
+                nz[i, 0] = c
+                nz[i, 1] = m
         np.add.at(self.requested, rows, mat)
         np.add.at(self.nonzero_req, rows, nz)
         np.add.at(self.pod_count, rows, 1)

@@ -28,6 +28,14 @@ tests/test_perf_smoke.py; also runnable standalone:
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py trace      # flight recorder
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py ingest     # pod-ingest plane
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py terms      # term-bank plane
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py columnar   # columnar cache
+
+`main_columnar()` (mode `columnar`) guards the columnar scheduler cache
+(state/columns.py): a covered plain+anti drain must commit every pod
+through the columnar bulk path — coverage > 0, ZERO lazy-view
+materializations and ZERO scalar object-path pods on the commit path —
+with the device-divergence probe (now including the vectorized
+columns-vs-banks cross-check) empty and `misses_after_warmup == 0`.
 
 `main_trace()` (mode `trace`) guards the flight recorder
 (kubernetes_tpu/obs): a traced drain must export a structurally valid
@@ -216,6 +224,40 @@ def terms_smoke_config():
                     match_labels={"soft": p.labels["soft"]}
                 ),
             )]
+        pods.append(p)
+    return nodes, pods
+
+
+def columnar_smoke_config():
+    """(nodes, pods): plain + required-anti mix — every commit flavor
+    the COVERED path serves (bulk fast path + arbiter), deliberately no
+    hard spread: defer-escalation routes through the oracle, which READS
+    the lazy NodeInfo views, and this config must prove the covered
+    commit path materializes ZERO of them."""
+    import bench
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+
+    nodes = [bench.mk_node(i, zone=bench.ZONES[i % 4]) for i in range(N_NODES)]
+    pods = []
+    for i in range(N_PODS):
+        if i % 8 == 0:
+            p = bench.mk_pod(i, cpu="100m", mem="64Mi",
+                             labels={"exclusive": f"x{i % 16}"})
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"exclusive": p.labels["exclusive"]}
+                    ),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ]))
+        else:
+            p = bench.mk_pod(i, cpu="100m", mem="64Mi")
         pods.append(p)
     return nodes, pods
 
@@ -901,6 +943,77 @@ def main_terms() -> dict:
     return detail
 
 
+def main_columnar() -> dict:
+    """Columnar-scheduler-cache smoke (state/columns.py): a covered
+    plain+anti drain must commit every pod through the COLUMNAR bulk
+    path with ZERO per-pod NodeInfo object updates — columnar coverage
+    > 0, zero lazy-view materializations on the commit path, zero
+    scalar (object-path) pods — while the banks stay bit-exact: the
+    device-divergence probe (which now cross-checks the columns against
+    the host banks as one vectorized compare) must come back empty, and
+    `misses_after_warmup == 0` as everywhere."""
+    import bench
+
+    bench.BATCH = SMOKE_BATCH
+    state = {}
+
+    def inspect(sched):
+        # drain FIRST: in-flight tail applies are part of the commit
+        # path — their materializations/scalar pods must not escape the
+        # zero-assertions by a stats snapshot taken too early
+        sched._commit_pipe.drain()
+        m = sched.mirror
+        m.sync()
+        m.device_arrays()
+        cols = sched.cache._columns
+        state["cols"] = cols.stats_snapshot() if cols is not None else None
+        state["divergence"] = m.device_bank_divergence()
+
+    detail = bench.run_config(
+        "tiny_columnar_smoke", columnar_smoke_config, inspect=inspect
+    )
+    problems = []
+    if detail["scheduled"] != N_PODS:
+        problems.append(f"scheduled {detail['scheduled']} of {N_PODS} pods")
+    cols = state.get("cols")
+    if cols is None:
+        problems.append(
+            "columnar cache never attached (KTPU_COLUMNAR_CACHE plane off)"
+        )
+    else:
+        if not cols.get("bulk_pods", 0):
+            problems.append(
+                "columnar coverage is ZERO (no pod committed through the "
+                "bulk column path)"
+            )
+        if cols.get("materializations", 0):
+            problems.append(
+                f"{cols['materializations']} lazy-view materialization(s) "
+                "on a covered drain — something on the commit path still "
+                "reads NodeInfo objects"
+            )
+        if cols.get("scalar_pods", 0):
+            problems.append(
+                f"{cols['scalar_pods']} pod(s) took the scalar object "
+                "path on a covered drain"
+            )
+    if state.get("divergence"):
+        problems.append(
+            f"columns/banks diverged: {state['divergence']}"
+        )
+    if detail["compile"]["misses_after_warmup"]:
+        problems.append(
+            f"{detail['compile']['misses_after_warmup']} compile-spec "
+            "miss(es) after warmup"
+        )
+    for k, v in detail["audit"].items():
+        if k.endswith("_violations") and v:
+            problems.append(f"audit: {k}={v}")
+    assert not problems, "; ".join(problems)
+    detail["columnar_state"] = state
+    return detail
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
     if mode == "preempt":
@@ -909,6 +1022,8 @@ if __name__ == "__main__":
         d = main_ingest()
     elif mode == "terms":
         d = main_terms()
+    elif mode == "columnar":
+        d = main_columnar()
     elif mode == "trace":
         d = main_trace()
         print(json.dumps({

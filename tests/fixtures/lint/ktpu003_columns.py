@@ -1,0 +1,44 @@
+"""MUST-FLAG KTPU003: unlocked scatter-add into the columnar cache's
+hot columns.
+
+The columnar-cache hazard shape (state/columns.py): the columns are
+written by bulk assume/forget on the COMMIT WORKER while the informer
+thread's pod events take the scalar path and the driver's fold planner
+reads the interned spec rows — an unlocked np.add.at is a lost-update
+race that silently skews `requested`/`pod_count` until the divergence
+probe (or a placement audit) trips. Same RMW class as PR 5's vocab-slot
+interning bug; every column is declared guarded-by the cache's lock.
+"""
+
+import threading
+
+import numpy as np
+
+
+class Columns:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.requested = np.zeros((8, 4), np.int64)  # ktpu: guarded-by(self._lock)
+        self.pod_count = np.zeros(8, np.int32)  # ktpu: guarded-by(self._lock)
+        self.spec_req = np.zeros((4, 4), np.int64)  # ktpu: guarded-by(self._lock)
+
+    def bad_assume(self, rows, slots):
+        # <- unlocked read-modify-write on guarded columns
+        np.add.at(self.requested, rows, self.spec_req[slots])
+        np.add.at(self.pod_count, rows, 1)
+
+    def good_assume(self, rows, slots):
+        with self._lock:
+            np.add.at(self.requested, rows, self.spec_req[slots])
+            np.add.at(self.pod_count, rows, 1)
+
+    def assume_bulk_locked(self, rows, slots):
+        # repo convention: the *_locked suffix asserts the caller (the
+        # cache's bulk state machine) already holds the lock
+        np.add.at(self.requested, rows, self.spec_req[slots])
+        np.add.at(self.pod_count, rows, 1)
+
+    # ktpu: holds(self._lock) the fold planner gathers delta rows inside
+    # the cache's locked window (plan_fold's delta_mats contract)
+    def delta_rows(self, slots):
+        return self.spec_req[slots]

@@ -1,0 +1,497 @@
+"""Columnar scheduler cache parity suite (state/columns.py + the
+SchedulerCache columnar integration).
+
+The tentpole's correctness pin: the columns, the lazily-materialized
+NodeInfo views, and the device banks must all agree BIT-FOR-BIT after
+every composition of bulk assume / forget / bind, node churn,
+preemption eviction, and gang rollback — and a drain with the columnar
+plane ON must schedule pod-for-pod identically to plane OFF (the
+columns are bookkeeping/transport, never policy). Plus: lazy-view
+staleness-by-generation, the vectorized cleanup_expired twin, the
+journal bound, the kill switch, and the A/B microbench smoke.
+"""
+
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    Quantity,
+    RESOURCE_CPU,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import (
+    Binder,
+    POD_GROUP_LABEL,
+    POD_GROUP_MIN_AVAILABLE,
+    Scheduler,
+)
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.columns import JOURNAL_BOUND
+from kubernetes_tpu.state.queue import PriorityQueue
+from kubernetes_tpu.state.tensors import Vocab
+
+HOST = "kubernetes.io/hostname"
+ZONE = "zone"
+
+
+def _nodes(n, zones=0, cpu=4000):
+    out = []
+    for i in range(n):
+        labels = {HOST: f"n{i}"}
+        if zones:
+            labels[ZONE] = f"z{i % zones}"
+        out.append(make_node(f"n{i}", cpu_milli=cpu, labels=labels))
+    return out
+
+
+def _anti_pod(name, app, cpu=100):
+    p = make_pod(name, cpu_milli=cpu, labels={"app": app})
+    p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+        PodAffinityTerm(
+            label_selector=LabelSelector(match_labels={"app": app}),
+            topology_key=HOST,
+        )
+    ]))
+    return p
+
+
+def _mk_cache(nodes, columnar=True, existing=(), **cache_kw):
+    cache = SchedulerCache(**cache_kw)
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(p)
+    if columnar:
+        cache.attach_columns(Vocab())
+    return cache
+
+
+def _raw_infos(cache):
+    """The raw (unresolved) NodeInfo objects, keyed by name."""
+    return {
+        k: dict.__getitem__(cache.snapshot.node_infos, k)
+        for k in cache.snapshot.node_infos
+    }
+
+
+def _assert_columns_exact(cache):
+    div = cache._columns.object_divergence(_raw_infos(cache))
+    assert div == [], div
+
+
+def _mk_sched(nodes, existing=(), columnar=True, **kw):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(p)
+    binds = []
+    binder = Binder(lambda pod, node: binds.append((pod.key(), node)))
+    kw.setdefault("deterministic", True)
+    sched = Scheduler(
+        cache=cache, queue=PriorityQueue(), binder=binder,
+        columnar_cache=columnar, **kw,
+    )
+    return sched, binds
+
+
+def _drain(sched, rounds=60):
+    total, assignments = 0, {}
+    for _ in range(rounds):
+        r = sched.schedule_batch()
+        total += r.scheduled
+        assignments.update(r.assignments)
+        if (r.scheduled == 0 and r.unschedulable == 0 and r.errors == 0
+                and r.deferred == 0):
+            active, backoff, unsched = sched.queue.counts()
+            if not (active + backoff + unsched):
+                break
+            time.sleep(0.06)
+            sched.queue.move_all_to_active()
+    sched.wait_for_binds()
+    return total, assignments
+
+
+# ---------------------------------------------------------------------------
+# cache-level round-trips (no scheduler)
+# ---------------------------------------------------------------------------
+
+def test_bulk_assume_forget_bind_round_trip_parity():
+    """assume → finish_bindings → forget over replicas sharing specs:
+    columns and materialized views track exactly; after the full forget
+    the columns are all-zero again."""
+    cache = _mk_cache(_nodes(4, zones=2))
+    pods = [
+        make_pod(f"p{i}", cpu_milli=100 + (i % 4) * 10,
+                 labels={"app": f"a{i % 4}"}).with_node(f"n{i % 4}")
+        for i in range(32)
+    ]
+    assert cache.assume_pods(pods) == []
+    cache.finish_bindings(pods)
+    _assert_columns_exact(cache)
+    cache.forget_pods(pods[16:])
+    _assert_columns_exact(cache)
+    cache.forget_pods(pods[:16])
+    cols = cache._columns
+    assert not cols.requested.any()
+    assert not cols.pod_count.any()
+    assert not cols.zone_pods.any()
+    assert cache.pod_count() == 0
+
+
+def test_ported_and_affinity_pods_hit_port_and_aff_columns():
+    cache = _mk_cache(_nodes(2))
+    ported = make_pod("web", cpu_milli=100)
+    ported.containers = [Container(
+        name="main", image="img",
+        requests={RESOURCE_CPU: Quantity.parse("100m")},
+        ports=[ContainerPort(container_port=8080, host_port=8080)],
+    )]
+    anti = _anti_pod("anti", app="solo")
+    assert cache.assume_pods([ported.with_node("n0"), anti.with_node("n1")]) == []
+    _assert_columns_exact(cache)
+    cols = cache._columns
+    assert cols.aff_count[cols.row_of["n1"]] == 1
+    assert cols.host_port_conflict("n0", ported)
+    assert not cols.host_port_conflict("n1", ported)
+    cache.forget_pods([ported.with_node("n0"), anti.with_node("n1")])
+    _assert_columns_exact(cache)
+
+
+def test_node_churn_with_pending_journal():
+    """remove_node on a node with an unmaterialized journal: the pop
+    resolves the view first (pod states dropped correctly), the row is
+    freed, and a new node reuses it cleanly."""
+    cache = _mk_cache(_nodes(3, zones=3))
+    pods = [make_pod(f"p{i}", cpu_milli=50).with_node(f"n{i % 3}") for i in range(9)]
+    assert cache.assume_pods(pods) == []
+    row_before = cache._columns.row_of["n1"]
+    cache.remove_node("n1")
+    assert cache.pod_count() == 6  # n1's three pods dropped with it
+    assert "n1" not in cache._columns.row_of
+    cache.add_node(make_node("n9", cpu_milli=4000, labels={HOST: "n9", ZONE: "z0"}))
+    assert cache._columns.row_of["n9"] == row_before  # free-list reuse
+    more = [make_pod(f"q{i}", cpu_milli=50).with_node("n9") for i in range(2)]
+    assert cache.assume_pods(more) == []
+    _assert_columns_exact(cache)
+
+
+def test_preemption_evict_round_trip():
+    """remove_pod (the victim-delete path) on both materialized and
+    journal-pending pods keeps columns exact."""
+    existing = []
+    for i in range(4):
+        v = make_pod(f"v{i}", cpu_milli=500, node_name=f"n{i % 2}")
+        existing.append(v)
+    cache = _mk_cache(_nodes(2), existing=existing)
+    fresh = [make_pod(f"f{i}", cpu_milli=100).with_node(f"n{i % 2}") for i in range(4)]
+    assert cache.assume_pods(fresh) == []
+    # evict one pre-existing (materialized) and one journal-pending pod
+    cache.remove_pod(existing[0])
+    cache.remove_pod(fresh[0])
+    _assert_columns_exact(cache)
+    assert cache.pod_count() == 6
+
+
+def test_lazy_view_staleness_by_generation():
+    """Bulk ops advance row_gen without touching the view; the first
+    read materializes and stamps the view's generation; a second read
+    replays nothing."""
+    cache = _mk_cache(_nodes(2))
+    cols = cache._columns
+    row = cols.row_of["n0"]
+    ni_raw = _raw_infos(cache)["n0"]
+    assert ni_raw.generation == 0
+    pods = [make_pod(f"p{i}", cpu_milli=100).with_node("n0") for i in range(3)]
+    assert cache.assume_pods(pods) == []
+    # view untouched: the object cache is STALE by generation
+    assert len(ni_raw.pods) == 0
+    assert cols.row_stale_locked(row)
+    assert int(cols.row_gen[row]) > ni_raw.generation
+    # first resolved read materializes + stamps
+    ni = cache.snapshot.get("n0")
+    assert ni is ni_raw and len(ni.pods) == 3
+    assert ni.generation == int(cols.row_gen[row])
+    assert not cols.row_stale_locked(row)
+    m0 = cols.stats_snapshot()["materializations"]
+    cache.snapshot.get("n0")  # second read: no replay
+    assert cols.stats_snapshot()["materializations"] == m0
+
+
+def test_cleanup_expired_vectorized_matches_legacy_semantics():
+    """The deadline-column cleanup expires exactly what the legacy walk
+    would: finished-and-past-deadline pods only, with stale slots
+    (informer confirm) dropped silently."""
+    clock = [0.0]
+    legacy = SchedulerCache(ttl=10.0, now=lambda: clock[0])
+    colcache = _mk_cache([], columnar=False, ttl=10.0, now=lambda: clock[0])
+    colcache.attach_columns(Vocab())
+    for c in (legacy, colcache):
+        c.add_node(make_node("n0", cpu_milli=4000, labels={HOST: "n0"}))
+    pods = [make_pod(f"p{i}", cpu_milli=10).with_node("n0") for i in range(6)]
+    for c in (legacy, colcache):
+        assert c.assume_pods(pods) == []
+        c.finish_bindings(pods[:4])     # 4 armed, 2 never finished
+        c.add_pod(pods[0])              # informer confirms one armed pod
+    clock[0] = 5.0
+    assert legacy.cleanup_expired() == [] and colcache.cleanup_expired() == []
+    clock[0] = 11.0
+    exp_l = sorted(p.key() for p in legacy.cleanup_expired())
+    exp_c = sorted(p.key() for p in colcache.cleanup_expired())
+    assert exp_c == exp_l == [f"default/p{i}" for i in (1, 2, 3)]
+    assert legacy.assumed_count() == colcache.assumed_count() == 2
+    _assert_columns_exact(colcache)
+
+
+def test_journal_bound_forces_materialization():
+    """A never-read node's journal must not grow without bound: churning
+    assume/forget past JOURNAL_BOUND materializes the row inline."""
+    cache = _mk_cache(_nodes(1))
+    cols = cache._columns
+    row = cols.row_of["n0"]
+    waves = (JOURNAL_BOUND // 64) + 2
+    for w in range(waves):
+        pods = [make_pod(f"w{w}p{i}", cpu_milli=1).with_node("n0") for i in range(32)]
+        assert cache.assume_pods(pods) == []
+        cache.forget_pods(pods)
+    assert len(cols._pending[row] or ()) < JOURNAL_BOUND
+    assert cols.stats_snapshot()["materializations"] > 0
+    _assert_columns_exact(cache)
+
+
+def test_kill_switch_leaves_legacy_cache(monkeypatch):
+    monkeypatch.setenv("KTPU_COLUMNAR_CACHE", "0")
+    sched, _ = _mk_sched(_nodes(2), enable_preemption=False, batch_size=4)
+    assert sched.cache._columns is None
+    assert not sched.columnar_cache
+    for i in range(4):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+    n, _ = _drain(sched)
+    assert n == 4
+    sched.close()
+
+
+def test_ingest_filters_pods_pseudo_resource():
+    """Adopting a pre-populated cache must filter the 'pods' pseudo-
+    resource exactly like every delta consumer does — otherwise the slot
+    skews forever and the divergence probe never goes quiet."""
+    from kubernetes_tpu.api.types import RESOURCE_PODS
+
+    odd = make_pod("odd", cpu_milli=100, node_name="n0")
+    odd.containers[0].requests[RESOURCE_PODS] = Quantity.parse(1)
+    cache = _mk_cache(_nodes(2), existing=[odd])
+    _assert_columns_exact(cache)
+    more = [make_pod(f"p{i}", cpu_milli=50).with_node("n0") for i in range(2)]
+    assert cache.assume_pods(more) == []
+    _assert_columns_exact(cache)
+
+
+def test_reattach_with_new_vocab_rebuilds_columns():
+    """A second scheduler over the same cache brings its own Vocab with
+    a different resource-slot order: attach_columns must REBUILD the
+    columns (reusing the old spec rows would scatter old-slot matrices
+    into new-slot banks)."""
+    cache = _mk_cache([], columnar=False)
+    cache.add_node(make_node("n0", cpu_milli=64_000, labels={HOST: "n0"}))
+    gpu_pod = make_pod("g0", cpu_milli=100, node_name="n0")
+    gpu_pod.containers[0].requests["example.com/gpu"] = Quantity.parse(2)
+    fpga_pod = make_pod("f0", cpu_milli=100, node_name="n0")
+    fpga_pod.containers[0].requests["example.com/fpga"] = Quantity.parse(1)
+    cache.add_pod(gpu_pod)
+    cache.add_pod(fpga_pod)
+    v1 = Vocab()
+    v1.slot_of_resource("example.com/gpu")  # gpu before fpga
+    v1.slot_of_resource("example.com/fpga")
+    cols1 = cache.attach_columns(v1)
+    _assert_columns_exact(cache)
+    v2 = Vocab()
+    v2.slot_of_resource("example.com/fpga")  # REVERSED slot order
+    v2.slot_of_resource("example.com/gpu")
+    cols2 = cache.attach_columns(v2)
+    assert cols2 is not cols1 and cols2.vocab is v2
+    assert cache._columns is cols2
+    _assert_columns_exact(cache)
+    # same vocab again: idempotent
+    assert cache.attach_columns(v2) is cols2
+    # and bulk ops on the rebuilt columns stay exact
+    more = [make_pod(f"m{i}", cpu_milli=50).with_node("n0") for i in range(3)]
+    assert cache.assume_pods(more) == []
+    _assert_columns_exact(cache)
+
+
+def test_pod_key_memo_survives_clone_then_rename():
+    """The controllers clone a template via with_node and then rename it
+    (new_child_pod / StatefulSet ordinals): the key memo must invalidate
+    on rename, never pin children to the template's identity."""
+    template = make_pod("tmpl", cpu_milli=10, namespace="ctrl")
+    assert template.key() == "ctrl/tmpl"  # seeds the memo
+    child = template.with_node("")
+    child.name = "tmpl-abc12"
+    assert child.key() == "ctrl/tmpl-abc12"
+    assert template.key() == "ctrl/tmpl"
+    child.namespace = "other"
+    assert child.key() == "other/tmpl-abc12"
+
+
+def test_vocab_mismatched_columns_fall_back_on_mirror_paths():
+    """Columns rebuilt on a foreign Vocab (second-scheduler re-attach)
+    must NOT feed this mirror's delta gather, fold planning, or the
+    divergence cross-check — slot orders differ. Everything falls back
+    to the per-pod build and the banks stay exact."""
+    from kubernetes_tpu.commit.fold import plan_fold
+    from kubernetes_tpu.state.cache import TensorMirror
+
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0", cpu_milli=64_000, labels={HOST: "n0"}))
+    mirror = TensorMirror(cache)
+    # foreign vocab with a REVERSED extended-resource slot order
+    foreign = Vocab()
+    foreign.slot_of_resource("example.com/fpga")
+    foreign.slot_of_resource("example.com/gpu")
+    cache.attach_columns(foreign)
+    mirror.vocab.slot_of_resource("example.com/gpu")
+    mirror.vocab.slot_of_resource("example.com/fpga")
+    gpu = make_pod("g0", cpu_milli=100)
+    gpu.containers[0].requests["example.com/gpu"] = Quantity.parse(2)
+    prog = plan_fold(mirror, [(gpu, mirror.row_of["n0"])], 16, 16)
+    # the fold planned from the PER-POD build in the mirror's slot space
+    gpu_slot = mirror.vocab.resource_slot["example.com/gpu"]
+    assert prog is not None and int(prog.req[0, gpu_slot]) == 2
+    assert cache.assume_pods([gpu.with_node("n0")]) == []
+    mirror.sync()
+    mirror.device_arrays()
+    div = mirror.device_bank_divergence()  # cross-check must not false-fire
+    assert div == [], div
+    assert int(mirror.nodes.requested[mirror.row_of["n0"], gpu_slot]) == 2
+
+
+# ---------------------------------------------------------------------------
+# plane ON == plane OFF, pod for pod (drains through the real driver)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scenario", ["mixed", "gang", "churn", "preempt"])
+def test_columnar_off_schedules_identically(scenario):
+    def build(sched):
+        if scenario == "mixed":
+            for i in range(12):
+                if i % 3 == 0:
+                    sched.queue.add(_anti_pod(f"a{i}", app=f"g{i % 2}"))
+                else:
+                    sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+        elif scenario == "gang":
+            for m in range(2):
+                sched.queue.add(make_pod(
+                    f"gm{m}", cpu_milli=100,
+                    labels={POD_GROUP_LABEL: "g1", POD_GROUP_MIN_AVAILABLE: "4"},
+                ))
+            for i in range(8):
+                sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+        elif scenario == "churn":
+            for i in range(8):
+                sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+        elif scenario == "preempt":
+            for i in range(3):
+                p = make_pod(f"hi{i}", cpu_milli=800)
+                p.priority = 1000
+                sched.queue.add(p)
+
+    def run(columnar):
+        existing = []
+        enable_preemption = scenario == "preempt"
+        nodes = _nodes(6, zones=3)
+        if scenario == "preempt":
+            nodes = _nodes(3, cpu=1000)
+            for i, nd in enumerate(nodes):
+                v = make_pod(f"victim{i}", cpu_milli=900, node_name=nd.name)
+                v.priority = 0
+                existing.append(v)
+        sched, _ = _mk_sched(
+            nodes, existing=existing, columnar=columnar,
+            enable_preemption=enable_preemption, batch_size=8,
+        )
+        build(sched)
+        if scenario == "churn":
+            r = sched.schedule_batch()
+            sched.cache.remove_node("n3")
+            sched.cache.add_node(
+                make_node("n9", cpu_milli=4000, labels={HOST: "n9", ZONE: "z0"})
+            )
+            for i in range(8, 16):
+                sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+            n, asg = _drain(sched)
+            n += r.scheduled
+            asg.update(r.assignments)
+        else:
+            n, asg = _drain(sched)
+        # settle + bank parity (the divergence probe includes the
+        # vectorized columns cross-check when columns are attached)
+        sched._commit_pipe.drain()
+        sched.mirror.sync()
+        sched.mirror.device_arrays()
+        div = sched.mirror.device_bank_divergence()
+        if columnar:
+            _assert_columns_exact(sched.cache)
+        sched.close()
+        return n, asg, div
+
+    n_on, asg_on, div_on = run(True)
+    n_off, asg_off, div_off = run(False)
+    assert n_on == n_off
+    assert asg_on == asg_off
+    assert div_on == [] and div_off == []
+
+
+# ---------------------------------------------------------------------------
+# microbench smoke + divergence probe sensitivity
+# ---------------------------------------------------------------------------
+
+def test_microbench_cache_smoke():
+    """Tier-1 wiring for scripts/microbench_cache.py: the A/B must run
+    and agree bit-for-bit (asserted inside main); timings are reported,
+    not asserted (CPU CI jitter)."""
+    import os
+    import sys
+
+    scripts = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+    )
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import microbench_cache
+
+    out = microbench_cache.main(smoke=True)
+    assert out["update_columnar_ms"] >= 0 and out["update_object_ms"] >= 0
+    assert out["cycle_columnar_ms"] >= 0 and out["cycle_object_ms"] >= 0
+    assert out["columnar_stats"]["bulk_pods"] > 0
+
+
+def test_columnar_divergence_probe_detects_skew():
+    """The vectorized columns-vs-banks cross-check must actually FIRE on
+    a forced skew (a probe that can't fail guards nothing)."""
+    sched, _ = _mk_sched(_nodes(2), enable_preemption=False, batch_size=4)
+    for i in range(4):
+        sched.queue.add(make_pod(f"p{i}", cpu_milli=100))
+    n, _ = _drain(sched)
+    assert n == 4
+    sched._commit_pipe.drain()
+    sched.mirror.sync()
+    sched.mirror.device_arrays()
+    assert sched.mirror.device_bank_divergence() == []
+    cols = sched.cache._columns
+    with sched.cache._lock:
+        cols.pod_count[cols.row_of["n0"]] += 1  # forced skew
+    div = sched.mirror.device_bank_divergence()
+    assert any(d.startswith("columns.") for d in div), div
+    with sched.cache._lock:
+        cols.pod_count[cols.row_of["n0"]] -= 1
+    assert sched.mirror.device_bank_divergence() == []
+    sched.close()

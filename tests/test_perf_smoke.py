@@ -146,6 +146,36 @@ def test_perf_smoke_term_plane(tmp_path, monkeypatch):
     assert detail["scheduled"] == perf_smoke.N_PODS
 
 
+def test_perf_smoke_columnar_cache(tmp_path, monkeypatch):
+    """Columnar-scheduler-cache acceptance, tier-1-fast: a covered
+    plain+anti drain commits every pod through the columnar bulk path
+    (coverage > 0) with ZERO lazy-view materializations and ZERO scalar
+    object-path pods on the commit path — per-pod NodeInfo/Quantity
+    object updates are gone from bulk assume/forget/bind — while the
+    device-divergence probe (now a vectorized columns-vs-banks
+    cross-check too) stays empty and no program compiles mid-drain.
+    Runs lock-order-audited: the column scatters join the cache lock's
+    acquisition graph."""
+    monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan_col"))
+    monkeypatch.setenv("KTPU_LOCK_AUDIT", "1")
+    from kubernetes_tpu.analysis.lockorder import REGISTRY
+
+    REGISTRY.reset()
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main_columnar()  # raises AssertionError on regression
+    REGISTRY.assert_acyclic()
+    cols = detail["columnar_state"]["cols"]
+    assert cols["bulk_pods"] > 0
+    assert cols["materializations"] == 0
+    assert cols["scalar_pods"] == 0
+    assert detail["columnar_state"]["divergence"] == []
+    assert detail["compile"]["misses_after_warmup"] == 0
+    assert detail["scheduled"] == perf_smoke.N_PODS
+
+
 def test_perf_smoke_ingest_plane(tmp_path, monkeypatch):
     """Pod-ingest-plane acceptance, tier-1-fast: on a quiet drain every
     dispatch takes the index-only path (coverage > 0, zero stale-row

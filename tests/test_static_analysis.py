@@ -149,6 +149,30 @@ def test_ktpu003_term_slab_refcount_pair():
     assert ("KTPU003", "TermSlab.entry_for") not in scopes
 
 
+def test_ktpu003_columnar_cache_pair():
+    """The columnar cache's fixture pair: an unlocked scatter-add into
+    the guarded hot columns flags (lost-update race between the commit
+    worker's bulk writes, the informer's scalar path, and the fold
+    planner's spec-row reads); the with-block twin, the *_locked-suffix
+    bulk method, and the holds()-marked delta-row gather pass."""
+    got = scan_fixture("ktpu003_columns.py")
+    scopes = rules_by_scope(got)
+    assert ("KTPU003", "Columns.bad_assume") in scopes
+    assert ("KTPU003", "Columns.good_assume") not in scopes
+    assert ("KTPU003", "Columns.assume_bulk_locked") not in scopes
+    assert ("KTPU003", "Columns.delta_rows") not in scopes
+
+
+def test_columns_module_clean_in_tree():
+    """The REAL columnar cache module: every guarded column access in
+    state/columns.py must satisfy KTPU003 (locked, *_locked, or holds)
+    — the tree scan must be clean on it."""
+    path = os.path.join(_REPO, "kubernetes_tpu", "state", "columns.py")
+    mod = load_module(path, _REPO)
+    got = run_checkers(mod, repo_config(), ALL_CHECKERS)
+    assert not [v.render() for v in got], [v.render() for v in got]
+
+
 def test_terms_plane_is_resident_surface_in_tree():
     """The REAL term plane is a KTPU002 resident-surface module (its
     device dicts must never be forced outside the designated sync
