@@ -513,12 +513,20 @@ def run_config(name, build, opts=None, inspect=None):
     # the duration of the drain (perf_smoke scrapes it mid-drain)
     global METRICS_SERVER
     msrv = None
+    # steady-state health monitor behind a flag (BENCH_HEALTH=1): the
+    # always-on gauges + sampled shadow audits run for the whole drain
+    # (armed here, after warmup, on the driver thread — the monitor's
+    # constructor publishes the driver-confined mirror census)
+    if os.environ.get("BENCH_HEALTH", "") not in ("", "0"):
+        sched.enable_health_monitor()
     if os.environ.get("BENCH_METRICS_PORT", "") != "":
         from kubernetes_tpu.metrics import MetricsServer
+        from kubernetes_tpu.obs.introspect import census as _census
 
         msrv = MetricsServer(
             port=int(os.environ["BENCH_METRICS_PORT"]),
             ready_fn=lambda: sched.ready,
+            debug_fn=lambda: _census(sched),
         ).start()
         METRICS_SERVER = msrv  # perf_smoke's mid-drain scraper reads the url
         print(f"[bench] metrics on {msrv.url}/metrics", file=sys.stderr, flush=True)

@@ -265,12 +265,17 @@ def run_sim(args) -> int:
         # /readyz gated on warmup (503 until the compile plan is armed —
         # a scrape-driven harness cannot race a cold scheduler)
         from .metrics import MetricsServer
+        from .obs.introspect import census as _census
 
         msrv = MetricsServer(
             host=args.address, port=args.metrics_port,
             ready_fn=lambda: sched.ready,
+            debug_fn=lambda: _census(sched),
         ).start()
-        print(f"metrics on {msrv.url}/metrics (readyz gated on warmup)")
+        print(
+            f"metrics on {msrv.url}/metrics (readyz gated on warmup; "
+            f"plane census on {msrv.url}/debug/ktpu)"
+        )
     api = FakeAPIServer()
     api_http = None
     if args.serve_api:

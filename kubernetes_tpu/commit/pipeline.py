@@ -76,6 +76,16 @@ class CommitPipeline:
             with self._lock:
                 self.stats["drain_wait_s"] += time.perf_counter() - t0
 
+    def census(self) -> Dict[str, object]:
+        """One lock-disciplined snapshot for the health plane
+        (obs/introspect): whether an apply is in flight plus the
+        submitted/wait/apply counters. Never blocks on the worker."""
+        with self._lock:
+            return {
+                "in_flight": self._inflight is not None,
+                "stats": dict(self.stats),
+            }
+
     def close(self) -> None:
         try:
             self.drain()

@@ -201,6 +201,51 @@ class CompilePlan:
                 ),
             }
 
+    # ktpu: holds(self._lock) shared by kind_census and health_census
+    def _kind_census_locked(self) -> Dict[str, Dict]:
+        out: Dict[str, Dict] = {}
+        for rec in self._records.values():
+            k = str(rec["spec"].kind)
+            e = out.setdefault(
+                k, {"rungs": 0, "dispatches": 0, "inline": 0, "compile_s": 0.0}
+            )
+            e["rungs"] += 1
+            e["dispatches"] += int(rec["count"])
+            if rec["source"] == SOURCE_INLINE:
+                e["inline"] += 1
+            e["compile_s"] += float(rec["compile_s"])
+        for e in out.values():
+            e["compile_s"] = round(e["compile_s"], 3)
+        return out
+
+    def kind_census(self) -> Dict[str, Dict]:
+        """Per-KIND_* ladder census (obs/introspect): declared rungs,
+        dispatch hits, inline-compiled rungs, and accumulated compile
+        wall per family — the 'is the ladder covering the workload'
+        answer at a glance, without the full per-spec list."""
+        with self._lock:
+            return self._kind_census_locked()
+
+    def health_census(self) -> Dict:
+        """The health monitor's compile block: the scalar stats + the
+        per-kind census in ONE short lock hold. Deliberately NOT
+        snapshot(): that builds and sorts the full per-spec list under
+        the lock — fine for bench detail, pure discarded work (and hot-
+        path lock contention) at a monitor's refresh cadence."""
+        with self._lock:
+            total = self.stats["hits"] + self.stats["misses"]
+            return {
+                "declared_specs": len(self._records),
+                "hits": int(self.stats["hits"]),
+                "misses": int(self.stats["misses"]),
+                "misses_after_warmup": int(self.stats["misses_after_warmup"]),
+                "compiles": int(self.stats["compiles"]),
+                "compile_s": round(self.stats["compile_s"], 3),
+                "coverage": round(self.stats["hits"] / total, 4) if total else None,
+                "warmed": self.warmed,
+                "kinds": self._kind_census_locked(),
+            }
+
     # -- metrics glue (lazy import: the plan must work without the registry) --
 
     def _metrics(self):

@@ -321,6 +321,20 @@ class StageBank:
             config_repr="gather",
         )
 
+    def census(self) -> Dict[str, object]:
+        """Device-twin half of the slab census (obs/introspect): resident
+        flag, the slab generation the device copy reflects, and the
+        uploader's flush counters — shares the slab lock so the numbers
+        are one consistent cut. Metadata only; never reads device
+        buffers."""
+        with self._lock:
+            return {
+                "resident": self._dev is not None,
+                "device_generation": self._dev_generation,
+                "warmed_generation": self._warmed_generation,
+                "stats": dict(self.stats),
+            }
+
     def warm(self) -> int:
         """Pre-compile the staging scatter programs (each rung ≤ capacity)
         with idempotent no-op patches, after ensuring the bank is resident

@@ -207,3 +207,21 @@ class PodStage:
     def valid_pair(self, row: int, gen: int) -> bool:
         with self._lock:
             return 0 <= row < self.capacity and self.row_gen[row] == gen
+
+    def census(self) -> Dict[str, object]:
+        """One lock-disciplined snapshot of the slab's steady-state
+        health (obs/introspect): occupancy, free-list depth, outstanding
+        refcounts, dirty (not-yet-shipped) rows, and the lifetime stats.
+        Counters and metadata only — never touches the row arrays."""
+        with self._lock:
+            return {
+                "enabled": True,
+                "capacity": int(self.capacity),
+                "rows": int(self.capacity - len(self._free)),
+                "free_rows": len(self._free),
+                "refs_total": int(self.refs.sum()),
+                "dirty_rows": len(self.dirty_rows),
+                "generation": int(self.generation),
+                "next_gen": int(self._next_gen),
+                "stats": dict(self.stats),
+            }

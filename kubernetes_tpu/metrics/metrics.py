@@ -233,6 +233,86 @@ sharded_fallbacks = registry.register(Counter(
     "value either way)",
     label_names=("reason",),
 ))
+# steady-state health plane (kubernetes_tpu/obs/introspect): always-on
+# gauges refreshed by the background health monitor (and by the driver's
+# per-batch gauge block for the queue split) — the production counterpart
+# of the flight recorder's traced windows. Every value is a counter or a
+# metadata read; nothing here ever forces a device value (KTPU004).
+queue_oldest_pending_age = registry.register(Gauge(
+    "scheduler_queue_oldest_pending_age_seconds",
+    "Age of the OLDEST currently-pending pod (active+backoff+"
+    "unschedulable), on the queue's own clock — the starvation gauge "
+    "next to scheduler_pending_pods",
+))
+plane_slab_occupancy = registry.register(Gauge(
+    "ktpu_plane_slab_occupancy",
+    "Rows/entries in use per device-residency plane slab (ingest = "
+    "staged pod rows, terms = interned term rows, columns = cache "
+    "column rows, mirror_nodes/mirror_sigs/mirror_patterns = bank rows)",
+    label_names=("plane",),
+))
+plane_slab_capacity = registry.register(Gauge(
+    "ktpu_plane_slab_capacity",
+    "Allocated slab capacity per plane (same label set as "
+    "ktpu_plane_slab_occupancy)",
+    label_names=("plane",),
+))
+plane_free_rows = registry.register(Gauge(
+    "ktpu_plane_free_rows",
+    "Free-list depth per plane slab",
+    label_names=("plane",),
+))
+plane_stale_rows = registry.register(Gauge(
+    "ktpu_plane_stale_rows",
+    "Rows whose derived copy lags the source of truth, per plane "
+    "(ingest/terms = staged rows not yet shipped to the device twin, "
+    "columns = lazy NodeInfo views behind the columns, mirror_nodes = "
+    "host rows pending a device patch)",
+    label_names=("plane",),
+))
+plane_refs_total = registry.register(Gauge(
+    "ktpu_plane_refs_total",
+    "Outstanding queue-entry references into a refcounted plane slab",
+    label_names=("plane",),
+))
+cache_journal_depth = registry.register(Gauge(
+    "ktpu_cache_journal_depth",
+    "Total journaled (sign, pod) ops pending behind the columnar "
+    "cache's lazy NodeInfo views (bounded by JOURNAL_BOUND per row)",
+))
+compile_ladder_rungs = registry.register(Gauge(
+    "ktpu_compile_ladder_rungs",
+    "Declared compile-plan specs per KIND_* family (the per-kind ladder "
+    "census)",
+    label_names=("kind",),
+))
+commit_inflight = registry.register(Gauge(
+    "ktpu_commit_inflight",
+    "1 while a columnar apply is in flight on the commit-pipeline "
+    "worker (the <=1-batch backpressure invariant, as a gauge)",
+))
+recorder_pending_device = registry.register(Gauge(
+    "ktpu_recorder_pending_device_spans",
+    "Flight-recorder two-phase device spans currently parked (bounded "
+    "by MAX_PENDING_DEVICE)",
+))
+health_monitor_up = registry.register(Gauge(
+    "ktpu_health_monitor_up",
+    "1 while the background steady-state health monitor thread is "
+    "running",
+))
+health_refresh = registry.register(Counter(
+    "ktpu_health_refresh_total",
+    "Health-monitor gauge refresh cycles completed",
+))
+shadow_audit = registry.register(Counter(
+    "ktpu_shadow_audit_total",
+    "Sampled shadow audits (device_bank_divergence + columns-vs-banks "
+    "cross-check) executed at the driver's safe sync point, by result "
+    "(clean|divergent|skipped — skipped means no resident device banks "
+    "existed to compare, never counted as clean)",
+    label_names=("result",),
+))
 
 
 class _Timer:

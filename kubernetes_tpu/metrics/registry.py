@@ -204,6 +204,27 @@ class Histogram(_Metric):
         with self._lock:
             return self._totals.get(labels, 0)
 
+    def labels(self) -> List[Tuple[str, ...]]:
+        """Every label combination observed so far (sorted) — lets the
+        perf-budget gate discover which stages have data without reaching
+        into the private maps."""
+        with self._lock:
+            return sorted(self._totals)
+
+    def bucket_counts(self, *labels: str) -> Tuple[List[int], int, float]:
+        """(per-bucket counts incl. the +Inf slot, total, sum) for one
+        label combination — the raw material for DELTA percentiles: the
+        perf-budget gate snapshots before a measured drain and diffs
+        after, so warmup compiles and other tests' observations in the
+        shared process-global histogram never pollute the gated p99."""
+        with self._lock:
+            c = self._counts.get(labels)
+            return (
+                list(c) if c else [0] * (len(self.buckets) + 1),
+                self._totals.get(labels, 0),
+                self._sums.get(labels, 0.0),
+            )
+
     def sum(self, *labels: str) -> float:
         with self._lock:
             return self._sums.get(labels, 0.0)

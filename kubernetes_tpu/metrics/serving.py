@@ -14,6 +14,7 @@ first batches pay the XLA compiles warmup exists to pre-pay.
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
@@ -29,12 +30,18 @@ class MetricsServer:
         registry=None,
         healthy_fn: Optional[Callable[[], bool]] = None,
         ready_fn: Optional[Callable[[], bool]] = None,
+        debug_fn: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry or default_registry
         self.healthy_fn = healthy_fn or (lambda: True)
         # readiness defaults to health for servers with no warmup notion
         # (the extender); a scheduler passes lambda: sched.ready
         self.ready_fn = ready_fn or self.healthy_fn
+        # /debug/ktpu (statusz-style): a callable returning the versioned
+        # plane-census JSON document (obs/introspect.census). Gated on
+        # ready_fn exactly like /readyz — a cold scheduler's census would
+        # describe a pre-warmup world the gauges never will.
+        self.debug_fn = debug_fn
         self._httpd = ThreadingHTTPServer((host, port), self._make_handler())
         self._thread: Optional[threading.Thread] = None
 
@@ -92,6 +99,29 @@ class MetricsServer:
                         self._send(b"ok")
                     else:
                         self._send(b"unhealthy", code=500)
+                elif path == "/debug/ktpu":
+                    # the plane-census introspection route (versioned JSON
+                    # schema, obs/introspect): 503 before warmup —
+                    # consistent with /readyz by construction (same gate)
+                    if server.debug_fn is None:
+                        self._send(b"not found", code=404)
+                    elif not server.ready_fn():
+                        self._send(
+                            b'{"error": "not ready"}', code=503,
+                            ctype="application/json",
+                        )
+                    else:
+                        try:
+                            body = json.dumps(
+                                server.debug_fn(), default=str
+                            ).encode()
+                        except Exception as e:  # census must never 500 the mux silently
+                            self._send(
+                                json.dumps({"error": str(e)}).encode(),
+                                code=500, ctype="application/json",
+                            )
+                        else:
+                            self._send(body, ctype="application/json")
                 else:
                     self._send(b"not found", code=404)
 

@@ -1,0 +1,483 @@
+"""Steady-state health plane: live plane-census introspection, always-on
+gauges, and sampled shadow audits.
+
+PR 7's flight recorder answers "what happened in this traced window";
+this module is the production counterpart — an always-on view of whether
+each device-residency plane is *healthy right now*, the kube-scheduler's
+`/metrics` discipline (PAPER.md §9) extended to the planes the reference
+cannot have:
+
+* **Unified plane census** — ``census(sched)`` assembles one versioned
+  JSON document from one lock-disciplined ``census()`` per subsystem:
+  the queue (depth split + oldest-pending age on the queue's own clock),
+  the ingest slab + staged bank, the term slab + term bank, the cache
+  (+ columnar columns/journal), the tensor mirror (bank occupancy, dirty
+  rows, fold bookkeeping, the bytes ledger), the compile ladder
+  (per-kind rung/hit/miss), the commit pipeline, and the flight
+  recorder. Exported three ways: kube-shaped gauges on the existing
+  registry (``export_gauges``), the ``/debug/ktpu`` JSON route on
+  ``MetricsServer`` (statusz-style, ``SCHEMA_VERSION``-tagged), and
+  ``scripts/ktpu_top.py``'s live terminal table.
+
+* **Background health monitor** — ``HealthMonitor`` refreshes the
+  gauges on an interval from its own thread. It is KTPU004-clean by
+  construction AND by machine check: every census function below is
+  ``# ktpu: hot-path``-marked, so a forcing call (``np.asarray``,
+  ``float``, ``block_until_ready`` on a device value) inside any of
+  them is a lint violation, not a code-review hope. Driver-confined
+  state (the tensor mirror) is never read from the monitor thread —
+  the DRIVER publishes ``TensorMirror.census()`` into the monitor's
+  guarded mailbox at its post-sync safe point
+  (``driver_sync_hook``), the same confinement contract every other
+  mirror entry point lives by.
+
+* **Sampled shadow audits** — every ``audit_every`` refreshes the
+  monitor marks an audit due; the driver executes it at the next
+  batch's safe sync point (commit pipeline drained, mirror freshly
+  synced): ``device_bank_divergence`` + the columns-vs-banks
+  cross-check, exported as ``ktpu_shadow_audit_total{result}`` with
+  last-divergence detail in ``/debug/ktpu`` — silent drift shows up in
+  minutes instead of at bench-audit time.
+
+Lock discipline: the monitor's shared state is guarded by ONE audited
+lock (role "health") that is always innermost — the monitor acquires
+plane locks strictly one-at-a-time while holding nothing, and merges
+results under the health lock afterwards, so it can never add an edge
+cycle to the lock-order graph (KTPU_LOCK_AUDIT drains include it).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..analysis.lockorder import audited_lock
+from ..metrics import metrics as M
+
+#: /debug/ktpu schema version — bump on any breaking key change; readers
+#: (ktpu_top, tests) refuse documents they don't understand
+SCHEMA_VERSION = 1
+
+#: every plane block a census document must carry (the six
+#: device-residency planes + the cache + the ladder + the recorder:
+#: ingest, terms, mirror [fold + sharded twins], compile, commit, queue)
+REQUIRED_PLANES = (
+    "queue", "ingest", "terms", "cache", "mirror", "compile", "commit",
+    "recorder",
+)
+
+#: per-plane keys validate_census demands when the plane is enabled
+_REQUIRED_KEYS = {
+    "queue": ("active", "backoff", "unschedulable", "oldest_pending_age_s",
+              "nominated", "scheduling_cycle"),
+    "ingest": ("capacity", "rows", "free_rows", "refs_total", "dirty_rows",
+               "generation", "stats", "bank"),
+    "terms": ("capacity", "rows", "free_rows", "entries", "refs_total",
+              "dirty_rows", "generation", "stats", "bank"),
+    "cache": ("nodes", "pods", "assumed", "pending_deltas", "dirty_nodes",
+              "mutation_count", "columns"),
+    "mirror": ("node_capacity", "node_rows", "sig_capacity", "sig_rows",
+               "pattern_capacity", "pattern_rows", "device_resident",
+               "pending_node_rows", "pending_usage_rows", "folded_usage_rows",
+               "fold_count", "folds_undonated", "rebuild_count",
+               "bytes_shipped"),
+    "compile": ("declared_specs", "hits", "misses", "misses_after_warmup",
+                "warmed", "kinds"),
+    "commit": ("in_flight", "stats", "verdicts"),
+    "recorder": ("enabled", "pending_device", "dropped_pending",
+                 "blackbox_records"),
+}
+
+
+# ---------------------------------------------------------------------------
+# plane census functions (each: one lock-disciplined snapshot, hot-path-
+# marked so ktpu-lint KTPU004 machine-checks the no-forcing contract)
+# ---------------------------------------------------------------------------
+
+# ktpu: hot-path
+def queue_census(queue) -> Dict:
+    return queue.census()
+
+
+# ktpu: hot-path
+def ingest_census(stage, bank) -> Dict:
+    if stage is None:
+        return {"enabled": False}
+    out = stage.census()
+    out["bank"] = bank.census() if bank is not None else None
+    return out
+
+
+# ktpu: hot-path
+def terms_census(tstage, term_bank) -> Dict:
+    if tstage is None:
+        return {"enabled": False}
+    out = tstage.census()
+    out["bank"] = term_bank.census() if term_bank is not None else None
+    return out
+
+
+# ktpu: hot-path
+def cache_census(cache) -> Dict:
+    return cache.census()
+
+
+# ktpu: hot-path
+def compile_census(plan) -> Dict:
+    # health_census, not snapshot(): one short lock hold, no per-spec
+    # list built and discarded at refresh cadence
+    return plan.health_census()
+
+
+# ktpu: hot-path
+def commit_census(pipe) -> Dict:
+    out = pipe.census()
+    # arbiter verdict totals ride the registry counter (process-global:
+    # advisory when several schedulers share the process, exact in the
+    # one-scheduler production shape)
+    out["verdicts"] = {
+        v: M.commit_arbiter_verdicts.value(v)
+        for v in ("place", "defer", "nofit")
+    }
+    return out
+
+
+# ktpu: hot-path
+def recorder_census(rec) -> Dict:
+    return rec.census()
+
+
+def mirror_census(mirror) -> Dict:
+    """The mirror block — DRIVER-THREAD ONLY (TensorMirror.census's
+    confinement contract). The monitor consumes it via the published
+    mailbox; callers invoking ``census(sched)`` directly must be on the
+    driver thread (tests, the drain loop) or accept an advisory read on
+    an idle scheduler."""
+    return mirror.census()
+
+
+# ktpu: hot-path
+def census(sched, monitor: Optional["HealthMonitor"] = None) -> Dict:
+    """The unified plane census: one versioned, JSON-serializable
+    document covering every plane (REQUIRED_PLANES). The mirror block
+    comes from the monitor's driver-published mailbox when a monitor is
+    attached; otherwise it is sampled in place (callers should then be
+    on the driver thread — see mirror_census)."""
+    mon = monitor if monitor is not None else getattr(sched, "health", None)
+    mirror_block = mon.published("mirror") if mon is not None else None
+    if mirror_block is None:
+        mirror_block = mirror_census(sched.mirror)
+    doc = {
+        "version": SCHEMA_VERSION,
+        "generated_unix": time.time(),
+        "ready": bool(sched.ready),
+        "planes": {
+            "queue": queue_census(sched.queue),
+            "ingest": ingest_census(sched.stage, sched.stage_bank),
+            "terms": terms_census(sched.tstage, sched.term_bank),
+            "cache": cache_census(sched.cache),
+            "mirror": mirror_block,
+            "compile": compile_census(sched.compile_plan),
+            "commit": commit_census(sched._commit_pipe),
+            "recorder": recorder_census(sched.obs),
+        },
+    }
+    if mon is not None:
+        doc["monitor"] = mon.census_block()
+    return doc
+
+
+def validate_census(doc: Dict) -> List[str]:
+    """Structural problems with a census document (empty list = valid):
+    the schema-versioned contract /debug/ktpu readers rely on. Shared by
+    the test suite and perf_smoke's health mode, like
+    obs.export.validate_trace."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return ["census is not an object"]
+    if doc.get("version") != SCHEMA_VERSION:
+        problems.append(
+            f"version {doc.get('version')!r} != schema {SCHEMA_VERSION}"
+        )
+    if "ready" not in doc:
+        problems.append("missing 'ready'")
+    planes = doc.get("planes")
+    if not isinstance(planes, dict):
+        return problems + ["missing 'planes' object"]
+    for name in REQUIRED_PLANES:
+        block = planes.get(name)
+        if not isinstance(block, dict):
+            problems.append(f"plane '{name}' missing")
+            continue
+        if block.get("enabled") is False:
+            continue  # disabled plane: the flag is the whole contract
+        for key in _REQUIRED_KEYS.get(name, ()):
+            if key not in block:
+                problems.append(f"plane '{name}' missing key '{key}'")
+    mon = doc.get("monitor")
+    if mon is not None:
+        for key in ("refreshes", "shadow_audits", "last_divergence"):
+            if key not in mon:
+                problems.append(f"monitor block missing key '{key}'")
+    try:
+        import json
+
+        json.dumps(doc, default=str)
+    except (TypeError, ValueError) as e:
+        problems.append(f"not JSON-serializable: {e}")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# gauge export
+# ---------------------------------------------------------------------------
+
+#: (census plane key, gauge label) pairs for the refcounted slabs
+_SLAB_PLANES = (("ingest", "ingest"), ("terms", "terms"))
+
+
+# ktpu: hot-path
+def export_gauges(doc: Dict) -> None:
+    """Project a census document onto the always-on registry gauges —
+    the kube-shaped scrape surface. Called by the health monitor each
+    refresh; safe from any thread (the gauges lock themselves)."""
+    planes = doc.get("planes", {})
+    q = planes.get("queue") or {}
+    M.pending_pods.set(q.get("active", 0), "active")
+    M.pending_pods.set(q.get("backoff", 0), "backoff")
+    M.pending_pods.set(q.get("unschedulable", 0), "unschedulable")
+    M.queue_oldest_pending_age.set(q.get("oldest_pending_age_s", 0.0))
+    for key, label in _SLAB_PLANES:
+        d = planes.get(key)
+        if not d or d.get("enabled") is False:
+            continue
+        M.plane_slab_occupancy.set(d.get("rows", 0), label)
+        M.plane_slab_capacity.set(d.get("capacity", 0), label)
+        M.plane_free_rows.set(d.get("free_rows", 0), label)
+        M.plane_stale_rows.set(d.get("dirty_rows", 0), label)
+        M.plane_refs_total.set(d.get("refs_total", 0), label)
+    cache = planes.get("cache") or {}
+    cols = cache.get("columns")
+    if cols:
+        M.plane_slab_occupancy.set(cols.get("rows", 0), "columns")
+        M.plane_slab_capacity.set(cols.get("capacity", 0), "columns")
+        M.plane_free_rows.set(cols.get("free_rows", 0), "columns")
+        M.plane_stale_rows.set(cols.get("stale_rows", 0), "columns")
+        M.cache_journal_depth.set(cols.get("journal_depth", 0))
+    mir = planes.get("mirror") or {}
+    if mir:
+        M.plane_slab_occupancy.set(mir.get("node_rows", 0), "mirror_nodes")
+        M.plane_slab_capacity.set(mir.get("node_capacity", 0), "mirror_nodes")
+        M.plane_stale_rows.set(
+            mir.get("pending_node_rows", 0) + mir.get("pending_usage_rows", 0),
+            "mirror_nodes",
+        )
+        M.plane_slab_occupancy.set(mir.get("sig_rows", 0), "mirror_sigs")
+        M.plane_slab_capacity.set(mir.get("sig_capacity", 0), "mirror_sigs")
+        M.plane_slab_occupancy.set(
+            mir.get("pattern_rows", 0), "mirror_patterns"
+        )
+        M.plane_slab_capacity.set(
+            mir.get("pattern_capacity", 0), "mirror_patterns"
+        )
+    comp = planes.get("compile") or {}
+    for kind, e in (comp.get("kinds") or {}).items():
+        M.compile_ladder_rungs.set(e.get("rungs", 0), kind)
+    commit = planes.get("commit") or {}
+    M.commit_inflight.set(1.0 if commit.get("in_flight") else 0.0)
+    rec = planes.get("recorder") or {}
+    M.recorder_pending_device.set(rec.get("pending_device", 0))
+
+
+# ---------------------------------------------------------------------------
+# the background health monitor
+# ---------------------------------------------------------------------------
+
+class HealthMonitor:
+    """Refreshes the steady-state gauges on an interval and schedules
+    sampled shadow audits at the driver's safe sync point. Create on
+    the DRIVER thread (the constructor publishes the initial mirror
+    census); arm with ``start()``; the scheduler's ``close()`` stops it.
+
+    Thread roles:
+      * monitor thread — ``refresh()``: plane censuses (each under its
+        own lock, one at a time), gauge export, audit-due bookkeeping;
+      * driver thread — ``driver_sync_hook()``: mirror-census
+        publication + due-audit execution (the ONE place the audit's
+        device forcing is legal: commit pipeline drained, mirror
+        freshly synced, and ``device_bank_divergence`` is already the
+        designed sync point of the resident-state plane);
+      * any thread — ``census_block()`` / ``published()`` readers
+        (the /debug/ktpu route runs on the metrics mux threads).
+    """
+
+    #: default cadence: gauges every 0.25s, one sampled audit per ~minute
+    #: (0.25s x 240). The audit is a full-bank device fetch on the driver
+    #: thread (~hundreds of ms at smoke scale), so its cadence is an
+    #: operator dial, deliberately orders of magnitude slower than the
+    #: gauge refresh — "drift shows up in minutes", not a per-batch tax.
+    DEFAULT_INTERVAL = 0.25
+    DEFAULT_AUDIT_EVERY = 240
+
+    def __init__(
+        self,
+        sched,
+        interval: float = DEFAULT_INTERVAL,
+        audit_every: int = DEFAULT_AUDIT_EVERY,
+    ):
+        self.sched = sched
+        self.interval = float(interval)
+        self.audit_every = int(audit_every)
+        # always-innermost lock (module docstring): role "health"
+        self._lock = audited_lock("health")
+        self._published: Dict[str, Dict] = {}  # ktpu: guarded-by(self._lock)
+        self._audit_counts: Dict[str, int] = {"clean": 0, "divergent": 0}  # ktpu: guarded-by(self._lock)
+        self._last_divergence: List[str] = []  # ktpu: guarded-by(self._lock)
+        self._last_audit_unix: Optional[float] = None  # ktpu: guarded-by(self._lock)
+        self._refreshes = 0  # ktpu: guarded-by(self._lock)
+        self._since_audit = 0  # ktpu: guarded-by(self._lock)
+        self._audit_due = False  # ktpu: guarded-by(self._lock)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # initial driver-side publication: the ctor runs on the driver
+        # thread by contract, so this read honors the mirror confinement
+        self.publish("mirror", mirror_census(sched.mirror))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "HealthMonitor":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="health-monitor", daemon=True
+        )
+        self._thread.start()
+        M.health_monitor_up.set(1.0)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        M.health_monitor_up.set(0.0)
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.refresh()
+            except Exception:  # pragma: no cover - monitor must never kill the process
+                import logging
+
+                logging.getLogger("kubernetes_tpu.obs").exception(
+                    "health monitor refresh failed"
+                )
+
+    # -- publication mailbox (driver -> monitor/readers) ---------------------
+
+    def publish(self, plane: str, snapshot: Dict) -> None:
+        with self._lock:
+            self._published[plane] = snapshot
+
+    def published(self, plane: str) -> Optional[Dict]:
+        with self._lock:
+            return self._published.get(plane)
+
+    # -- the refresh cycle (monitor thread; also callable inline) ------------
+
+    # ktpu: hot-path
+    def refresh(self) -> Dict:
+        """One monitor cycle: census -> gauges -> audit-due bookkeeping.
+        Counters and metadata only (hot-path-marked: a forcing call in
+        here is a KTPU004 violation)."""
+        doc = census(self.sched, monitor=self)
+        export_gauges(doc)
+        with self._lock:
+            self._refreshes += 1
+            self._since_audit += 1
+            if self.audit_every > 0 and self._since_audit >= self.audit_every:
+                self._since_audit = 0
+                self._audit_due = True
+        M.health_refresh.inc()
+        return doc
+
+    def request_audit(self) -> None:
+        """Mark a shadow audit due out-of-cycle (tests; an operator
+        poking /debug/ktpu after an alert)."""
+        with self._lock:
+            self._audit_due = True
+
+    # -- driver-side hooks (driver thread ONLY) ------------------------------
+
+    def driver_sync_hook(self) -> None:
+        """Called by the driver at its post-sync safe point (commit
+        pipeline drained, mirror freshly synced): publish the
+        driver-confined mirror census and execute any due shadow
+        audit."""
+        self.publish("mirror", mirror_census(self.sched.mirror))
+        with self._lock:
+            due, self._audit_due = self._audit_due, False
+        if due:
+            self.run_shadow_audit()
+
+    def run_shadow_audit(self) -> List[str]:
+        """Execute one shadow audit ON THE DRIVER THREAD at a safe sync
+        point: the existing device_bank_divergence probe (which includes
+        the vectorized columns-vs-banks cross-check) — the drift that
+        used to surface only at bench-audit time, sampled into the
+        steady state. Ships any still-pending dirty rows first
+        (device_arrays — the exact patch the next dispatch would pay,
+        just earlier in the same cycle) so the probe compares a SETTLED
+        host/device pair: right after sync() the host is legitimately
+        ahead of the device, and auditing that window would report the
+        pipeline's own in-flight delta as drift. Returns the divergence
+        list (empty = clean). With no resident device banks there is
+        nothing to compare — counted as result="skipped", never as a
+        phantom "clean" (the probe's early-return would otherwise let
+        the clean counter climb having verified nothing)."""
+        mirror = self.sched.mirror
+        if mirror._dev_nodes is None:
+            M.shadow_audit.inc("skipped")
+            with self._lock:
+                self._audit_counts["skipped"] = (
+                    self._audit_counts.get("skipped", 0) + 1
+                )
+                self._last_audit_unix = time.time()
+            return []
+        mirror.device_arrays()
+        div = list(mirror.device_bank_divergence())
+        result = "divergent" if div else "clean"
+        M.shadow_audit.inc(result)
+        now = time.time()
+        with self._lock:
+            self._audit_counts[result] = self._audit_counts.get(result, 0) + 1
+            self._last_audit_unix = now
+            if div:
+                self._last_divergence = div
+        return div
+
+    # -- readers -------------------------------------------------------------
+
+    def audit_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._audit_counts)
+
+    def census_block(self) -> Dict:
+        """The monitor's own block of the census document."""
+        with self._lock:
+            return {
+                "running": self.running,
+                "interval_s": self.interval,
+                "audit_every": self.audit_every,
+                "refreshes": self._refreshes,
+                "shadow_audits": dict(self._audit_counts),
+                "last_audit_unix": self._last_audit_unix,
+                "last_divergence": list(self._last_divergence),
+            }

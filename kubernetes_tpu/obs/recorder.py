@@ -318,7 +318,21 @@ class FlightRecorder:
         if not records:
             return None
         if path is None:
-            directory = os.environ.get("KTPU_TRACE_DIR", ".")
+            # dump-dir hygiene: KTPU_BLACKBOX_DIR > KTPU_TRACE_DIR > the
+            # system temp dir — NEVER the CWD (crash artifacts were
+            # littering repo checkouts; a configured artifacts dir is
+            # created on demand so a crash handler can't fail on mkdir)
+            import tempfile
+
+            directory = (
+                os.environ.get("KTPU_BLACKBOX_DIR")
+                or os.environ.get("KTPU_TRACE_DIR")
+                or tempfile.gettempdir()
+            )
+            try:
+                os.makedirs(directory, exist_ok=True)
+            except OSError:
+                directory = tempfile.gettempdir()
             path = os.path.join(
                 directory, f"ktpu_blackbox_{reason}_{os.getpid()}.json"
             )
@@ -335,6 +349,20 @@ class FlightRecorder:
             len(records), path, reason,
         )
         return path
+
+    def census(self) -> Dict[str, object]:
+        """The recorder's steady-state health block (obs/introspect):
+        enabled flag, parked two-phase device spans, overflow-abandoned
+        count, black-box depth, ring count. Metadata only — never
+        resolves (forces) a parked handle."""
+        with self._mu:
+            return {
+                "enabled": self.enabled,
+                "pending_device": len(self._pending),
+                "dropped_pending": int(self.dropped_pending),
+                "blackbox_records": len(self._blackbox),
+                "rings": len(self._rings),
+            }
 
     # -- export --------------------------------------------------------------
 

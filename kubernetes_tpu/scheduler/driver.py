@@ -745,6 +745,16 @@ class Scheduler:
         if trace:
             OBS.enable(True)
         self.obs = OBS
+        # steady-state health plane (obs/introspect): armed explicitly
+        # via enable_health_monitor() or KTPU_HEALTH=1 — a background
+        # gauge-refresh thread plus driver-executed sampled shadow
+        # audits. None = no monitor thread, zero steady-state cost
+        # beyond one attribute read per batch.
+        self.health = None
+        # last throttled observation of the O(pending) oldest-age gauge
+        self._oldest_age_obs_ts = 0.0
+        if _os.environ.get("KTPU_HEALTH", "") not in ("", "0"):
+            self.enable_health_monitor(start=False)
         # black-box baseline: cumulative counters diffed per batch into
         # the bounded cycle ring (ktpu: confined(driver))
         self._bb_prev: Optional[Dict] = None
@@ -787,6 +797,36 @@ class Scheduler:
         the two-phase device-timing idiom."""
         self.obs.export(path)
         return path
+
+    def enable_health_monitor(
+        self, interval: float = 0.25, audit_every: int = 240,
+        start: bool = True,
+    ):
+        """Arm the steady-state health monitor (obs/introspect):
+        always-on plane gauges refreshed every `interval` seconds off a
+        background thread, with a sampled shadow audit (device-bank +
+        columns cross-check) executed at the driver's safe sync point
+        every `audit_every` refreshes — one audit per ~minute at the
+        defaults: the audit is a full-bank fetch on the driver thread,
+        so its cadence is an operator dial, not a per-batch tax.
+        Idempotent, and RECONFIGURES an
+        existing monitor in place (a monitor pre-created by KTPU_HEALTH=1
+        must not silently keep its default cadence when a caller asks
+        for another). Returns the monitor. Must be called on the driver
+        thread (the monitor's constructor publishes the driver-confined
+        mirror census)."""
+        if self.health is None:
+            from ..obs.introspect import HealthMonitor
+
+            self.health = HealthMonitor(
+                self, interval=interval, audit_every=audit_every
+            )
+        else:
+            self.health.interval = float(interval)
+            self.health.audit_every = int(audit_every)
+        if start:
+            self.health.start()
+        return self.health
 
     def _bb_counters(self) -> Dict:
         """Cumulative counters the black box diffs per batch."""
@@ -2001,6 +2041,13 @@ class Scheduler:
             plan.mark_warmed()
             plan.persist()
             self._aot_enabled = True
+            if self.health is not None:
+                # warm banks are resident now: refresh the published
+                # mirror census (still the driver thread) and arm the
+                # monitor thread — like the uploaders, it starts at
+                # warmup so tests that never warm get no surprise thread
+                self.health.publish("mirror", self.mirror.census())
+                self.health.start()
         except Exception:
             # a failed warmup is harmless for correctness but must be
             # VISIBLE: the first real batch will silently pay the compile
@@ -3014,6 +3061,15 @@ class Scheduler:
         M.scheduling_stage_duration.observe(dt_sync, "sync")
         OBS.record("sync", t_sync)
         trace.step("tensor mirror sync")
+        # steady-state health plane: the post-sync, pipeline-drained
+        # moment is the monitor's designated safe point — the driver
+        # publishes the mirror census (driver-confined state never
+        # crosses to the monitor thread) and executes any due sampled
+        # shadow audit here, where device_bank_divergence is already
+        # the resident-state plane's designed sync point
+        if self.health is not None:
+            self.health.driver_sync_hook()
+            trace.step("health sync hook")
         # the snapshot moved (sync) — rebuild the oracle metadata index
         # lazily if this batch needs it
         self._aff_index = None
@@ -3676,6 +3732,17 @@ class Scheduler:
         M.pending_pods.set(active, "active")
         M.pending_pods.set(backoff, "backoff")
         M.pending_pods.set(unsched, "unschedulable")
+        # oldest-pending age on the queue's own clock, observed OUTSIDE
+        # the queue lock (oldest_pending_age releases it before
+        # returning) — the starvation gauge next to the depth split.
+        # THROTTLED: the min-timestamp walk is O(pending) under the
+        # queue lock, so unlike the O(1) depth gauges it refreshes at
+        # most twice a second, not per batch (the health monitor's
+        # refresh exports it on its own cadence too).
+        now_pc = time.perf_counter()
+        if now_pc - getattr(self, "_oldest_age_obs_ts", 0.0) >= 0.5:
+            self._oldest_age_obs_ts = now_pc
+            M.queue_oldest_pending_age.set(self.queue.oldest_pending_age())
         # the reference's 100ms slow-cycle contract (LogIfLong,
         # generic_scheduler.go:175-176) — per batch here
         trace.log_if_long()
@@ -3721,6 +3788,8 @@ class Scheduler:
         self.flush_speculative()
         self.wait_for_binds()
         self._commit_pipe.close()
+        if self.health is not None:
+            self.health.stop()
         if self.stage_bank is not None:
             self.stage_bank.close()
         if self.term_bank is not None:

@@ -640,6 +640,24 @@ class SchedulerCache:
         with self._lock:
             return len(self._pod_states)
 
+    def census(self) -> Dict:
+        """One lock-disciplined snapshot of the cache's steady-state
+        health (obs/introspect): object-state counts, the delta-log
+        backlog, and (columnar mode) the columns' own census. Counters
+        and metadata only — len() on the lazy node_infos map is a raw
+        key count, resolving nothing."""
+        with self._lock:
+            cols = self._columns
+            return {
+                "nodes": len(self.snapshot.node_infos),
+                "pods": len(self._pod_states),
+                "assumed": len(self._assumed),
+                "pending_deltas": len(self.pod_deltas),
+                "dirty_nodes": len(self.dirty_nodes),
+                "mutation_count": int(self.mutation_count),
+                "columns": cols.census_locked() if cols is not None else None,
+            }
+
 
 class TensorMirror:
     """Keeps device-facing banks (NodeBank + SigBank + PatternBank) patched
@@ -1571,6 +1589,52 @@ class TensorMirror:
         if 0 <= row < len(self.name_of_row):
             return self.name_of_row[row]
         return None
+
+    # ktpu: confined(driver) census of driver-confined bookkeeping — the
+    # health monitor never calls this itself: the DRIVER publishes it at
+    # the post-sync safe point (obs/introspect.HealthMonitor.driver_sync_
+    # hook), the same confinement contract every other mirror entry point
+    # lives by. Counters and metadata only; never reads device buffers.
+    # The one sanctioned OFF-driver caller is introspect.census's
+    # no-monitor /debug/ktpu fallback, which accepts an ADVISORY read:
+    # every field is a single len()/int read (atomic, possibly torn as a
+    # set) except the ledger copy below, which is retry-wrapped because
+    # the UPLOADER threads add fresh ledger kinds concurrently even in
+    # normal driver-thread use.
+    def census(self) -> Dict:
+        for _ in range(4):
+            try:
+                shipped = dict(self.bytes_shipped)
+                break
+            except RuntimeError:  # a writer added a kind mid-copy
+                continue
+        else:  # pragma: no cover - needs 4 adds of brand-new kinds mid-copy
+            shipped = {}
+        return {
+            "node_capacity": int(self.nodes.capacity),
+            "node_rows": len(self.row_of),
+            "sig_capacity": int(self.eps.capacity),
+            "sig_rows": len(self.eps._sig_of),
+            "pattern_capacity": int(self.pats.capacity),
+            "pattern_rows": len(self.pats._row_of),
+            "device_resident": (
+                self._dev_nodes is not None and not self._device_stale
+            ),
+            "pending_node_rows": len(self._pending_node_rows),
+            "pending_usage_rows": len(self._pending_usage_rows),
+            "pending_pat_rows": len(self._pending_pat_rows),
+            "folded_usage_rows": len(self._folded_usage_rows),
+            "folded_pat_rows": len(self._folded_pat_rows),
+            "dirty_sig_rows": len(self.eps.dirty_sig_rows),
+            "dirty_pattern_rows": len(self.pats.dirty_pattern_rows),
+            "nominee_overlay": self._nominee_overlay is not None,
+            "fold_count": int(self.fold_count),
+            "folds_undonated": int(self.folds_undonated),
+            "rebuild_count": int(self.rebuild_count),
+            "generation": int(getattr(self, "generation", 0)),
+            "device_generation": self.device_generation,
+            "bytes_shipped": shipped,
+        }
 
 
 def _nbytes(v) -> int:

@@ -677,3 +677,27 @@ class CacheColumns:
     def stats_snapshot(self) -> Dict[str, int]:
         with self._lock:
             return dict(self.stats)
+
+    def census_locked(self) -> Dict[str, object]:
+        """The columns' steady-state health block (obs/introspect),
+        caller holds the cache lock: row occupancy, the lazy-view journal
+        depth (total pending ops behind unmaterialized NodeInfo views),
+        stale/overgrown row counts, and the interned-spec-row census.
+        Counters and metadata only."""
+        pend = self._pending
+        journal = 0
+        for row in self._stale_rows:
+            ops = pend[row]
+            if ops:
+                journal += len(ops)
+        return {
+            "capacity": int(self.capacity),
+            "rows": len(self.row_of),
+            "free_rows": len(self._free_rows),
+            "stale_rows": len(self._stale_rows),
+            "journal_depth": journal,
+            "overgrown_rows": len(self._overgrown),
+            "spec_rows": len(self._slot_of),
+            "generation": int(self.generation),
+            "stats": dict(self.stats),
+        }

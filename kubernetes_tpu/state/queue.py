@@ -669,3 +669,46 @@ class PriorityQueue:
         """(active, backoff, unschedulable) — the pending_pods gauge split."""
         with self._lock:
             return len(self._active), len(self._backoff), len(self._unschedulable)
+
+    # ktpu: holds(self._lock) min-timestamp walk over the pending set
+    def _oldest_pending_ts_locked(self) -> Optional[float]:
+        oldest = None
+        for k in self._pending_keys_locked():
+            info = self._infos.get(k)
+            if info is not None and (oldest is None or info.timestamp < oldest):
+                oldest = info.timestamp
+        return oldest
+
+    def oldest_pending_age(self) -> float:
+        """Age of the OLDEST pending entry, on the queue's OWN clock (the
+        age()/attempt_age() discipline — callers never mix clocks). The
+        lock covers only the min-timestamp walk; the gauge observation
+        the driver/health monitor makes from this value happens outside
+        it. 0.0 when nothing is pending."""
+        with self._lock:
+            now = self._now()
+            oldest = self._oldest_pending_ts_locked()
+        if oldest is None:
+            return 0.0
+        return max(now - oldest, 0.0)
+
+    def census(self) -> Dict:
+        """One lock-disciplined snapshot of the queue's steady-state
+        health (obs/introspect): pending depth by sub-queue, the oldest
+        pending entry's age on the queue's clock, and the nomination
+        index size. Counters and metadata only — the monitor's
+        no-forcing contract starts here."""
+        with self._lock:
+            now = self._now()
+            oldest = self._oldest_pending_ts_locked()
+            return {
+                "active": len(self._active),
+                "backoff": len(self._backoff),
+                "unschedulable": len(self._unschedulable),
+                "oldest_pending_age_s": (
+                    max(now - oldest, 0.0) if oldest is not None else 0.0
+                ),
+                "nominated": len(self.nominated),
+                "scheduling_cycle": self._scheduling_cycle,
+                "closed": self.closed,
+            }

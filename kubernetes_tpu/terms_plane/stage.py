@@ -266,6 +266,25 @@ class TermStage:
             e = self._entries.get(eid)
             return e is not None and e.gen == gen
 
+    def census(self) -> Dict[str, object]:
+        """One lock-disciplined snapshot of the term slab's steady-state
+        health (obs/introspect): interned entries, row occupancy,
+        free-list depth, outstanding refcounts, dirty rows, lifetime
+        stats. Counters and metadata only."""
+        with self._lock:
+            return {
+                "enabled": True,
+                "capacity": int(self.capacity),
+                "rows": int(self.capacity - len(self._free)),
+                "free_rows": len(self._free),
+                "entries": len(self._entries),
+                "refs_total": int(sum(e.refs for e in self._entries.values())),
+                "dirty_rows": len(self.dirty_rows),
+                "generation": int(self.generation),
+                "next_gen": int(self._next_gen),
+                "stats": dict(self.stats),
+            }
+
     # ktpu: holds(self._lock) the driver's prologue resolves entries
     # inside its locked capture window
     def entry_for(self, eid: int, gen: int, key) -> Optional[TermEntry]:

@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""ktpu top: a live terminal table of the steady-state health census.
+
+Renders the per-plane slab/occupancy/staleness view from EITHER source:
+
+  * the ``/debug/ktpu`` JSON route (the full versioned census —
+    preferred: includes the ladder kinds, fold bookkeeping, and the
+    monitor's shadow-audit tallies), or
+  * a raw ``/metrics`` registry scrape (the ``ktpu_*`` gauge subset —
+    works against any Prometheus-compatible relay of the scrape, no
+    debug route required).
+
+Usage:
+    python scripts/ktpu_top.py --url http://127.0.0.1:9090            # auto
+    python scripts/ktpu_top.py --url http://... --source metrics      # scrape
+    python scripts/ktpu_top.py --url http://... --once                # one shot
+
+The render functions are pure (census/parsed-scrape dict -> str) so the
+test suite drives them without a server.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+#: one Prometheus text-format sample line: name{labels} value
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?'
+    r' (?P<value>-?[0-9.eE+-]+|\+Inf|-Inf|NaN)$'
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\["\\n])*)"')
+
+_PLANE_ORDER = (
+    "ingest", "terms", "columns", "mirror_nodes", "mirror_sigs",
+    "mirror_patterns",
+)
+
+
+def parse_metrics_text(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str], ...], float]]:
+    """{metric name: {sorted (label, value) tuple: sample value}} from a
+    raw /metrics body. Comment/blank lines skipped; unparseable sample
+    lines raise (a scrape the Prometheus parser would reject must not be
+    silently half-rendered)."""
+    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable /metrics line: {line!r}")
+        labels = tuple(sorted(
+            (k, v) for k, v in _LABEL.findall(m.group("labels") or "")
+        ))
+        value = m.group("value")
+        v = float("inf") if value == "+Inf" else (
+            float("-inf") if value == "-Inf" else float(value)
+        )
+        out.setdefault(m.group("name"), {})[labels] = v
+    return out
+
+
+def _metric(parsed, name, **labels) -> Optional[float]:
+    series = parsed.get(name)
+    if not series:
+        return None
+    key = tuple(sorted(labels.items()))
+    return series.get(key)
+
+
+def _fmt(v, integer=True) -> str:
+    if v is None:
+        return "-"
+    if integer:
+        return str(int(v))
+    return f"{v:.2f}"
+
+
+def _table(rows: List[Tuple[str, ...]], header: Tuple[str, ...]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    lines = ["  ".join(str(c).ljust(w) for c, w in zip(header, widths))]
+    for r in rows:
+        lines.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# render: census (the /debug/ktpu document)
+# ---------------------------------------------------------------------------
+
+def render_census(doc: Dict) -> str:
+    p = doc.get("planes", {})
+    q = p.get("queue") or {}
+    out = [
+        f"ktpu top — census v{doc.get('version')} — "
+        f"ready={doc.get('ready')}",
+        (
+            f"queue    active={_fmt(q.get('active'))} "
+            f"backoff={_fmt(q.get('backoff'))} "
+            f"unschedulable={_fmt(q.get('unschedulable'))} "
+            f"oldest={_fmt(q.get('oldest_pending_age_s'), integer=False)}s "
+            f"nominated={_fmt(q.get('nominated'))}"
+        ),
+    ]
+    rows: List[Tuple[str, ...]] = []
+    for key, label in (("ingest", "ingest"), ("terms", "terms")):
+        d = p.get(key) or {}
+        if d.get("enabled") is False:
+            rows.append((label, "off", "-", "-", "-"))
+            continue
+        rows.append((
+            label,
+            f"{_fmt(d.get('rows'))}/{_fmt(d.get('capacity'))}",
+            _fmt(d.get("free_rows")), _fmt(d.get("dirty_rows")),
+            _fmt(d.get("refs_total")),
+        ))
+    cols = (p.get("cache") or {}).get("columns")
+    if cols:
+        rows.append((
+            "columns",
+            f"{_fmt(cols.get('rows'))}/{_fmt(cols.get('capacity'))}",
+            _fmt(cols.get("free_rows")), _fmt(cols.get("stale_rows")),
+            f"j={_fmt(cols.get('journal_depth'))}",
+        ))
+    mir = p.get("mirror") or {}
+    if mir:
+        stale = (
+            (mir.get("pending_node_rows") or 0)
+            + (mir.get("pending_usage_rows") or 0)
+        )
+        rows.append((
+            "mirror_nodes",
+            f"{_fmt(mir.get('node_rows'))}/{_fmt(mir.get('node_capacity'))}",
+            "-", _fmt(stale),
+            f"folds={_fmt(mir.get('fold_count'))}",
+        ))
+        rows.append((
+            "mirror_sigs",
+            f"{_fmt(mir.get('sig_rows'))}/{_fmt(mir.get('sig_capacity'))}",
+            "-", _fmt(mir.get("dirty_sig_rows")), "-",
+        ))
+        rows.append((
+            "mirror_patterns",
+            f"{_fmt(mir.get('pattern_rows'))}/"
+            f"{_fmt(mir.get('pattern_capacity'))}",
+            "-", _fmt(mir.get("dirty_pattern_rows")), "-",
+        ))
+    out.append(_table(rows, ("PLANE", "ROWS/CAP", "FREE", "STALE", "REFS")))
+    comp = p.get("compile") or {}
+    kinds = comp.get("kinds") or {}
+    kind_bits = " ".join(
+        f"{k}={v.get('rungs')}" for k, v in sorted(kinds.items())
+    )
+    out.append(
+        f"ladder   specs={_fmt(comp.get('declared_specs'))} "
+        f"misses_after_warmup={_fmt(comp.get('misses_after_warmup'))} "
+        f"[{kind_bits}]"
+    )
+    commit = p.get("commit") or {}
+    cstats = commit.get("stats") or {}
+    out.append(
+        f"commit   in_flight={int(bool(commit.get('in_flight')))} "
+        f"submitted={_fmt(cstats.get('submitted'))}"
+    )
+    rec = p.get("recorder") or {}
+    out.append(
+        f"recorder enabled={int(bool(rec.get('enabled')))} "
+        f"pending_device={_fmt(rec.get('pending_device'))} "
+        f"blackbox={_fmt(rec.get('blackbox_records'))}"
+    )
+    mon = doc.get("monitor")
+    if mon:
+        audits = mon.get("shadow_audits") or {}
+        div = mon.get("last_divergence") or []
+        out.append(
+            f"audits   clean={_fmt(audits.get('clean'))} "
+            f"divergent={_fmt(audits.get('divergent'))}"
+            + (f" LAST DIVERGENCE: {div}" if div else "")
+        )
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# render: raw registry scrape (the ktpu_* gauge subset)
+# ---------------------------------------------------------------------------
+
+def render_metrics(parsed: Dict) -> str:
+    out = ["ktpu top — /metrics scrape"]
+    out.append(
+        "queue    "
+        f"active={_fmt(_metric(parsed, 'scheduler_pending_pods', queue='active'))} "
+        f"backoff={_fmt(_metric(parsed, 'scheduler_pending_pods', queue='backoff'))} "
+        f"unschedulable={_fmt(_metric(parsed, 'scheduler_pending_pods', queue='unschedulable'))} "
+        f"oldest={_fmt(_metric(parsed, 'scheduler_queue_oldest_pending_age_seconds'), integer=False)}s"
+    )
+    rows = []
+    for plane in _PLANE_ORDER:
+        occ = _metric(parsed, "ktpu_plane_slab_occupancy", plane=plane)
+        if occ is None:
+            continue
+        cap = _metric(parsed, "ktpu_plane_slab_capacity", plane=plane)
+        rows.append((
+            plane,
+            f"{_fmt(occ)}/{_fmt(cap)}",
+            _fmt(_metric(parsed, "ktpu_plane_free_rows", plane=plane)),
+            _fmt(_metric(parsed, "ktpu_plane_stale_rows", plane=plane)),
+            _fmt(_metric(parsed, "ktpu_plane_refs_total", plane=plane)),
+        ))
+    out.append(_table(rows, ("PLANE", "ROWS/CAP", "FREE", "STALE", "REFS")))
+    ladder = parsed.get("ktpu_compile_ladder_rungs") or {}
+    kind_bits = " ".join(
+        f"{dict(labels).get('kind')}={int(v)}"
+        for labels, v in sorted(ladder.items())
+    )
+    out.append(
+        f"ladder   misses_after_warmup="
+        f"{_fmt(_metric(parsed, 'scheduler_compile_spec_misses_after_warmup'))} "
+        f"[{kind_bits}]"
+    )
+    out.append(
+        f"commit   in_flight={_fmt(_metric(parsed, 'ktpu_commit_inflight'))}"
+    )
+    out.append(
+        "audits   "
+        f"clean={_fmt(_metric(parsed, 'ktpu_shadow_audit_total', result='clean'))} "
+        f"divergent={_fmt(_metric(parsed, 'ktpu_shadow_audit_total', result='divergent'))} "
+        f"journal={_fmt(_metric(parsed, 'ktpu_cache_journal_depth'))}"
+    )
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# fetch + main loop
+# ---------------------------------------------------------------------------
+
+def snapshot_from_debug(base_url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(f"{base_url}/debug/ktpu", timeout=timeout) as r:
+        return render_census(json.loads(r.read().decode()))
+
+
+def snapshot_from_metrics(base_url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(f"{base_url}/metrics", timeout=timeout) as r:
+        return render_metrics(parse_metrics_text(r.read().decode()))
+
+
+def main(argv: List[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True,
+                    help="MetricsServer base url, e.g. http://127.0.0.1:9090")
+    ap.add_argument("--source", choices=("auto", "debug", "metrics"),
+                    default="auto")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args(argv)
+
+    def shot() -> str:
+        if args.source in ("auto", "debug"):
+            try:
+                return snapshot_from_debug(args.url)
+            except Exception:
+                if args.source == "debug":
+                    raise
+        return snapshot_from_metrics(args.url)
+
+    if args.once:
+        print(shot())
+        return 0
+    try:
+        while True:
+            body = shot()
+            sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Perf-budget regression gate: machine-check "did this PR regress a
+stage budget" against the committed budget file
+(kubernetes_tpu/analysis/perf_budget.json) — the scheduler_perf
+threshold discipline of the reference (PAPER.md §9), wired into
+preflight.sh next to the ktpu-lint invariant gate.
+
+How it measures
+---------------
+Stage budgets are p99 ceilings over the
+``scheduler_scheduling_stage_duration_seconds`` histogram, computed as a
+DELTA: ``snapshot_stages()`` captures per-stage bucket counts after
+warmup, the measured drain runs, and ``stage_p99_delta()`` diffs — so
+warmup's inline compiles and (in a shared pytest process) other tests'
+observations never pollute the gated number. Quantized to bucket
+resolution: the gate catches order-of-magnitude regressions (a stage
+newly paying an inline XLA compile, a hidden device sync), not 10%
+noise. Counter invariants (misses_after_warmup, sharded fallbacks,
+legacy-path ratios) come from the measured scheduler's own stats.
+
+Ratchet discipline (the ktpu-lint baseline contract, INVARIANTS.md)
+-------------------------------------------------------------------
+The budget is GROW-ONLY and fails CLOSED:
+  * deleting a required stage/counter entry is a violation;
+  * an entry without a justification (``why``) is a violation;
+  * a stage observed in the measured drain with NO budget entry is a
+    violation (new stages must gain budgets, with a why);
+  * and of course any p99 over budget / counter over max is one.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/perf_gate.py --check   # run the
+        health-mode smoke drain and gate it against the budget
+    python scripts/perf_gate.py --show                      # print the
+        committed budget
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+BUDGET_VERSION = 1
+BUDGET_PATH = os.path.join(
+    _REPO, "kubernetes_tpu", "analysis", "perf_budget.json"
+)
+
+#: entries the committed budget MUST carry — deleting one is the
+#: ratchet violation the gate fails closed on
+REQUIRED_STAGES = (
+    "sync", "encode", "gather", "dispatch", "fetch", "commit", "apply",
+    "bind", "fold",
+)
+REQUIRED_COUNTERS = (
+    "misses_after_warmup", "sharded_fallbacks", "ingest_legacy_ratio",
+    "term_legacy_ratio",
+)
+
+
+def load_budget(path: Optional[str] = None) -> Dict:
+    with open(path or BUDGET_PATH) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# observation collection (delta-based, warmup-excluded)
+# ---------------------------------------------------------------------------
+
+def snapshot_stages(hist=None) -> Dict[Tuple[str, ...], List[int]]:
+    """Per-stage bucket-count snapshot of the stage-duration histogram —
+    take one AFTER warmup / BEFORE the measured drain, pass it to
+    stage_p99_delta afterwards."""
+    from kubernetes_tpu.metrics import metrics as M
+
+    h = hist if hist is not None else M.scheduling_stage_duration
+    return {labels: h.bucket_counts(*labels)[0] for labels in h.labels()}
+
+
+def stage_p99_delta(
+    before: Dict[Tuple[str, ...], List[int]], hist=None
+) -> Dict[str, float]:
+    """{stage: p99 seconds} from the bucket-count DELTA since `before`
+    (bucket-upper-bound resolution; +inf when the tail bucket grew).
+    Stages with zero new observations are omitted."""
+    from kubernetes_tpu.metrics import metrics as M
+
+    h = hist if hist is not None else M.scheduling_stage_duration
+    out: Dict[str, float] = {}
+    for labels in h.labels():
+        counts, _, _ = h.bucket_counts(*labels)
+        prev = before.get(labels, [0] * len(counts))
+        delta = [c - p for c, p in zip(counts, prev)]
+        total = sum(delta)
+        if total <= 0:
+            continue
+        target = 0.99 * total
+        acc = 0
+        p99 = float("inf")
+        for i, b in enumerate(h.buckets):
+            acc += delta[i]
+            if acc >= target:
+                p99 = b
+                break
+        out[labels[0]] = p99
+    return out
+
+
+def collect(
+    stage_before: Dict[Tuple[str, ...], List[int]],
+    counters: Dict[str, float],
+    hist=None,
+) -> Dict:
+    """Assemble the observation dict check() consumes."""
+    return {
+        "stage_p99_s": stage_p99_delta(stage_before, hist=hist),
+        "counters": dict(counters),
+    }
+
+
+def counters_from_sched(sched) -> Dict[str, float]:
+    """The budget's counter invariants from a measured scheduler's own
+    plan/stats (NOT the process-global registry: other tests in a shared
+    pytest process legitimately exercise legacy fallbacks and would
+    false-fire a global read)."""
+    s = sched.stats
+    idx = s.get("ingest_index_batches", 0)
+    leg = s.get("ingest_legacy_batches", 0)
+    tidx = s.get("term_index_batches", 0)
+    tleg = s.get("term_legacy_batches", 0)
+    return {
+        "misses_after_warmup": int(
+            sched.compile_plan.stats.get("misses_after_warmup", 0)
+        ),
+        "sharded_fallbacks": int(s.get("sharded_fallbacks", 0)),
+        "ingest_legacy_ratio": leg / max(idx + leg, 1),
+        "term_legacy_ratio": tleg / max(tidx + tleg, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the gate (pure: tests inject synthetic budgets/observations)
+# ---------------------------------------------------------------------------
+
+def check(budget: Dict, obs: Dict) -> List[str]:
+    """Problems list (empty = the gate passes). Fails closed on ratchet
+    violations (deleted entries, missing justifications, unbudgeted
+    observed stages) as well as on actual regressions."""
+    problems: List[str] = []
+    if budget.get("version") != BUDGET_VERSION:
+        problems.append(
+            f"budget version {budget.get('version')!r} != {BUDGET_VERSION}"
+        )
+    stages = budget.get("stage_p99_s") or {}
+    counters = budget.get("counters") or {}
+    for s in REQUIRED_STAGES:
+        if s not in stages:
+            problems.append(
+                f"ratchet violation: required stage budget '{s}' missing "
+                "from perf_budget.json (budgets are grow-only — entries "
+                "may be loosened with justification, never deleted)"
+            )
+    for c in REQUIRED_COUNTERS:
+        if c not in counters:
+            problems.append(
+                f"ratchet violation: required counter budget '{c}' missing "
+                "from perf_budget.json"
+            )
+    for name, entry in list(stages.items()) + list(counters.items()):
+        if not isinstance(entry, dict) or not str(entry.get("why", "")).strip():
+            problems.append(
+                f"budget entry '{name}' carries no justification ('why') — "
+                "the ratchet requires every budget to explain itself"
+            )
+    for stage, p99 in (obs.get("stage_p99_s") or {}).items():
+        entry = stages.get(stage)
+        if not isinstance(entry, dict):
+            problems.append(
+                f"stage '{stage}' was observed in the measured drain but "
+                "has NO budget entry — add one (with a why) to "
+                "perf_budget.json"
+            )
+            continue
+        try:
+            limit = float(entry["budget"])
+        except (KeyError, TypeError, ValueError):
+            problems.append(f"stage budget '{stage}' has no numeric 'budget'")
+            continue
+        if p99 > limit:
+            problems.append(
+                f"stage '{stage}' p99 {p99:g}s exceeds budget {limit:g}s "
+                "(delta-measured over the drain, warmup excluded)"
+            )
+    for name, value in (obs.get("counters") or {}).items():
+        entry = counters.get(name)
+        if not isinstance(entry, dict):
+            continue  # unbudgeted counters are informational
+        try:
+            limit = float(entry["max"])
+        except (KeyError, TypeError, ValueError):
+            problems.append(f"counter budget '{name}' has no numeric 'max'")
+            continue
+        if float(value) > limit:
+            problems.append(
+                f"counter '{name}' = {value} exceeds budget max {limit:g}"
+            )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: List[str]) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="run the health-mode smoke drain and gate it")
+    ap.add_argument("--show", action="store_true",
+                    help="print the committed budget and exit")
+    ap.add_argument("--budget", default=None, help="budget file override")
+    args = ap.parse_args(argv)
+
+    budget = load_budget(args.budget)
+    if args.show:
+        json.dump(budget, sys.stdout, indent=2)
+        print()
+        return 0
+    if not args.check:
+        ap.print_help()
+        return 2
+
+    # structural half first: a broken budget must fail even if the run
+    # would — the ratchet is not contingent on a healthy drain
+    structural = check(budget, {"stage_p99_s": {}, "counters": {}})
+    if structural:
+        print("perf_gate: FAIL (budget file)", file=sys.stderr)
+        for p in structural:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    if scripts_dir not in sys.path:
+        sys.path.insert(0, scripts_dir)
+    import perf_smoke
+
+    # gate_budget=False: the smoke still raises on HEALTH regressions
+    # (audits, gauges, overhead), but budget evaluation happens HERE so
+    # a regression produces the structured report below — and so a
+    # --budget override is actually the budget being judged
+    detail = perf_smoke.main_health(gate_budget=False)
+    obs = detail["budget_obs"]
+    problems = check(load_budget(args.budget), obs)
+    print(json.dumps({"obs": obs, "problems": problems}, indent=2))
+    if problems:
+        print("perf_gate: FAIL", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print("perf_gate: PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

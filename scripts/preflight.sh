@@ -21,6 +21,16 @@ echo "== preflight: full test suite (8-device CPU mesh) =="
 python -m pytest tests/ -q
 
 if [[ "${1:-}" != "--fast" ]]; then
+  echo "== preflight: perf-budget regression gate (perf_gate --check) =="
+  # same grow-only, justification-comment ratchet discipline as the
+  # ktpu-lint baseline: deleted budget entries fail closed, measured
+  # stage p99s must stay under the committed budgets (health-mode drain).
+  # Deliberately a SECOND, standalone drain beyond the pytest health
+  # test above: the gate must hold in a fresh process with nothing but
+  # the committed budget, and the suite run has already warmed the XLA
+  # disk cache so this leg is minutes, not the cold-compile cost.
+  JAX_PLATFORMS=cpu python scripts/perf_gate.py --check
+
   echo "== preflight: __graft_entry__ compile check =="
   JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g

@@ -1,0 +1,44 @@
+"""MUST-FLAG KTPU004 + KTPU003: a health-monitor census that forces a
+device value / writes its shared state unlocked.
+
+The steady-state health monitor's hazard shape (obs/introspect): the
+monitor thread refreshes gauges next to a live drain, so its census
+functions are `# ktpu: hot-path`-marked — reading a device bank's VALUE
+(np.asarray / float / .item) from the monitor silently serializes the
+pipelined drain on every refresh interval, and its shared state (read by
+the /debug/ktpu mux threads and written by monitor + driver hooks) is
+guarded-by one audited lock. The sanctioned pattern is the metadata-only
+census: shapes, lens, counters, the bytes ledger — never array contents.
+"""
+
+import threading
+
+import numpy as np
+
+
+class Monitor:
+    def __init__(self, mirror):
+        self._lock = threading.Lock()
+        self.mirror = mirror
+        self.last_census = {}  # ktpu: guarded-by(self._lock)
+
+    # ktpu: hot-path
+    def bad_census(self):
+        bank_dev = self.mirror.dev_nodes
+        census = {
+            # <- forces a device->host sync on every monitor refresh
+            "requested_total": float(np.asarray(bank_dev["requested"]).sum()),
+        }
+        self.last_census = census  # <- unlocked write to guarded state
+        return census
+
+    # ktpu: hot-path
+    def good_census(self):
+        bank_dev = self.mirror.dev_nodes
+        census = {
+            "rows": bank_dev["requested"].shape[0],  # metadata probe: free
+            "bytes": dict(self.mirror.bytes_shipped),  # host counters
+        }
+        with self._lock:
+            self.last_census = census
+        return census

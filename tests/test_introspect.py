@@ -1,0 +1,454 @@
+"""Steady-state health plane (kubernetes_tpu/obs/introspect): the unified
+plane census, the /debug/ktpu route, always-on queue gauges, sampled
+shadow audits (incl. the forced-skew divergent path), the perf-budget
+gate's fail-closed semantics, ktpu_top rendering from both sources, and
+black-box dump-dir hygiene.
+
+The monitor-ON drain with overhead/audit/coverage acceptance lives in
+test_perf_smoke.test_perf_smoke_health_monitor (the audited full drain);
+this module pins the mechanics with a small shared warmed scheduler.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+pytest.importorskip("jax")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPTS = os.path.join(_REPO, "scripts")
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+from kubernetes_tpu.metrics import MetricsServer, metrics as M  # noqa: E402
+from kubernetes_tpu.obs import introspect  # noqa: E402
+from kubernetes_tpu.obs.recorder import FlightRecorder  # noqa: E402
+from kubernetes_tpu.state.queue import PriorityQueue  # noqa: E402
+
+
+def _mk_pods(n, base=0, anti_every=6):
+    import bench
+    from kubernetes_tpu.api.types import (
+        Affinity,
+        LabelSelector,
+        PodAffinityTerm,
+        PodAntiAffinity,
+    )
+
+    pods = []
+    for i in range(n):
+        if anti_every and i % anti_every == 0:
+            p = bench.mk_pod(base + i, cpu="100m", mem="64Mi",
+                             labels={"exclusive": f"ix{base + i}"})
+            p.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels={"exclusive": p.labels["exclusive"]}
+                    ),
+                    topology_key="kubernetes.io/hostname",
+                )
+            ]))
+        else:
+            p = bench.mk_pod(base + i, cpu="100m", mem="64Mi")
+        pods.append(p)
+    return pods
+
+
+@pytest.fixture(scope="module")
+def warmed():
+    """One warmed, drained scheduler with a (thread-stopped) health
+    monitor attached — shared by the census/route/audit tests."""
+    import bench
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.state.cache import SchedulerCache
+
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.add_node(bench.mk_node(i, zone=bench.ZONES[i % 4]))
+    queue = PriorityQueue()
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=Binder(), batch_size=16,
+        enable_preemption=False, spec_depth=2,
+    )
+    sched.mirror.reserve(4, 160)
+    for p in _mk_pods(48):
+        queue.add(p)
+    sched.warmup()
+    # start=False: tests drive refresh()/audits deterministically inline;
+    # the monitor THREAD is exercised by the perf_smoke health mode
+    mon = sched.enable_health_monitor(
+        interval=0.05, audit_every=2, start=False
+    )
+    res = sched.run_until_empty()
+    sched.wait_for_binds()
+    assert res.scheduled == 48
+    yield sched, mon
+    sched.close()
+
+
+# ---------------------------------------------------------------------------
+# the unified census + schema
+# ---------------------------------------------------------------------------
+
+def test_census_covers_all_planes_and_validates(warmed):
+    sched, mon = warmed
+    doc = introspect.census(sched)
+    assert introspect.validate_census(doc) == []
+    planes = doc["planes"]
+    assert set(introspect.REQUIRED_PLANES) <= set(planes)
+    # a warmed drained scheduler has real occupancy everywhere
+    assert planes["ingest"]["capacity"] > 0
+    assert planes["terms"]["capacity"] > 0
+    assert planes["cache"]["nodes"] == 4
+    assert planes["cache"]["columns"]["rows"] == 4
+    assert planes["mirror"]["device_resident"] is True
+    assert planes["mirror"]["node_rows"] == 4
+    assert planes["compile"]["warmed"] is True
+    assert planes["compile"]["kinds"], "per-kind ladder census is empty"
+    assert planes["queue"]["active"] == 0
+    assert doc["monitor"]["shadow_audits"] is not None
+    json.dumps(doc, default=str)  # the route's serialization contract
+
+
+def test_validate_census_catches_structural_breaks(warmed):
+    sched, _ = warmed
+    doc = introspect.census(sched)
+    bad = json.loads(json.dumps(doc, default=str))
+    bad["version"] = 99
+    assert any("version" in p for p in introspect.validate_census(bad))
+    bad = json.loads(json.dumps(doc, default=str))
+    del bad["planes"]["mirror"]
+    assert any("mirror" in p for p in introspect.validate_census(bad))
+    bad = json.loads(json.dumps(doc, default=str))
+    del bad["planes"]["queue"]["oldest_pending_age_s"]
+    assert any(
+        "oldest_pending_age_s" in p for p in introspect.validate_census(bad)
+    )
+
+
+def test_export_gauges_projects_census(warmed):
+    sched, mon = warmed
+    doc = mon.refresh()  # inline refresh: census -> gauges
+    assert introspect.validate_census(doc) == []
+    assert M.plane_slab_occupancy.value("ingest") > 0
+    assert M.plane_slab_capacity.value("ingest") >= 256
+    assert M.plane_slab_occupancy.value("mirror_nodes") == 4
+    assert M.plane_slab_capacity.value("columns") >= 4
+    assert "ktpu_compile_ladder_rungs{" in M.registry.expose_text()
+    assert M.health_refresh.value() >= 1
+
+
+# ---------------------------------------------------------------------------
+# sampled shadow audits: clean + forced-skew divergent
+# ---------------------------------------------------------------------------
+
+def test_shadow_audit_clean_then_forced_skew_divergent(warmed):
+    sched, mon = warmed
+    m = sched.mirror
+    sched._commit_pipe.drain()
+    m.sync()
+    m.device_arrays()
+    assert mon.run_shadow_audit() == []  # healthy drain: clean
+    clean_before = M.shadow_audit.value("clean")
+    assert clean_before >= 1
+    # forced skew: perturb HOST truth so device + columns both disagree
+    m.nodes.requested[0, 0] += 1
+    try:
+        div = mon.run_shadow_audit()
+        assert div, "forced skew not detected"
+        assert M.shadow_audit.value("divergent") >= 1
+        block = mon.census_block()
+        assert block["shadow_audits"]["divergent"] >= 1
+        assert block["last_divergence"]  # detail lands in /debug/ktpu
+        doc = introspect.census(sched)
+        assert doc["monitor"]["last_divergence"]
+    finally:
+        m.nodes.requested[0, 0] -= 1
+    assert mon.run_shadow_audit() == []  # restored: clean again
+
+
+def test_audit_due_bookkeeping_schedules_at_driver_hook(warmed):
+    sched, mon = warmed
+    counts0 = mon.audit_counts()
+    mon.refresh()  # audit_every=2: first refresh arms nothing...
+    mon.refresh()  # ...second marks due
+    mon.driver_sync_hook()  # the driver's safe point executes it
+    counts1 = mon.audit_counts()
+    assert sum(counts1.values()) == sum(counts0.values()) + 1
+
+
+# ---------------------------------------------------------------------------
+# /debug/ktpu route
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=5):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_debug_route_503_before_warmup_consistent_with_readyz():
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+
+    cold = Scheduler(binder=Binder(), enable_preemption=False)
+    srv = MetricsServer(
+        port=0, ready_fn=lambda: cold.ready,
+        debug_fn=lambda: introspect.census(cold),
+    ).start()
+    try:
+        ready_code, _ = _get(f"{srv.url}/readyz")
+        debug_code, _ = _get(f"{srv.url}/debug/ktpu")
+        assert ready_code == 503
+        assert debug_code == 503  # same gate, by construction
+    finally:
+        srv.stop()
+        cold.close()
+
+
+def test_debug_route_serves_schema_valid_census(warmed):
+    import ktpu_top
+
+    sched, mon = warmed
+    srv = MetricsServer(
+        port=0, ready_fn=lambda: sched.ready,
+        debug_fn=lambda: introspect.census(sched),
+    ).start()
+    try:
+        code, body = _get(f"{srv.url}/readyz")
+        assert code == 200
+        code, body = _get(f"{srv.url}/debug/ktpu")
+        assert code == 200
+        doc = json.loads(body)
+        assert introspect.validate_census(doc) == []
+        # ktpu_top renders a live table from BOTH sources over HTTP
+        top = ktpu_top.snapshot_from_debug(srv.url)
+        assert "ingest" in top and "mirror_nodes" in top
+        mon.refresh()  # ensure the gauges reflect this scheduler
+        top = ktpu_top.snapshot_from_metrics(srv.url)
+        assert "ingest" in top and "mirror_nodes" in top
+    finally:
+        srv.stop()
+
+
+def test_debug_route_answers_during_drain_without_blocking(warmed):
+    """The census must answer with bounded latency while the driver is
+    mid-drain — its snapshots hold each plane lock only for a counter
+    walk, never for device work."""
+    sched, _ = warmed
+    for p in _mk_pods(64, base=50_000, anti_every=0):
+        sched.queue.add(p)
+    srv = MetricsServer(
+        port=0, ready_fn=lambda: sched.ready,
+        debug_fn=lambda: introspect.census(sched),
+    ).start()
+    codes, lats, errors = [], [], []
+    stop = threading.Event()
+
+    def scrape():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(
+                    f"{srv.url}/debug/ktpu", timeout=10
+                ) as r:
+                    codes.append(r.status)
+                    json.loads(r.read().decode())
+            except Exception as e:  # noqa: BLE001 - recorded for the assert
+                errors.append(repr(e))
+            lats.append(time.perf_counter() - t0)
+            time.sleep(0.01)
+
+    t = threading.Thread(target=scrape, name="debug-scraper")
+    t.start()
+    try:
+        res = sched.run_until_empty()
+        sched.wait_for_binds()
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        srv.stop()
+    assert res.scheduled == 64
+    assert not errors, errors[:3]
+    assert codes and all(c == 200 for c in codes)
+    assert max(lats) < 2.0, f"census latency p100 {max(lats):.3f}s"
+
+
+# ---------------------------------------------------------------------------
+# kube-shaped queue gauges (oldest-pending age on the queue's own clock)
+# ---------------------------------------------------------------------------
+
+def test_queue_oldest_age_pinned_across_add_pop_requeue():
+    import bench
+
+    t = {"now": 100.0}
+    q = PriorityQueue(now=lambda: t["now"])
+    p1 = bench.mk_pod(1, cpu="100m", mem="64Mi")
+    p2 = bench.mk_pod(2, cpu="100m", mem="64Mi")
+    assert q.oldest_pending_age() == 0.0  # empty queue
+    q.add(p1)  # timestamp 100
+    t["now"] = 103.0
+    q.add(p2)  # timestamp 103
+    t["now"] = 104.0
+    assert q.oldest_pending_age() == pytest.approx(4.0)
+    cen = q.census()
+    assert cen["active"] == 2
+    assert cen["oldest_pending_age_s"] == pytest.approx(4.0)
+    # the gauges project from the census (observed OUTSIDE the lock)
+    introspect.export_gauges({"planes": {"queue": cen}})
+    assert M.pending_pods.value("active") == 2
+    assert M.queue_oldest_pending_age.value() == pytest.approx(4.0)
+    # pop the oldest: age re-anchors on the remaining entry
+    batch = q.pop_batch(1)
+    assert batch[0].pod.key() == p1.key()
+    assert q.oldest_pending_age() == pytest.approx(1.0)  # p2, queued at 103
+    # requeue (defer verdict): the original enqueue timestamp survives,
+    # so the entry's age resumes, not restarts
+    q.requeue(batch)
+    t["now"] = 107.0
+    assert q.oldest_pending_age() == pytest.approx(7.0)
+    q.delete(p1)
+    q.delete(p2)
+    assert q.oldest_pending_age() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# perf-budget gate: fails closed
+# ---------------------------------------------------------------------------
+
+def test_perf_gate_committed_budget_is_structurally_sound():
+    import perf_gate
+
+    budget = perf_gate.load_budget()
+    assert perf_gate.check(budget, {"stage_p99_s": {}, "counters": {}}) == []
+
+
+def test_perf_gate_fails_closed_on_injected_regression():
+    import perf_gate
+
+    budget = perf_gate.load_budget()
+    obs = {"stage_p99_s": {"dispatch": float("inf")}, "counters": {}}
+    assert any("dispatch" in p for p in perf_gate.check(budget, obs))
+    obs = {"stage_p99_s": {}, "counters": {"misses_after_warmup": 3}}
+    assert any("misses_after_warmup" in p for p in perf_gate.check(budget, obs))
+    obs = {"stage_p99_s": {}, "counters": {"ingest_legacy_ratio": 0.5}}
+    assert any("ingest_legacy_ratio" in p for p in perf_gate.check(budget, obs))
+
+
+def test_perf_gate_fails_closed_on_ratchet_violations():
+    import copy
+
+    import perf_gate
+
+    budget = perf_gate.load_budget()
+    empty = {"stage_p99_s": {}, "counters": {}}
+    # deleted stage entry
+    b = copy.deepcopy(budget)
+    del b["stage_p99_s"]["commit"]
+    assert any(
+        "ratchet" in p and "commit" in p for p in perf_gate.check(b, empty)
+    )
+    # deleted counter entry
+    b = copy.deepcopy(budget)
+    del b["counters"]["sharded_fallbacks"]
+    assert any(
+        "ratchet" in p and "sharded_fallbacks" in p
+        for p in perf_gate.check(b, empty)
+    )
+    # stripped justification
+    b = copy.deepcopy(budget)
+    b["stage_p99_s"]["sync"]["why"] = ""
+    assert any("justification" in p for p in perf_gate.check(b, empty))
+    # a new stage observed with no budget entry must fail, not pass
+    obs = {"stage_p99_s": {"brand_new_stage": 0.01}, "counters": {}}
+    assert any("brand_new_stage" in p for p in perf_gate.check(budget, obs))
+
+
+def test_perf_gate_delta_p99_excludes_presnapshot_samples():
+    """The delta discipline: warmup's inline-compile walls (observed
+    BEFORE the snapshot) must not pollute the gated p99, and a
+    post-snapshot outlier must dominate it."""
+    import perf_gate
+    from kubernetes_tpu.metrics.registry import Histogram
+
+    h = Histogram("t_introspect_stage", "t", label_names=("stage",),
+                  buckets=(0.1, 1.0, 10.0))
+    h.observe(50.0, "dispatch")  # "warmup compile": pre-snapshot
+    before = perf_gate.snapshot_stages(h)
+    for _ in range(100):
+        h.observe(0.05, "dispatch")
+    p99 = perf_gate.stage_p99_delta(before, h)
+    assert p99["dispatch"] == pytest.approx(0.1)  # outlier excluded
+    for _ in range(10):
+        h.observe(50.0, "dispatch")  # injected mid-drain stall
+    p99 = perf_gate.stage_p99_delta(before, h)
+    assert p99["dispatch"] == float("inf")  # caught at bucket resolution
+
+
+# ---------------------------------------------------------------------------
+# ktpu_top: pure renderers
+# ---------------------------------------------------------------------------
+
+def test_ktpu_top_parses_and_renders_registry_scrape(warmed):
+    import ktpu_top
+
+    _, mon = warmed
+    mon.refresh()
+    parsed = ktpu_top.parse_metrics_text(M.registry.expose_text())
+    assert "ktpu_plane_slab_occupancy" in parsed
+    body = ktpu_top.render_metrics(parsed)
+    for frag in ("ingest", "terms", "mirror_nodes", "queue", "audits"):
+        assert frag in body, body
+    with pytest.raises(ValueError):
+        ktpu_top.parse_metrics_text("not a metric line at all{")
+
+
+def test_ktpu_top_renders_census_table(warmed):
+    import ktpu_top
+
+    sched, _ = warmed
+    body = ktpu_top.render_census(introspect.census(sched))
+    for frag in ("ingest", "terms", "columns", "mirror_nodes", "ladder",
+                 "commit", "recorder", "audits"):
+        assert frag in body, body
+
+
+# ---------------------------------------------------------------------------
+# black-box dump hygiene (KTPU_BLACKBOX_DIR, never CWD)
+# ---------------------------------------------------------------------------
+
+def test_blackbox_dump_routes_to_configured_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("KTPU_BLACKBOX_DIR", str(tmp_path / "artifacts"))
+    monkeypatch.delenv("KTPU_TRACE_DIR", raising=False)
+    rec = FlightRecorder(enabled=True)
+    rec.record_cycle({"cycle": 1})
+    path = rec.dump_blackbox("introspect-test")
+    assert path is not None
+    assert os.path.dirname(path) == str(tmp_path / "artifacts")
+    assert os.path.exists(path)
+    with open(path) as f:
+        assert json.load(f)["reason"] == "introspect-test"
+
+
+def test_blackbox_dump_default_never_lands_in_cwd(tmp_path, monkeypatch):
+    monkeypatch.delenv("KTPU_BLACKBOX_DIR", raising=False)
+    monkeypatch.delenv("KTPU_TRACE_DIR", raising=False)
+    monkeypatch.chdir(tmp_path)
+    rec = FlightRecorder(enabled=True)
+    rec.record_cycle({"cycle": 1})
+    path = rec.dump_blackbox("introspect-cwd-test")
+    try:
+        assert path is not None
+        assert os.path.dirname(path) == tempfile.gettempdir()
+        assert not list(tmp_path.glob("ktpu_blackbox_*.json"))
+    finally:
+        if path and os.path.exists(path):
+            os.remove(path)

@@ -176,6 +176,34 @@ def test_perf_smoke_columnar_cache(tmp_path, monkeypatch):
     assert detail["scheduled"] == perf_smoke.N_PODS
 
 
+def test_perf_smoke_health_monitor(tmp_path, monkeypatch):
+    """Steady-state-health acceptance, tier-1-fast: with the background
+    monitor ON during a mixed drain, the always-on plane gauges are
+    non-empty and parseable, >=1 sampled shadow audit runs CLEAN (zero
+    divergent), the /debug/ktpu census validates against its versioned
+    schema, the committed perf budget (perf_gate) passes on the
+    delta-measured stage p99s, `misses_after_warmup == 0` holds monitor-
+    ON, and the monitor stays within the PR 7 trace-overhead bound.
+    Runs lock-order-audited: the monitor's "health" lock role joins the
+    acquisition graph alongside every plane lock it snapshots."""
+    monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan_hm"))
+    monkeypatch.setenv("KTPU_LOCK_AUDIT", "1")
+    from kubernetes_tpu.analysis.lockorder import REGISTRY
+
+    REGISTRY.reset()
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main_health()  # raises AssertionError on regression
+    REGISTRY.assert_acyclic()
+    assert detail["audits"]["clean"] >= 1
+    assert detail["audits"].get("divergent", 0) == 0
+    assert detail["misses_after_warmup"] == 0
+    assert detail["budget_obs"]["stage_p99_s"], "no stage p99 data collected"
+    assert detail["scheduled"] == 2 * perf_smoke.N_PODS + 64
+
+
 def test_perf_smoke_ingest_plane(tmp_path, monkeypatch):
     """Pod-ingest-plane acceptance, tier-1-fast: on a quiet drain every
     dispatch takes the index-only path (coverage > 0, zero stale-row

@@ -194,6 +194,23 @@ def test_ktpu004_flags_hot_path_sync():
     assert ("KTPU004", "cold_fetch") not in scopes  # not hot-marked
 
 
+def test_ktpu004_monitor_census_fixture_pair():
+    """The health monitor's fixture pair (obs/introspect): a census that
+    FORCES a device value from the hot-path-marked monitor refresh must
+    flag KTPU004, its unlocked write to the monitor's guarded mailbox
+    must flag KTPU003, and the sanctioned metadata-only census (shape
+    probes, host counters, locked mailbox write) must stay clean."""
+    got = scan_fixture("ktpu004_monitor_census.py")
+    bad = [v for v in got if "bad_census" in v.scope]
+    assert any(v.rule == "KTPU004" for v in bad), [v.render() for v in got]
+    assert any(
+        v.rule == "KTPU003" and "last_census" in v.detail for v in bad
+    ), [v.render() for v in got]
+    assert not [v for v in got if "good_census" in v.scope], [
+        v.render() for v in got if "good_census" in v.scope
+    ]
+
+
 def test_ktpu005_flags_shadowed_bucket_import():
     """The seed `_bucket` UnboundLocalError (broke warmup for every
     enable_preemption=False drain), plus the generalized shadow."""
