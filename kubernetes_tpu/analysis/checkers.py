@@ -673,5 +673,11 @@ def repo_config() -> AnalysisConfig:
             # two-phase device spans (export/drain time only; the hot
             # half, device_begin, never forces)
             "FlightRecorder.resolve_pending",
+            # the staged banks' shadow-audit probe (fault-plane probe
+            # gate): full-array fetch via a device-side copy, driver
+            # thread, safe-sync-point only — the StageBank counterpart of
+            # TensorMirror.device_bank_divergence (TermBankDevice
+            # inherits it)
+            "StageBank.device_divergence",
         ),
     )

@@ -20,18 +20,41 @@ does the decode/index work, scheduleOne only pops keys). Handlers
 therefore stay cheap-but-not-free; the reflector's recover-and-restart
 discipline below already tolerates a slow or raising handler without
 killing replication for the kind.
+
+Failure discipline (the reference reflector's backoff-manager shape): a
+failing LIST retries under capped exponential backoff with jitter — the
+seed's flat 0.05s-forever retry was a hot loop against a down apiserver.
+Every relist (initial sync, 410 Gone, stream close, handler error, list
+error) counts into `scheduler_informer_relists_total{kind}` with the
+last reason kept on the informer (`last_relist_reason`). Handler
+dispatch is at-least-once: the store commits AFTER the handlers ran, so
+a raising handler drops the stream and the relist re-delivers the event
+instead of silently losing it.
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from ..analysis.lockorder import audited_lock
 from ..apiserver.store import ADDED, DELETED, MODIFIED, FakeAPIServer, GoneError, _key_of
+from ..metrics import metrics as M
 
 logger = logging.getLogger("kubernetes_tpu.informer")
+
+#: failed-list retry backoff (reflector.go backoff manager shape): capped
+#: exponential with jitter, reset on the first successful list
+RELIST_BACKOFF_INITIAL = 0.05
+RELIST_BACKOFF_MAX = 5.0
+
+
+class _RelistHandlerError(Exception):
+    """A handler raised during RELIST dispatch (store not committed —
+    the retry re-delivers). Distinct from a list error so the retry is
+    labeled honestly."""
 
 
 class Informer:
@@ -39,7 +62,8 @@ class Informer:
 
     def __init__(self, api: FakeAPIServer, kind: str,
                  label_selector: Optional[Dict[str, str]] = None,
-                 field_selector: Optional[Dict[str, str]] = None):
+                 field_selector: Optional[Dict[str, str]] = None,
+                 fault_plan=None):
         self.api = api
         self.kind = kind
         # server-side filtering (labels/fields on list+watch): a kubelet's
@@ -53,7 +77,14 @@ class Informer:
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.relist_count = 0  # observability for tests
+        # relist observability: the counter is the per-kind metric
+        # (scheduler_informer_relists_total); the reason/error strings
+        # answer "why did replication restart" without a log dive
+        self.last_relist_reason: Optional[str] = None
+        self.last_relist_error: Optional[str] = None
+        # fault plane (kubernetes_tpu/faults): watch-break / list-error
+        # injection sites; None = one attribute read per event
+        self.fault_plan = fault_plan
 
     # -- registration ---------------------------------------------------------
 
@@ -84,6 +115,12 @@ class Informer:
     def has_synced(self) -> bool:
         return self._synced.is_set()
 
+    def relists(self) -> int:
+        """Completed relists for THIS kind, from the process-global
+        counter (the metric is the source of truth; the old test-only
+        `relist_count` attribute is gone)."""
+        return int(M.informer_relists.value(self.kind))
+
     # -- the loop -------------------------------------------------------------
 
     def start(self) -> "Informer":
@@ -108,13 +145,38 @@ class Informer:
     def wait_for_sync(self, timeout: float = 10.0) -> bool:
         return self._synced.wait(timeout)
 
+    def _backoff_wait(self, backoff: float) -> float:
+        """One rung of the relist retry ladder: jittered stop-aware wait,
+        then return the doubled (capped) delay — single-sourced so every
+        failure path (list error, relist handler error, watch error)
+        retries with identical shape."""
+        self._stop.wait(backoff * random.uniform(0.8, 1.2))
+        return min(backoff * 2, RELIST_BACKOFF_MAX)
+
     def _run(self) -> None:
+        reason = "sync"  # first relist is the initial LIST
+        backoff = RELIST_BACKOFF_INITIAL
         while not self._stop.is_set():
             try:
-                rv = self._relist()
-            except Exception:
-                self._stop.wait(0.05)
+                rv = self._relist(reason)
+            except _RelistHandlerError as e:
+                # a handler raised mid-relist-dispatch: the store was NOT
+                # committed (commit-after-dispatch, like _apply), so the
+                # retry's diff re-delivers the interrupted events
+                self.last_relist_error = repr(e.__cause__ or e)
+                reason = "handler-error"
+                backoff = self._backoff_wait(backoff)
                 continue
+            except Exception as e:
+                # capped exponential backoff + jitter (the reference
+                # reflector's backoff manager) — the seed retried a
+                # failing list every flat 0.05s forever, a hot loop
+                # against a down apiserver
+                self.last_relist_error = repr(e)
+                reason = "list-error"
+                backoff = self._backoff_wait(backoff)
+                continue
+            backoff = RELIST_BACKOFF_INITIAL  # success resets the ladder
             self._synced.set()
             try:
                 watcher = self.api.watch(
@@ -123,33 +185,53 @@ class Informer:
                     field_selector=self.field_selector,
                 )
             except GoneError:
-                continue  # immediately relist
+                reason = "gone"
+                continue  # immediately relist (410: history compacted)
+            except Exception as e:
+                # a failing WATCH call retries through the same ladder
+                self.last_relist_error = repr(e)
+                reason = "watch-error"
+                backoff = self._backoff_wait(backoff)
+                continue
             try:
                 while not self._stop.is_set():
                     ev = watcher.next(timeout=0.2)
                     if ev is None:
                         if watcher.closed:
+                            reason = "stream-closed"
                             break  # stream ended → relist (reflector restart)
                         continue
+                    fp = self.fault_plan
+                    if fp is not None and fp.fire("watch-break", self.kind):
+                        # injected mid-stream break: drop the stream and
+                        # recover through the normal relist path
+                        reason = "watch-break"
+                        break
                     try:
                         self._apply(ev.type, ev.obj)
                     except Exception:
                         # a broken handler must not kill replication for the
                         # kind — log, drop the stream, relist (the reference
-                        # Reflector's recover-and-restart discipline)
+                        # Reflector's recover-and-restart discipline). The
+                        # store commits AFTER dispatch (_apply), so the
+                        # relist diff re-delivers this event: at-least-once,
+                        # never silent loss.
                         logger.exception(
                             "informer %s: handler failed on %s; relisting",
                             self.kind, ev.type,
                         )
+                        reason = "handler-error"
                         break
             finally:
                 watcher.close()
 
-    def _relist(self) -> int:
+    def _relist(self, reason: str) -> int:
         """The list half of ListAndWatch: replace the store, synthesizing
         add/update/delete diffs against the previous contents (DeltaFIFO
         Replace/Sync semantics)."""
-        self.relist_count += 1
+        fp = self.fault_plan
+        if fp is not None:  # injection site: apiserver list error
+            fp.raise_if("list-error", self.kind)
         items, rv = self.api.list(
             self.kind,
             label_selector=self.label_selector,
@@ -158,26 +240,44 @@ class Informer:
         fresh = {_key_of(o): o for o in items}
         with self._lock:
             old = self._store
+        # dispatch BEFORE committing the store (the _apply discipline):
+        # if a handler raises mid-diff, the store still holds `old`, so
+        # the retry's diff re-delivers the interrupted events instead of
+        # coming back empty and silently losing them
+        try:
+            for key, obj in fresh.items():
+                prev = old.get(key)
+                if prev is None:
+                    self._dispatch("add", obj)
+                elif prev.resource_version != obj.resource_version:
+                    self._dispatch("update", prev, obj)
+            for key, obj in old.items():
+                if key not in fresh:
+                    self._dispatch("delete", obj)
+        except Exception as e:
+            logger.exception(
+                "informer %s: handler failed during relist dispatch; "
+                "store NOT committed — retrying", self.kind,
+            )
+            raise _RelistHandlerError(str(e)) from e
+        with self._lock:
             self._store = fresh
-        for key, obj in fresh.items():
-            prev = old.get(key)
-            if prev is None:
-                self._dispatch("add", obj)
-            elif prev.resource_version != obj.resource_version:
-                self._dispatch("update", prev, obj)
-        for key, obj in old.items():
-            if key not in fresh:
-                self._dispatch("delete", obj)
+        self.last_relist_reason = reason
+        M.informer_relists.inc(self.kind)
         return rv
 
     def _apply(self, type_: str, obj: Any) -> None:
         key = _key_of(obj)
         with self._lock:
             prev = self._store.get(key)
-            if type_ == DELETED:
-                self._store.pop(key, None)
-            else:
-                self._store[key] = obj
+        # dispatch BEFORE committing the store: if a handler raises, the
+        # stream drops and the relist diffs the fresh list against the
+        # store — a store already containing this object would diff
+        # empty and silently LOSE the event for every handler. Commit-
+        # after-dispatch makes delivery at-least-once (the reference's
+        # DeltaFIFO pop-after-process), at the cost of a possible
+        # duplicate dispatch to handlers that succeeded before the raise
+        # (handlers are idempotent per the queue/cache add contracts).
         if type_ == ADDED:
             if prev is None:
                 self._dispatch("add", obj)
@@ -190,19 +290,26 @@ class Informer:
                 self._dispatch("update", prev, obj)
         elif type_ == DELETED and prev is not None:
             self._dispatch("delete", obj)
+        with self._lock:
+            if type_ == DELETED:
+                self._store.pop(key, None)
+            else:
+                self._store[key] = obj
 
 
-def start_scheduler_informers(api: FakeAPIServer, handlers) -> Dict[str, Informer]:
+def start_scheduler_informers(
+    api: FakeAPIServer, handlers, fault_plan=None
+) -> Dict[str, Informer]:
     """AddAllEventHandlers (eventhandlers.go:380): wire pod + node informers
     into the scheduler's EventHandlers. Returns the informers keyed by kind
     (caller stops them)."""
-    pods = Informer(api, "pods")
+    pods = Informer(api, "pods", fault_plan=fault_plan)
     pods.add_event_handler(
         on_add=handlers.on_pod_add,
         on_update=handlers.on_pod_update,
         on_delete=handlers.on_pod_delete,
     )
-    nodes = Informer(api, "nodes")
+    nodes = Informer(api, "nodes", fault_plan=fault_plan)
     nodes.add_event_handler(
         on_add=handlers.on_node_add,
         on_update=lambda old, new: handlers.on_node_update(old, new),

@@ -1,0 +1,47 @@
+"""Fault plane: runtime degradation ladder + deterministic chaos harness.
+
+The six device-residency planes each ship a bit-identical legacy host
+path behind a static env kill switch; this package flips those paths at
+RUNTIME and proves the machinery with seeded fault injection:
+
+* ``breaker``  — per-plane circuit breakers (closed → open → half-open,
+  counted thresholds, injectable clock) + the BreakerBoard trip →
+  recovery handshake; an open breaker routes a plane's dispatches to its
+  legacy path, a half-open one re-closes only through a shadow-audit-
+  gated probe batch.
+* ``recover``  — the driver-thread recovery actions: bank/mirror resync
+  from host truth through already-warmed programs, exactly-once uploader
+  restarts, columns detach/re-attach, divergence escalation.
+* ``inject``   — ``FaultPlan``: a seeded, counted schedule of injected
+  faults keyed by annotated injection-site names, reachable via
+  ``Scheduler(fault_plan=...)`` or ``KTPU_FAULTS=<spec>``; zero overhead
+  when absent (one attribute read per site).
+"""
+
+from .breaker import (
+    BreakerBoard,
+    CLOSED,
+    DEFAULT_COOLDOWN_S,
+    DEFAULT_THRESHOLD,
+    HALF_OPEN,
+    OPEN,
+    PLANES,
+    PlaneBreaker,
+)
+from .inject import FaultEvent, FaultPlan, InjectedFault, apply_bank_skew, plan_from_env
+
+__all__ = [
+    "BreakerBoard",
+    "CLOSED",
+    "DEFAULT_COOLDOWN_S",
+    "DEFAULT_THRESHOLD",
+    "FaultEvent",
+    "FaultPlan",
+    "HALF_OPEN",
+    "InjectedFault",
+    "OPEN",
+    "PLANES",
+    "PlaneBreaker",
+    "apply_bank_skew",
+    "plan_from_env",
+]

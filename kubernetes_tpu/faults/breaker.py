@@ -1,0 +1,338 @@
+"""Per-plane circuit breakers: the runtime half of the kill switches.
+
+Every device-residency plane (PRs 2-9) ships a bit-identical legacy host
+path behind a STATIC env kill switch (``KTPU_INGEST_PLANE=0``, ...), but
+nothing flips those paths at runtime: a dead uploader thread, an XLA
+dispatch error, or a shadow-audit divergence either killed the drain or
+silently stalled it. This module converts the six independent switches
+into one degradation ladder:
+
+* ``PlaneBreaker`` — the classic closed → open → half-open machine, with
+  counted failure thresholds and a wall-clock cool-down on an INJECTABLE
+  clock (tests never sleep). A closed breaker is ONE attribute read on
+  the hot path (``breaker.closed``, a plain bool — the FlightRecorder
+  disabled-path idiom); only a non-closed breaker ever takes the lock.
+
+* ``BreakerBoard`` — one breaker per plane boundary that can fail at
+  runtime (ingest/term slab uploads + gathers, the fold dispatch, the
+  commit arbiter + pipeline worker, the columnar-cache scatters, the
+  mirror's patch scatters), sharing ONE audited lock (role "faults",
+  always a leaf: reporters may hold a plane lock when they report, the
+  board never acquires anything while holding its own).
+
+The soundness argument is the ON==OFF parity discipline of PRs 2-9: an
+open breaker routes that plane's dispatches to its existing legacy host
+path, which is bit-identical by construction, so tripping a breaker can
+degrade throughput but never placements. A half-open breaker admits ONE
+probe batch; the driver re-closes it only after the PR 10 shadow audit
+(device_bank_divergence + columns cross-check) comes back clean at the
+next safe sync point — resync-before-close, audit-gated.
+
+Trip-side effects (gauges, the recovery queue) happen on the reporter's
+thread under the board lock; the RECOVERY ACTIONS themselves (bank
+resync, uploader restart, columns re-attach — faults/recover.py) only
+ever run on the driver thread at its post-sync safe point, because they
+touch driver-confined mirror state.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..analysis.lockorder import audited_lock
+from ..metrics import metrics as M
+
+logger = logging.getLogger("kubernetes_tpu.faults")
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+#: numeric projection for the ktpu_plane_breaker_state gauge
+STATE_VALUE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+#: the plane boundaries that can fail at runtime — each maps to a legacy
+#: host path (ingest/terms/fold/commit/columns) or a full-reupload resync
+#: (mirror); see faults/recover.py for each plane's recovery action
+PLANES = ("ingest", "terms", "fold", "commit", "columns", "mirror")
+
+#: consecutive failures before a closed breaker trips
+DEFAULT_THRESHOLD = 3
+#: seconds an open breaker waits before offering a half-open probe
+DEFAULT_COOLDOWN_S = 5.0
+#: failed probes double the cool-down up to this multiple (escalation)
+MAX_COOLDOWN_FACTOR = 8
+
+
+class PlaneBreaker:
+    """One plane's closed → open → half-open machine. All transitions run
+    under the BOARD's shared lock (passed in); the hot-path gate is the
+    plain ``closed`` bool, written only inside transitions."""
+
+    def __init__(
+        self,
+        plane: str,
+        lock,
+        threshold: int = DEFAULT_THRESHOLD,
+        cooldown_s: float = DEFAULT_COOLDOWN_S,
+        clock: Callable[[], float] = time.monotonic,
+        window_s: Optional[float] = None,
+    ):
+        self.plane = plane
+        self._lock = lock
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        # consecutive-failure window: a fault arriving more than this
+        # after the previous one restarts the count (sporadic faults
+        # spread over hours must not accumulate into a trip). Decoupled
+        # from the cool-down: plane boundaries fire at batch cadence,
+        # which can be much slower than the probe cadence.
+        self.window_s = (
+            float(window_s) if window_s is not None
+            else max(30.0, self.cooldown_s * 10)
+        )
+        self._clock = clock
+        #: hot-path gate — True iff state == CLOSED. Plain attribute so
+        #: the covered dispatch pays one read, no lock (torn reads are
+        #: benign: both paths are correct, only coverage shifts a batch).
+        self.closed = True
+        self.state = CLOSED  # ktpu: guarded-by(self._lock)
+        self.failures = 0  # consecutive, while closed; ktpu: guarded-by(self._lock)
+        self.trips = 0  # ktpu: guarded-by(self._lock)
+        self.probes_passed = 0  # ktpu: guarded-by(self._lock)
+        self.probes_failed = 0  # ktpu: guarded-by(self._lock)
+        self.probing = False  # a probe batch is in flight; ktpu: guarded-by(self._lock)
+        self.last_reason: Optional[str] = None  # ktpu: guarded-by(self._lock)
+        self._last_failure_ts = 0.0  # ktpu: guarded-by(self._lock)
+        self._open_until = 0.0  # ktpu: guarded-by(self._lock)
+        self._cooldown = float(cooldown_s)  # escalates on failed probes; ktpu: guarded-by(self._lock)
+        self.trip_log: List[Tuple[float, str]] = []  # bounded; ktpu: guarded-by(self._lock)
+
+    # -- transitions (board lock held by callers or taken here) --------------
+
+    # ktpu: holds(self._lock)
+    def _trip_locked(self, reason: str) -> None:
+        self.state = OPEN
+        self.closed = False
+        self.probing = False
+        self.trips += 1
+        self.last_reason = reason
+        self._open_until = self._clock() + self._cooldown
+        self.trip_log.append((time.time(), reason))
+        del self.trip_log[:-16]
+        M.plane_breaker_state.set(STATE_VALUE[OPEN], self.plane)
+        M.plane_trips.inc(self.plane, reason)
+        logger.warning(
+            "plane breaker TRIPPED: %s (%s) — routing to the legacy host "
+            "path for %.1fs, then probing",
+            self.plane, reason, self._cooldown,
+        )
+
+    def record_failure(self, reason: str, force: bool = False) -> bool:
+        """One fault at this plane's boundary. Returns True when this
+        report TRIPPED the breaker (closed → open, or a failed probe
+        re-opening) — the board queues the recovery action then.
+        ``force=True`` trips immediately regardless of the counted
+        threshold (shadow-audit divergence: the banks are already known
+        wrong, waiting for two more batches of wrong is not prudence)."""
+        with self._lock:
+            if self.state == OPEN:
+                self.last_reason = reason
+                return False
+            if self.state == HALF_OPEN:
+                self._probe_failed_locked(reason)
+                return True
+            # windowed counting without a hot-path success hook: a fault
+            # arriving more than window_s after the previous one restarts
+            # the consecutive count (sporadic faults spread over hours
+            # must not accumulate into a trip)
+            now = self._clock()
+            if now - self._last_failure_ts > self.window_s:
+                self.failures = 0
+            self._last_failure_ts = now
+            self.failures += 1
+            self.last_reason = reason
+            if force or self.failures >= self.threshold:
+                self.failures = 0
+                self._trip_locked(reason)
+                return True
+            return False
+
+    def allow_probe(self) -> bool:
+        """Non-closed gate: True exactly once per cool-down expiry — the
+        caller's next covered dispatch is the probe batch. While a probe
+        is in flight every other dispatch stays on the legacy path."""
+        with self._lock:
+            if self.state == OPEN and self._clock() >= self._open_until:
+                self.state = HALF_OPEN
+                self.probing = True
+                M.plane_breaker_state.set(STATE_VALUE[HALF_OPEN], self.plane)
+                logger.info(
+                    "plane breaker %s: half-open — probing one covered batch",
+                    self.plane,
+                )
+                return True
+            if self.state == HALF_OPEN and not self.probing:
+                self.probing = True
+                return True
+            return False
+
+    def probe_passed(self) -> None:
+        """The probe batch completed AND the shadow audit came back clean
+        (the driver's _fault_service is the only caller): re-close and
+        reset the cool-down escalation."""
+        with self._lock:
+            if self.state == CLOSED:
+                return
+            self.state = CLOSED
+            self.closed = True
+            self.probing = False
+            self.failures = 0
+            self.probes_passed += 1
+            self._cooldown = self.cooldown_s
+            M.plane_breaker_state.set(STATE_VALUE[CLOSED], self.plane)
+            logger.info("plane breaker %s: probe clean — CLOSED", self.plane)
+
+    # ktpu: holds(self._lock)
+    def _probe_failed_locked(self, reason: str) -> None:
+        self.probes_failed += 1
+        self._cooldown = min(
+            self._cooldown * 2, self.cooldown_s * MAX_COOLDOWN_FACTOR
+        )
+        self._trip_locked(f"probe:{reason}")
+
+    def probe_failed(self, reason: str) -> None:
+        """The probe batch faulted or its shadow audit found divergence:
+        back to open with the cool-down doubled (bounded escalation)."""
+        with self._lock:
+            if self.state == CLOSED:
+                return
+            self._probe_failed_locked(reason)
+
+    # -- readers -------------------------------------------------------------
+
+    def census(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "state": self.state,
+                "failures": self.failures,
+                "trips": self.trips,
+                "probes_passed": self.probes_passed,
+                "probes_failed": self.probes_failed,
+                "probing": self.probing,
+                "last_reason": self.last_reason,
+                "cooldown_s": self._cooldown,
+                "open_remaining_s": (
+                    max(self._open_until - self._clock(), 0.0)
+                    if self.state == OPEN else 0.0
+                ),
+            }
+
+
+class BreakerBoard:
+    """All plane breakers plus the trip → recovery handshake.
+
+    Faults are REPORTED from whatever thread hit them (driver, commit
+    worker, uploader, informer); recovery ACTIONS are queued here and
+    executed only by the driver at its post-sync safe point
+    (``Scheduler._fault_service`` → ``faults.recover.run_recoveries``).
+    ``quiet`` is the one-attribute-read hot-path gate: True while every
+    breaker is closed and nothing is pending, so a healthy drain pays a
+    single bool read per plane gate and one per batch."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        threshold: int = DEFAULT_THRESHOLD,
+        cooldown_s: float = DEFAULT_COOLDOWN_S,
+        window_s: Optional[float] = None,
+    ):
+        # role "faults": always a leaf — reporters hold plane locks when
+        # they report; nothing is ever acquired while this lock is held
+        self._lock = audited_lock("faults")
+        self.clock = clock
+        self.breakers: Dict[str, PlaneBreaker] = {
+            p: PlaneBreaker(
+                p, self._lock, threshold=threshold, cooldown_s=cooldown_s,
+                clock=clock, window_s=window_s,
+            )
+            for p in PLANES
+        }
+        #: hot-path gate: True while every breaker is closed AND no
+        #: recovery is pending — the healthy steady state. Plain bool.
+        self.quiet = True
+        self._pending_recovery: List[str] = []  # ktpu: guarded-by(self._lock)
+        for p in PLANES:
+            M.plane_breaker_state.set(STATE_VALUE[CLOSED], p)
+
+    def breaker(self, plane: str) -> PlaneBreaker:
+        return self.breakers[plane]
+
+    # ktpu: holds(self._lock)
+    def _recompute_quiet_locked(self) -> None:
+        self.quiet = not self._pending_recovery and all(
+            b.state == CLOSED for b in self.breakers.values()
+        )
+
+    def record_failure(self, plane: str, reason: str, force: bool = False) -> bool:
+        """Report one fault; on a trip, queue the plane's recovery for
+        the driver's next safe point. A FORCED report queues the
+        recovery even when the breaker is already open — forced means
+        known-wrong state (a dead uploader, a divergent audit), and its
+        repair action must run regardless of what tripped the breaker
+        first (an uploader dying during another fault's cool-down would
+        otherwise never be restarted: the clean probe would re-close the
+        breaker right over the dead thread). Callable from any thread
+        (may hold a plane lock — the board lock is a leaf)."""
+        b = self.breakers.get(plane)
+        if b is None:
+            return False
+        tripped = b.record_failure(reason, force=force)
+        with self._lock:
+            if (tripped or force) and plane not in self._pending_recovery:
+                self._pending_recovery.append(plane)
+            self._recompute_quiet_locked()
+        return tripped
+
+    def ok(self, plane: str) -> bool:
+        """Dispatch gate for a plane: covered while closed, or exactly
+        one probe batch when a cool-down expired. (The hot path short-
+        circuits on ``quiet`` before ever calling this.)"""
+        b = self.breakers[plane]
+        return b.closed or b.allow_probe()
+
+    def take_recoveries(self) -> List[str]:
+        """Drain the pending recovery queue (driver thread only)."""
+        with self._lock:
+            out, self._pending_recovery = self._pending_recovery, []
+            return out
+
+    def probing_planes(self) -> List[str]:
+        with self._lock:
+            return [p for p, b in self.breakers.items() if b.probing]
+
+    def settle(self) -> None:
+        """Re-derive ``quiet`` after probe resolutions (driver thread)."""
+        with self._lock:
+            self._recompute_quiet_locked()
+
+    def any_open(self) -> bool:
+        with self._lock:
+            return any(b.state != CLOSED for b in self.breakers.values())
+
+    def trips_total(self) -> int:
+        with self._lock:
+            return sum(b.trips for b in self.breakers.values())
+
+    # ktpu: hot-path census for /debug/ktpu + the health monitor: counters
+    # and strings only, never a device value
+    def census(self) -> Dict[str, object]:
+        doc = {p: b.census() for p, b in self.breakers.items()}
+        with self._lock:
+            return {
+                "quiet": self.quiet,
+                "pending_recovery": list(self._pending_recovery),
+                "breakers": doc,
+            }

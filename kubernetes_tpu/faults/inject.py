@@ -1,0 +1,204 @@
+"""Seeded fault injection: the deterministic chaos harness.
+
+A ``FaultPlan`` is a schedule of injected faults keyed by INJECTION-SITE
+name. Sites are fixed, annotated points in the pipeline where a real
+fault can occur; each calls ``plan.fire(site, arg)`` — a counted,
+deterministic trigger — and raises ``InjectedFault`` (or performs the
+site's side effect, e.g. closing a watch stream) when the schedule says
+so. With no plan configured the cost at every site is ONE attribute read
+(``self._fault_plan is None`` — the FlightRecorder disabled-path idiom),
+and because every site lives inside a ``# ktpu: hot-path`` function, a
+site that forced a device value to decide whether to fire would be a
+KTPU004 lint violation, not a code-review hope (the injection-site
+fixture pair pins both directions).
+
+Registered sites (driver + banks + informer + monitor sync point):
+
+  ``uploader-death``   arg=ingest|terms   the bank drain thread raises and dies
+  ``device-raise``     arg=solve|arbiter|fold|gather-stage|gather-terms|patch|apply
+                       the named device dispatch raises
+  ``watch-break``      arg=<kind>         the informer drops its watch stream
+  ``list-error``       arg=<kind>         the informer's relist raises
+  ``bind-error``       (no arg)           the bind RPC raises
+  ``bank-skew``        (no arg)           a device bank row is corrupted (+1),
+                       so the next shadow audit reports divergence
+
+Spec grammar (``KTPU_FAULTS`` / ``FaultPlan.parse``), semicolon-joined:
+
+    site[:arg][@n][xk]     fire on the n-th matching call (default 1),
+                           k consecutive times (default 1)
+
+    KTPU_FAULTS="uploader-death:ingest@2;device-raise:solve@3x2;bank-skew@4"
+
+``FaultPlan.seeded(seed, sites)`` draws each event's trigger count from
+``random.Random(seed)`` instead — same seed, same schedule, every run
+(the perf_smoke ``faults`` mode's chaos drain is built on this).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an injection site the active FaultPlan triggered. A
+    plain RuntimeError subclass on purpose: the pipeline's fault handling
+    must treat it exactly like the real failure it stands in for."""
+
+
+@dataclass
+class FaultEvent:
+    site: str
+    arg: str = ""  # "" matches any arg at the site
+    at: int = 1  # fire on the at-th matching call (1-based)
+    times: int = 1  # ... and the next times-1 calls too
+    fired: int = field(default=0, compare=False)  # runtime bookkeeping
+
+    def spec(self) -> str:
+        s = self.site + (f":{self.arg}" if self.arg else "")
+        if self.at != 1:
+            s += f"@{self.at}"
+        if self.times != 1:
+            s += f"x{self.times}"
+        return s
+
+
+class FaultPlan:
+    """A deterministic, counted schedule of injected faults. Thread-safe
+    (sites fire from informer/uploader/bind threads); the lock is a plain
+    ``threading.Lock`` — injection is a test/chaos facility, never on by
+    default, so it stays outside the audited-lock role set."""
+
+    def __init__(self, events: Sequence[FaultEvent], seed: int = 0):
+        self.events: List[FaultEvent] = list(events)
+        self.seed = seed
+        # (site, arg) per-arg call counts + (site, None) site-wide totals
+        self._counts: Dict[Tuple[str, Optional[str]], int] = {}
+        self._fired: List[str] = []
+        self._lock = threading.Lock()
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        """Parse the KTPU_FAULTS grammar (module docstring). Unknown
+        sites are accepted verbatim — the plan is a schedule, the sites
+        define the vocabulary."""
+        import re
+
+        pat = re.compile(
+            r"^(?P<site>[A-Za-z_][\w.-]*)"
+            r"(?::(?P<arg>[\w./-]*))?"
+            r"(?:@(?P<at>\d+))?"
+            r"(?:x(?P<times>\d+))?$"
+        )
+        events: List[FaultEvent] = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            m = pat.match(part)
+            if m is None:
+                raise ValueError(f"bad KTPU_FAULTS entry: {part!r}")
+            events.append(FaultEvent(
+                site=m.group("site"),
+                arg=m.group("arg") or "",
+                at=int(m.group("at") or 1),
+                times=int(m.group("times") or 1),
+            ))
+        return cls(events, seed=seed)
+
+    @classmethod
+    def seeded(
+        cls, seed: int, sites: Sequence[Tuple[str, str, int]],
+        times: int = 1,
+    ) -> "FaultPlan":
+        """Draw each site's trigger count deterministically from the
+        seed: ``sites`` is [(site, arg, max_at)] and each event fires on
+        a call index drawn uniformly from [1, max_at]. Same seed, same
+        schedule — the chaos drain's reproducibility contract."""
+        rng = random.Random(seed)
+        events = [
+            FaultEvent(site=s, arg=a, at=rng.randint(1, max(m, 1)), times=times)
+            for s, a, m in sites
+        ]
+        return cls(events, seed=seed)
+
+    # -- the trigger ---------------------------------------------------------
+
+    def fire(self, site: str, arg: str = "") -> bool:
+        """Count this call against every matching event and report
+        whether an injected fault is due NOW. Sites call this only after
+        the one-attribute-read plan-present check. Events WITH an arg
+        count that arg's calls; events WITHOUT one count the site's
+        calls across all args ("the n-th matching call" means exactly
+        that — an any-arg event must not re-fire at the n-th call of
+        every distinct arg)."""
+        with self._lock:
+            n_arg = self._counts[(site, arg)] = (
+                self._counts.get((site, arg), 0) + 1
+            )
+            n_site = self._counts[(site, None)] = (
+                self._counts.get((site, None), 0) + 1
+            )
+            for ev in self.events:
+                if ev.site != site or (ev.arg and ev.arg != arg):
+                    continue
+                n = n_arg if ev.arg else n_site
+                if ev.at <= n < ev.at + ev.times and ev.fired < ev.times:
+                    ev.fired += 1
+                    self._fired.append(f"{ev.spec()}#{n}")
+                    del self._fired[:-64]
+                    return True
+        return False
+
+    def raise_if(self, site: str, arg: str = "") -> None:
+        """fire() + raise — the one-liner most sites use."""
+        if self.fire(site, arg):
+            raise InjectedFault(f"injected: {site}" + (f":{arg}" if arg else ""))
+
+    def exhausted(self) -> bool:
+        """True once every scheduled event has fully fired — the chaos
+        harness's 'all faults delivered' assertion."""
+        with self._lock:
+            return all(ev.fired >= ev.times for ev in self.events)
+
+    def census(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "events": [
+                    {"spec": ev.spec(), "fired": ev.fired} for ev in self.events
+                ],
+                "recent_fired": list(self._fired),
+            }
+
+
+def apply_bank_skew(mirror) -> None:
+    """The ``bank-skew`` site's side effect: nudge one device bank array
+    (+1 on the node allocatable column) WITHOUT touching host truth, so
+    the device twin is verifiably wrong and the next shadow audit must
+    report divergence — the forced-skew sensitivity probe of PR 9/10, as
+    an injectable fault. `alloc` on purpose: the usage columns
+    (requested/pod_count) are re-shipped host-wins by every post-commit
+    patch, which would quietly heal the skew before an audit ever saw
+    it; allocatable only ships on full node-row patches (node events).
+    Non-donating (builds a fresh array), so in-flight dispatches holding
+    the previous buffers are unaffected."""
+    dev = mirror._dev_nodes
+    if dev is None:
+        return
+    key = "alloc" if "alloc" in dev else next(iter(dev))
+    mirror._dev_nodes = {**dev, key: dev[key] + 1}
+
+
+def plan_from_env(environ) -> Optional[FaultPlan]:
+    """Build the plan KTPU_FAULTS names, or None (the zero-overhead
+    default). ``KTPU_FAULTS_SEED`` seeds the plan's RNG bookkeeping."""
+    spec = environ.get("KTPU_FAULTS", "")
+    if not spec:
+        return None
+    return FaultPlan.parse(spec, seed=int(environ.get("KTPU_FAULTS_SEED", "0")))

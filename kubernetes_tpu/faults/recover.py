@@ -1,0 +1,127 @@
+"""Recovery actions: what a tripped breaker DOES, on the driver thread.
+
+Every action here mutates driver-confined state (the tensor mirror, the
+staged banks' device twins, the columnar cache attachment), so the board
+only QUEUES recoveries at trip time; the driver executes them at its
+post-sync safe point (``Scheduler._fault_service`` — commit pipeline
+drained, mirror freshly synced, the same designated window the PR 10
+shadow audits use). The actions:
+
+* **bank resync** (ingest/terms trip) — the slab's device twin is
+  re-uploaded from host truth: ``StageBank.resync()`` drops the resident
+  dict so the next covered dispatch's flush takes the full-upload path.
+  Full uploads are ``_to_dev`` placements of existing host arrays — NO
+  new XLA programs — and any subsequent dirty-row scatters land on the
+  already-warmed KIND_STAGE/KIND_TERM rungs: resync never compiles.
+
+* **uploader restart** (dead drain thread) — restarted EXACTLY ONCE per
+  trip, with the dirty backlog flushed synchronously first so the new
+  worker starts from a clean slate (and a restart loop can never spin:
+  the next death is a fresh counted fault that must re-trip the breaker
+  before anyone restarts again).
+
+* **mirror/fold resync** — ``TensorMirror.mark_device_stale()``: the next
+  ``device_arrays()`` re-uploads the full banks from host truth (host
+  wins, the resident-state plane's own recovery primitive), clearing any
+  partially-applied fold or patch. Same no-new-compiles argument.
+
+* **columns re-attach probe** — a columns trip DETACHES the columnar
+  cache inline (the cache materializes every lazy view from its journal
+  first, so object truth survives the broken columns); the probe path
+  re-attaches fresh columns built from current object truth, and the
+  shadow audit's columns-vs-banks cross-check gates the close.
+
+* **divergence escalation** — a divergent shadow audit (PR 10) stops
+  being just a metric: it force-trips the mirror breaker (the banks are
+  KNOWN wrong — no counted threshold), queues the resync, and dumps the
+  flight recorder's black box for the post-mortem.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+logger = logging.getLogger("kubernetes_tpu.faults")
+
+
+def resync_bank(bank) -> None:
+    """Re-upload one staged bank's device twin from host truth (and
+    restart its uploader if the thread died). Driver thread only."""
+    if bank is None:
+        return
+    restarted = bank.restart_uploader()
+    bank.resync()
+    if restarted:
+        logger.warning(
+            "fault recovery: %s uploader restarted (restart #%d), dirty "
+            "backlog flushed synchronously",
+            bank.THREAD_NAME, bank.uploader_restarts,
+        )
+
+
+def resync_mirror(sched) -> None:
+    """Force the next device_arrays() to re-upload the full banks from
+    host truth — clears partially-applied folds/patches/skew. No new
+    compiles: the full upload is placement, not a program."""
+    sched.mirror.mark_device_stale()
+
+
+def reattach_columns(sched) -> bool:
+    """Columns probe: rebuild the columnar cache from current object
+    truth (attach_columns is idempotent and journal-safe). Returns True
+    when columns are attached after the call."""
+    if not sched.columnar_cache:
+        return False
+    try:
+        sched.cache.attach_columns(sched.mirror.vocab)
+        return True
+    except Exception:
+        logger.exception("fault recovery: columns re-attach failed")
+        return False
+
+
+def detach_columns(sched) -> None:
+    sched.cache.detach_columns()
+
+
+def run_recoveries(sched, planes: List[str]) -> None:
+    """Execute the queued recovery action for each tripped plane.
+    Driver thread, at the post-sync safe point, holding no locks."""
+    for plane in planes:
+        try:
+            if plane == "ingest":
+                resync_bank(sched.stage_bank)
+            elif plane == "terms":
+                resync_bank(sched.term_bank)
+            elif plane in ("fold", "mirror"):
+                resync_mirror(sched)
+            elif plane == "columns":
+                # the inline fault handler already detached (object truth
+                # preserved); nothing to do until the probe re-attaches
+                detach_columns(sched)
+            elif plane == "commit":
+                # the pipeline worker survives (exceptions are captured
+                # by its Future); the open breaker routes batches to the
+                # scalar loop — no state to repair
+                pass
+        except Exception:
+            logger.exception("fault recovery for plane %r failed", plane)
+
+
+def escalate_divergence(sched, divergence: List[str]) -> None:
+    """A shadow audit found device/host divergence: automatic trip +
+    resync + black-box dump (metric → action). Driver thread (the audit
+    runs at the safe sync point by construction)."""
+    board = getattr(sched, "faults", None)
+    if board is None:
+        return
+    logger.error(
+        "shadow audit DIVERGENT (%s) — tripping mirror breaker, resyncing "
+        "device banks from host truth", ", ".join(divergence[:8]),
+    )
+    board.record_failure("mirror", "shadow-divergence", force=True)
+    try:
+        sched.obs.dump_blackbox("shadow-divergence")
+    except Exception:  # the dump is forensics, never load-bearing
+        logger.exception("black-box dump after divergence failed")

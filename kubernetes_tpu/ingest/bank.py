@@ -22,6 +22,7 @@ legacy per-batch upload — so warmed executables match dispatched ones.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -91,6 +92,9 @@ class StageBank:
     THREAD_NAME = "ingest-upload"
     LEDGER_KIND = "stage"
     RUNGS = STAGE_RUNGS
+    #: fault-plane identity (kubernetes_tpu/faults): the breaker this
+    #: bank's runtime faults report to
+    PLANE = "ingest"
 
     def __init__(
         self,
@@ -122,6 +126,21 @@ class StageBank:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
+        # fault plane (kubernetes_tpu/faults): the driver attaches a
+        # fault sink (breaker board) and, under injection, a FaultPlan —
+        # both default None so a standalone bank costs one attribute read
+        self.fault_sink = None
+        self.fault_plan = None
+        # uploader liveness: the drain thread stamps a heartbeat each
+        # loop so the health monitor can flag a stalled/dead uploader
+        # even with the fault plane disabled (census schema v2)
+        self._heartbeat_ts = 0.0  # ktpu: guarded-by(self._lock)
+        self._last_uploader_error: Optional[str] = None  # ktpu: guarded-by(self._lock)
+        self.uploader_restarts = 0  # ktpu: guarded-by(self._lock)
+        # set by a dying drain thread BEFORE it reports; lets the
+        # recovery distinguish "death in progress, thread still
+        # unwinding" (join it) from "worker healthy" (leave it alone)
+        self._death_pending = False  # ktpu: guarded-by(self._lock)
         stage.on_dirty = self._wake.set
 
     # -- placement -----------------------------------------------------------
@@ -243,30 +262,59 @@ class StageBank:
         self._worker.start()
 
     def _drain(self) -> None:
-        while not self._stop.is_set():
-            self._wake.wait(timeout=0.05)
-            self._wake.clear()
-            if self._stop.is_set():
-                return
-            need_warm = False
+        try:
+            while not self._stop.is_set():
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                if self._stop.is_set():
+                    return
+                need_warm = False
+                with self._lock:
+                    # liveness heartbeat: stamped every loop so census
+                    # readers can distinguish a dead thread from an idle
+                    # one (health monitor's stalled-uploader flag)
+                    self._heartbeat_ts = time.monotonic()
+                    if self._dev is None:
+                        # the first-ever upload stays with the driver
+                        # (warmup), where the compile plan can account it
+                        continue
+                    fp = self.fault_plan
+                    if fp is not None:  # injection site: one attr read
+                        fp.raise_if("uploader-death", self.PLANE)
+                    if self._warmed_generation != self.stage.generation:
+                        need_warm = True  # warmed OUTSIDE the lock, below
+                    elif self.stage.dirty_rows or (
+                        self._dev_generation != self.stage.generation
+                    ):
+                        self._flush_locked(sync=False)
+                if need_warm:
+                    # slab rebuilt (growth): the scatter programs' row-
+                    # capacity axis changed — pre-compile the rungs against
+                    # SYNTHETIC shape-twins, holding no lock (the compiles
+                    # take seconds; admissions and dispatches must not block
+                    # on them), before any flush admits the new programs
+                    self._warm_synthetic()
+        except Exception as e:
+            # the drain thread is DYING — until now this was invisible
+            # (a daemon thread's death just stops the off-thread flushes;
+            # dispatch-time sync flushes keep the plane correct, slower).
+            # Record why, and force-trip the plane breaker: the recovery
+            # restarts the worker exactly once per trip with the dirty
+            # backlog flushed synchronously (faults/recover.resync_bank).
             with self._lock:
-                if self._dev is None:
-                    # the first-ever upload stays with the driver
-                    # (warmup), where the compile plan can account it
-                    continue
-                if self._warmed_generation != self.stage.generation:
-                    need_warm = True  # warmed OUTSIDE the lock, below
-                elif self.stage.dirty_rows or (
-                    self._dev_generation != self.stage.generation
-                ):
-                    self._flush_locked(sync=False)
-            if need_warm:
-                # slab rebuilt (growth): the scatter programs' row-
-                # capacity axis changed — pre-compile the rungs against
-                # SYNTHETIC shape-twins, holding no lock (the compiles
-                # take seconds; admissions and dispatches must not block
-                # on them), before any flush admits the new programs
-                self._warm_synthetic()
+                self._last_uploader_error = repr(e)
+                self._death_pending = True
+            sink = self.fault_sink
+            if sink is not None:
+                sink(self.PLANE, "uploader-death", True)
+            logging.getLogger("kubernetes_tpu.ingest").exception(
+                "%s worker DIED — plane breaker tripped; dispatch-time "
+                "sync flushes cover until the recovery restarts it",
+                self.THREAD_NAME,
+            )
+            # swallow rather than re-raise: the thread exits either way,
+            # the death is recorded above, and an unhandled thread
+            # exception would only add noise on top of the breaker trip
 
     def _warm_synthetic(self) -> None:
         """Pre-compile the scatter rungs at the slab's CURRENT shapes
@@ -293,6 +341,85 @@ class StageBank:
         with self._lock:
             if self.stage.generation == gen:
                 self._warmed_generation = gen
+
+    def restart_uploader(self) -> bool:
+        """Fault-plane recovery (driver thread): restart a DEAD drain
+        worker — exactly once per breaker trip by construction (the
+        recovery queue drains once per trip; the next death is a fresh
+        counted fault that must re-trip before anyone restarts again).
+        The dirty backlog is flushed synchronously first so the new
+        worker starts from a clean slate. Returns True if restarted."""
+        w = self._worker
+        if w is None or self._stop.is_set():
+            return False
+        if w.is_alive():
+            # the trip is reported from the dying thread's except handler
+            # BEFORE the thread has finished unwinding — a recovery that
+            # runs promptly can observe it still alive. death_pending
+            # disambiguates: join a dying thread briefly; never touch a
+            # healthy one (it would block the driver for the timeout).
+            with self._lock:
+                dying = self._death_pending
+            if not dying:
+                return False
+            w.join(timeout=2.0)
+            if w.is_alive():
+                return False  # pathological: try again on the next trip
+        with self._lock:
+            self._death_pending = False
+            if self._dev is not None:
+                self._flush_locked(sync=True)
+            self.uploader_restarts += 1
+        self.start()
+        return True
+
+    def resync(self) -> None:
+        """Fault-plane recovery (driver thread): drop the device twin so
+        the next flush takes the FULL-upload path — re-built from host
+        truth via `_to_dev` placement (no new XLA programs; later dirty-
+        row scatters land on the already-warmed rungs)."""
+        with self._lock:
+            self._dev = None
+
+    # the staged banks' shadow-audit probe: like the mirror's
+    # device_bank_divergence it is a debug/verification API that fetches
+    # full arrays — a designated sync point, never a hot-path call
+    # (checkers.repo_config sync_allowlist carries it)
+    def device_divergence(self) -> List[str]:
+        """Names of device-twin arrays NOT bit-identical to the host slab
+        (dtype-canonicalized) — the ingest/terms half of the fault
+        plane's probe gate. Flushes dirty rows first (driver thread): an
+        un-flushed row is pipeline lag, not drift. Fetches go through a
+        device-side copy (the mirror probe's discipline) so the probe
+        never caches host views on live buffers."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._dev is None:
+                return []
+            self._flush_locked(sync=True)
+            host = self.stage.batch.arrays()
+            dev = dict(self._dev)
+            # only LIVE rows compare: release() frees host rows without
+            # dirtying them — the device keeps stale content by design,
+            # and no live (row, gen) pair can ever gather a freed row
+            live = np.asarray(self.stage.live_rows_locked(), np.int64)
+        out: List[str] = []
+        for k, h in host.items():
+            d = dev.get(k)
+            if d is None:
+                out.append(f"{self.LEDGER_KIND}.{k}:missing")
+                continue
+            dn = np.asarray(jnp.array(d, copy=True))
+            hn = np.asarray(h)
+            if dn.shape != hn.shape:
+                out.append(f"{self.LEDGER_KIND}.{k}:shape")
+                continue
+            if live.size and not np.array_equal(
+                dn[live], hn[live].astype(dn.dtype)
+            ):
+                out.append(f"{self.LEDGER_KIND}.{k}")
+        return out
 
     def close(self) -> None:
         self._stop.set()
@@ -327,12 +454,26 @@ class StageBank:
         uploader's flush counters — shares the slab lock so the numbers
         are one consistent cut. Metadata only; never reads device
         buffers."""
+        w = self._worker
         with self._lock:
             return {
                 "resident": self._dev is not None,
                 "device_generation": self._dev_generation,
                 "warmed_generation": self._warmed_generation,
                 "stats": dict(self.stats),
+                # uploader liveness (census schema v2): a started-but-
+                # dead worker is the stalled-uploader signal the health
+                # monitor flags even with the fault plane disabled
+                "uploader": {
+                    "started": w is not None,
+                    "alive": bool(w is not None and w.is_alive()),
+                    "heartbeat_age_s": (
+                        round(time.monotonic() - self._heartbeat_ts, 3)
+                        if self._heartbeat_ts else None
+                    ),
+                    "restarts": self.uploader_restarts,
+                    "last_error": self._last_uploader_error,
+                },
             }
 
     def warm(self) -> int:

@@ -204,6 +204,17 @@ class PodStage:
                 # freed host rows are never gathered (no live (row, gen)
                 # names them), so the device twin needs no update
 
+    # ktpu: holds(self._lock) callers hold the slab lock (StageBank's
+    # device_divergence probe)
+    def live_rows_locked(self) -> List[int]:
+        """Row indices currently ALLOCATED (not on the free list) — the
+        only rows the gather can ever read, and therefore the only rows
+        the device-twin parity probe may compare: release() frees host
+        rows without dirtying them (the device keeps stale content by
+        design, doc above)."""
+        free = set(self._free)
+        return [r for r in range(self.capacity) if r not in free]
+
     def valid_pair(self, row: int, gen: int) -> bool:
         with self._lock:
             return 0 <= row < self.capacity and self.row_gen[row] == gen

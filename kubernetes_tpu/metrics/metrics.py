@@ -313,6 +313,46 @@ shadow_audit = registry.register(Counter(
     "existed to compare, never counted as clean)",
     label_names=("result",),
 ))
+# fault plane (kubernetes_tpu/faults): the runtime degradation ladder —
+# per-plane circuit breakers over the existing legacy host paths, plus
+# the failure-path counters the reference scheduler keeps implicitly
+# (bind errors requeue through backoff, broken watches relist).
+plane_breaker_state = registry.register(Gauge(
+    "ktpu_plane_breaker_state",
+    "Circuit-breaker state per device-residency plane boundary "
+    "(0 = closed/covered, 1 = half-open probe, 2 = open/legacy path)",
+    label_names=("plane",),
+))
+plane_trips = registry.register(Counter(
+    "ktpu_plane_trips_total",
+    "Circuit-breaker trips per plane, by the reason that tripped it "
+    "(exception class, uploader-dead, shadow-divergence, probe:<reason>)",
+    label_names=("plane", "reason"),
+))
+bind_failures = registry.register(Counter(
+    "scheduler_bind_failures_total",
+    "Bind-pipeline failures by reason (rpc = the bind call itself, "
+    "volumes/permit/prebind = earlier pipeline stages, pipeline = an "
+    "unclassified bind-path error); each failed pod re-queues through "
+    "the backoff tier with per-pod exponential backoff (1s→10s, the "
+    "DefaultPodBackoff shape), never straight back to activeQ",
+    label_names=("reason",),
+))
+informer_relists = registry.register(Counter(
+    "scheduler_informer_relists_total",
+    "Reflector relists per informer kind (ListAndWatch restarts: initial "
+    "sync, 410 Gone, stream close, handler error, list error) — the "
+    "replication-health counter next to the queue gauges",
+    label_names=("kind",),
+))
+uploader_stalled = registry.register(Gauge(
+    "ktpu_uploader_stalled",
+    "1 while a plane's background uploader thread is dead/stalled with "
+    "the slab still live (the health monitor's liveness flag; the drain "
+    "stays correct via synchronous dispatch-time flushes, but the "
+    "off-thread win is gone until the fault plane restarts it)",
+    label_names=("plane",),
+))
 
 
 class _Timer:

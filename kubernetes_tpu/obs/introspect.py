@@ -53,18 +53,22 @@ import time
 from typing import Dict, List, Optional
 
 from ..analysis.lockorder import audited_lock
+from ..faults.breaker import STATE_VALUE as _BREAKER_STATE_VALUE
 from ..metrics import metrics as M
 
 #: /debug/ktpu schema version — bump on any breaking key change; readers
-#: (ktpu_top, tests) refuse documents they don't understand
-SCHEMA_VERSION = 1
+#: (ktpu_top, tests) refuse documents they don't understand.
+#: v2: staged-bank blocks grew the `uploader` liveness sub-block
+#: (heartbeat/alive/restarts) and the document grew the `faults` plane
+#: (per-plane breaker census, kubernetes_tpu/faults).
+SCHEMA_VERSION = 2
 
 #: every plane block a census document must carry (the six
-#: device-residency planes + the cache + the ladder + the recorder:
-#: ingest, terms, mirror [fold + sharded twins], compile, commit, queue)
+#: device-residency planes + the cache + the ladder + the recorder +
+#: the fault plane's breaker board)
 REQUIRED_PLANES = (
     "queue", "ingest", "terms", "cache", "mirror", "compile", "commit",
-    "recorder",
+    "recorder", "faults",
 )
 
 #: per-plane keys validate_census demands when the plane is enabled
@@ -87,6 +91,7 @@ _REQUIRED_KEYS = {
     "commit": ("in_flight", "stats", "verdicts"),
     "recorder": ("enabled", "pending_device", "dropped_pending",
                  "blackbox_records"),
+    "faults": ("quiet", "breakers"),
 }
 
 
@@ -148,6 +153,21 @@ def recorder_census(rec) -> Dict:
     return rec.census()
 
 
+# ktpu: hot-path
+def faults_census(sched) -> Dict:
+    """The breaker board's block (kubernetes_tpu/faults): per-plane
+    state/trips/probes plus the active FaultPlan schedule when injection
+    is armed. Counters and strings only."""
+    board = getattr(sched, "faults", None)
+    if board is None:
+        return {"enabled": False}
+    doc = board.census()
+    fp = getattr(sched, "_fault_plan", None)
+    if fp is not None:
+        doc["plan"] = fp.census()
+    return doc
+
+
 def mirror_census(mirror) -> Dict:
     """The mirror block — DRIVER-THREAD ONLY (TensorMirror.census's
     confinement contract). The monitor consumes it via the published
@@ -181,6 +201,7 @@ def census(sched, monitor: Optional["HealthMonitor"] = None) -> Dict:
             "compile": compile_census(sched.compile_plan),
             "commit": commit_census(sched._commit_pipe),
             "recorder": recorder_census(sched.obs),
+            "faults": faults_census(sched),
         },
     }
     if mon is not None:
@@ -257,6 +278,18 @@ def export_gauges(doc: Dict) -> None:
         M.plane_free_rows.set(d.get("free_rows", 0), label)
         M.plane_stale_rows.set(d.get("dirty_rows", 0), label)
         M.plane_refs_total.set(d.get("refs_total", 0), label)
+        # uploader liveness flag (census schema v2): a started-but-dead
+        # drain thread — the plane stays correct via synchronous
+        # dispatch-time flushes, but the off-thread win is silently gone,
+        # so the monitor flags it even with the fault plane disabled
+        up = (d.get("bank") or {}).get("uploader") or {}
+        stalled = bool(up.get("started")) and not up.get("alive", True)
+        M.uploader_stalled.set(1.0 if stalled else 0.0, label)
+    faults = planes.get("faults") or {}
+    for plane, b in (faults.get("breakers") or {}).items():
+        M.plane_breaker_state.set(
+            _BREAKER_STATE_VALUE.get(b.get("state"), 0.0), plane
+        )
     cache = planes.get("cache") or {}
     cols = cache.get("columns")
     if cols:
@@ -455,6 +488,15 @@ class HealthMonitor:
         div = list(mirror.device_bank_divergence())
         result = "divergent" if div else "clean"
         M.shadow_audit.inc(result)
+        if div:
+            # escalation (kubernetes_tpu/faults): a divergent audit is
+            # KNOWN-wrong device state, not a suspicion — force-trip the
+            # mirror breaker, queue the resync from host truth, dump the
+            # black box. We are on the driver thread at its safe sync
+            # point by this method's own contract, holding no locks.
+            from ..faults.recover import escalate_divergence
+
+            escalate_divergence(self.sched, div)
         now = time.time()
         with self._lock:
             self._audit_counts[result] = self._audit_counts.get(result, 0) + 1

@@ -529,6 +529,7 @@ class Scheduler:
         term_plane: bool = True,
         columnar_cache: bool = True,
         trace: Optional[bool] = None,
+        fault_plan=None,
     ):
         self.cache = cache or SchedulerCache()
         self.queue = queue or PriorityQueue()
@@ -755,6 +756,34 @@ class Scheduler:
         self._oldest_age_obs_ts = 0.0
         if _os.environ.get("KTPU_HEALTH", "") not in ("", "0"):
             self.enable_health_monitor(start=False)
+        # fault plane (kubernetes_tpu/faults): the runtime degradation
+        # ladder. Every plane boundary that can fail at runtime reports
+        # to a per-plane circuit breaker; an open breaker routes that
+        # plane's dispatches to its existing legacy host path (the
+        # ON==OFF parity discipline is what makes this sound), and a
+        # half-open probe re-closes only through a shadow-audit-gated
+        # batch at the driver's safe sync point (_fault_service).
+        # `fault_plan` (or KTPU_FAULTS=<spec>) arms seeded fault
+        # injection; absent, every injection site is one attribute read.
+        from ..faults import BreakerBoard, plan_from_env
+
+        self.faults = BreakerBoard()
+        self._fault_plan = fault_plan if fault_plan is not None else (
+            plan_from_env(_os.environ)
+        )
+        # sinks route through _report_fault (not a bound board method) so
+        # tests that swap self.faults for a fake-clock board keep working
+        self.cache.fault_sink = self._report_fault
+        self.mirror.fault_sink = self._report_fault
+        self.mirror.fault_plan = self._fault_plan
+        if self.stage_bank is not None:
+            self.stage_bank.fault_sink = self._report_fault
+            self.stage_bank.fault_plan = self._fault_plan
+        if self.term_bank is not None:
+            self.term_bank.fault_sink = self._report_fault
+            self.term_bank.fault_plan = self._fault_plan
+        if self._fault_plan is not None and self.cache._columns is not None:
+            self._arm_columns_hook()
         # black-box baseline: cumulative counters diffed per batch into
         # the bounded cycle ring (ktpu: confined(driver))
         self._bb_prev: Optional[Dict] = None
@@ -827,6 +856,98 @@ class Scheduler:
         if start:
             self.health.start()
         return self.health
+
+    # -- fault plane (kubernetes_tpu/faults) ---------------------------------
+
+    def _report_fault(self, plane: str, reason: str, force: bool = False) -> bool:
+        """The one fault sink every reporter (banks, cache, mirror, the
+        driver's own gates) routes through — reads self.faults at call
+        time so a swapped board keeps receiving."""
+        return self.faults.record_failure(plane, reason, force=force)
+
+    def _arm_columns_hook(self) -> None:
+        """Attach the columnar-scatter injection site to the CURRENT
+        columns object (re-run after a probe re-attach)."""
+        fp = self._fault_plan
+        cols = self.cache._columns
+        if fp is None or cols is None:
+            return
+        cols.fault_hook = lambda: fp.raise_if("device-raise", "columns")
+
+    def _probe_divergence(self, planes: List[str]) -> List[str]:
+        """The probe gate's shadow audit, at the driver's safe sync
+        point: the PR 10 mirror probe (device_bank_divergence, including
+        the columns-vs-banks cross-check) plus, for the staged-bank
+        planes, each bank's own device-twin parity check. Ships pending
+        dirty rows first so the probe compares a settled pair."""
+        div: List[str] = []
+        if self.mirror._dev_nodes is not None:
+            self.mirror.device_arrays()
+            div.extend(self.mirror.device_bank_divergence())
+        if "ingest" in planes and self.stage_bank is not None:
+            div.extend(self.stage_bank.device_divergence())
+        if "terms" in planes and self.term_bank is not None:
+            div.extend(self.term_bank.device_divergence())
+        return div
+
+    def _fault_service(self) -> None:
+        """The fault plane's driver-side tick, at the post-sync safe
+        point (commit pipeline drained, mirror freshly synced — the same
+        window the PR 10 shadow audits use). In order: resolve probes
+        whose covered batch has now fully settled (audit-gated close),
+        run queued recovery actions for freshly tripped planes, then
+        offer the gate-less planes (columns, mirror) their next probe.
+        Skipped entirely — one attribute read — while the board is
+        quiet."""
+        from ..faults import recover as _recover
+
+        board = self.faults
+        # 1) resolve in-flight probes: the probe batch dispatched during
+        # the PREVIOUS cycle; its commits/folds are drained+synced now
+        probing = board.probing_planes()
+        if probing:
+            div = self._probe_divergence(probing)
+            for plane in probing:
+                b = board.breakers[plane]
+                if not b.probing:
+                    continue  # a fault during the probe already re-opened it
+                if div:
+                    b.probe_failed("audit:" + div[0])
+                    # plane-appropriate repair before the NEXT probe: a
+                    # divergent staged bank must resync ITS device twin
+                    # (run_recoveries routes each plane to its action) —
+                    # resyncing only the mirror would leave an ingest/
+                    # terms twin wrong forever, probes failing at 8x
+                    _recover.run_recoveries(self, [plane])
+                else:
+                    b.probe_passed()
+        # 2) recovery actions for planes that tripped since the last tick
+        pending = board.take_recoveries()
+        if pending:
+            _recover.run_recoveries(self, pending)
+        # 3) gate-less probes: columns and the mirror have no per-dispatch
+        # ok() gate, so their half-open transition is initiated here; the
+        # probe resolves at the NEXT tick, after a real batch ran covered
+        cb = board.breakers["columns"]
+        if not cb.closed and not cb.probing and cb.allow_probe():
+            if _recover.reattach_columns(self):
+                self._arm_columns_hook()
+            else:
+                cb.probe_failed("reattach")
+        mb = board.breakers["mirror"]
+        if not mb.closed and not mb.probing:
+            mb.allow_probe()
+        board.settle()
+
+    def service_faults(self) -> None:
+        """Settle the fault plane at an explicit safe point (tests,
+        drain tails, idle schedulers): drain the commit pipeline, sync
+        the mirror, then run the same recovery/probe service the
+        per-batch hook runs. Idempotent; cheap when the board is quiet."""
+        self._commit_pipe.drain()
+        self.mirror.sync()
+        if not self.faults.quiet:
+            self._fault_service()
 
     def _bb_counters(self) -> Dict:
         """Cumulative counters the black box diffs per batch."""
@@ -960,16 +1081,34 @@ class Scheduler:
         the fold is transport, never correctness."""
         if not self.fold_plane or not self.mirror.can_fold():
             return False
+        if not (self.faults.quiet or self.faults.ok("fold")):
+            return False  # fold breaker open: host scatter path (legacy)
         from ..commit.fold import plan_fold
 
         t0 = time.perf_counter()
-        prog = plan_fold(self.mirror, pairs, self._b_bucket, self._fp_bucket)
-        if prog is None:
-            return False
-        self._fp_bucket = max(self._fp_bucket, prog.pat_bucket)
-        spec = self._fold_spec()
-        known = self.compile_plan.admit(spec)
-        if not self.mirror.fold_commit(prog):
+        try:
+            fp = self._fault_plan
+            if fp is not None:  # injection site: one attribute read
+                fp.raise_if("device-raise", "fold")
+            prog = plan_fold(self.mirror, pairs, self._b_bucket, self._fp_bucket)
+            if prog is None:
+                return False
+            self._fp_bucket = max(self._fp_bucket, prog.pat_bucket)
+            spec = self._fold_spec()
+            known = self.compile_plan.admit(spec)
+            if not self.mirror.fold_commit(prog):
+                return False
+        except Exception as e:
+            # a fold that raised may have PARTIALLY landed on device:
+            # host wins — force a full bank re-upload before the next
+            # dispatch reads them, and report to the fold breaker. The
+            # caller takes the host scatter path (assumes not tagged
+            # folded), so correctness never depends on the broken fold.
+            self.mirror.mark_device_stale()
+            self._report_fault("fold", type(e).__name__)
+            self.stats["fold_fault_batches"] = (
+                self.stats.get("fold_fault_batches", 0) + 1
+            )
             return False
         if not known:
             self.compile_plan.note_compiled(
@@ -1149,6 +1288,9 @@ class Scheduler:
         # gather OUTSIDE the slab lock: the captured device dicts are
         # immutable (functional updates), and an unwarmed rung's inline
         # XLA compile here must not stall informer-thread admissions
+        fp = self._fault_plan
+        if fp is not None:  # injection site (faults/inject): one attr read
+            fp.raise_if("device-raise", "gather-stage")
         known = self.compile_plan.admit(spec)
         t_g = time.perf_counter()
         pa_dev = gather_stage(bank_dev, idx, keep, empty_dev, fb)
@@ -1280,6 +1422,9 @@ class Scheduler:
         # gather OUTSIDE the slab lock: the captured device dicts are
         # immutable (functional updates), and an unwarmed rung's inline
         # XLA compile here must not stall informer-thread admissions
+        fp = self._fault_plan
+        if fp is not None:  # injection site (faults/inject): one attr read
+            fp.raise_if("device-raise", "gather-terms")
         known = self.compile_plan.admit(spec)
         t_g = time.perf_counter()
         ta_dev = gather_terms(bank_dev, idx, own, keep, empty_dev)
@@ -1369,11 +1514,23 @@ class Scheduler:
                 # reps fall back to the legacy host-built PodBatch, counted.
                 batch = None
                 pa_dev = None
-                staged = (
-                    self._stage_prologue(reps, rep_infos)
-                    if self.ingest_plane and self.stage is not None
-                    else None
-                )
+                staged = None
+                if self.ingest_plane and self.stage is not None and (
+                    self.faults.quiet or self.faults.ok("ingest")
+                ):
+                    try:
+                        staged = self._stage_prologue(reps, rep_infos)
+                    except KeySlotOverflow:
+                        raise  # vocab growth: the outer rebuild loop owns it
+                    except Exception as e:
+                        # runtime plane fault: report to the breaker and
+                        # take the legacy host-built PodBatch for this
+                        # batch (bit-identical by the ON==OFF contract)
+                        self._report_fault("ingest", type(e).__name__)
+                        self.stats["ingest_fault_batches"] = (
+                            self.stats.get("ingest_fault_batches", 0) + 1
+                        )
+                        staged = None
                 if staged is not None:
                     pa_dev, fallback_arr = staged
                 else:
@@ -1392,11 +1549,22 @@ class Scheduler:
                 # compile-then-recompile-at-the-monotone-bucket retry
                 # exists on it.
                 tb = None
-                tp = (
-                    self._term_prologue(reps, rep_infos, rep_keys, selectors)
-                    if self.term_plane and self.tstage is not None
-                    else None
-                )
+                tp = None
+                if self.term_plane and self.tstage is not None and (
+                    self.faults.quiet or self.faults.ok("terms")
+                ):
+                    try:
+                        tp = self._term_prologue(
+                            reps, rep_infos, rep_keys, selectors
+                        )
+                    except KeySlotOverflow:
+                        raise
+                    except Exception as e:
+                        self._report_fault("terms", type(e).__name__)
+                        self.stats["term_fault_batches"] = (
+                            self.stats.get("term_fault_batches", 0) + 1
+                        )
+                        tp = None
                 if tp is not None:
                     ta_arrays, aux = tp["ta"], tp["aux"]
                 else:
@@ -1665,6 +1833,9 @@ class Scheduler:
         # counted, logged, and still compiled inline (correctness first).
         solve_spec = self._solve_spec(gang=is_gang, with_carry=carry is not None)
         spec_known = self.compile_plan.admit(solve_spec)
+        fault_plan = self._fault_plan
+        if fault_plan is not None:  # injection site: one attribute read
+            fault_plan.raise_if("device-raise", "solve")
         t_spec = time.perf_counter()
         if is_gang:
             from ..ops.pipeline import solve_pipeline_gang
@@ -1735,6 +1906,9 @@ class Scheduler:
             # a deployment whose plugins/extenders/volumes force the legacy
             # loop must not pay the verdict scan at all
             and self._commit_plane_statics_ok()
+            # commit breaker open: the legacy scalar loop is the route —
+            # don't pay the verdict scan for verdicts that won't be used
+            and (self.faults.quiet or self.faults.ok("commit"))
         ):
             from ..commit.arbiter import arbitrate
 
@@ -1742,15 +1916,23 @@ class Scheduler:
             arb_spec = self._arbiter_spec(with_carry=carry is not None)
             arb_known = self.compile_plan.admit(arb_spec)
             t_arb = time.perf_counter()
-            verdict_dev = arb_fn(
-                na_dev, pa_arrays, ea_dev, ta_arrays, ids,
-                assign, pb=pb, carry=carry,
-                term_kinds=term_kinds, n_buckets=n_buckets,
-            )
+            try:
+                if fault_plan is not None:  # injection site
+                    fault_plan.raise_if("device-raise", "arbiter")
+                verdict_dev = arb_fn(
+                    na_dev, pa_arrays, ea_dev, ta_arrays, ids,
+                    assign, pb=pb, carry=carry,
+                    term_kinds=term_kinds, n_buckets=n_buckets,
+                )
+            except Exception as e:
+                # arbiter dispatch fault: the scalar commit loop covers
+                # this batch (verdicts are an optimization, not truth)
+                self._report_fault("commit", type(e).__name__)
+                verdict_dev = None
             self.stats["arbiter_dispatch_s"] = self.stats.get(
                 "arbiter_dispatch_s", 0.0
             ) + (time.perf_counter() - t_arb)
-            if not arb_known:
+            if not arb_known and verdict_dev is not None:
                 self.compile_plan.note_compiled(
                     arb_spec,
                     time.perf_counter() - t_arb,
@@ -2299,15 +2481,15 @@ class Scheduler:
                 try:
                     self.volume_binder.bind_pod_volumes(pod)
                 except Exception as e:
-                    self._unbind(info, assumed, node_name, state, cycle, f"bindVolumes: {e}")
+                    self._unbind(info, assumed, node_name, state, cycle, f"bindVolumes: {e}", reason="volumes")
                     return
             st = self.framework.run_permit(state, pod, node_name)
             if not st.is_success():
-                self._unbind(info, assumed, node_name, state, cycle, f"permit: {st.message}")
+                self._unbind(info, assumed, node_name, state, cycle, f"permit: {st.message}", reason="permit")
                 return
             st = self.framework.run_pre_bind(state, pod, node_name)
             if not st.is_success():
-                self._unbind(info, assumed, node_name, state, cycle, f"prebind: {st.message}")
+                self._unbind(info, assumed, node_name, state, cycle, f"prebind: {st.message}", reason="prebind")
                 return
             ext_b = next(
                 (
@@ -2319,6 +2501,9 @@ class Scheduler:
             )
             t_bind = time.perf_counter()
             try:
+                fp = self._fault_plan
+                if fp is not None:  # injection site: one attribute read
+                    fp.raise_if("bind-error")
                 if ext_b is not None:
                     # extender-delegated binding (scheduler_interface.go:53,
                     # scheduler.go:557-571 via extendersBinding)
@@ -2329,7 +2514,7 @@ class Scheduler:
                         raise RuntimeError(st.message)
                     self.binder.bind(pod, node_name)
             except Exception as e:  # bind RPC failed → forget + requeue
-                self._unbind(info, assumed, node_name, state, cycle, f"bind: {e}")
+                self._unbind(info, assumed, node_name, state, cycle, f"bind: {e}", reason="rpc")
                 return
             now = time.perf_counter()
             M.binding_duration.observe(now - t_bind)
@@ -2360,7 +2545,15 @@ class Scheduler:
         Semantics identical to bind_async when lean conditions hold: no
         volume binder, permit/prebind success by vacuity, framework bind
         SKIP → default binder."""
+        fp = self._fault_plan
         bind = self.binder.bind
+        if fp is not None:
+            _real_bind = bind
+
+            def bind(pod, node):  # injection shim: bind-error site
+                fp.raise_if("bind-error")
+                _real_bind(pod, node)
+
         age = self.queue.age
         attempt_age = self.queue.attempt_age
         events = self.event_fn
@@ -2379,7 +2572,7 @@ class Scheduler:
                 try:
                     bind(pod, node_name)
                 except Exception as e:  # bind RPC failed → forget + requeue
-                    self._unbind(info, assumed, node_name, state, cycle, f"bind: {e}")
+                    self._unbind(info, assumed, node_name, state, cycle, f"bind: {e}", reason="rpc")
                     continue
                 bound = True
                 now = time.perf_counter()
@@ -2431,12 +2624,26 @@ class Scheduler:
         self._finalize_commit(info, assumed, node_name, cycle, state, defer=defer, lean=lean)
         return True
 
-    def _unbind(self, info: PodInfo, assumed: Pod, node_name: str, state, cycle: int, msg: str) -> None:
+    def _unbind(
+        self, info: PodInfo, assumed: Pod, node_name: str, state, cycle: int,
+        msg: str, reason: str = "pipeline",
+    ) -> None:
+        """Bind-pipeline failure: forget the assume and re-queue through
+        the BACKOFF tier with per-pod exponential backoff (the kube 1s→10s
+        DefaultPodBackoff shape) — the old path re-added immediately via
+        the unschedulable map, which either hot-looped a broken binder or
+        parked the pod behind a cluster event that may never come.
+        Counted by scheduler_bind_failures_total{reason}."""
         self.cache.forget_pod(assumed)
         if self.volume_binder is not None:
             self.volume_binder.forget_pod_volumes(info.pod)
         self.framework.run_unreserve(state, info.pod, node_name)
-        self._fail(info, cycle, msg)
+        M.bind_failures.inc(reason)
+        self.event_fn(info.pod, "FailedScheduling", msg)
+        M.scheduling_attempt_duration.observe(
+            self.queue.attempt_age(info), "unschedulable"
+        )
+        self.queue.requeue_backoff(info)
 
     def _fail(self, info: PodInfo, cycle: int, msg: str) -> None:
         self.event_fn(info.pod, "FailedScheduling", msg)
@@ -2906,7 +3113,32 @@ class Scheduler:
             # in that thread's ring, so the timeline shows the overlap
             # with the driver's next solve fetch
             t_apply = time.perf_counter()
-            result = columnar.apply(place, folded=folded)
+            try:
+                fp = self._fault_plan
+                if fp is not None:  # injection site: one attribute read
+                    fp.raise_if("device-raise", "apply")
+                result = columnar.apply(place, folded=folded)
+            except Exception as e:
+                # commit-worker fault: nothing has been bound yet — undo
+                # whatever DID get assumed (forget_pods skips unknown
+                # keys, so a partial assume unwinds exactly), correct any
+                # phantom fold lanes host-wins, and re-queue every pod
+                # through the backoff tier. Zero lost, zero
+                # double-scheduled; the breaker routes later batches to
+                # the scalar loop once tripped.
+                self._report_fault("commit", type(e).__name__)
+                try:
+                    self.cache.forget_pods(
+                        [info.pod.with_node(node) for info, node in place]
+                    )
+                except Exception:
+                    pass  # forget is best-effort cleanup here
+                if folded:
+                    for _info, node in place:
+                        self.mirror.note_failed_fold(node)
+                for info, _node in place:
+                    self.queue.requeue_backoff(info)
+                return
             OBS.record("apply", t_apply, pods=len(place))
             M.commit_apply_duration.observe(result.seconds)
             M.scheduling_stage_duration.observe(result.seconds, "apply")
@@ -3070,6 +3302,29 @@ class Scheduler:
         if self.health is not None:
             self.health.driver_sync_hook()
             trace.step("health sync hook")
+        # fault plane: recoveries + audit-gated probe resolution at the
+        # same safe point (one attribute read while everything is closed)
+        if not self.faults.quiet:
+            self._fault_service()
+            trace.step("fault service")
+        fault_plan = self._fault_plan
+        if fault_plan is not None and fault_plan.fire("bank-skew"):
+            # chaos harness: corrupt a device bank array so the next
+            # shadow audit MUST report divergence (and escalate: trip +
+            # resync + black box) — the forced-skew sensitivity probe as
+            # a fault. Settle the banks FIRST (ship pending/stale state)
+            # and audit at THIS safe point: a pending full re-upload
+            # (e.g. a fold fault's resync) would otherwise legitimately
+            # heal the skew before any audit saw it, silently voiding
+            # the escalation-path coverage the injection exists for.
+            from ..faults.inject import apply_bank_skew
+
+            if self.mirror._dev_nodes is not None:
+                self.mirror.device_arrays()
+            apply_bank_skew(self.mirror)
+            if self.health is not None:
+                self.health.request_audit()
+                self.health.driver_sync_hook()
         # the snapshot moved (sync) — rebuild the oracle metadata index
         # lazily if this batch needs it
         self._aff_index = None
@@ -3133,11 +3388,19 @@ class Scheduler:
             M.priority_evaluation_duration.observe(dt_solve)
             trace.step("device solve (mask+score+assign)")
         except Exception as e:
+            # a solve/fetch error is an ERROR, not unschedulability: the
+            # pods retry through the backoff tier (1s→10s per pod — the
+            # MakeDefaultErrorFunc shape) instead of parking in
+            # unschedulableQ behind a cluster event that may never come
             for info in infos:
                 res.errors += 1
                 if self.error_fn:
                     self.error_fn(info.pod, e)
-                self._fail(info, cycle, f"solve error: {e}")
+                self.event_fn(info.pod, "FailedScheduling", f"solve error: {e}")
+                M.scheduling_attempt_duration.observe(
+                    self.queue.attempt_age(info), "unschedulable"
+                )
+                self.queue.requeue_backoff(info)
             M.schedule_attempts.inc(M.ERROR, by=len(infos))
             return res
         # SPECULATIVE PIPELINING (the reference's assume-then-async-bind

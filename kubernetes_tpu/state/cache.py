@@ -154,6 +154,10 @@ class SchedulerCache:
         # column scatters and the NodeInfo objects a lazy journal-backed
         # view. None = every legacy path intact (the kill switch).
         self._columns = None
+        # fault plane (kubernetes_tpu/faults): a broken columnar scatter
+        # detaches the columns INLINE (object truth survives via the
+        # journal) and reports here; None = one attribute read
+        self.fault_sink = None
         self._deadlines = None
         self.dirty_nodes: Set[str] = set()  # generation-equivalent dirty set
         self.removed_nodes: Set[str] = set()
@@ -255,6 +259,48 @@ class SchedulerCache:
             else:
                 cols._overgrown.discard(row)
 
+    def detach_columns(self) -> None:
+        """RUNTIME kill switch for the columnar plane (the fault plane's
+        columns recovery): materialize every lazy NodeInfo view from its
+        journal, then drop the columns and the deadline column — the
+        legacy object paths take over exactly as KTPU_COLUMNAR_CACHE=0
+        would have from the start. Object truth is complete because the
+        journal is appended BEFORE the column scatter (columns.py
+        _bulk_locked), so even a scatter that died mid-batch left a full
+        replay log. Idempotent; re-attach later via attach_columns."""
+        with self._lock:
+            self._detach_columns_locked()
+
+    # ktpu: holds(self._lock)
+    def _detach_columns_locked(self) -> None:
+        cols = self._columns
+        if cols is None:
+            return
+        # rows with journaled ops whose scatter never completed are not
+        # in _stale_rows yet — mark them so the full materialize below
+        # replays EVERY pending op into the object views
+        for row, ops in enumerate(cols._pending):
+            if ops:
+                cols._stale_rows.add(row)
+        self._materialize_view(None)
+        self._columns = None
+        self._deadlines = None  # cleanup_expired falls back to the legacy walk
+
+    # ktpu: holds(self._lock)
+    def _columns_fault_locked(self, exc: Exception) -> None:
+        """A columnar scatter raised mid-update: the columns are garbage
+        but object truth is recoverable (journal-before-scatter), so
+        detach inline — the CURRENT operation completes on the object
+        path semantics — and report to the fault sink (the driver's
+        breaker board force-trips: broken columns are known-wrong state,
+        not a counted suspicion). The breaker's half-open probe
+        re-attaches fresh columns and the columns-vs-banks shadow audit
+        gates the close."""
+        self._detach_columns_locked()
+        sink = self.fault_sink
+        if sink is not None:
+            sink("columns", type(exc).__name__, True)
+
     # -- helpers -------------------------------------------------------------
 
     def _node_info(self, name: str) -> Optional[NodeInfo]:
@@ -272,14 +318,20 @@ class SchedulerCache:
             ni.node.labels = {}
             ni.add_pod(pod)
             if cols is not None:
-                row = cols.add_node_locked(pod.node_name, {})
-                cols.apply_one_locked(row, pod, 1)
+                try:
+                    row = cols.add_node_locked(pod.node_name, {})
+                    cols.apply_one_locked(row, pod, 1)
+                except Exception as e:
+                    self._columns_fault_locked(e)
             self.dirty_nodes.add(pod.node_name)
             self.mutation_count += 1
             return
         ni.add_pod(pod)
         if cols is not None:
-            cols.apply_one_locked(cols.row_of[pod.node_name], pod, 1)
+            try:
+                cols.apply_one_locked(cols.row_of[pod.node_name], pod, 1)
+            except Exception as e:
+                self._columns_fault_locked(e)
         self.mutation_count += 1
         # single-pod change: a DELTA, not node dirt — the mirror patches the
         # node row + signature/pattern counts in O(1) instead of re-counting
@@ -294,7 +346,10 @@ class SchedulerCache:
         if removed is not None:
             cols = self._columns
             if cols is not None:
-                cols.apply_one_locked(cols.row_of[pod.node_name], removed, -1)
+                try:
+                    cols.apply_one_locked(cols.row_of[pod.node_name], removed, -1)
+                except Exception as e:
+                    self._columns_fault_locked(e)
             self.mutation_count += 1
             self._push_delta(pod.node_name, removed, -1)
 
@@ -382,9 +437,15 @@ class SchedulerCache:
                 deltas.append((pod.node_name, pod, 1, folded))
             if acc_pods:
                 self._collapse_deltas_locked()
-                cols.assume_bulk_locked(acc_rows, acc_pods)
+                try:
+                    cols.assume_bulk_locked(acc_rows, acc_pods)
+                except Exception as e:
+                    # journal-before-scatter: the detach below replays
+                    # every pending op (this batch included) into the
+                    # object views, so the assumes stand on object truth
+                    self._columns_fault_locked(e)
                 self.mutation_count += len(acc_pods)
-                if cols._overgrown:
+                if self._columns is not None and cols._overgrown:
                     self._drain_overgrown_locked()
         return rejected
 
@@ -472,9 +533,12 @@ class SchedulerCache:
                 deltas.append((p.node_name, p, -1, False))
             if acc_pods:
                 self._collapse_deltas_locked()
-                cols.forget_bulk_locked(acc_rows, acc_pods)
+                try:
+                    cols.forget_bulk_locked(acc_rows, acc_pods)
+                except Exception as e:
+                    self._columns_fault_locked(e)
                 self.mutation_count += len(acc_pods)
-                if cols._overgrown:
+                if self._columns is not None and cols._overgrown:
                     self._drain_overgrown_locked()
 
     # -- informer-confirmed pod events (cache.go:389-520) --------------------
@@ -746,6 +810,12 @@ class TensorMirror:
         # programs are admitted as KIND_PATCH specs so a post-warmup
         # scatter compile is a VISIBLE miss, not a silent mid-drain stall
         self.compile_plan = None
+        # fault plane (kubernetes_tpu/faults): patch-scatter failures
+        # report here (the driver's breaker board) and self-heal via the
+        # full-upload path; fault_plan arms the device-raise:patch
+        # injection site. Both default None — one attribute read each.
+        self.fault_sink = None
+        self.fault_plan = None
         # mesh-bound fold kernels (ops/fold.make_sharded_fold_fns), built
         # lazily on first fold after set_mesh
         self._sharded_folds = None
@@ -1089,6 +1159,15 @@ class TensorMirror:
             self.generation += 1
             return False
 
+    # ktpu: confined(driver) fault-plane recovery primitive
+    def mark_device_stale(self) -> None:
+        """Force the next device_arrays() to re-upload the FULL banks
+        from host truth (host wins) — clears partially-applied folds,
+        broken patches, or injected skew. The fault plane's resync
+        action; a full upload is `_to_dev` placement of existing host
+        arrays, so resync never meets the XLA compiler."""
+        self._device_stale = True
+
     def set_mesh(self, mesh) -> None:
         """Keep the node-major device banks SHARDED-resident on `mesh`
         (leading axis split over the "nodes" mesh axis). Without this the
@@ -1201,12 +1280,24 @@ class TensorMirror:
         srows = sorted(self.eps.dirty_sig_rows)
         prows = sorted(self.pats.dirty_pattern_rows)
         skip_n = ("image_scaled",) if self._image_stale else ()
-        self._dev_nodes = patch(self._dev_nodes, host_n, nrows, skip=skip_n)
-        if urows:
-            usage_host = {
-                k: host_n[k] for k in ("requested", "nonzero_req", "pod_count")
-            }
-            self._dev_nodes = patch(self._dev_nodes, usage_host, urows, kind="usage")
+        try:
+            self._dev_nodes = patch(self._dev_nodes, host_n, nrows, skip=skip_n)
+            if urows:
+                usage_host = {
+                    k: host_n[k] for k in ("requested", "nonzero_req", "pod_count")
+                }
+                self._dev_nodes = patch(self._dev_nodes, usage_host, urows, kind="usage")
+        except Exception as e:
+            # patch-scatter fault (the fault plane's "mirror" boundary):
+            # the device banks may be PARTIALLY patched — host wins.
+            # Report to the breaker and fall back to the full-upload
+            # path, which rebuilds every resident array from host truth
+            # (placement only, no compiles) and clears the pending sets.
+            sink = self.fault_sink
+            if sink is not None:
+                sink("mirror", type(e).__name__)
+            self._device_stale = True
+            return self.device_arrays()
         self._image_stale = False
 
         # the eps/pats dicts have TWO row spaces each: metadata ([S]/[PT]-
@@ -1223,8 +1314,16 @@ class TensorMirror:
             return {**meta_dev, **cnt_dev}
 
         pat_crows = sorted(self._pending_pat_rows | self._pending_node_rows)
-        self._dev_eps = patch_bank(self._dev_eps, host_e, srows, crows)
-        self._dev_pats = patch_bank(self._dev_pats, host_p, prows, pat_crows)
+        try:
+            self._dev_eps = patch_bank(self._dev_eps, host_e, srows, crows)
+            self._dev_pats = patch_bank(self._dev_pats, host_p, prows, pat_crows)
+        except Exception as e:
+            # same patch-fault fallback as the node-bank section above
+            sink = self.fault_sink
+            if sink is not None:
+                sink("mirror", type(e).__name__)
+            self._device_stale = True
+            return self.device_arrays()
         self._pending_node_rows.clear()
         self._pending_usage_rows.clear()
         self._pending_pat_rows.clear()
@@ -1276,6 +1375,9 @@ class TensorMirror:
 
         from ..obs import NOOP_SPAN, RECORDER as _rec
 
+        fp = self.fault_plan
+        if fp is not None and not warm:  # injection site: one attr read
+            fp.raise_if("device-raise", "patch")
         cap = next(iter(host.values())).shape[0]
         rb = min(_patch_rung(len(rows)), cap)
         plan = self.compile_plan

@@ -213,6 +213,10 @@ class CacheColumns:
             "materialized_pods": 0,
             "spec_rows": 0,
         }
+        # fault-plane injection hook (kubernetes_tpu/faults): armed by
+        # the driver only when a FaultPlan is configured; None = one
+        # attribute read per scatter (the zero-overhead contract)
+        self.fault_hook = None
 
     # -- row management (caller holds the cache lock) ------------------------
 
@@ -442,6 +446,13 @@ class CacheColumns:
     # -- bulk columnar mutation (caller holds the cache lock) ----------------
 
     def _scatter_locked(self, ridx: np.ndarray, slots: np.ndarray, sign: int) -> None:
+        # fault-plane injection site (kubernetes_tpu/faults): the driver
+        # arms `fault_hook` only when a FaultPlan is configured — one
+        # attribute read otherwise. A raise here is handled by the cache
+        # (inline detach: journal-before-scatter keeps object truth).
+        hook = self.fault_hook
+        if hook is not None:
+            hook()
         # forget is the exact integer inverse: subtract.at instead of
         # negating (a negation copies the whole gathered delta matrix)
         scatter = np.add.at if sign > 0 else np.subtract.at

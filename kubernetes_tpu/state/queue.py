@@ -497,6 +497,28 @@ class PriorityQueue:
                 self._unschedulable[key] = info
             self._update_nominated(info.pod)
 
+    def requeue_backoff(self, info: PodInfo) -> None:
+        """Bind/RPC-failure requeue: ALWAYS the backoff tier with per-pod
+        exponential backoff (1s → 10s, pod_backoff.go DefaultPodBackoff),
+        never unschedulableQ. A bind failure is not unschedulability —
+        the pod had a node; re-adding it immediately (the old forget +
+        requeue path) retries a possibly-still-broken binder in a hot
+        loop, while parking it in unschedulableQ makes it wait for a
+        cluster event that may never come. The attempt count (and so the
+        backoff) resets through clear_backoff like every other failure."""
+        with self._lock:
+            key = info.pod.key()
+            self._stage_acquire_if_stale(info)
+            self._attempts[key] = self._attempts.get(key, 0) + 1
+            self._last_failure[key] = self._now()
+            self._unschedulable.pop(key, None)
+            self._infos[key] = info
+            expiry = self._now() + self._backoff_duration(key)
+            heapq.heappush(self._backoff, (expiry, info.seq, key))
+            self._update_nominated(info.pod)
+            # wake blocked poppers so they flush the backoff heap when due
+            self._lock.notify()
+
     def scheduling_cycle(self) -> int:
         with self._lock:
             return self._scheduling_cycle

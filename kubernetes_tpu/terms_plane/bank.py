@@ -32,6 +32,7 @@ class TermBankDevice(StageBank):
     state, like StageBank shares the pod slab's."""
 
     THREAD_NAME = "terms-upload"
+    PLANE = "terms"  # fault-plane breaker identity (kubernetes_tpu/faults)
     # slab uploads/scatters ledger under their own kind so the
     # per-dispatch "terms" kind (index/owner vectors vs the legacy
     # full-table upload) stays a clean A/B — the stage-vs-pods split

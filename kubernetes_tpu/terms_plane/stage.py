@@ -266,6 +266,16 @@ class TermStage:
             e = self._entries.get(eid)
             return e is not None and e.gen == gen
 
+    # ktpu: holds(self._lock) callers hold the slab lock (the device-twin
+    # parity probe, TermBankDevice via StageBank.device_divergence)
+    def live_rows_locked(self) -> List[int]:
+        """Row indices currently ALLOCATED (not on the free list) — the
+        only rows the gather can read, so the only rows the parity probe
+        may compare: freeing an entry leaves its device rows stale by
+        design (doc above)."""
+        free = set(self._free)
+        return [r for r in range(self.capacity) if r not in free]
+
     def census(self) -> Dict[str, object]:
         """One lock-disciplined snapshot of the term slab's steady-state
         health (obs/introspect): interned entries, row occupancy,

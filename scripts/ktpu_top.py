@@ -184,6 +184,22 @@ def render_census(doc: Dict) -> str:
             f"divergent={_fmt(audits.get('divergent'))}"
             + (f" LAST DIVERGENCE: {div}" if div else "")
         )
+    faults = p.get("faults") or {}
+    breakers = faults.get("breakers") or {}
+    if breakers:
+        # one line, closed planes compressed — open/half-open breakers
+        # are the thing an operator is looking for
+        bits = []
+        for plane, b in sorted(breakers.items()):
+            if b.get("state") == "closed" and not b.get("trips"):
+                continue
+            bits.append(
+                f"{plane}={b.get('state')}"
+                f"(trips={b.get('trips')},reason={b.get('last_reason')})"
+            )
+        out.append(
+            "breakers " + (" ".join(bits) if bits else "all closed, 0 trips")
+        )
     return "\n".join(out)
 
 
@@ -233,6 +249,17 @@ def render_metrics(parsed: Dict) -> str:
         f"divergent={_fmt(_metric(parsed, 'ktpu_shadow_audit_total', result='divergent'))} "
         f"journal={_fmt(_metric(parsed, 'ktpu_cache_journal_depth'))}"
     )
+    states = parsed.get("ktpu_plane_breaker_state") or {}
+    if states:
+        _NAMES = {0.0: "closed", 1.0: "half_open", 2.0: "open"}
+        bits = [
+            f"{dict(labels).get('plane')}={_NAMES.get(v, v)}"
+            for labels, v in sorted(states.items())
+            if v  # closed breakers stay quiet, like the census render
+        ]
+        out.append(
+            "breakers " + (" ".join(bits) if bits else "all closed")
+        )
     return "\n".join(out)
 
 

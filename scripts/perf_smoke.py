@@ -30,6 +30,17 @@ tests/test_perf_smoke.py; also runnable standalone:
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py terms      # term-bank plane
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py columnar   # columnar cache
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py health     # health monitor
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py faults     # seeded chaos drain
+
+`main_faults()` (mode `faults`) guards the fault plane
+(kubernetes_tpu/faults): a seeded chaos drain — uploader death,
+per-kind device raises, a watch-stream break, bind errors, a
+commit-worker death, and a forced bank skew injected into one mixed +
+preemption workload through the REAL informer replication path — must
+complete with zero lost and zero double-bound pods, every targeted
+plane must trip AND re-close through its shadow-audit-gated probe, the
+skew must surface as a divergent (escalated) audit, and the final audit
+must be clean.
 
 `main_health()` (mode `health`) guards the steady-state health plane
 (kubernetes_tpu/obs/introspect): with the background monitor ON during a
@@ -1292,6 +1303,305 @@ def main_columnar() -> dict:
     return detail
 
 
+FAULTS_SPEC = (
+    # the seeded chaos schedule, by injection site (faults/inject):
+    # counts are CALL indices at each site, chosen so every fault lands
+    # in a known phase of the drain — same spec, same schedule, any run.
+    "uploader-death:ingest@1;"      # first post-warmup uploader wake dies
+    "device-raise:gather-terms@3x3;"  # 3 consecutive → terms breaker trips
+    "device-raise:fold@2x3;"        # 3 consecutive → fold breaker trips
+    "device-raise:apply@2x3;"       # commit worker dies 3× → commit trips
+    "device-raise:solve@8;"         # one solve dispatch raises mid-drain
+    "bind-error@4x2;"               # two bind RPCs fail → backoff requeues
+    "watch-break:pods@30;"          # the pod watch stream breaks mid-drain
+    "bank-skew@5"                   # device bank skewed → divergent audit
+)
+
+#: planes the seeded schedule MUST trip (columns is exercised by the
+#: unit suite; the smoke proves the drain-scale ladder)
+FAULTS_EXPECT_TRIPPED = ("ingest", "terms", "fold", "commit", "mirror")
+
+
+def main_faults() -> dict:
+    """Seeded chaos smoke (kubernetes_tpu/faults): ONE drain through the
+    REAL replication protocol (FakeAPIServer → informers → EventHandlers
+    → queue/cache, binds echo back through the watch) with the full
+    seeded fault schedule injected — uploader death, per-kind device
+    raises, a watch-stream break, bind errors, a commit-worker death,
+    and a forced bank skew — over a mixed (anti + hard-spread + plain)
+    workload plus a preemption wave. Asserts the degradation ladder's
+    acceptance criteria: the drain completes with ZERO lost and ZERO
+    double-bound pods, every fault in the schedule fired, every plane
+    the schedule targets tripped AND re-closed through the audit-gated
+    probe, the final shadow audit is clean, and the recovered planes are
+    COVERED again (index-path dispatches after re-close)."""
+    import threading
+    import time
+
+    import bench
+    from kubernetes_tpu.apiserver.store import FakeAPIServer
+    from kubernetes_tpu.client.informer import APIBinder, start_scheduler_informers
+    from kubernetes_tpu.faults import CLOSED, FaultPlan
+    from kubernetes_tpu.metrics import metrics as M
+    from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+    from kubernetes_tpu.scheduler.eventhandlers import EventHandlers
+    from kubernetes_tpu.state.cache import SchedulerCache
+    from kubernetes_tpu.state.queue import PriorityQueue
+
+    plan = FaultPlan.parse(FAULTS_SPEC)
+    api = FakeAPIServer()
+    nodes = [bench.mk_node(i, zone=bench.ZONES[i % 4]) for i in range(N_NODES)]
+    for n in nodes:
+        api.create("nodes", n)
+
+    cache = SchedulerCache()
+    queue = PriorityQueue()
+    binds: list = []
+    bind_lock = threading.Lock()
+    api_binder = APIBinder(api)
+
+    def counted_bind(pod, node):
+        api_binder.bind(pod, node)  # a raising bind is NOT counted
+        with bind_lock:
+            binds.append(pod.key())
+
+    def delete_victim(p):
+        # kube semantics: deleting an already-gone victim is a no-op (a
+        # second preemption round can race the informer's removal)
+        from kubernetes_tpu.apiserver.store import NotFoundError
+
+        try:
+            api.delete("pods", p.key())
+        except NotFoundError:
+            pass
+
+    sched = Scheduler(
+        cache=cache, queue=queue, binder=Binder(counted_bind),
+        batch_size=SMOKE_BATCH, enable_preemption=True, spec_depth=2,
+        delete_fn=delete_victim,
+        fault_plan=plan,
+    )
+    # smoke-scale breaker cadence: trips must probe within the drain,
+    # but the failure WINDOW stays wide — the schedule's consecutive
+    # site calls land minutes apart at chaos-drain batch cadence
+    for b in sched.faults.breakers.values():
+        b.cooldown_s = 0.75
+        b._cooldown = 0.75
+        b.window_s = 300.0
+    mon = sched.enable_health_monitor(interval=3600, audit_every=0, start=False)
+    # baseline the process-global counters: a full pytest run's earlier
+    # tests already incremented them, and absolute asserts would false-
+    # pass on that history (the PR 10 never-the-shared-registry rule)
+    rpc_fail0 = M.bind_failures.value("rpc")
+    relists0 = int(M.informer_relists.value("pods"))
+    handlers = EventHandlers(cache, queue)
+    informers = start_scheduler_informers(api, handlers, fault_plan=plan)
+    problems = []
+    created = {}
+    try:
+        for inf in informers.values():
+            assert inf.wait_for_sync()
+
+        def create_pending(pods):
+            for p in pods:
+                created[p.key()] = p
+                api.create("pods", p)
+
+        # phase 1: the mixed wave (anti + hard spread + plain) — most of
+        # the schedule lands here
+        _, wave1 = tiny_commit_plane_config()
+        create_pending(wave1)
+        deadline = time.monotonic() + 30
+        while queue.pending_count() < len(wave1) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        sched.warmup()
+
+        def drain(expect_bound, budget_s=120.0):
+            """Drive batches until every expected pod is bound in the
+            apiserver (lost pods would hang here — the budget converts a
+            hang into a failure), servicing faults on idle rounds so
+            open breakers keep probing."""
+            deadline = time.monotonic() + budget_s
+            while time.monotonic() < deadline:
+                bound = sum(
+                    1 for p in api.list("pods")[0] if p.node_name
+                )
+                if bound >= expect_bound and queue.pending_count() == 0:
+                    return True
+                r = sched.schedule_batch()
+                if not (r.scheduled or r.unschedulable or r.errors
+                        or r.deferred):
+                    sched.service_faults()
+                    queue.flush()
+                    time.sleep(0.2)  # backoff requeues / informer lag
+            return False
+
+        if not drain(len(wave1)):
+            problems.append("mixed chaos wave never fully bound")
+        sched.wait_for_binds()
+
+        # phase 2: preemption wave — fill the cluster with BOUND
+        # low-priority victims, then high-priority pods that only fit by
+        # eviction (victim deletes flow through the real API + informer)
+        victims = []
+        for i in range(N_NODES * 3):  # 3 × 9000m of each node's 32 cores
+            p = bench.mk_pod(1_000_000 + i, cpu="9000m", mem="1Gi",
+                             labels={"app": f"lowprio-{i % 4}"})
+            p.priority = 0
+            p.node_name = f"node-{i % N_NODES}"
+            victims.append(p)
+            api.create("pods", p)
+        hiprio = []  # 6000m does NOT fit next to 27000m used: must evict
+        for i in range(500_000, 500_000 + 4):
+            p = bench.mk_pod(i, cpu="6000m", mem="2Gi",
+                             labels={"app": "hiprio"})
+            p.priority = 1000
+            hiprio.append(p)
+        deadline = time.monotonic() + 30
+        while cache.pod_count() < len(wave1) + len(victims) and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)  # victims land in the cache via the informer
+        create_pending(hiprio)
+        deadline = time.monotonic() + 30
+        while queue.pending_count() < len(hiprio) and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        total_created = len(api.list("pods")[0])  # wave1 + victims + hiprio
+        # hiprio pods bind; some victims get DELETED (absent from the
+        # store afterwards) — expected bound = everything still present
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            live = api.list("pods")[0]
+            if all(p.node_name for p in live) and queue.pending_count() == 0:
+                break
+            r = sched.schedule_batch()
+            if not (r.scheduled or r.unschedulable or r.errors or r.deferred):
+                sched.service_faults()
+                queue.flush()
+                time.sleep(0.2)
+        sched.wait_for_binds()
+        live = api.list("pods")[0]
+        if not all(p.node_name for p in live):
+            problems.append(
+                f"{sum(1 for p in live if not p.node_name)} pod(s) left "
+                "unbound after the preemption wave"
+            )
+        n_evicted = total_created - len(live)
+        if not n_evicted:
+            problems.append("no preemption happened — the wave is broken")
+
+        if not plan.exhausted():
+            problems.append(f"schedule not fully delivered: {plan.census()}")
+
+        # phase 3: recovery wave — every tripped plane must re-close
+        # through its audit-gated probe, then run COVERED again
+        idx0 = sched.stats.get("ingest_index_batches", 0)
+        tidx0 = sched.stats.get("term_index_batches", 0)
+        next_recovery = [700_000]  # monotone key source: no re-creates
+
+        deadline = time.monotonic() + 60
+        first_wave = True
+        while time.monotonic() < deadline:
+            states = {p: b.state for p, b in sched.faults.breakers.items()}
+            # at least one recovery wave ALWAYS runs: the re-covered
+            # assertion below needs covered batches after the re-closes
+            if not first_wave and all(s == CLOSED for s in states.values()):
+                break
+            first_wave = False
+            wave = []
+            for _ in range(8):
+                wave.append(bench.mk_pod(next_recovery[0], cpu="100m",
+                                         mem="64Mi"))
+                next_recovery[0] += 1
+            create_pending(wave)
+            t0 = time.monotonic()
+            while queue.pending_count() == 0 and time.monotonic() - t0 < 5:
+                time.sleep(0.01)
+            drain(len(api.list("pods")[0]), budget_s=20.0)
+        sched.wait_for_binds()
+
+        census = sched.faults.census()["breakers"]
+        for plane in FAULTS_EXPECT_TRIPPED:
+            if not census[plane]["trips"]:
+                problems.append(f"plane {plane} never tripped: {census[plane]}")
+        for plane, c in census.items():
+            if c["state"] != CLOSED:
+                problems.append(f"plane {plane} did not re-close: {c}")
+        for plane in ("ingest", "terms", "fold", "commit", "mirror"):
+            if census[plane]["trips"] and not (
+                census[plane]["probes_passed"]
+            ):
+                problems.append(
+                    f"plane {plane} closed without a passed probe: "
+                    f"{census[plane]}"
+                )
+        # recovered planes are COVERED again: index-path dispatches after
+        # the trips (not a permanent legacy fallback)
+        if not sched.stats.get("ingest_index_batches", 0) > idx0:
+            problems.append("ingest plane never re-covered after its trip")
+        if not sched.stats.get("term_index_batches", 0) > tidx0:
+            problems.append("term plane never re-covered after its trip")
+
+        # audits green: the forced skew was caught (divergent >= 1,
+        # escalated) and the FINAL audit on the recovered banks is clean
+        sched._commit_pipe.drain()
+        sched.mirror.sync()
+        final_div = mon.run_shadow_audit()
+        if final_div:
+            problems.append(f"final shadow audit divergent: {final_div}")
+        audits = mon.audit_counts()
+        if not audits.get("divergent"):
+            problems.append(
+                f"the forced bank skew never produced a divergent audit "
+                f"({audits})"
+            )
+        uploader = sched.stage_bank.census()["uploader"]
+        if uploader["restarts"] != 1:
+            problems.append(
+                f"uploader restarted {uploader['restarts']}× (contract: "
+                "exactly once per trip)"
+            )
+        if not uploader["alive"]:
+            problems.append("restarted uploader is not running")
+        if M.bind_failures.value("rpc") - rpc_fail0 < 2:
+            problems.append("injected bind errors were not counted")
+        if int(M.informer_relists.value("pods")) - relists0 < 2:
+            problems.append("the watch break never forced a relist")
+
+        # zero lost / zero double-scheduled: every surviving pod bound
+        # exactly once (victims were deleted, never re-bound)
+        from collections import Counter
+
+        per_key = Counter(binds)
+        dups = {k: v for k, v in per_key.items() if v > 1}
+        if dups:
+            problems.append(f"double-bound pods: {dups}")
+        live = api.list("pods")[0]
+        unbound = [p.key() for p in live if not p.node_name]
+        if unbound:
+            problems.append(f"lost pods (never bound): {unbound[:8]}")
+    finally:
+        for inf in informers.values():
+            inf.stop()
+        sched.close()
+
+    assert not problems, "; ".join(problems)
+    return {
+        "config": "tiny_faults_smoke",
+        "bound": len(binds),
+        "evicted": n_evicted,
+        "breakers": {
+            p: {k: c[k] for k in ("state", "trips", "probes_passed")}
+            for p, c in census.items()
+        },
+        "audits": audits,
+        "plan": plan.census(),
+        "uploader_restarts": uploader["restarts"],
+        "relists": int(M.informer_relists.value("pods")) - relists0,
+        "phase_split_s": dict(sched.stats),
+    }
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
     if mode == "preempt":
@@ -1317,6 +1627,15 @@ if __name__ == "__main__":
             k: d[k] for k in (
                 "config", "scheduled", "audits", "overhead_frac",
                 "misses_after_warmup", "budget_obs", "census_planes",
+            )
+        }))
+        sys.exit(0)
+    elif mode == "faults":
+        d = main_faults()
+        print(json.dumps({
+            k: d[k] for k in (
+                "config", "bound", "evicted", "breakers", "audits",
+                "uploader_restarts", "relists",
             )
         }))
         sys.exit(0)

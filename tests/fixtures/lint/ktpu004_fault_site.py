@@ -1,0 +1,45 @@
+"""MUST-FLAG KTPU004: a fault-injection site that FORCES a device value
+to decide whether to fire, inside a hot-path dispatch function.
+
+The fault plane's injection-site contract (kubernetes_tpu/faults): every
+site lives inside a `# ktpu: hot-path` function and must cost exactly
+ONE attribute read when no FaultPlan is configured — and when one is,
+the trigger decision is a host-side counter (`plan.fire(site)`), never a
+device read. A site that inspects a device bank's VALUE to decide
+("inject only when the bank is non-empty") silently serializes the
+pipelined drain on every dispatch — the exact stall class KTPU004
+exists to catch. The sanctioned idiom is the attribute-read + counted
+raise below.
+"""
+
+import numpy as np
+
+
+class InjectedFault(RuntimeError):
+    pass
+
+
+class Dispatcher:
+    def __init__(self, bank_dev):
+        self.bank_dev = bank_dev
+        self.fault_plan = None
+
+    # ktpu: hot-path
+    def bad_dispatch(self, idx):
+        fp = self.fault_plan
+        if fp is not None:
+            # <- forces a device->host sync ON THE HOT PATH to decide
+            # whether to inject — the site itself became the stall
+            occupied = float(np.asarray(self.bank_dev["rows"]).sum())
+            if occupied > 0 and fp.fire("device-raise"):
+                raise InjectedFault("device-raise")
+        return self.bank_dev["rows"]
+
+    # ktpu: hot-path
+    def good_dispatch(self, idx):
+        # sanctioned injection-site idiom: one attribute read when no
+        # plan is armed; the trigger is a host-side counted schedule
+        fp = self.fault_plan
+        if fp is not None and fp.fire("device-raise"):
+            raise InjectedFault("device-raise")
+        return self.bank_dev["rows"]

@@ -106,12 +106,13 @@ def test_informer_sync_watch_and_relist():
         time.sleep(0.01)
     assert "n3" in seen["add"] and "n1" in seen["update"] and "n0" in seen["delete"]
     # simulate apiserver dropping the watch: the informer must relist
-    before = inf.relist_count
+    before = inf.relists()  # scheduler_informer_relists_total{kind}
     api.close_watchers("nodes")
     deadline = time.time() + 5
-    while inf.relist_count == before and time.time() < deadline:
+    while inf.relists() == before and time.time() < deadline:
         time.sleep(0.01)
-    assert inf.relist_count > before
+    assert inf.relists() > before
+    assert inf.last_relist_reason in ("stream-closed", "gone")
     assert {o.name for o in inf.list()} == {"n1", "n2", "n3"}
     inf.stop()
 

@@ -243,14 +243,15 @@ def test_informer_over_http_survives_stream_drop(served):
     while time.monotonic() < deadline and not store._watchers.get("pods"):
         time.sleep(0.02)
     assert store._watchers.get("pods"), "watch never attached"
-    relists0 = inf.relist_count
+    relists0 = inf.relists()  # scheduler_informer_relists_total{kind}
     store.close_watchers("pods")  # server restart: all streams die
     store.create("pods", make_pod("b"))  # lands while no stream is up
     deadline = time.monotonic() + 10
     while time.monotonic() < deadline and inf.get("default/b") is None:
         time.sleep(0.05)
     assert inf.get("default/b") is not None, "relist never caught up"
-    assert inf.relist_count > relists0
+    assert inf.relists() > relists0
+    assert inf.last_relist_reason in ("stream-closed", "gone")
     inf.stop()
 
 

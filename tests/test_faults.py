@@ -1,0 +1,404 @@
+"""Fault-plane suite (kubernetes_tpu/faults): the breaker state machine
+on a fake clock, the seeded FaultPlan's deterministic schedule, and the
+driver-integrated degradation ladder — trips route planes to their
+legacy paths, recoveries resync from host truth, probes re-close only
+through the shadow-audit gate, and no pod is ever lost or bound twice.
+
+(The full seeded chaos drain — uploader kill + device raises + watch
+break + bind errors + forced skew in one workload — lives in
+scripts/perf_smoke.py `faults` mode, wired into test_perf_smoke with
+KTPU_LOCK_AUDIT=1.)
+"""
+
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.faults import (
+    BreakerBoard,
+    CLOSED,
+    FaultPlan,
+    HALF_OPEN,
+    InjectedFault,
+    OPEN,
+    PLANES,
+)
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.scheduler.driver import Binder, Scheduler
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine (fake clock, no scheduler)
+# ---------------------------------------------------------------------------
+
+def test_breaker_trips_at_counted_threshold_and_cools_down():
+    clk = FakeClock()
+    board = BreakerBoard(clock=clk, threshold=3, cooldown_s=5.0)
+    b = board.breaker("ingest")
+    assert b.closed and board.quiet
+    assert not board.record_failure("ingest", "boom")
+    assert not board.record_failure("ingest", "boom")
+    assert board.record_failure("ingest", "boom")  # third: trip
+    assert b.state == OPEN and not b.closed and not board.quiet
+    assert board.take_recoveries() == ["ingest"]
+    # open: no probe before the cool-down expires
+    assert not board.ok("ingest")
+    clk.advance(4.9)
+    assert not board.ok("ingest")
+    clk.advance(0.2)
+    assert board.ok("ingest")  # half-open: exactly one probe
+    assert b.state == HALF_OPEN and b.probing
+    assert not board.ok("ingest")  # second caller stays legacy
+    b.probe_passed()
+    assert b.state == CLOSED and b.closed
+    board.settle()
+    assert board.quiet
+
+
+def test_breaker_failure_window_restarts_count():
+    """Sporadic faults spread wider than one cool-down must NOT
+    accumulate into a trip (windowed counting)."""
+    clk = FakeClock()
+    board = BreakerBoard(clock=clk, threshold=3, cooldown_s=5.0,
+                         window_s=5.0)
+    for _ in range(5):
+        assert not board.record_failure("fold", "sporadic")
+        clk.advance(6.0)  # wider than the window: count restarts
+    assert board.breaker("fold").state == CLOSED
+    # default window decouples from the cool-down (batch cadence can be
+    # much slower than the probe cadence)
+    assert BreakerBoard().breaker("fold").window_s >= 30.0
+
+
+def test_probe_failure_escalates_cooldown_and_force_trip():
+    clk = FakeClock()
+    board = BreakerBoard(clock=clk, threshold=3, cooldown_s=2.0)
+    b = board.breaker("mirror")
+    assert board.record_failure("mirror", "shadow-divergence", force=True)
+    assert b.state == OPEN  # force: no counted threshold
+    clk.advance(2.1)
+    assert board.ok("mirror")
+    # a fault DURING the probe re-opens with the cool-down doubled
+    assert board.record_failure("mirror", "probe-batch-fault")
+    assert b.state == OPEN and b.probes_failed == 1
+    clk.advance(2.1)
+    assert not board.ok("mirror")  # 4s now, not 2s
+    clk.advance(2.1)
+    assert board.ok("mirror")
+    b.probe_passed()
+    assert b.state == CLOSED and b._cooldown == 2.0  # escalation reset
+
+
+def test_board_census_covers_every_plane():
+    board = BreakerBoard()
+    doc = board.census()
+    assert set(doc["breakers"]) == set(PLANES)
+    assert doc["quiet"] is True
+    for b in doc["breakers"].values():
+        assert b["state"] == CLOSED
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: grammar, determinism, seeded schedules
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parse_grammar_and_counted_fire():
+    p = FaultPlan.parse("device-raise:solve@3x2;bind-error;watch-break:pods@2")
+    specs = [e.spec() for e in p.events]
+    assert specs == ["device-raise:solve@3x2", "bind-error", "watch-break:pods@2"]
+    # counted per (site, arg): fires on call 3 and 4 only
+    fires = [p.fire("device-raise", "solve") for _ in range(5)]
+    assert fires == [False, False, True, True, False]
+    assert p.fire("bind-error")  # @1 default
+    assert not p.fire("watch-break", "pods")
+    assert p.fire("watch-break", "pods")
+    assert p.exhausted()
+    with pytest.raises(ValueError):
+        FaultPlan.parse("bad entry with spaces")
+
+
+def test_fault_plan_seeded_schedule_is_reproducible():
+    sites = [("device-raise", "solve", 10), ("bind-error", "", 6)]
+    a = FaultPlan.seeded(42, sites)
+    b = FaultPlan.seeded(42, sites)
+    c = FaultPlan.seeded(43, sites)
+    assert [e.at for e in a.events] == [e.at for e in b.events]
+    assert [e.at for e in a.events] != [e.at for e in c.events] or a.seed != c.seed
+    assert all(1 <= e.at <= 10 for e in a.events[:1])
+
+
+def test_forced_report_while_open_still_queues_recovery():
+    """An uploader dying DURING another fault's cool-down must still get
+    its recovery: a forced report in the OPEN state queues the plane's
+    repair action even though it cannot re-trip the breaker (otherwise a
+    clean probe would re-close right over the dead thread)."""
+    clk = FakeClock()
+    board = BreakerBoard(clock=clk, threshold=1, cooldown_s=5.0)
+    assert board.record_failure("ingest", "gather-fault")  # trips
+    assert board.take_recoveries() == ["ingest"]
+    # while OPEN: an unforced report queues nothing...
+    assert not board.record_failure("ingest", "another")
+    assert board.take_recoveries() == []
+    # ...but a FORCED one (known-wrong state) queues the recovery
+    assert not board.record_failure("ingest", "uploader-death", force=True)
+    assert board.take_recoveries() == ["ingest"]
+
+
+def test_any_arg_event_counts_site_wide_calls():
+    """'fire on the n-th matching call' for an arg-less event means the
+    n-th call at the SITE, not the n-th call of every distinct arg."""
+    p = FaultPlan.parse("device-raise@2")
+    assert not p.fire("device-raise", "solve")   # site call 1
+    assert p.fire("device-raise", "fold")        # site call 2: fires
+    assert not p.fire("device-raise", "gather-stage")  # call 3: spent
+    assert not p.fire("device-raise", "solve")
+    assert p.exhausted()
+    assert p.events[0].fired == 1  # once total, never once-per-arg
+
+
+def test_raise_if_raises_injected_fault():
+    p = FaultPlan.parse("uploader-death:ingest@1")
+    with pytest.raises(InjectedFault):
+        p.raise_if("uploader-death", "ingest")
+
+
+# ---------------------------------------------------------------------------
+# queue: bind/solve failures take the backoff tier
+# ---------------------------------------------------------------------------
+
+def test_requeue_backoff_exponential_per_pod():
+    now = FakeClock()
+    q = PriorityQueue(now=now)
+    q.add(make_pod("p0"))
+    info = q.pop_batch(1)[0]
+    q.requeue_backoff(info)
+    assert q.counts() == (0, 1, 0)  # backoff tier, not unschedulable
+    assert q.pop_batch(1) == []  # 1s initial backoff holds it
+    now.advance(1.1)
+    info = q.pop_batch(1)[0]
+    # second failure: doubled backoff
+    q.requeue_backoff(info)
+    now.advance(1.1)
+    assert q.pop_batch(1) == []  # 2s now
+    now.advance(1.0)
+    assert len(q.pop_batch(1)) == 1
+
+
+def test_injected_bind_error_requeues_with_backoff_and_metric():
+    from kubernetes_tpu.metrics import metrics as M
+
+    cache = SchedulerCache()
+    for i in range(2):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000))
+    q = PriorityQueue()
+    plan = FaultPlan.parse("bind-error@2")
+    s = Scheduler(cache=cache, queue=q, binder=Binder(), batch_size=8,
+                  enable_preemption=False, fault_plan=plan)
+    rpc0 = M.bind_failures.value("rpc")
+    for i in range(4):
+        q.add(make_pod(f"p{i}", cpu_milli=50))
+    r1 = s.schedule_batch()
+    s.wait_for_binds()
+    assert r1.scheduled == 4  # counted at commit; one bind failed after
+    assert M.bind_failures.value("rpc") == rpc0 + 1
+    # the failed pod is in the BACKOFF tier, not unschedulable
+    active, backoff, unsched = q.counts()
+    assert backoff == 1 and unsched == 0
+    time.sleep(1.1)
+    total = r1.scheduled - 1  # one bind failed
+    for _ in range(10):
+        r = s.run_until_empty()
+        total += r.scheduled
+        if total >= 4:
+            break
+        time.sleep(0.5)
+    s.wait_for_binds()
+    assert total == 4
+    assert s.cache.pod_count() == 4  # bound exactly once each
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# driver integration: trips route to legacy, probes re-close audit-gated
+# ---------------------------------------------------------------------------
+
+def _mini_sched(plan=None, pods=32, cooldown=1.0):
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu_milli=8000))
+    q = PriorityQueue()
+    s = Scheduler(cache=cache, queue=q, binder=Binder(), batch_size=8,
+                  enable_preemption=False, fault_plan=plan)
+    clk = FakeClock()
+    s.faults = BreakerBoard(clock=clk, cooldown_s=cooldown)
+    for i in range(pods):
+        q.add(make_pod(f"p{i}", cpu_milli=50))
+    return s, q, clk
+
+
+def _drain(s, q, clk, want, max_cycles=80, step=0.5):
+    total = 0
+    for _ in range(max_cycles):
+        r = s.schedule_batch()
+        total += r.scheduled
+        clk.advance(step)
+        if total >= want:
+            break
+        if not (r.scheduled or r.unschedulable or r.errors or r.deferred):
+            q.flush()
+            time.sleep(0.25)  # let backoff requeues expire
+    s.wait_for_binds()
+    return total
+
+
+def test_gather_faults_trip_ingest_breaker_then_probe_recloses():
+    plan = FaultPlan.parse("device-raise:gather-stage@2x3")
+    s, q, clk = _mini_sched(plan, pods=64)
+    total = _drain(s, q, clk, want=64)
+    assert total == 64
+    c = s.faults.census()["breakers"]["ingest"]
+    assert c["trips"] == 1 and c["state"] == CLOSED and c["probes_passed"] >= 1
+    # while open, dispatches took the LEGACY host path (counted)
+    assert s.stats.get("ingest_legacy_batches", 0) >= 1
+    assert s.stats.get("ingest_fault_batches", 0) == 3
+    assert plan.exhausted()
+    s.close()
+
+
+def test_solve_fault_errors_requeue_and_drain_completes():
+    plan = FaultPlan.parse("device-raise:solve@2")
+    s, q, clk = _mini_sched(plan, pods=32)
+    total = _drain(s, q, clk, want=32)
+    assert total == 32
+    assert plan.exhausted()
+    assert s.cache.pod_count() == 32
+    s.close()
+
+
+def test_uploader_death_restarts_exactly_once_per_trip():
+    plan = FaultPlan.parse("uploader-death:ingest@1")
+    s, q, clk = _mini_sched(plan, pods=32)
+    s.warmup()  # arms the uploader threads
+    # let the uploader wake, hit the injected death, and report
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not s.faults.breakers["ingest"].trips:
+        s.stage.on_dirty()  # wake the (possibly already dead) worker
+        time.sleep(0.05)
+    assert s.faults.breakers["ingest"].trips == 1  # force-trip on death
+    total = _drain(s, q, clk, want=32)
+    assert total == 32
+    bank = s.stage_bank.census()["uploader"]
+    assert bank["restarts"] == 1
+    assert bank["alive"] is True  # the restarted worker is running
+    assert "uploader-death" in str(bank["last_error"])
+    s.close()
+
+
+def test_fold_fault_resyncs_banks_and_audit_stays_clean():
+    plan = FaultPlan.parse("device-raise:fold@1x3")
+    s, q, clk = _mini_sched(plan, pods=64)
+    total = _drain(s, q, clk, want=64)
+    assert total == 64
+    c = s.faults.census()["breakers"]["fold"]
+    assert c["trips"] == 1
+    # banks resynced from host truth: the parity probe must be clean
+    s.service_faults()
+    s.mirror.device_arrays()
+    assert s.mirror.device_bank_divergence() == []
+    s.close()
+
+
+def test_columns_fault_detaches_inline_and_probe_reattaches():
+    plan = FaultPlan.parse("device-raise:columns@2")
+    s, q, clk = _mini_sched(plan, pods=48)
+    assert s.cache._columns is not None
+    total = _drain(s, q, clk, want=48)
+    assert total == 48
+    c = s.faults.census()["breakers"]["columns"]
+    assert c["trips"] == 1
+    # the inline detach preserved object truth mid-batch (every pod
+    # landed exactly once in the NodeInfo views)
+    assert s.cache.pod_count() == 48
+    # the probe re-attached fresh columns and the audit re-closed it
+    assert c["state"] == CLOSED
+    assert s.cache._columns is not None
+    s.close()
+
+
+def test_shadow_divergence_escalates_trip_resync_blackbox(tmp_path, monkeypatch):
+    monkeypatch.setenv("KTPU_BLACKBOX_DIR", str(tmp_path))
+    from kubernetes_tpu.faults.inject import apply_bank_skew
+    from kubernetes_tpu.metrics import metrics as M
+
+    s, q, clk = _mini_sched(None, pods=16)
+    mon = s.enable_health_monitor(interval=3600, audit_every=0, start=False)
+    total = _drain(s, q, clk, want=16)
+    assert total == 16
+    d0 = M.shadow_audit.value("divergent")
+    s._commit_pipe.drain()
+    s.mirror.sync()
+    s.mirror.device_arrays()
+    apply_bank_skew(s.mirror)
+    div = mon.run_shadow_audit()
+    assert div, "forced skew must be detected"
+    assert M.shadow_audit.value("divergent") == d0 + 1
+    # escalation: metric → automatic trip + queued resync
+    b = s.faults.breakers["mirror"]
+    assert b.trips == 1 and b.last_reason == "shadow-divergence"
+    # the driver's next safe point resyncs + probes + re-closes
+    s.service_faults()  # recovery (resync queued at trip)
+    clk.advance(10.0)
+    s.service_faults()  # half-open
+    s.service_faults()  # audit-gated close
+    assert b.state == CLOSED
+    assert mon.run_shadow_audit() == []  # resynced from host truth
+    s.close()
+
+
+def test_no_fault_plan_means_no_plan_attribute_and_quiet_board():
+    """The zero-overhead contract: without KTPU_FAULTS / fault_plan, every
+    injection site sees None (one attribute read) and the board is quiet
+    (one bool read per batch)."""
+    s, q, clk = _mini_sched(None, pods=8)
+    assert s._fault_plan is None
+    assert s.mirror.fault_plan is None
+    assert s.stage_bank.fault_plan is None
+    assert s.cache._columns is not None and s.cache._columns.fault_hook is None
+    assert s.faults.quiet
+    total = _drain(s, q, clk, want=8)
+    assert total == 8
+    assert s.faults.quiet and s.faults.trips_total() == 0
+    s.close()
+
+
+def test_census_and_gauges_reflect_breaker_transitions():
+    from kubernetes_tpu.metrics import metrics as M
+    from kubernetes_tpu.obs import introspect as insp
+
+    plan = FaultPlan.parse("device-raise:gather-stage@1x3")
+    s, q, clk = _mini_sched(plan, pods=48)
+    total = _drain(s, q, clk, want=48)
+    assert total == 48
+    doc = insp.census(s)
+    assert insp.validate_census(doc) == []
+    faults = doc["planes"]["faults"]
+    assert faults["breakers"]["ingest"]["trips"] == 1
+    assert faults["plan"]["events"][0]["fired"] == 3
+    assert M.plane_trips.value("ingest", "InjectedFault") >= 1
+    assert M.plane_breaker_state.value("ingest") == 0.0  # re-closed
+    s.close()

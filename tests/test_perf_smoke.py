@@ -225,3 +225,41 @@ def test_perf_smoke_ingest_plane(tmp_path, monkeypatch):
     assert detail["mirror_rebuilds"] == 0
     assert detail["compile"]["misses_after_warmup"] == 0
     assert detail["scheduled"] == perf_smoke.N_PODS + perf_smoke.N_UNIQ
+
+
+def test_perf_smoke_fault_plane_chaos(tmp_path, monkeypatch):
+    """Fault-plane acceptance, tier-1-fast: the seeded chaos drain
+    (uploader kill + per-kind device raises + watch break + bind errors
+    + commit-worker death + forced bank skew over a mixed + preemption
+    workload, through the REAL informer replication path) must complete
+    with zero lost and zero double-bound pods, every targeted plane must
+    trip AND re-close through its shadow-audit-gated probe, the forced
+    skew must surface as a divergent audit (escalated: trip + resync +
+    black box), and the final audit must be clean — all under the
+    lock-order audit."""
+    monkeypatch.setenv("KTPU_COMPILE_CACHE_DIR", str(tmp_path / "plan"))
+    monkeypatch.setenv("KTPU_BLACKBOX_DIR", str(tmp_path / "bb"))
+    monkeypatch.setenv("KTPU_LOCK_AUDIT", "1")
+    monkeypatch.delenv("KTPU_FAULTS", raising=False)
+    from kubernetes_tpu.analysis.lockorder import REGISTRY
+
+    REGISTRY.reset()
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main_faults()  # raises AssertionError on regression
+    REGISTRY.assert_acyclic()
+    report = REGISTRY.report()
+    assert report["acquisitions"] > 0 and report["edges"]
+    # (no edge assertion for the board's own "faults" lock: it is a LEAF
+    # by contract — its only neighbors are the metric locks, which are
+    # plain primitives when metrics.py was imported before the audit env
+    # was set, as happens in the full suite)
+    for plane in perf_smoke.FAULTS_EXPECT_TRIPPED:
+        b = detail["breakers"][plane]
+        assert b["trips"] >= 1 and b["state"] == "closed", (plane, b)
+        assert b["probes_passed"] >= 1, (plane, b)
+    assert detail["audits"].get("divergent", 0) >= 1
+    assert detail["uploader_restarts"] == 1
+    assert detail["evicted"] > 0  # the preemption wave really preempted

@@ -116,6 +116,16 @@ def test_ktpu002_obs_resolver_allowlisted_in_tree():
     assert not [v.render() for v in got if v.rule in ("KTPU002", "KTPU004")]
 
 
+def test_ktpu004_fault_injection_site_idiom():
+    """The fault plane's injection-site contract: a site that forces a
+    device value to decide whether to fire inside a hot-path dispatch
+    flags; the attribute-read + counted-raise idiom does not."""
+    got = scan_fixture("ktpu004_fault_site.py")
+    scopes = rules_by_scope(got)
+    assert ("KTPU004", "Dispatcher.bad_dispatch") in scopes
+    assert ("KTPU004", "Dispatcher.good_dispatch") not in scopes
+
+
 def test_ktpu003_flags_unlocked_guarded_access():
     """PR 5's unlocked vocab-slot interning: guarded attr accessed outside
     the lock flags; with-block, _locked suffix and holds() pass."""
