@@ -467,6 +467,25 @@ class _Handler(BaseHTTPRequestHandler):
         if r is None:
             return self._send_json(404, _status(404, "NotFound", self.path))
         kind, rest, _, _ns_scope = r
+        # pod status subresource (PUT .../pods/{ns}/{name}/status): the
+        # scheduler's preemption nomination write. Status-only — the
+        # store patches nominatedNodeName and nothing else, so it can
+        # never clobber a concurrent bind's spec.nodeName.
+        if kind == "pods" and len(rest) == 3 and rest[2] == "status":
+            if not self._auth("update", "pods/status", rest[0]):
+                return
+            try:
+                body = self._read_body()
+                nominated = (body.get("status") or {}).get("nominatedNodeName")
+            except Exception as e:
+                return self._send_json(400, _status(400, "BadRequest", str(e)))
+            try:
+                updated = self.store.update_pod_status(
+                    rest[0], rest[1], nominated_node_name=nominated,
+                )
+            except NotFoundError as e:
+                return self._send_json(404, _status(404, "NotFound", str(e)))
+            return self._send_json(200, pod_to_k8s(updated))
         codec = _CODECS.get(kind)
         if codec is None or self._obj_key(kind, rest) is None:
             return self._send_json(404, _status(404, "NotFound", self.path))

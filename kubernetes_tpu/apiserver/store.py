@@ -413,21 +413,59 @@ class FakeAPIServer:
 
     def bind(self, namespace: str, name: str, node_name: str) -> None:
         """POST pods/<p>/binding: sets spec.nodeName (registry/core/pod/rest
-        BindingREST semantics — fails if already bound elsewhere)."""
+        BindingREST semantics — 409 Conflict for ANY already-bound pod,
+        including a re-bind to the same node: the real BindingREST fails
+        whenever spec.nodeName is set. The SAME-node Conflict is the
+        crash-restart plane's idempotency signal — a binder replaying a
+        bind whose first attempt actually landed (process death between
+        the POST and its bookkeeping) gets a 409 it can verify against
+        the bound node and treat as success (client/informer.APIBinder);
+        a DIFFERENT-node Conflict is a double-schedule and escalates.
+        Binding also clears status.nominatedNodeName: the pod stopped
+        being a pending nominee the moment it landed (the store-side
+        half of the nomination wire round-trip)."""
         key = f"{namespace}/{name}"
         with self._lock:
             pods = self._objects.setdefault("pods", {})
             pod = pods.get(key)
             if pod is None:
                 raise NotFoundError(key)
-            if pod.node_name and pod.node_name != node_name:
+            if pod.node_name:
                 raise ConflictError(f"pod {key} already bound to {pod.node_name}")
             prev = pod
             pod = copy.deepcopy(pod)
             pod.node_name = node_name
+            pod.nominated_node_name = ""
             pod.resource_version = str(self._bump())
             pods[key] = pod
             if self._wal is not None:
                 self._wal.append("PUT", "pods", key, self._current_rv, pod)
                 self._wal.maybe_compact(self._objects, self._current_rv)
             self._emit("pods", MODIFIED, copy.deepcopy(pod), self._current_rv, old=prev)
+
+    def update_pod_status(self, namespace: str, name: str, *,
+                          nominated_node_name: Optional[str] = None) -> Any:
+        """PUT pods/<p>/status (the scheduler's preemption nomination
+        write, scheduler.go:436-470 podPreemptor.SetNominatedNodeName):
+        patches ONLY status fields — spec and labels are untouched, so a
+        concurrent bind can never be clobbered by a racing nomination.
+        The write is durable (WAL) and watched like any MODIFIED, which
+        is what lets a restarted scheduler reconstruct the nominated-pod
+        overlay from a plain relist."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pods = self._objects.setdefault("pods", {})
+            pod = pods.get(key)
+            if pod is None:
+                raise NotFoundError(key)
+            prev = pod
+            pod = copy.deepcopy(pod)
+            if nominated_node_name is not None:
+                pod.nominated_node_name = nominated_node_name
+            pod.resource_version = str(self._bump())
+            pods[key] = pod
+            if self._wal is not None:
+                self._wal.append("PUT", "pods", key, self._current_rv, pod)
+                self._wal.maybe_compact(self._objects, self._current_rv)
+            self._emit("pods", MODIFIED, copy.deepcopy(pod), self._current_rv, old=prev)
+            return copy.deepcopy(pod)

@@ -40,7 +40,15 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from ..analysis.lockorder import audited_lock
-from ..apiserver.store import ADDED, DELETED, MODIFIED, FakeAPIServer, GoneError, _key_of
+from ..apiserver.store import (
+    ADDED,
+    ConflictError,
+    DELETED,
+    FakeAPIServer,
+    GoneError,
+    MODIFIED,
+    _key_of,
+)
 from ..metrics import metrics as M
 
 logger = logging.getLogger("kubernetes_tpu.informer")
@@ -320,13 +328,45 @@ def start_scheduler_informers(
     return {"pods": pods, "nodes": nodes}
 
 
+class BindMismatchError(ConflictError):
+    """A bind 409 whose pod is bound to a DIFFERENT node than asked — a
+    double-schedule, never a replay. Escalates through the bind-failure
+    path (backoff + scheduler_bind_failures_total) after being counted
+    loudly as outcome=mismatch."""
+
+
 class APIBinder:
     """Binder that POSTs the binding subresource at the fake apiserver —
     the real bind path (factory.go:713-725): the informer's MODIFIED echo
-    confirms the assumed pod in the cache."""
+    confirms the assumed pod in the cache.
+
+    IDEMPOTENT under at-least-once delivery: the binding subresource
+    409s for ANY already-bound pod (BindingREST semantics), so a bind
+    replayed after a crash — the POST landed, the process died before
+    the bookkeeping, the restarted drain re-issues it — resolves the
+    Conflict by reading the pod back: bound to the SAME node means the
+    first attempt won and this one counts as success (outcome=benign,
+    scheduler_bind_conflicts_total); a DIFFERENT node means a real
+    double-schedule and raises BindMismatchError. The commit path
+    therefore never routes a benign replay to the bind-failure backoff
+    tier."""
 
     def __init__(self, api: FakeAPIServer):
         self.api = api
 
     def bind(self, pod, node_name: str) -> None:
-        self.api.bind(pod.namespace, pod.name, node_name)
+        try:
+            self.api.bind(pod.namespace, pod.name, node_name)
+        except ConflictError as e:
+            try:
+                bound = self.api.get("pods", pod.key()).node_name
+            except Exception:
+                bound = None
+            if bound == node_name:
+                M.bind_conflicts.inc("benign")
+                return  # replay of a bind that already landed: success
+            M.bind_conflicts.inc("mismatch")
+            raise BindMismatchError(
+                f"pod {pod.key()}: asked {node_name}, bound to {bound!r} "
+                f"({e})"
+            ) from e

@@ -286,3 +286,15 @@ class RemoteAPIServer:
             f"/api/v1/pods/{namespace}/{name}/binding",
             {"target": {"kind": "Node", "name": node_name}},
         )
+
+    def update_pod_status(self, namespace: str, name: str, *,
+                          nominated_node_name=None) -> Any:
+        """PUT pods/{name}/status — the preemption nomination write,
+        FakeAPIServer.update_pod_status's surface over the wire."""
+        _, from_k8s = _CODECS["pods"]
+        body = {"status": {}}
+        if nominated_node_name is not None:
+            body["status"]["nominatedNodeName"] = nominated_node_name
+        return from_k8s(
+            self._req("PUT", f"/api/v1/pods/{namespace}/{name}/status", body)
+        )

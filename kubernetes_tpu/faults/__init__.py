@@ -28,9 +28,19 @@ from .breaker import (
     PLANES,
     PlaneBreaker,
 )
-from .inject import FaultEvent, FaultPlan, InjectedFault, apply_bank_skew, plan_from_env
+from .inject import (
+    CRASH_SITE,
+    FaultEvent,
+    FaultPlan,
+    InjectedFault,
+    SimulatedCrash,
+    apply_bank_skew,
+    plan_from_env,
+)
 
 __all__ = [
+    "CRASH_SITE",
+    "SimulatedCrash",
     "BreakerBoard",
     "CLOSED",
     "DEFAULT_COOLDOWN_S",

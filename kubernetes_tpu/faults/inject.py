@@ -23,12 +23,33 @@ Registered sites (driver + banks + informer + monitor sync point):
   ``bank-skew``        (no arg)           a device bank row is corrupted (+1),
                        so the next shadow audit reports divergence
 
+KILL-POINTS (the crash-restart harness, ``kubernetes_tpu/restart``): the
+``crash`` site simulates ``kill -9`` at a named pipeline stage — it
+raises ``SimulatedCrash`` (a BaseException on purpose: every ``except
+Exception`` fault handler in the pipeline must NOT absorb a process
+death — nothing recovers, nothing rolls back, the supervisor rebuilds
+the whole instance from the API server) and latches ``plan.crashed`` so
+the dead instance's surviving threads are fenced from the API server
+(``crash_gate``). Registered kill-points, by arg:
+
+  ``crash``  arg=post-solve          after the solve result lands, before
+                                     any commit touches the cache
+             arg=mid-apply           on the commit worker, mid columnar
+                                     apply (assumes landed, zero binds)
+             arg=mid-bind-chunk      between two binds of one lean chunk
+             arg=post-bind           after a bind POST landed, before the
+                                     confirm/finish bookkeeping
+             arg=mid-preemption      between victim eviction and the
+                                     preemptor's nomination write
+             arg=mid-uploader-flush  inside a staged-bank dirty-row flush
+
 Spec grammar (``KTPU_FAULTS`` / ``FaultPlan.parse``), semicolon-joined:
 
     site[:arg][@n][xk]     fire on the n-th matching call (default 1),
                            k consecutive times (default 1)
 
     KTPU_FAULTS="uploader-death:ingest@2;device-raise:solve@3x2;bank-skew@4"
+    KTPU_FAULTS="crash:mid-bind-chunk@2"   # die at the 2nd chunk boundary
 
 ``FaultPlan.seeded(seed, sites)`` draws each event's trigger count from
 ``random.Random(seed)`` instead — same seed, same schedule, every run
@@ -47,6 +68,22 @@ class InjectedFault(RuntimeError):
     """Raised by an injection site the active FaultPlan triggered. A
     plain RuntimeError subclass on purpose: the pipeline's fault handling
     must treat it exactly like the real failure it stands in for."""
+
+
+#: the ``crash`` injection site's name (kill-points pass the stage as arg)
+CRASH_SITE = "crash"
+
+
+class SimulatedCrash(BaseException):
+    """A deterministic stand-in for ``kill -9`` at a pipeline kill-point.
+
+    BaseException, NOT Exception, on purpose: the fault plane's handlers
+    (fold fallback, commit-worker unwind, bank death recording, the
+    black-box dump) all catch ``Exception`` — a process death must sail
+    through every one of them untouched, exactly like a real SIGKILL
+    gives no thread a chance to clean up. Only the restart supervisor
+    (``kubernetes_tpu/restart``) catches it, and its response is to
+    abandon the instance and rebuild from the API server."""
 
 
 @dataclass
@@ -79,6 +116,14 @@ class FaultPlan:
         self._counts: Dict[Tuple[str, Optional[str]], int] = {}
         self._fired: List[str] = []
         self._lock = threading.Lock()
+        # latched by the FIRST crash kill-point to fire (the stage name):
+        # the supervisor polls it to detect deaths on worker threads, and
+        # crash_gate() fences the dead instance's surviving threads off
+        # the API server — kill -9 stops every thread at once; this is
+        # the in-process equivalent. Never reset: a plan is one process
+        # lifetime, the supervisor hands the next incarnation a fresh
+        # view via `rearm()`.
+        self.crashed: Optional[str] = None
 
     # -- construction --------------------------------------------------------
 
@@ -159,6 +204,43 @@ class FaultPlan:
         """fire() + raise — the one-liner most sites use."""
         if self.fire(site, arg):
             raise InjectedFault(f"injected: {site}" + (f":{arg}" if arg else ""))
+
+    # -- kill-points (crash-restart harness) ---------------------------------
+
+    def crash_if(self, point: str) -> None:
+        """The kill-point one-liner: counted like any site, but a firing
+        ``crash:<point>`` latches ``crashed`` BEFORE raising, so every
+        other thread's next ``crash_gate()`` dies too — the whole
+        instance stops acting, not just the thread that hit the point."""
+        if self.crashed is not None:
+            raise SimulatedCrash(self.crashed)
+        if self.fire(CRASH_SITE, point):
+            self.crashed = point
+            raise SimulatedCrash(point)
+
+    def crash_gate(self) -> None:
+        """Fence for outward-facing writes (binds, victim deletes,
+        nomination patches): once any kill-point fired, the dead
+        instance's surviving threads must not keep mutating the API
+        server. One attribute read when no crash has happened."""
+        if self.crashed is not None:
+            raise SimulatedCrash(self.crashed)
+
+    def rearm(self) -> "FaultPlan":
+        """The restarted incarnation's view of the SAME schedule: shared
+        events and call counts (a ``crash:<site>@n`` that fired stays
+        fired — the matrix drives one kill per cell unless the spec says
+        otherwise), but a cleared ``crashed`` latch so the new instance's
+        writes pass the gate. Returns a plan sharing this plan's
+        bookkeeping."""
+        twin = FaultPlan.__new__(FaultPlan)
+        twin.events = self.events
+        twin.seed = self.seed
+        twin._counts = self._counts
+        twin._fired = self._fired
+        twin._lock = self._lock
+        twin.crashed = None
+        return twin
 
     def exhausted(self) -> bool:
         """True once every scheduled event has fully fired — the chaos

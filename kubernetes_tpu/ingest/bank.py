@@ -161,6 +161,15 @@ class StageBank:
         """Ship the slab's dirty rows into the device dict (stage lock
         held). Full upload on first use or after a slab rebuild."""
         stage = self.stage
+        fp = self.fault_plan
+        if fp is not None:
+            # kill-point (crash-restart harness): die inside a bank
+            # upload — full upload (warmup/resync: the process dies
+            # DURING reconciliation) or dirty-row flush (rows half-
+            # shipped, the twin torn). Nothing recovers here; the
+            # restarted instance rebuilds the slab from the relisted
+            # queue and re-uploads from host truth.
+            fp.crash_if("mid-uploader-flush")
         if self._dev is None or self._dev_generation != stage.generation:
             with (_REC.span("upload", kind="full", sync=sync)
                   if _REC.enabled else _NOOP):
@@ -294,7 +303,16 @@ class StageBank:
                     # take seconds; admissions and dispatches must not block
                     # on them), before any flush admits the new programs
                     self._warm_synthetic()
-        except Exception as e:
+        except BaseException as e:
+            from ..faults.inject import SimulatedCrash
+
+            if isinstance(e, SimulatedCrash):
+                # kill -9 (crash-restart harness): the thread just stops
+                # — no breaker report, no bookkeeping, nothing recovers;
+                # the supervisor rebuilds the whole instance
+                return
+            if not isinstance(e, Exception):
+                raise  # KeyboardInterrupt/SystemExit: not ours to handle
             # the drain thread is DYING — until now this was invisible
             # (a daemon thread's death just stops the off-thread flushes;
             # dispatch-time sync flushes keep the plane correct, slower).
@@ -422,6 +440,16 @@ class StageBank:
         return out
 
     def close(self) -> None:
+        """Graceful shutdown: flush the dirty backlog synchronously (a
+        clean close must not strand rows the uploader hadn't shipped —
+        the device twin stays host-true to the last admission), then
+        stop and join the worker with a bounded timeout. Idempotent."""
+        try:
+            with self._lock:
+                if self._dev is not None and self.stage.dirty_rows:
+                    self._flush_locked(sync=True)
+        except Exception:
+            pass  # a broken flush must not block shutdown
         self._stop.set()
         self._wake.set()
         w = self._worker

@@ -345,6 +345,36 @@ informer_relists = registry.register(Counter(
     "replication-health counter next to the queue gauges",
     label_names=("kind",),
 ))
+bind_conflicts = registry.register(Counter(
+    "scheduler_bind_conflicts_total",
+    "409 Conflicts from the pods/binding subresource by outcome: benign "
+    "= the pod is already bound to the SAME node the binder asked for "
+    "(an at-least-once replay — crash between the bind POST and its "
+    "bookkeeping, or a retried RPC whose first attempt landed — counted "
+    "and treated as success, never routed to the bind-failure backoff "
+    "tier), mismatch = bound to a DIFFERENT node (a double-schedule; "
+    "escalates as a real bind failure)",
+    label_names=("outcome",),
+))
+restarts = registry.register(Counter(
+    "scheduler_restarts_total",
+    "Cold starts reconciled by the crash-restart plane "
+    "(kubernetes_tpu/restart): each count is one full rebuild of the "
+    "scheduler's device-resident state from an API-server relist",
+))
+restart_reconcile_duration = registry.register(Histogram(
+    "scheduler_restart_reconcile_duration_seconds",
+    "Cold-start reconciliation wall by phase (kubernetes_tpu/restart): "
+    "relist (the API-server list round-trips), nodes (cache/columns "
+    "node rebuild), assume (bulk re-assume of bound pods through the "
+    "columnar path), queue (pending re-admission through the ingest/"
+    "term slabs), nominations (nominated-pod overlay reconstruction), "
+    "banks (TensorMirror/staged-bank device rebuild), warmup (compile-"
+    "plan re-warm from the persistent ladder), informers (reflector "
+    "start + initial sync)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+    label_names=("phase",),
+))
 uploader_stalled = registry.register(Gauge(
     "ktpu_uploader_stalled",
     "1 while a plane's background uploader thread is dead/stalled with "

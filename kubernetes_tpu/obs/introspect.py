@@ -61,14 +61,18 @@ from ..metrics import metrics as M
 #: v2: staged-bank blocks grew the `uploader` liveness sub-block
 #: (heartbeat/alive/restarts) and the document grew the `faults` plane
 #: (per-plane breaker census, kubernetes_tpu/faults).
-SCHEMA_VERSION = 2
+#: v3: the document grew the `restart` plane (crash-restart plane,
+#: kubernetes_tpu/restart): reconciled flag + the last cold-start's
+#: phase-timed report, so ktpu_top answers "when did this instance last
+#: rebuild, and what did each reconciliation phase cost".
+SCHEMA_VERSION = 3
 
 #: every plane block a census document must carry (the six
 #: device-residency planes + the cache + the ladder + the recorder +
-#: the fault plane's breaker board)
+#: the fault plane's breaker board + the crash-restart plane)
 REQUIRED_PLANES = (
     "queue", "ingest", "terms", "cache", "mirror", "compile", "commit",
-    "recorder", "faults",
+    "recorder", "faults", "restart",
 )
 
 #: per-plane keys validate_census demands when the plane is enabled
@@ -92,6 +96,7 @@ _REQUIRED_KEYS = {
     "recorder": ("enabled", "pending_device", "dropped_pending",
                  "blackbox_records"),
     "faults": ("quiet", "breakers"),
+    "restart": ("reconciled",),
 }
 
 
@@ -154,6 +159,17 @@ def recorder_census(rec) -> Dict:
 
 
 # ktpu: hot-path
+def restart_census(sched) -> Dict:
+    """The crash-restart plane's block: whether this instance was cold-
+    start reconciled (kubernetes_tpu/restart) and, if so, the last
+    reconciliation's phase-timed report. Counters and strings only."""
+    report = getattr(sched, "restart_report", None)
+    if not report:
+        return {"reconciled": False}
+    return {"reconciled": True, "last": report}
+
+
+# ktpu: hot-path
 def faults_census(sched) -> Dict:
     """The breaker board's block (kubernetes_tpu/faults): per-plane
     state/trips/probes plus the active FaultPlan schedule when injection
@@ -202,6 +218,7 @@ def census(sched, monitor: Optional["HealthMonitor"] = None) -> Dict:
             "commit": commit_census(sched._commit_pipe),
             "recorder": recorder_census(sched.obs),
             "faults": faults_census(sched),
+            "restart": restart_census(sched),
         },
     }
     if mon is not None:

@@ -1,0 +1,42 @@
+"""Crash-restart plane: cold-start reconciliation + kill-point chaos.
+
+The process-level complement of the fault plane (kubernetes_tpu/faults):
+PR 13 made the scheduler survive plane faults INSIDE a live process;
+this package makes the process itself killable anywhere — ``kill -9``
+mid-drain, mid-bind, mid-preemption — and restartable with zero lost
+and zero double-bound pods, because the API server is the only durable
+state and everything device-resident is reconstructible from a relist.
+
+* ``reconcile`` — ``cold_start``: the phase-timed rebuild (relist →
+  nodes → bulk columnar re-assume → queue/slab re-admission →
+  nomination overlay → informers → bank resync → persistent-ladder
+  re-warm).
+* ``supervisor`` — the deterministic crash harness: ``crash:<site>``
+  kill-points (faults/inject) raise ``SimulatedCrash``, the Supervisor
+  buries the dead instance, rebuilds, reconciles, resumes.
+* ``invariants`` — ``check_invariants``: the per-cell acceptance gate
+  (zero lost, zero double-bound, no over-commit, clean shadow audit).
+"""
+
+from .invariants import check_invariants, check_overcommit
+from .reconcile import PHASES, ReconcileReport, cold_start
+from .supervisor import (
+    Incarnation,
+    Supervisor,
+    SupervisorReport,
+    make_scheduler_factory,
+    run_cell,
+)
+
+__all__ = [
+    "PHASES",
+    "Incarnation",
+    "ReconcileReport",
+    "Supervisor",
+    "SupervisorReport",
+    "check_invariants",
+    "check_overcommit",
+    "cold_start",
+    "make_scheduler_factory",
+    "run_cell",
+]

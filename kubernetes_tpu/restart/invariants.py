@@ -1,0 +1,125 @@
+"""The restart matrix's invariant checker: what MUST hold after any
+kill × restart × resumed-drain cell.
+
+* **Zero lost pods** — every pod ever created is either bound in the
+  store, legitimately evicted (a preemption victim — the caller names
+  the evictable set), or still present and pending (a completed drain
+  has none of those). A pod in NONE of these states died with a process
+  and was never reconstructed: the exact bug class this plane exists to
+  kill.
+* **Zero double-bound pods** — structural at the store (the binding
+  subresource 409s any re-bind), so the checker asserts the conflict
+  ledger: ``mismatch`` outcomes must be zero (a mismatch means some
+  incarnation tried to bind a pod somewhere else — a double-schedule
+  the fence caught; benign outcomes are expected replays and fine).
+* **No node over-commit** — per node, the bound pods' accumulated
+  requests fit the allocatable for every resource, pod count included.
+  Over-commit is how a missed re-assume manifests: the restarted
+  scheduler solves against capacity the dead process already spent.
+* **Clean shadow audit** — the surviving instance's device banks and
+  columns are bit-true to host truth (the PR 10 probe at the PR 13
+  sync point): a restart must not leave the device planes quietly
+  skewed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+
+def check_invariants(
+    api,
+    created_keys: Sequence[str],
+    evictable_keys: Sequence[str] = (),
+    sched=None,
+    mismatch_conflicts: Optional[int] = None,
+) -> List[str]:
+    """Return the list of violated invariants (empty = cell green).
+    `mismatch_conflicts` is the DELTA of
+    ``scheduler_bind_conflicts_total{outcome=mismatch}`` over the cell
+    (the caller baselines the process-global counter). `sched` (the
+    surviving instance, driver thread) arms the shadow-audit check."""
+    problems: List[str] = []
+    pods, _ = api.list("pods")
+    by_key = {p.key(): p for p in pods}
+    evictable: Set[str] = set(evictable_keys)
+
+    # zero lost
+    lost = [
+        k for k in created_keys
+        if k not in by_key and k not in evictable
+    ]
+    if lost:
+        problems.append(f"lost pods (absent from the store): {sorted(lost)[:8]}")
+    unbound = [k for k, p in by_key.items() if not p.node_name]
+    if unbound:
+        problems.append(
+            f"{len(unbound)} pod(s) present but never bound: "
+            f"{sorted(unbound)[:8]}"
+        )
+
+    # zero double-bound
+    if mismatch_conflicts:
+        problems.append(
+            f"{mismatch_conflicts} mismatch bind conflict(s): some "
+            "incarnation attempted to bind an already-bound pod to a "
+            "DIFFERENT node"
+        )
+
+    # no node over-commit
+    problems.extend(check_overcommit(api))
+
+    # clean shadow audit on the survivor
+    if sched is not None:
+        try:
+            sched._commit_pipe.drain()
+            sched.mirror.sync()
+            div = sched._probe_divergence(["ingest", "terms"])
+        except Exception as e:
+            div = [f"audit-error:{e!r}"]
+        if div:
+            problems.append(f"shadow audit divergent after restart: {div}")
+    return problems
+
+
+def check_overcommit(api) -> List[str]:
+    """Per-node occupancy vs allocatable over the STORE's bound pods —
+    independent of any scheduler instance's cache, so a cache that
+    forgot a binding cannot hide the over-commit it caused."""
+    from ..api.types import RESOURCE_CPU
+    from ..oracle.nodeinfo import accumulated_request
+
+    problems: List[str] = []
+    nodes, _ = api.list("nodes")
+    pods, _ = api.list("pods")
+    used: Dict[str, Dict[str, int]] = {}
+    count: Dict[str, int] = {}
+    for p in pods:
+        if not p.node_name:
+            continue
+        acc = used.setdefault(p.node_name, {})
+        count[p.node_name] = count.get(p.node_name, 0) + 1
+        # accumulated_request is milli for cpu, raw for everything else
+        for rn, v in accumulated_request(p).items():
+            if rn != "pods":
+                acc[rn] = acc.get(rn, 0) + v
+    for n in nodes:
+        acc = used.get(n.name, {})
+        for rn, v in acc.items():
+            alloc_q = n.allocatable.get(rn)
+            if alloc_q is None:
+                continue
+            cap = (
+                alloc_q.milli_value() if rn == RESOURCE_CPU else alloc_q.value()
+            )
+            if v > cap:
+                problems.append(
+                    f"node {n.name} over-committed on {rn}: {v} > {cap}"
+                )
+        pods_alloc = n.allocatable.get("pods")
+        if pods_alloc is not None and count.get(n.name, 0) > pods_alloc.value():
+            problems.append(
+                f"node {n.name} over pod capacity: "
+                f"{count.get(n.name, 0)} > {pods_alloc.value()}"
+            )
+    return problems

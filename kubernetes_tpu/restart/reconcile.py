@@ -1,0 +1,222 @@
+"""Cold-start reconciliation: rebuild EVERYTHING from the API server.
+
+The reference kube-scheduler is deliberately restartable: on startup it
+relists through its informers, rebuilds the scheduler cache from the
+assigned pods it finds (`spec.nodeName`), reconstructs the nominated-pod
+map from `status.nominatedNodeName`, and resumes scheduling — etcd (the
+API server) is the ONLY durable state (PAPER.md §6, the `scheduleOne` /
+cache-rebuild contract). This module gives our scheduler the same
+property with six device-resident planes in the way: a ``cold_start``
+rebuilds, in order,
+
+  1. **relist** — one LIST per kind against the persistent API server
+     (the single source of truth; nothing from the dead process is
+     consulted, because nothing from the dead process exists).
+  2. **nodes** — the cluster topology into the cache (and its
+     CacheColumns rows, when the columnar plane is armed).
+  3. **assume** — every BOUND pod bulk re-added as CONFIRMED state
+     through the columnar path (``SchedulerCache.add_pods``: one
+     vectorized scatter of interned per-spec delta rows — O(batch), not
+     an O(pods) object walk). This MUST precede any scheduling: a pod
+     solved before its node's occupancy is restored would double-book
+     capacity (the re-assume-before-schedule ordering invariant,
+     INVARIANTS.md).
+  4. **queue** — every pending pod owned by this scheduler re-admitted
+     through ``PriorityQueue.add``, which re-stages the ingest/term
+     slabs exactly as a live admission would (enqueue-time encoding is
+     the admission path — a restart is just a very large admission
+     burst) and rebuilds the nominated-pod overlay from each pod's
+     persisted ``status.nominatedNodeName`` — an in-flight preemption
+     RESUMES (the preemptor re-solves into its reserved capacity)
+     instead of re-evicting fresh victims.
+  5. **nominations** — verification that the overlay matches the wire
+     truth exactly (counted; a mismatch is a reconciliation bug, not a
+     warning).
+  6. **informers** — the reflector loops start and complete their
+     initial sync. Their relist re-delivers objects the direct phases
+     already applied; every handler target (queue.add, cache.add_pod)
+     is idempotent under re-delivery by contract, and no scheduling has
+     begun yet, so the duplicate window is race-free.
+  7. **banks** — the TensorMirror is marked device-stale (host truth
+     wins; the PR 13 resync primitive) and synced host-side.
+  8. **warmup** — ``Scheduler.warmup()``: the persisted compile ladder
+     re-warms trace-only against the XLA persistent cache
+     (``misses_after_warmup == 0`` holds across a restart), the full
+     device banks upload, and the staged-bank uploaders arm.
+
+Binds that were IN FLIGHT at death need no replay log: the API server
+already resolved them. A bind whose POST landed shows up in the relist
+as a bound pod (phase 3 re-assumes it); one whose POST never happened
+shows up pending (phase 4 re-queues it; the resumed drain re-solves and
+re-binds). The only ambiguous case — the POST landed but the dead
+process never saw the response — resolves at re-bind time: the binding
+subresource 409s, and the idempotent binder counts a same-node Conflict
+as success (``scheduler_bind_conflicts_total{outcome=benign}``).
+
+Every phase is timed into
+``scheduler_restart_reconcile_duration_seconds{phase}`` and the report
+lands on ``sched.restart_report`` (surfaced by the census /
+``ktpu_top``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..metrics import metrics as M
+
+#: report phases, in execution order (the census/ktpu_top render order)
+PHASES = (
+    "relist", "nodes", "assume", "queue", "nominations", "informers",
+    "banks", "warmup",
+)
+
+
+@dataclass
+class ReconcileReport:
+    """One cold start's phase-timed flight record (JSON-serializable via
+    as_dict — the census carries it)."""
+
+    started_unix: float = 0.0
+    phases_s: Dict[str, float] = field(default_factory=dict)
+    nodes: int = 0
+    bound: int = 0
+    pending: int = 0
+    nominations: int = 0
+    nomination_mismatches: int = 0
+    warmed_pods: int = 0
+    total_s: float = 0.0
+
+    def as_dict(self) -> Dict:
+        return {
+            "started_unix": self.started_unix,
+            "phases_s": {k: round(v, 6) for k, v in self.phases_s.items()},
+            "nodes": self.nodes,
+            "bound": self.bound,
+            "pending": self.pending,
+            "nominations": self.nominations,
+            "nomination_mismatches": self.nomination_mismatches,
+            "warmed_pods": self.warmed_pods,
+            "total_s": round(self.total_s, 6),
+        }
+
+
+class _Phase:
+    """Context manager: one timed reconciliation phase (metric + report)."""
+
+    def __init__(self, report: ReconcileReport, name: str):
+        self.report = report
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self.report.phases_s[self.name] = dt
+        M.restart_reconcile_duration.observe(dt, self.name)
+        return False
+
+
+def cold_start(
+    sched,
+    api,
+    scheduler_name: str = "default-scheduler",
+    handlers=None,
+    start_informers: bool = True,
+    fault_plan=None,
+    warmup: bool = True,
+    informer_sync_timeout: float = 30.0,
+) -> ReconcileReport:
+    """Reconcile a FRESH ``Scheduler`` against `api` (module docstring
+    for the phase contract). The scheduler must not have scheduled
+    anything yet — reconciliation is a cold-start path, not a live
+    repair (the fault plane owns live repairs). Returns the phase-timed
+    report (also stored on ``sched.restart_report``); when
+    `start_informers`, the started informers land on
+    ``sched.restart_informers`` (the caller owns stopping them)."""
+    from ..client.informer import start_scheduler_informers
+    from ..scheduler.eventhandlers import EventHandlers
+
+    report = ReconcileReport(started_unix=time.time())
+    t_total = time.perf_counter()
+
+    with _Phase(report, "relist"):
+        node_items, _node_rv = api.list("nodes")
+        pod_items, _pod_rv = api.list("pods")
+
+    with _Phase(report, "nodes"):
+        for node in node_items:
+            sched.cache.add_node(node)
+        report.nodes = len(node_items)
+
+    with _Phase(report, "assume"):
+        bound = [p for p in pod_items if p.node_name]
+        sched.cache.add_pods(bound)
+        report.bound = len(bound)
+
+    with _Phase(report, "queue"):
+        pending = [
+            p for p in pod_items
+            if not p.node_name and p.scheduler_name == scheduler_name
+        ]
+        for p in pending:
+            sched.queue.add(p)
+        report.pending = len(pending)
+
+    with _Phase(report, "nominations"):
+        # the overlay was rebuilt by queue.add (each pod's persisted
+        # status.nominatedNodeName feeds _update_nominated); verify it
+        # matches the wire truth EXACTLY — a miss here means a resumed
+        # preemption would re-evict, the bug this phase exists to catch
+        want = {
+            p.key(): p.nominated_node_name
+            for p in pending if p.nominated_node_name
+        }
+        have: Dict[str, str] = {}
+        for node in set(want.values()):
+            for p in sched.queue.nominated_pods_for_node(node):
+                have[p.key()] = node
+        report.nominations = len(want)
+        report.nomination_mismatches = sum(
+            1 for k, n in want.items() if have.get(k) != n
+        )
+
+    if start_informers:
+        with _Phase(report, "informers"):
+            h = handlers or EventHandlers(
+                sched.cache, sched.queue, scheduler_name=scheduler_name
+            )
+            informers = start_scheduler_informers(
+                api, h, fault_plan=fault_plan
+            )
+            # publish IMMEDIATELY: a crash in the banks/warmup phases
+            # below must not strand the just-started watcher threads in
+            # a local the supervisor's _bury can never reach
+            sched.restart_informers = informers
+            for inf in informers.values():
+                if not inf.wait_for_sync(informer_sync_timeout):
+                    raise TimeoutError(
+                        f"informer {inf.kind} failed initial sync within "
+                        f"{informer_sync_timeout}s"
+                    )
+
+    with _Phase(report, "banks"):
+        # host truth wins: whatever a previous incarnation left on the
+        # device is unreachable (new process) — mark stale so the first
+        # device_arrays() performs the full re-upload, then build the
+        # host-side mirror structures from the reconciled cache
+        sched.mirror.mark_device_stale()
+        sched.mirror.sync()
+
+    if warmup:
+        with _Phase(report, "warmup"):
+            report.warmed_pods = sched.warmup()
+
+    report.total_s = time.perf_counter() - t_total
+    M.restarts.inc()
+    sched.restart_report = report.as_dict()
+    return report

@@ -1,0 +1,313 @@
+"""Crash-restart supervisor: kill the scheduler anywhere, resume the drain.
+
+The deterministic chaos harness for process-level death. A ``Supervisor``
+owns ONE persistent ``FakeAPIServer`` (the durable state — "etcd is the
+checkpoint") and drives scheduler INSTANCES against it. The active
+``FaultPlan``'s ``crash:<site>[@n]`` kill-points simulate ``kill -9`` at
+a named pipeline stage: the firing thread raises ``SimulatedCrash`` (a
+BaseException no fault handler absorbs) and latches ``plan.crashed``, so
+
+* the supervisor's drive loop detects the death even when the kill-point
+  fired on a worker thread (commit worker, bind pool, uploader), and
+* the dead instance's surviving threads are FENCED: every outward write
+  (bind POST, victim delete, nomination patch) passes ``crash_gate()``
+  first and dies instead of mutating the API server post-mortem —
+  ``kill -9`` stops all threads at once; the gate is the in-process
+  equivalent, with the one honest relaxation that a write already past
+  the gate when the crash fires behaves as if it landed just before
+  death (indistinguishable from the API server's point of view).
+
+On death the supervisor ABANDONS the instance (``Scheduler.abort()`` —
+no flush, no persist, no graceful anything; a dead process cleans
+nothing), builds a fresh instance with a fresh cache/queue/mirror, and
+``cold_start``-reconciles it from the API server (restart/reconcile.py).
+The compile plan hands each incarnation the SAME persistent cache
+directory, so a restart re-warms trace-only (``misses_after_warmup ==
+0`` across the kill).
+
+``check_invariants`` is the per-cell acceptance gate: zero lost pods,
+zero double-bound pods (structural: the binding subresource 409s any
+re-bind, plus a zero mismatch-conflict count), no node over-commit
+against allocatable, and a clean shadow audit on the surviving
+instance's device banks.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..faults.inject import FaultPlan, SimulatedCrash
+from .reconcile import ReconcileReport, cold_start
+
+
+@dataclass
+class Incarnation:
+    """One scheduler instance's lifetime under the supervisor."""
+
+    index: int
+    sched: object
+    informers: Dict = field(default_factory=dict)
+    report: Optional[ReconcileReport] = None
+    outcome: str = "running"  # running | crashed:<site> | done | timeout
+
+
+@dataclass
+class SupervisorReport:
+    """One chaos cell's result: the incarnation trail + terminal state."""
+
+    incarnations: List[Incarnation] = field(default_factory=list)
+    crashes: int = 0
+    completed: bool = False
+    problems: List[str] = field(default_factory=list)
+
+    @property
+    def final(self) -> Incarnation:
+        return self.incarnations[-1]
+
+
+class Supervisor:
+    """Build → drive → (crash → bury → rebuild → reconcile)* → verify.
+
+    `scheduler_factory(fault_plan)` must return a FRESH Scheduler wired
+    to the supervisor's API server: its binder/delete_fn/nominate_fn
+    must route through ``guard()`` so the crash fence holds (the
+    module-level ``build_instance`` helper wires the standard shape).
+    """
+
+    def __init__(self, api, plan: Optional[FaultPlan],
+                 scheduler_factory, scheduler_name: str = "default-scheduler"):
+        self.api = api
+        self.plan = plan
+        self.scheduler_factory = scheduler_factory
+        self.scheduler_name = scheduler_name
+        self.report = SupervisorReport()
+        # harness hook: called as on_tick(supervisor, incarnation) once
+        # per drive iteration — chaos cells inject mid-drain arrivals /
+        # node churn here (the open-loop traffic the matrix needs)
+        self.on_tick = None
+        # harness hook: called as on_restart(supervisor) after a dead
+        # incarnation is buried and BEFORE its successor cold-starts —
+        # the window where "traffic that arrived while the process was
+        # down" lands in the store, so the restart's relist (and its
+        # warmup census over the relisted queue) sees it
+        self.on_restart = None
+
+    # -- the crash fence ------------------------------------------------------
+
+    def guard(self, fn):
+        """Wrap an outward-facing write so a dead instance's surviving
+        threads cannot keep mutating the API server."""
+        plan = self.plan
+        if plan is None:
+            return fn
+
+        def gated(*a, **k):
+            plan.crash_gate()
+            return fn(*a, **k)
+
+        return gated
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _spawn(self) -> Incarnation:
+        plan = self.plan
+        if plan is not None and self.report.incarnations:
+            # the restarted incarnation sees the same schedule (already-
+            # fired kill-points stay fired) with the crash latch cleared
+            self.plan = plan = plan.rearm()
+        sched = self.scheduler_factory(plan)
+        inc = Incarnation(index=len(self.report.incarnations), sched=sched)
+        self.report.incarnations.append(inc)
+        # cold_start may itself hit a kill-point (a crash scheduled into
+        # a warmup-time flush) — the caller supervises it like any death
+        inc.report = cold_start(
+            sched, self.api, scheduler_name=self.scheduler_name,
+            fault_plan=plan,
+        )
+        inc.informers = getattr(sched, "restart_informers", {}) or {}
+        return inc
+
+    def _bury(self, inc: Incarnation) -> None:
+        """Post-mortem cleanup of the HARNESS's threads (informers,
+        pools) — never graceful scheduler shutdown: the crash fence has
+        every in-flight task fast-failing, so the joins are bounded.
+        The dead instance's state is garbage by definition; only the
+        API server carries truth forward."""
+        # a crash INSIDE cold_start means inc.informers was never
+        # populated — the reconcile path publishes the started watchers
+        # on the scheduler the moment they exist, so read both
+        informers = dict(
+            getattr(inc.sched, "restart_informers", {}) or {}
+        )
+        informers.update(inc.informers)
+        for inf in informers.values():
+            try:
+                inf.stop()
+            except Exception:
+                pass
+        try:
+            inc.sched.abort()
+        except BaseException:
+            pass  # a second SimulatedCrash out of a drain is expected
+
+    def _drive(self, inc: Incarnation, deadline: float,
+               settle_s: float = 0.05) -> str:
+        """Run one incarnation's drain until the cluster is fully bound
+        (API-server truth), a kill-point fires, or the deadline passes."""
+        plan = self.plan
+        api = self.api
+        sched = inc.sched
+        queue = sched.queue
+        while time.monotonic() < deadline:
+            if plan is not None and plan.crashed is not None:
+                return f"crashed:{plan.crashed}"
+            if self.on_tick is not None:
+                self.on_tick(self, inc)
+            live, _ = api.list("pods")
+            if all(p.node_name for p in live) and queue.pending_count() == 0:
+                try:
+                    sched.wait_for_binds()
+                except SimulatedCrash as e:
+                    return f"crashed:{e}"
+                live, _ = api.list("pods")
+                if all(p.node_name for p in live):
+                    return "done"
+            try:
+                r = sched.schedule_batch()
+            except SimulatedCrash as e:
+                return f"crashed:{e}"
+            if plan is not None and plan.crashed is not None:
+                # a worker-thread kill-point fired during this batch
+                return f"crashed:{plan.crashed}"
+            if not (r.scheduled or r.unschedulable or r.errors or r.deferred):
+                try:
+                    sched.service_faults()
+                except SimulatedCrash as e:
+                    return f"crashed:{e}"
+                queue.flush()
+                time.sleep(settle_s)  # binds/backoffs/informer lag settle
+        return "timeout"
+
+    def run(self, budget_s: float = 120.0, max_restarts: int = 8) -> SupervisorReport:
+        """The supervision loop: drive until the drain completes, the
+        budget expires, or the restart bound trips (a runaway crash
+        schedule must fail loudly, not spin). A kill-point firing inside
+        reconciliation/warmup is supervised like any other death."""
+        deadline = time.monotonic() + budget_s
+        while True:
+            try:
+                inc = self._spawn()
+                outcome = self._drive(inc, deadline)
+            except SimulatedCrash as e:
+                inc = self.report.incarnations[-1]
+                outcome = f"crashed:{e}"
+            inc.outcome = outcome
+            if outcome == "done":
+                self.report.completed = True
+                return self.report
+            if outcome == "timeout":
+                self.report.problems.append(
+                    f"incarnation {inc.index} timed out mid-drain"
+                )
+                return self.report
+            # crashed: bury, rebuild, reconcile, resume
+            self.report.crashes += 1
+            self._bury(inc)
+            if self.report.crashes > max_restarts:
+                self.report.problems.append(
+                    f"restart bound exceeded ({max_restarts})"
+                )
+                return self.report
+            if self.on_restart is not None:
+                self.on_restart(self)
+
+
+# ---------------------------------------------------------------------------
+# the standard instance shape (what perf_smoke/tests wire)
+# ---------------------------------------------------------------------------
+
+def make_scheduler_factory(
+    supervisor_ref: Dict,
+    api,
+    compile_cache_dir: Optional[str] = None,
+    scheduler_kwargs: Optional[Dict] = None,
+):
+    """Factory building the standard API-server-wired instance: an
+    idempotent APIBinder, victim deletes and nomination patches against
+    the store, every outward write behind the crash fence, and a
+    compile plan persisting to `compile_cache_dir` so every incarnation
+    re-warms from the previous one's ladder. `supervisor_ref` is a
+    one-slot dict the caller fills with the Supervisor after
+    construction (factory and supervisor reference each other)."""
+    from ..apiserver.store import NotFoundError
+    from ..client.informer import APIBinder
+    from ..compile import CompilePlan
+    from ..compile.cache import PersistentCompileCache
+    from ..scheduler.driver import Binder, Scheduler
+    from ..state.cache import SchedulerCache
+    from ..state.queue import PriorityQueue
+
+    def factory(fault_plan):
+        sup = supervisor_ref["sup"]
+        api_binder = APIBinder(api)
+
+        def delete_victim(p):
+            # kube semantics: deleting an already-gone victim is a no-op
+            try:
+                api.delete("pods", p.key())
+            except NotFoundError:
+                pass
+
+        def nominate(pod, node):
+            api.update_pod_status(
+                pod.namespace, pod.name, nominated_node_name=node
+            )
+
+        plan = None
+        if compile_cache_dir is not None:
+            plan = CompilePlan(cache=PersistentCompileCache(compile_cache_dir))
+        kwargs = dict(
+            cache=SchedulerCache(),
+            queue=PriorityQueue(),
+            binder=Binder(sup.guard(api_binder.bind)),
+            delete_fn=sup.guard(delete_victim),
+            nominate_fn=sup.guard(nominate),
+            fault_plan=fault_plan,
+        )
+        if plan is not None:
+            kwargs["compile_plan"] = plan
+        kwargs.update(scheduler_kwargs or {})
+        return Scheduler(**kwargs)
+
+    return factory
+
+
+def run_cell(
+    api,
+    crash_spec: str,
+    compile_cache_dir: Optional[str] = None,
+    scheduler_kwargs: Optional[Dict] = None,
+    budget_s: float = 120.0,
+    extra_faults: str = "",
+    on_tick=None,
+    on_restart=None,
+) -> SupervisorReport:
+    """One chaos-matrix cell: supervise a drain of `api`'s current pods
+    under `crash_spec` (e.g. ``"crash:mid-bind-chunk@2"``; semicolon-
+    join several for multi-restart cells; `extra_faults` appends
+    ordinary PR 13 fault sites). Returns the SupervisorReport — the
+    caller asserts invariants via ``check_invariants``."""
+    spec = ";".join(s for s in (crash_spec, extra_faults) if s)
+    plan = FaultPlan.parse(spec) if spec else None
+    ref: Dict = {}
+    factory = make_scheduler_factory(
+        ref, api, compile_cache_dir=compile_cache_dir,
+        scheduler_kwargs=scheduler_kwargs,
+    )
+    sup = Supervisor(api, plan, factory)
+    sup.on_tick = on_tick
+    sup.on_restart = on_restart
+    ref["sup"] = sup
+    return sup.run(budget_s=budget_s)

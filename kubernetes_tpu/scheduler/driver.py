@@ -515,6 +515,7 @@ class Scheduler:
         event_fn: Optional[Callable[[Pod, str, str], None]] = None,
         pdb_lister: Optional[Callable[[], List[PodDisruptionBudget]]] = None,
         delete_fn: Optional[Callable[[Pod], None]] = None,
+        nominate_fn: Optional[Callable[[Pod, str], None]] = None,
         extenders: Optional[List] = None,
         volume_checker: Optional[Callable] = None,
         volume_binder=None,
@@ -564,6 +565,13 @@ class Scheduler:
         # informer remove the pod; with no API, fall back to direct removal.
         self.pdb_lister = pdb_lister or (lambda: [])
         self.delete_fn = delete_fn
+        # nomination write-through (podPreemptor.SetNominatedNodeName,
+        # scheduler.go:436-470): persists status.nominatedNodeName at the
+        # API server so an in-flight preemption SURVIVES a scheduler
+        # restart — the relist reconstructs the nominated-pod overlay
+        # instead of re-evicting fresh victims. None = local-only field
+        # (standalone mode, no API server).
+        self.nominate_fn = nominate_fn
         # HTTP extenders (core/extender.go): consulted per pod on the host
         # commit path at Filter/Prioritize time, and at Bind when one
         # handles binding (scheduler_interface.go:28-73)
@@ -784,6 +792,14 @@ class Scheduler:
             self.term_bank.fault_plan = self._fault_plan
         if self._fault_plan is not None and self.cache._columns is not None:
             self._arm_columns_hook()
+        # crash-restart plane (kubernetes_tpu/restart): the last cold-
+        # start reconciliation's phase-timed report (None = this process
+        # was never restarted/reconciled); surfaced through the census
+        # so ktpu_top shows when and how the instance last rebuilt
+        self.restart_report = None
+        # close() latch + shutdown flight record (the final census)
+        self._closed = False
+        self.last_census: Optional[Dict] = None
         # black-box baseline: cumulative counters diffed per batch into
         # the bounded cycle ring (ktpu: confined(driver))
         self._bb_prev: Optional[Dict] = None
@@ -2125,6 +2141,21 @@ class Scheduler:
                         infos, carry=disp["carry_dev"], allow_rebuild=False
                     )
                     self._finish_solve(disp2)
+                if any(pod_group_name(pi.pod) for pi in infos):
+                    # gang-flavored peek: the live dispatch above warmed
+                    # ONLY the solve_gang variant — the plain variant is
+                    # a distinct XLA signature, and a mixed queue's first
+                    # non-gang batch would pay it inline (seen on restart
+                    # reconciliation, where a gang relists into the
+                    # warmup peek and the dead process's ladder never
+                    # persisted). Foreground-warm the plain base too.
+                    self._warm_svc.warm_specs(
+                        [self._solve_spec(gang=False, with_carry=wc)
+                         for wc in ((False, True) if self.speculate
+                                    else (False,))],
+                        dev=None if self.fold_plane
+                        else self.mirror.device_arrays(),
+                    )
             if self.enable_preemption:
                 # pin the preemptor-axis bucket so every device preemption
                 # round shares ONE signature (padded scan steps are cheap;
@@ -2568,6 +2599,12 @@ class Scheduler:
             pod = info.pod
             bound = False
             try:
+                if fp is not None:
+                    # kill-point: between two binds of one chunk — the
+                    # earlier items' POSTs landed, this one and the rest
+                    # never happen (the restart's idempotent re-bind /
+                    # relist confirm covers both halves)
+                    fp.crash_if("mid-bind-chunk")
                 t_bind = time.perf_counter()
                 try:
                     bind(pod, node_name)
@@ -2575,6 +2612,11 @@ class Scheduler:
                     self._unbind(info, assumed, node_name, state, cycle, f"bind: {e}", reason="rpc")
                     continue
                 bound = True
+                if fp is not None:
+                    # kill-point: the POST landed, the confirm/finish
+                    # bookkeeping never runs — the canonical benign-409
+                    # replay window
+                    fp.crash_if("post-bind")
                 now = time.perf_counter()
                 binds.append(now - t_bind)
                 e2es.append(now - t_decided)
@@ -2717,6 +2759,7 @@ class Scheduler:
         Preempt, scheduler.go:436-470) — shared by the per-pod scalar path
         and the device-batched path."""
         M.preemption_victims.observe(len(victims))
+        fp = self._fault_plan
         for v in victims:
             if self.delete_fn is not None:
                 # API delete: the informer's delete event removes it from the
@@ -2725,9 +2768,26 @@ class Scheduler:
             else:
                 self.cache.remove_pod(v)
             self.event_fn(v, "Preempted", f"by {pod.key()}")
+        if fp is not None:
+            # kill-point: process dies with victims evicted but the
+            # preemptor's nomination never written — the restart must
+            # NOT re-evict (the freed capacity is real; the relisted
+            # pending preemptor simply re-solves into it)
+            fp.crash_if("mid-preemption")
         for key in clear:
             self.queue.clear_nomination(key)
         pod.nominated_node_name = node
+        if self.nominate_fn is not None:
+            # persist status.nominatedNodeName (the wire half — the
+            # informer's MODIFIED echo is what every OTHER scheduler
+            # process, and a restarted this-one, reconstructs from)
+            try:
+                self.nominate_fn(pod, node)
+            except Exception as e:
+                # a failed status write degrades to local-only nomination
+                # (exactly the reference's behavior: SetNominatedNodeName
+                # errors are logged, the in-memory nomination stands)
+                self.event_fn(pod, "FailedNomination", f"{e}")
         self.event_fn(pod, "Nominated", node)
 
     def _preempt_deferred(self, fails: List[PodInfo], cycle: int, res: ScheduleResult) -> None:
@@ -3118,6 +3178,11 @@ class Scheduler:
                 if fp is not None:  # injection site: one attribute read
                     fp.raise_if("device-raise", "apply")
                 result = columnar.apply(place, folded=folded)
+                if fp is not None:
+                    # kill-point: commit worker dies mid-apply — assumes
+                    # landed in the (now dead) cache, zero binds issued;
+                    # the API server still holds every pod pending
+                    fp.crash_if("mid-apply")
             except Exception as e:
                 # commit-worker fault: nothing has been bound yet — undo
                 # whatever DID get assumed (forget_pods skips unknown
@@ -3403,6 +3468,11 @@ class Scheduler:
                 self.queue.requeue_backoff(info)
             M.schedule_attempts.inc(M.ERROR, by=len(infos))
             return res
+        if fault_plan is not None:
+            # kill-point: solve result in hand, nothing committed — the
+            # popped pods die with the process and only the API server's
+            # pending copies survive (the restart relist re-queues them)
+            fault_plan.crash_if("post-solve")
         # SPECULATIVE PIPELINING (the reference's assume-then-async-bind
         # discipline applied to the solve, SURVEY §2.3), depth spec_depth:
         # pop and dispatch the next batches chained on each other's device
@@ -3588,6 +3658,12 @@ class Scheduler:
                     [m[1] for m in assumed_meta], folded=folded
                 )
             )
+            if fault_plan is not None:
+                # kill-point: the bulk apply landed (assumes in the
+                # dying cache) but no bind was submitted — same window
+                # the commit-worker mid-apply site covers on the
+                # arbitrated path
+                fault_plan.crash_if("mid-apply")
             if folded:
                 for j in rejected:
                     self.mirror.note_failed_fold(assumed_meta[j][2])
@@ -4043,28 +4119,97 @@ class Scheduler:
         return n
 
     def close(self) -> None:
-        """Orderly shutdown: re-queue speculatively parked pods, drain the
-        async bind pipeline, and retire the background compile-warmup
-        worker (an XLA compile in flight at interpreter exit aborts the
-        process — queued warms are dropped, the running one completes and
-        the grown ladder persists). Safe to call more than once."""
-        self.flush_speculative()
-        self.wait_for_binds()
-        self._commit_pipe.close()
+        """Orderly shutdown, in dependency order: re-queue speculatively
+        parked pods, drain the commit pipeline (its worker SUBMITS bind
+        chunks), retire the bind pool for good (no recreation — a closed
+        scheduler must leak zero threads), stop the health monitor and
+        both staged-bank uploaders with join timeouts (each bank flushes
+        its dirty backlog synchronously first, so the device twins are
+        host-true at the moment the workers die), retire the background
+        compile-warmup worker (an XLA compile in flight at interpreter
+        exit aborts the process — queued warms are dropped, the running
+        one completes and the grown ladder persists), and emit a final
+        census (`last_census`) as the shutdown flight record. Idempotent:
+        a second close() returns immediately."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        try:
+            self.flush_speculative()
+            # drain-then-shutdown, not wait_for_binds: that helper
+            # recreates the pool for callers that keep scheduling;
+            # close must not
+            self._commit_pipe.drain()
+        finally:
+            # a raising drain (a worker exception — or a SimulatedCrash
+            # — re-raised on this thread) must still stop every worker:
+            # the _closed latch above makes a retry a no-op, so this is
+            # the only shot at not leaking threads
+            self._bind_pool.shutdown(wait=True)
+            self._commit_pipe.close()
+            if self.health is not None:
+                self.health.stop()
+            if self.stage_bank is not None:
+                self.stage_bank.close()
+            if self.term_bank is not None:
+                self.term_bank.close()
+            if self._warm_svc is not None:
+                self._warm_svc.stop()
+                self._warm_svc.join()
+                self.compile_plan.persist()
+        # final census — every worker above is stopped, so this is the
+        # one census guaranteed quiescent; kept on the instance (and
+        # returned by obs/introspect.census consumers) as the shutdown
+        # flight record
+        try:
+            from ..obs.introspect import census as _census
+
+            self.last_census = _census(self)
+        except Exception:
+            self.last_census = None  # forensics, never load-bearing
+
+    def abort(self) -> None:
+        """NON-graceful teardown for the crash-restart harness
+        (kubernetes_tpu/restart): a dead process flushes nothing,
+        persists nothing, emits nothing — this only stops the
+        instance's threads so a supervised in-process "kill" doesn't
+        leak them across incarnations. The commit worker is shut down
+        WITHOUT draining (drain re-raises the captured crash), the bind
+        pool without recreation, the bank uploaders without their
+        backlog flush, and the warm worker without persisting the
+        ladder (the previous warmup already persisted it — a crash
+        after warmup loses nothing). Idempotent; close() after abort()
+        is a no-op."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        try:
+            self._bind_pool.shutdown(wait=True)
+        except BaseException:
+            pass
+        try:
+            self._commit_pipe._pool.shutdown(wait=True)
+        except BaseException:
+            pass
         if self.health is not None:
             self.health.stop()
-        if self.stage_bank is not None:
-            self.stage_bank.close()
-        if self.term_bank is not None:
-            self.term_bank.close()
+        for bank in (self.stage_bank, self.term_bank):
+            if bank is not None:
+                bank._stop.set()
+                bank._wake.set()
+                w = bank._worker
+                if w is not None and w.is_alive():
+                    w.join(timeout=5)
         if self._warm_svc is not None:
             self._warm_svc.stop()
             self._warm_svc.join()
-            self.compile_plan.persist()
 
     def wait_for_binds(self) -> None:
         """Drain the bind pipeline (tests/benchmarks). The commit pipeline
-        settles first — its worker is what SUBMITS the lean bind chunks."""
+        settles first — its worker is what SUBMITS the lean bind chunks.
+        No-op after close() (the pool must stay retired)."""
+        if getattr(self, "_closed", False):
+            return
         self._commit_pipe.drain()
         self._bind_pool.shutdown(wait=True)
         self._bind_pool = ThreadPoolExecutor(

@@ -435,19 +435,28 @@ class SchedulerCache:
                 acc_rows.append(row)
                 acc_pods.append(pod)
                 deltas.append((pod.node_name, pod, 1, folded))
-            if acc_pods:
-                self._collapse_deltas_locked()
-                try:
-                    cols.assume_bulk_locked(acc_rows, acc_pods)
-                except Exception as e:
-                    # journal-before-scatter: the detach below replays
-                    # every pending op (this batch included) into the
-                    # object views, so the assumes stand on object truth
-                    self._columns_fault_locked(e)
-                self.mutation_count += len(acc_pods)
-                if self._columns is not None and cols._overgrown:
-                    self._drain_overgrown_locked()
+            self._bulk_scatter_locked(cols, acc_rows, acc_pods)
         return rejected
+
+    # ktpu: holds(self._lock) shared tail of the columnar bulk adders
+    def _bulk_scatter_locked(self, cols, acc_rows: List[int],
+                             acc_pods: List[Pod]) -> None:
+        """The columnar bulk-add scatter tail (assume_pods / add_pods —
+        ONE copy of the collapse-then-scatter, fault-fallback, and
+        overgrown-drain discipline): collapse the memoized delta sources
+        first, scatter the accumulated rows, and on a scatter fault
+        detach to object truth (journal-before-scatter makes the replay
+        complete, this batch included)."""
+        if not acc_pods:
+            return
+        self._collapse_deltas_locked()
+        try:
+            cols.assume_bulk_locked(acc_rows, acc_pods)
+        except Exception as e:
+            self._columns_fault_locked(e)
+        self.mutation_count += len(acc_pods)
+        if self._columns is not None and cols._overgrown:
+            self._drain_overgrown_locked()
 
     def finish_binding(self, pod: Pod) -> None:
         """FinishBinding: start the TTL clock (cache.go:300)."""
@@ -561,10 +570,63 @@ class SchedulerCache:
                     self._deadlines.discard_locked(key)
                 return
             if st is not None:
+                if (pod.resource_version
+                        and st.pod.resource_version == pod.resource_version):
+                    # re-delivery of the exact object already held (the
+                    # store bumps resourceVersion on every write, so an
+                    # equal rv IS the same object): no-op. Matters at
+                    # cold start, where the informer's initial sweep
+                    # re-delivers every pod the bulk columnar re-assume
+                    # just added — the scalar remove/re-add walk would
+                    # materialize lazy column views per pod, degrading
+                    # reconciliation back to the O(pods) object walk.
+                    return
                 self.update_pod(st.pod, pod)
                 return
             self._pod_states[key] = _PodState(pod=pod)
             self._add_pod_to_node(pod)
+
+    def add_pods(self, pods: List[Pod]) -> int:
+        """Bulk AddPod for the cold-start reconciliation path
+        (kubernetes_tpu/restart): a relist's BOUND pods re-enter the
+        cache as CONFIRMED state (never assumed — the API server already
+        holds their bindings; re-assume-then-confirm would arm TTL
+        clocks for binds that finished in a previous process lifetime).
+        Rides the columnar plane exactly like assume_pods — one
+        vectorized scatter of the interned per-spec delta rows, zero
+        per-pod NodeInfo/Quantity object work — so reconciling a
+        100k-pod cluster costs O(batch), not O(pods) object walks. Pods
+        whose key is already tracked take the scalar add_pod confirm/
+        update path (idempotent re-delivery); pods on unknown nodes take
+        the eager headless path. Returns the number newly added."""
+        added = 0
+        dup: List[Pod] = []
+        with self._lock:
+            states = self._pod_states
+            cols = self._columns
+            acc_rows: List[int] = []
+            acc_pods: List[Pod] = []
+            for pod in pods:
+                key = pod.key()
+                if key in states:
+                    dup.append(pod)
+                    continue
+                added += 1
+                states[key] = _PodState(pod=pod)
+                if cols is None:
+                    self._add_pod_to_node(pod)
+                    continue
+                row = cols.row_of.get(pod.node_name)
+                if row is None:
+                    self._add_pod_to_node(pod)
+                    continue
+                acc_rows.append(row)
+                acc_pods.append(pod)
+                self.pod_deltas.append((pod.node_name, pod, 1, False))
+            self._bulk_scatter_locked(cols, acc_rows, acc_pods)
+        for pod in dup:
+            self.add_pod(pod)
+        return added
 
     def update_pod(self, old: Pod, new: Pod) -> None:
         with self._lock:

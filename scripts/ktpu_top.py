@@ -200,6 +200,25 @@ def render_census(doc: Dict) -> str:
         out.append(
             "breakers " + (" ".join(bits) if bits else "all closed, 0 trips")
         )
+    restart = p.get("restart") or {}
+    if restart.get("reconciled"):
+        # the crash-restart plane's flight record: when this instance
+        # last cold-start reconciled, and what each phase cost
+        last = restart.get("last") or {}
+        phases = last.get("phases_s") or {}
+        phase_bits = " ".join(
+            f"{k}={v:.3f}s" for k, v in phases.items()
+        )
+        out.append(
+            f"restart  reconciled nodes={_fmt(last.get('nodes'))} "
+            f"bound={_fmt(last.get('bound'))} "
+            f"pending={_fmt(last.get('pending'))} "
+            f"nominations={_fmt(last.get('nominations'))} "
+            f"total={_fmt(last.get('total_s'), integer=False)}s"
+            + (f" [{phase_bits}]" if phase_bits else "")
+        )
+    else:
+        out.append("restart  never reconciled (cold-started fresh)")
     return "\n".join(out)
 
 

@@ -31,6 +31,16 @@ tests/test_perf_smoke.py; also runnable standalone:
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py columnar   # columnar cache
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py health     # health monitor
     JAX_PLATFORMS=cpu python scripts/perf_smoke.py faults     # seeded chaos drain
+    JAX_PLATFORMS=cpu python scripts/perf_smoke.py restart    # crash-restart cell
+
+`main_restart()` (mode `restart`) guards the crash-restart plane
+(kubernetes_tpu/restart): a deterministic crash:mid-bind-chunk
+kill-point mid-drain, the supervised restart (fresh instance,
+cold-start reconciliation from the persistent FakeAPIServer's relist),
+and the resumed drain to completion — zero lost / zero double-bound
+pods, no node over-commit, a clean shadow audit on the survivor,
+`misses_after_warmup == 0` on the restarted incarnation, and the
+reconciliation wall reported per phase.
 
 `main_faults()` (mode `faults`) guards the fault plane
 (kubernetes_tpu/faults): a seeded chaos drain — uploader death,
@@ -1602,6 +1612,108 @@ def main_faults() -> dict:
     }
 
 
+def main_restart() -> dict:
+    """Crash-restart smoke (kubernetes_tpu/restart): ONE persistent
+    FakeAPIServer holds the mixed (anti + hard-spread + plain) workload;
+    a deterministic ``crash:mid-bind-chunk@2`` kill-point simulates
+    ``kill -9`` mid-drain — some binds of the chunk landed, the rest
+    never happened — the Supervisor buries the dead instance, cold-start
+    reconciles a FRESH one from the relist (same persistent compile
+    ladder: the re-warm is trace-only), and the resumed drain completes.
+    Asserts the crash-restart acceptance set: the kill fired, exactly
+    one restart, zero lost / zero double-bound pods, no node
+    over-commit, a clean shadow audit on the survivor,
+    ``misses_after_warmup == 0`` on the restarted incarnation, and the
+    reconciliation wall reported by phase (the report AND
+    ``scheduler_restart_reconcile_duration_seconds{phase}``)."""
+    import tempfile
+
+    from kubernetes_tpu.apiserver.store import FakeAPIServer
+    from kubernetes_tpu.metrics import metrics as M
+    from kubernetes_tpu.restart import PHASES, check_invariants, run_cell
+
+    api = FakeAPIServer()
+    nodes, pods = tiny_commit_plane_config()
+    for n in nodes:
+        api.create("nodes", n)
+    created = []
+    for p in pods:
+        created.append(p.key())
+        api.create("pods", p)
+
+    # baseline the process-global counters (the PR 10 never-the-shared-
+    # registry rule: earlier tests in a full run already incremented them)
+    mm0 = M.bind_conflicts.value("mismatch")
+    restarts0 = M.restarts.value()
+    phase_counts0 = {
+        ph: M.restart_reconcile_duration.count(ph) for ph in PHASES
+    }
+
+    cache_dir = tempfile.mkdtemp(prefix="ktpu_restart_smoke_")
+    rep = run_cell(
+        api, "crash:mid-bind-chunk@2", compile_cache_dir=cache_dir,
+        scheduler_kwargs=dict(batch_size=SMOKE_BATCH, speculate=False),
+        budget_s=180.0,
+    )
+    problems = list(rep.problems)
+    if not rep.completed:
+        problems.append("resumed drain never completed")
+    if rep.crashes != 1:
+        problems.append(f"expected exactly 1 kill, saw {rep.crashes}")
+    if len(rep.incarnations) != 2:
+        problems.append(f"expected 2 incarnations, saw {len(rep.incarnations)}")
+    surv = rep.final.sched
+    problems += check_invariants(
+        api, created, sched=surv,
+        mismatch_conflicts=M.bind_conflicts.value("mismatch") - mm0,
+    )
+    if surv.compile_plan.stats["misses_after_warmup"]:
+        problems.append(
+            f"misses_after_warmup="
+            f"{surv.compile_plan.stats['misses_after_warmup']} on the "
+            "restarted incarnation (the persistent ladder re-warm must "
+            "be trace-only)"
+        )
+    report = rep.final.report
+    if report is None or not report.phases_s:
+        problems.append("survivor carries no phase-timed reconcile report")
+    else:
+        missing = [ph for ph in PHASES if ph not in report.phases_s]
+        if missing:
+            problems.append(f"reconcile report missing phases: {missing}")
+    # the wall also reached the exposition surface, per phase (2 cold
+    # starts ran: the first incarnation's and the restarted one's)
+    under_counted = [
+        ph for ph in PHASES
+        if M.restart_reconcile_duration.count(ph) - phase_counts0[ph] < 2
+    ]
+    if under_counted:
+        problems.append(
+            "scheduler_restart_reconcile_duration_seconds missing phase "
+            f"observations: {under_counted}"
+        )
+    if M.restarts.value() - restarts0 < 2:
+        problems.append("scheduler_restarts_total did not count the cold starts")
+
+    # teardown (harness hygiene)
+    for inc in rep.incarnations:
+        for inf in inc.informers.values():
+            inf.stop()
+    surv.close()
+    assert not problems, "; ".join(problems)
+    return {
+        "config": "tiny_restart_smoke",
+        "crashes": rep.crashes,
+        "incarnations": len(rep.incarnations),
+        "bound": sum(1 for p in api.list("pods")[0] if p.node_name),
+        "reconcile_phases_s": {
+            k: round(v, 6) for k, v in report.phases_s.items()
+        },
+        "reconcile_total_s": round(report.total_s, 6),
+        "misses_after_warmup": surv.compile_plan.stats["misses_after_warmup"],
+    }
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
     if mode == "preempt":
@@ -1638,6 +1750,10 @@ if __name__ == "__main__":
                 "uploader_restarts", "relists",
             )
         }))
+        sys.exit(0)
+    elif mode == "restart":
+        d = main_restart()
+        print(json.dumps(d))
         sys.exit(0)
     else:
         d = main(sharded=(mode == "sharded"))

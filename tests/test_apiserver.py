@@ -72,9 +72,14 @@ def test_bind_subresource_conflicts():
     api.create("pods", make_pod("p0", cpu_milli=1, mem=0))
     api.bind("default", "p0", "n1")
     assert api.get("pods", "default/p0").node_name == "n1"
-    api.bind("default", "p0", "n1")  # idempotent re-bind to same node ok
+    # BindingREST semantics: ANY re-bind of a bound pod is 409 — the
+    # same-node case too (the idempotent-replay handling lives with the
+    # binder, client/informer.APIBinder, which verifies the bound node)
+    with pytest.raises(ConflictError):
+        api.bind("default", "p0", "n1")
     with pytest.raises(ConflictError):
         api.bind("default", "p0", "n2")
+    assert api.get("pods", "default/p0").node_name == "n1"
 
 
 # --- informer ---------------------------------------------------------------

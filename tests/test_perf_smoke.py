@@ -263,3 +263,40 @@ def test_perf_smoke_fault_plane_chaos(tmp_path, monkeypatch):
     assert detail["audits"].get("divergent", 0) >= 1
     assert detail["uploader_restarts"] == 1
     assert detail["evicted"] > 0  # the preemption wave really preempted
+
+
+def test_perf_smoke_crash_restart(tmp_path, monkeypatch):
+    """Crash-restart acceptance, tier-1-fast: a deterministic
+    `crash:mid-bind-chunk` kill-point mid-drain, the supervised restart
+    (fresh instance, cold-start reconciliation from the persistent
+    FakeAPIServer's relist), and the resumed drain to completion — zero
+    lost pods, zero double-bound pods, no node over-commit, a clean
+    shadow audit on the survivor, `misses_after_warmup == 0` on the
+    restarted incarnation (the persistent ladder re-warm is trace-only),
+    and the reconciliation wall reported per phase through the report
+    AND `scheduler_restart_reconcile_duration_seconds{phase}` — all
+    under the lock-order audit."""
+    monkeypatch.setenv("KTPU_LOCK_AUDIT", "1")
+    monkeypatch.setenv("KTPU_BLACKBOX_DIR", str(tmp_path / "bb"))
+    monkeypatch.delenv("KTPU_FAULTS", raising=False)
+    monkeypatch.delenv("KTPU_COMPILE_CACHE_DIR", raising=False)
+    from kubernetes_tpu.analysis.lockorder import REGISTRY
+
+    REGISTRY.reset()
+    if _SCRIPTS not in sys.path:
+        sys.path.insert(0, _SCRIPTS)
+    import perf_smoke
+
+    detail = perf_smoke.main_restart()  # raises AssertionError on regression
+    REGISTRY.assert_acyclic()
+    report = REGISTRY.report()
+    assert report["acquisitions"] > 0 and report["edges"]
+    assert detail["crashes"] == 1
+    assert detail["incarnations"] == 2
+    assert detail["misses_after_warmup"] == 0
+    assert detail["bound"] == perf_smoke.N_PODS
+    # every reconciliation phase was timed on the survivor
+    from kubernetes_tpu.restart import PHASES
+
+    for ph in PHASES:
+        assert ph in detail["reconcile_phases_s"], ph

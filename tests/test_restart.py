@@ -1,0 +1,483 @@
+"""Crash-restart plane suite (kubernetes_tpu/restart): the kill-point ×
+workload chaos matrix, the mid-drain double restart, cold-start
+reconciliation units, bind idempotency, the nomination wire round-trip,
+and graceful-shutdown hardening.
+
+Every matrix cell drives ONE persistent FakeAPIServer through a
+supervised drain with a deterministic `crash:<site>[@n]` kill-point:
+the instance dies at the named pipeline stage, the supervisor buries
+it, builds a fresh scheduler, cold-start-reconciles from the relist,
+and resumes — asserting zero lost pods, zero double-bound pods, no
+node over-commit, a clean shadow audit on the survivor, and
+misses_after_warmup == 0 on the restarted incarnation (the persistent
+compile ladder makes the re-warm trace-only).
+"""
+
+import threading
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    LabelSelector,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+)
+from kubernetes_tpu.apiserver.store import ConflictError, FakeAPIServer
+from kubernetes_tpu.client.informer import APIBinder, BindMismatchError
+from kubernetes_tpu.metrics import metrics as M
+from kubernetes_tpu.models.generators import make_node, make_pod
+from kubernetes_tpu.restart import (
+    Supervisor,
+    check_invariants,
+    cold_start,
+    make_scheduler_factory,
+)
+from kubernetes_tpu.faults.inject import FaultPlan, SimulatedCrash
+from kubernetes_tpu.scheduler.driver import (
+    POD_GROUP_LABEL,
+    POD_GROUP_MIN_AVAILABLE,
+    Binder,
+    Scheduler,
+)
+from kubernetes_tpu.state.cache import SchedulerCache
+from kubernetes_tpu.state.queue import PriorityQueue
+
+N_NODES = 4
+NODE_CPU = 4000  # milli
+
+#: the six kill-points, each pinned at a call index that lands mid-drain
+#: (batch 1 commits/binds/preempts, batch 2 solves after the injected
+#: late arrivals) — the same spec is deterministic across runs and
+#: workloads by the FaultPlan counted-trigger contract
+KILL_POINTS = (
+    "crash:post-solve@2",
+    "crash:mid-apply@1",
+    "crash:mid-bind-chunk@2",
+    "crash:post-bind@2",
+    "crash:mid-preemption@1",
+    "crash:mid-uploader-flush@1",
+)
+
+WORKLOADS = ("mixed", "anti", "gang", "preemption")
+
+#: scheduler shape shared by every cell so the whole matrix rides one
+#: set of XLA programs (jit caches are process-wide)
+CELL_KWARGS = dict(batch_size=16, enable_preemption=True, speculate=False)
+
+
+def build_cluster(api):
+    for i in range(N_NODES):
+        api.create("nodes", make_node(
+            f"n{i}", cpu_milli=NODE_CPU, mem=32 * 2**30,
+            labels={"kubernetes.io/hostname": f"n{i}",
+                    "zone": "za" if i % 2 else "zb"},
+        ))
+
+
+def build_workload(api, kind: str, salt: str):
+    """Create the cell's UPFRONT pods. Every workload shares the same
+    skeleton so every kill-point can fire in every cell: bound
+    low-priority victims (one per node), plain pods (batch 1 is a lean
+    bulk commit → mid-apply/mid-bind-chunk/post-bind), a high-priority
+    preemptor that only fits by eviction (→ mid-preemption), and
+    workload-specific term-carrying pods. Returns (created_keys,
+    evictable_keys, late_pods) — late_pods are injected after batch 1
+    (→ post-solve@2 lands on a real second batch, and their admission
+    dirties the staged slabs → mid-uploader-flush)."""
+    created, evict = [], []
+
+    def create(p):
+        created.append(p.key())
+        api.create("pods", p)
+
+    for i in range(N_NODES):  # bound victims: 3000m of each node's 4000m
+        v = make_pod(f"v{salt}-{i}", cpu_milli=3000, mem=2**20,
+                     labels={"app": f"victim-{salt}"}, node_name=f"n{i}")
+        v.priority = 0
+        create(v)
+        evict.append(v.key())
+    for i in range(N_NODES):  # plains: 600m into each node's 1000m gap
+        create(make_pod(f"pl{salt}-{i}", cpu_milli=600, mem=2**20))
+    if kind in ("mixed", "anti"):
+        n_anti = 2 if kind == "mixed" else 4
+        for i in range(n_anti):  # required self-anti: one per node
+            create(make_pod(
+                f"an{salt}-{i}", cpu_milli=200, mem=2**20,
+                labels={"app": f"anti-{salt}"},
+                affinity=Affinity(pod_anti_affinity=PodAntiAffinity(required=[
+                    PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"app": f"anti-{salt}"}),
+                        topology_key="kubernetes.io/hostname",
+                    )])),
+            ))
+    if kind == "mixed":
+        for i in range(2):  # DoNotSchedule zone spread
+            create(make_pod(
+                f"sp{salt}-{i}", cpu_milli=100, mem=2**20,
+                labels={"app": f"spread-{salt}"},
+                topology_spread_constraints=[TopologySpreadConstraint(
+                    max_skew=1, topology_key="zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector=LabelSelector(
+                        match_labels={"app": f"spread-{salt}"}),
+                )],
+            ))
+    hi = make_pod(f"hi{salt}", cpu_milli=1500, mem=2**20,
+                  labels={"app": f"hi-{salt}"})
+    hi.priority = 1000
+    create(hi)
+
+    late = [make_pod(f"lt{salt}-{i}", cpu_milli=100, mem=2**20)
+            for i in range(2)]
+    if kind == "gang":  # the gang arrives late so batch 1 stays lean
+        for i in range(4):
+            late.append(make_pod(
+                f"gg{salt}-{i}", cpu_milli=100, mem=2**20,
+                labels={POD_GROUP_LABEL: f"gang-{salt}",
+                        POD_GROUP_MIN_AVAILABLE: "4"},
+            ))
+    created.extend(p.key() for p in late)
+    return created, evict, late
+
+
+def run_matrix_cell(kill_spec: str, kind: str, cache_dir: str, salt: str,
+                    budget_s: float = 60.0):
+    """One supervised chaos cell; returns (report, problems)."""
+    api = FakeAPIServer()
+    build_cluster(api)
+    created, evict, late = build_workload(api, kind, salt)
+    mm0 = M.bind_conflicts.value("mismatch")
+
+    injected = [False]
+
+    def inject_late():
+        injected[0] = True
+        for p in late:
+            api.create("pods", p)
+
+    def on_tick(sup, inc):
+        # inject the late arrivals once the drain is underway (after
+        # batch 1) so a second batch, and fresh slab dirt, always exist
+        if not injected[0] and inc.sched.stats.get("batches", 0) >= 1:
+            inject_late()
+
+    def on_restart(sup):
+        # a crash that fired before the live injection window means the
+        # late traffic "arrived while the process was down": it lands in
+        # the store BEFORE the successor cold-starts, so the relist (and
+        # the warmup census over the relisted queue — solve_gang etc.
+        # must warm from what is actually pending) sees it. A mid-drain
+        # NEW-kind arrival is an ordinary live-process miss, orthogonal
+        # to what this matrix pins.
+        if not injected[0]:
+            inject_late()
+
+    plan = FaultPlan.parse(kill_spec)
+    ref = {}
+    factory = make_scheduler_factory(
+        ref, api, compile_cache_dir=cache_dir,
+        scheduler_kwargs=dict(CELL_KWARGS),
+    )
+    sup = Supervisor(api, plan, factory)
+    sup.on_tick = on_tick
+    sup.on_restart = on_restart
+    ref["sup"] = sup
+    rep = sup.run(budget_s=budget_s)
+    problems = list(rep.problems)
+    if not rep.completed:
+        problems.append("drain never completed")
+    if rep.crashes < kill_spec.count("crash:"):
+        problems.append(
+            f"expected {kill_spec.count('crash:')} kill(s), saw "
+            f"{rep.crashes} — the kill-point never fired"
+        )
+    surv = rep.final.sched
+    problems += check_invariants(
+        api, created, evictable_keys=evict, sched=surv,
+        mismatch_conflicts=M.bind_conflicts.value("mismatch") - mm0,
+    )
+    # the RESTARTED incarnation re-warmed trace-only from the persistent
+    # ladder: zero compile misses after its warmup
+    if surv.compile_plan.stats["misses_after_warmup"]:
+        problems.append(
+            f"misses_after_warmup="
+            f"{surv.compile_plan.stats['misses_after_warmup']} on the "
+            "restarted incarnation"
+        )
+    if rep.final.report is None or not rep.final.report.phases_s.get("warmup"):
+        problems.append("survivor carries no phase-timed reconcile report")
+    # teardown (harness hygiene, not part of the contract under test)
+    for inc in rep.incarnations:
+        for inf in inc.informers.values():
+            inf.stop()
+    surv.close()
+    return rep, problems
+
+
+@pytest.mark.parametrize("kind", WORKLOADS)
+def test_restart_matrix(kind, tmp_path):
+    """The kill-point × workload grid: every kill-point fires against
+    every workload; every cell restarts, reconciles, and completes with
+    the full invariant set green. Each cell gets its OWN persistent
+    ladder dir — the restarted incarnation loads exactly what its dead
+    predecessor persisted (a shared dir would also re-trace every other
+    cell's specs at each warmup, O(cells × specs) setup for nothing)."""
+    failures = []
+    for k, kill in enumerate(KILL_POINTS):
+        rep, problems = run_matrix_cell(
+            kill, kind, str(tmp_path / f"ladder-{k}"), salt=f"{kind[:2]}{k}"
+        )
+        if problems:
+            failures.append(f"[{kind} × {kill}] {'; '.join(problems)}")
+    assert not failures, "\n".join(failures)
+
+
+def test_restart_double_kill_mid_drain(tmp_path):
+    """A drain that dies TWICE — mid-bind-chunk, then post-solve on the
+    restarted incarnation — must still converge with the invariants
+    green (the reconcile path is idempotent under repetition)."""
+    rep, problems = run_matrix_cell(
+        "crash:mid-bind-chunk@2;crash:post-solve@3", "mixed",
+        str(tmp_path / "ladder"), salt="dbl",
+    )
+    assert rep.crashes == 2, (rep.crashes, rep.problems)
+    assert len(rep.incarnations) == 3
+    assert not problems, "\n".join(problems)
+
+
+# ---------------------------------------------------------------------------
+# cold-start reconciliation units
+# ---------------------------------------------------------------------------
+
+def test_cold_start_rebuilds_cache_queue_and_report():
+    api = FakeAPIServer()
+    build_cluster(api)
+    bound = make_pod("b0", cpu_milli=500, mem=2**20, node_name="n1")
+    api.create("pods", bound)
+    for i in range(3):
+        api.create("pods", make_pod(f"q{i}", cpu_milli=100, mem=2**20))
+    foreign = make_pod("f0", cpu_milli=100, mem=2**20)
+    foreign.scheduler_name = "other-scheduler"
+    api.create("pods", foreign)
+
+    sched = Scheduler(cache=SchedulerCache(), queue=PriorityQueue(),
+                      **CELL_KWARGS)
+    try:
+        report = cold_start(sched, api)
+        assert report.nodes == N_NODES
+        assert report.bound == 1
+        assert report.pending == 3  # the foreign-scheduler pod is NOT ours
+        assert sched.cache.pod_count() == 1
+        assert not sched.cache.is_assumed("default/b0")  # confirmed, not assumed
+        assert sched.queue.pending_count() == 3
+        assert set(report.phases_s) >= {
+            "relist", "nodes", "assume", "queue", "nominations",
+            "informers", "banks", "warmup",
+        }
+        assert sched.restart_report["bound"] == 1
+        # the report reaches the census (schema v3) and ktpu_top renders it
+        from kubernetes_tpu.obs.introspect import census, validate_census
+
+        doc = census(sched)
+        assert validate_census(doc) == []
+        assert doc["planes"]["restart"]["reconciled"] is True
+        import os
+        import sys
+
+        scripts = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts")
+        if scripts not in sys.path:
+            sys.path.insert(0, scripts)
+        import ktpu_top
+
+        out = ktpu_top.render_census(doc)
+        assert "restart" in out and "reconciled" in out
+    finally:
+        for inf in getattr(sched, "restart_informers", {}).values():
+            inf.stop()
+        sched.close()
+
+
+def test_cold_start_reconstructs_nomination_overlay():
+    """The nominated-node wire round-trip: a preemption nomination
+    persisted via update_pod_status survives a relist — the fresh
+    queue's overlay matches the wire EXACTLY, and the nominee usage
+    fold sees the same (node, pod) extras the dead process saw."""
+    api = FakeAPIServer()
+    build_cluster(api)
+    p = make_pod("nom0", cpu_milli=1500, mem=2**20)
+    p.priority = 1000
+    api.create("pods", p)
+    api.update_pod_status("default", "nom0", nominated_node_name="n2")
+    # relisted pod carries the nomination on the wire
+    assert api.get("pods", "default/nom0").nominated_node_name == "n2"
+
+    sched = Scheduler(cache=SchedulerCache(), queue=PriorityQueue(),
+                      **CELL_KWARGS)
+    try:
+        report = cold_start(sched, api, warmup=False, start_informers=False)
+        assert report.nominations == 1
+        assert report.nomination_mismatches == 0
+        noms = sched.queue.nomination_extras(set())
+        assert [(n, pp.key()) for n, pp in noms] == [("n2", "default/nom0")]
+        # usage-fold parity: the overlay the device fold consumes is
+        # exactly the pre-crash nomination
+        assert [pp.key() for pp in sched.queue.nominated_pods_for_node("n2")] \
+            == ["default/nom0"]
+    finally:
+        sched.close()
+
+
+def test_nomination_cleared_on_bind():
+    api = FakeAPIServer()
+    build_cluster(api)
+    api.create("pods", make_pod("c0", cpu_milli=100, mem=2**20))
+    api.update_pod_status("default", "c0", nominated_node_name="n1")
+    api.bind("default", "c0", "n1")
+    pod = api.get("pods", "default/c0")
+    assert pod.node_name == "n1"
+    assert pod.nominated_node_name == ""  # clear-on-bind
+
+
+# ---------------------------------------------------------------------------
+# bind idempotency (the benign/mismatch Conflict split)
+# ---------------------------------------------------------------------------
+
+def test_bind_conflict_benign_vs_mismatch():
+    api = FakeAPIServer()
+    build_cluster(api)
+    api.create("pods", make_pod("ic0", cpu_milli=100, mem=2**20))
+    binder = APIBinder(api)
+    pod = api.get("pods", "default/ic0")
+    b0 = M.bind_conflicts.value("benign")
+    m0 = M.bind_conflicts.value("mismatch")
+    binder.bind(pod, "n0")
+    # replay of a landed bind: the store 409s, the binder verifies the
+    # node and treats it as success
+    binder.bind(pod, "n0")
+    assert M.bind_conflicts.value("benign") == b0 + 1
+    # a DIFFERENT node is a double-schedule: escalates, never silent
+    with pytest.raises(BindMismatchError):
+        binder.bind(pod, "n3")
+    assert M.bind_conflicts.value("mismatch") == m0 + 1
+    assert api.get("pods", "default/ic0").node_name == "n0"  # store unscathed
+    # the raw store surface stays strict (BindingREST semantics)
+    with pytest.raises(ConflictError):
+        api.bind("default", "ic0", "n0")
+
+
+def test_benign_conflict_not_routed_to_backoff():
+    """The commit path counts a same-node replay as SCHEDULED: the pod
+    must not land in the bind-failure backoff tier."""
+    api = FakeAPIServer()
+    build_cluster(api)
+    # pinned to n0 so the replayed decision matches the landed bind (a
+    # DIFFERENT node would be a true mismatch and SHOULD escalate)
+    p = make_pod("rb0", cpu_milli=100, mem=2**20,
+                 node_selector={"kubernetes.io/hostname": "n0"})
+    api.create("pods", p)
+    # simulate the landed-but-unacknowledged first attempt
+    api.bind("default", "rb0", "n0")
+
+    cache = SchedulerCache()
+    queue = PriorityQueue()
+    binder = APIBinder(api)
+    rpc0 = M.bind_failures.value("rpc")
+    sched = Scheduler(cache=cache, queue=queue, binder=Binder(binder.bind),
+                      **CELL_KWARGS)
+    try:
+        # force the replay: the scheduler believes the pod pending and
+        # solves it onto n0's ample capacity; the bind 409s benign
+        queue.add(p)
+        for node in api.list("nodes")[0]:
+            cache.add_node(node)
+        deadline = time.monotonic() + 20
+        while queue.pending_count() and time.monotonic() < deadline:
+            sched.schedule_batch()
+            sched.wait_for_binds()
+        assert M.bind_failures.value("rpc") == rpc0  # no backoff routing
+        assert api.get("pods", "default/rb0").node_name == "n0"
+    finally:
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown hardening
+# ---------------------------------------------------------------------------
+
+def _pkg_threads():
+    return {
+        t for t in threading.enumerate()
+        if t.name.startswith(("bind", "commit-apply", "ingest-upload",
+                              "terms-upload", "health-monitor",
+                              "compile-warmup"))
+        and t.is_alive()
+    }
+
+
+def test_close_is_idempotent_and_leaks_no_threads():
+    # snapshot first: assert on THIS scheduler's delta only — in a full
+    # suite run, other tests' daemon uploaders may outlive their tests,
+    # and this test's contract is "close() leaks nothing it created"
+    pre_existing = _pkg_threads()
+    api = FakeAPIServer()
+    build_cluster(api)
+    for i in range(4):
+        api.create("pods", make_pod(f"cl{i}", cpu_milli=100, mem=2**20))
+    sched = Scheduler(cache=SchedulerCache(), queue=PriorityQueue(),
+                      binder=Binder(APIBinder(api).bind), **CELL_KWARGS)
+    cold_start(sched, api)
+    sched.enable_health_monitor(interval=0.05)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        live, _ = api.list("pods")
+        if all(p.node_name for p in live):
+            break
+        sched.schedule_batch()
+        sched.wait_for_binds()
+
+    def ours():
+        return _pkg_threads() - pre_existing
+
+    assert ours(), "expected live worker threads before close"
+    for inf in sched.restart_informers.values():
+        inf.stop()
+    sched.close()
+    deadline = time.monotonic() + 5
+    while ours() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not ours(), f"leaked threads: {ours()}"
+    # the final census was emitted and is schema-valid
+    from kubernetes_tpu.obs.introspect import validate_census
+
+    assert sched.last_census is not None
+    assert validate_census(sched.last_census) == []
+    # second close: clean no-op
+    sched.close()
+    assert not ours()
+
+
+def test_simulated_crash_passes_fault_handlers():
+    """SimulatedCrash must NOT be absorbed by any `except Exception`
+    fault handler — kill -9 gives nothing a chance to recover."""
+    assert issubclass(SimulatedCrash, BaseException)
+    assert not issubclass(SimulatedCrash, Exception)
+    plan = FaultPlan.parse("crash:post-solve@1")
+    with pytest.raises(SimulatedCrash):
+        plan.crash_if("post-solve")
+    assert plan.crashed == "post-solve"
+    # the latch fences every later kill-point call AND the write gate
+    with pytest.raises(SimulatedCrash):
+        plan.crash_if("mid-apply")
+    with pytest.raises(SimulatedCrash):
+        plan.crash_gate()
+    # the rearmed twin shares counts but passes the gate
+    twin = plan.rearm()
+    twin.crash_gate()
+    assert twin.events is plan.events
