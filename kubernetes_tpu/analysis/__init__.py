@@ -7,9 +7,12 @@ enforced here as machine-checked rules instead of review lore:
   scripts/ktpu_lint.py        CLI over the checker registry (``--check``
                               gates preflight and tier-1)
   analysis/core.py            walk/annotation/baseline infrastructure
-  analysis/checkers.py        the KTPU001..KTPU005 rules
-  analysis/lockorder.py       runtime lock-order/race harness
-                              (KTPU_LOCK_AUDIT=1)
+  analysis/checkers.py        the module-local KTPU001..KTPU005 rules
+  analysis/callgraph.py       repo-wide conservative call graph
+  analysis/roles.py           thread-role inference + the
+                              interprocedural KTPU006..KTPU008 rules
+  analysis/lockorder.py       runtime lock-order/race harness + the
+                              thread-role audit twin (KTPU_LOCK_AUDIT=1)
 
 Each rule is the static twin of a runtime guarantee the benches already
 assert (see INVARIANTS.md for the rule → historical-bug cross-reference).

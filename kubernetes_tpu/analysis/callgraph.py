@@ -1,0 +1,691 @@
+"""Repo-wide call graph: the interprocedural substrate of KTPU006–008.
+
+The module-local checkers (checkers.py) see one file at a time; the
+thread-role rules need to know *who can call what across the whole
+package* — an unannotated attribute written on the uploader thread and
+read on the driver is invisible module-locally, and a hot-path function
+that forces a host sync one call deep is invisible to KTPU004. This
+module builds the conservative call graph those rules walk:
+
+* **functions** — every ``def`` in every scanned module, keyed by a
+  stable uid ``<relpath>::<qualname>`` (nested defs included: a bind
+  closure submitted to a pool is its own node);
+* **classes** — name, bases (resolved through imports), own methods,
+  and an *attribute type map* inferred from ``__init__``/class-body
+  assignments (``self.x = ClassName(...)``, ``self.x = param`` with an
+  annotated param, ``self.x: T``), so ``self.queue.pop_batch()``
+  resolves to ``PriorityQueue.pop_batch`` instead of every ``pop_batch``
+  in the tree;
+* **edges** — caller → callee, each tagged ``direct`` (module function,
+  ``self.method`` dispatch through the class hierarchy, typed-receiver
+  method, resolved import) or ``fuzzy`` (name-only method match, used
+  as a last resort for *distinctive* names — see ``_FUZZY_BLOCKLIST``).
+
+Resolution is deliberately conservative in the sound direction for role
+propagation: ``self.m()`` dispatches to ``m`` anywhere in the class's
+hierarchy (ancestors AND repo subclasses — the receiver may be any of
+them), a typed receiver includes subclass overrides, and a class call
+edges to every ``__init__`` on its MRO. Where the graph cannot resolve
+(callbacks stored in attributes, ``Thread(target=...)`` indirection),
+the ``# ktpu: thread-entry`` seed grammar in roles.py closes the gap —
+and the runtime role audit (lockorder.assert_roles_subset) is the
+soundness probe that catches anything both of them miss.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import ModuleInfo, dotted_name, load_module
+
+#: method names too generic for name-only (fuzzy) resolution: linking
+#: every `x.get(...)` to every repo class defining `get` would weld the
+#: whole graph together. Calls on these either resolve typed or not at
+#: all; the runtime role audit exists to catch the "not at all" misses.
+_FUZZY_BLOCKLIST = frozenset({
+    "get", "set", "add", "pop", "put", "update", "items", "keys", "values",
+    "append", "extend", "insert", "remove", "discard", "clear", "copy",
+    "count", "index", "sort", "reverse", "join", "split", "strip", "close",
+    "start", "stop", "run", "wait", "notify", "notify_all", "acquire",
+    "release", "read", "write", "flush", "send", "recv", "encode", "decode",
+    "format", "replace", "match", "search", "group", "setdefault",
+    "submit", "result", "done", "cancel", "shutdown", "is_set", "list",
+    "delete", "create", "name", "key", "keys_view", "exists", "mkdir",
+    "lower", "upper", "startswith", "endswith",
+})
+
+#: above this many same-name candidates a fuzzy link is noise, not signal
+_FUZZY_MAX_TARGETS = 4
+
+
+@dataclass
+class FuncInfo:
+    """One function/method/nested def."""
+
+    uid: str  # "<relpath>::<qualname>" — stable across line edits
+    relpath: str
+    qualname: str  # dotted, as ModuleInfo.qualname renders it
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    mod: ModuleInfo
+    cls: Optional["ClassInfo"] = None  # immediate enclosing class, if any
+    #: nearest enclosing class even for nested defs (a bind closure's
+    #: `self` still means the method's class) — set by _link_classes
+    owner_cls: Optional["ClassInfo"] = None
+
+
+@dataclass
+class ClassInfo:
+    relpath: str
+    name: str
+    node: ast.ClassDef
+    mod: ModuleInfo
+    base_names: List[str] = field(default_factory=list)  # as written
+    bases: List["ClassInfo"] = field(default_factory=list)  # resolved
+    subclasses: List["ClassInfo"] = field(default_factory=list)
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)  # own only
+    #: attr -> ClassInfo (inferred instance type of self.<attr>)
+    attr_types: Dict[str, "ClassInfo"] = field(default_factory=dict)
+    #: attr -> set of lock ROLE names (audited_lock("x") ctor sites +
+    #: aliases like `self._lock = stage._lock`); sets because a subclass
+    #: may rebind the aliased source (PodStage "stage" vs TermStage "terms")
+    lock_attrs: Dict[str, Set[str]] = field(default_factory=dict)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.relpath, self.name)
+
+    def mro_like(self) -> List["ClassInfo"]:
+        """self + resolved ancestors, breadth-first (good enough for
+        attribute/method lookup; diamonds just mean both branches)."""
+        out: List[ClassInfo] = []
+        frontier = [self]
+        seen: Set[Tuple[str, str]] = set()
+        while frontier:
+            c = frontier.pop(0)
+            if c.key in seen:
+                continue
+            seen.add(c.key)
+            out.append(c)
+            frontier.extend(c.bases)
+        return out
+
+    def family(self) -> List["ClassInfo"]:
+        """self + ancestors + all transitive repo subclasses — every
+        class an instance at a ``self.m()`` call site might be."""
+        out = {c.key: c for c in self.mro_like()}
+        frontier = [self]
+        while frontier:
+            c = frontier.pop(0)
+            for s in c.subclasses:
+                if s.key not in out:
+                    out[s.key] = s
+                    frontier.append(s)
+        return list(out.values())
+
+    def find_method(self, name: str) -> List[FuncInfo]:
+        """`name` looked up over the family: the ancestors supply the
+        inherited implementation, the subclasses the overrides."""
+        hits: List[FuncInfo] = []
+        for c in self.family():
+            fi = c.methods.get(name)
+            if fi is not None:
+                hits.append(fi)
+        return hits
+
+
+@dataclass
+class Edge:
+    src: str  # FuncInfo.uid
+    dst: str
+    kind: str  # "direct" | "fuzzy"
+    line: int
+
+
+class RepoGraph:
+    """The package-wide index + call edges."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}  # relpath -> info
+        self.functions: Dict[str, FuncInfo] = {}  # uid -> info
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}
+        self.class_by_name: Dict[str, List[ClassInfo]] = {}
+        self.methods_by_name: Dict[str, List[FuncInfo]] = {}
+        self.module_funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        #: relpath -> alias -> ("module", relpath) | ("symbol", relpath,
+        #: name) | ("external", dotted)
+        self.imports: Dict[str, Dict[str, Tuple]] = {}
+        #: relpath -> module-level var name -> ClassInfo
+        self.module_var_types: Dict[str, Dict[str, ClassInfo]] = {}
+        self.edges: Dict[str, List[Edge]] = {}
+        self._edge_seen: Set[Tuple[str, str, str]] = set()
+        #: func ast node -> uid (innermost-def attribution for walks)
+        self.node_uid: Dict[int, str] = {}
+
+    # -- queries -------------------------------------------------------------
+
+    def callees(self, uid: str, fuzzy: bool = True) -> List[Edge]:
+        es = self.edges.get(uid, [])
+        return es if fuzzy else [e for e in es if e.kind == "direct"]
+
+    def function_for_node(self, mod: ModuleInfo, node: ast.AST) -> Optional[FuncInfo]:
+        """The innermost enclosing def's FuncInfo for an arbitrary node."""
+        fn = node if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+            else mod.enclosing_function(node)
+        if fn is None:
+            return None
+        return self.functions.get(self.node_uid.get(id(fn), ""))
+
+    def resolve_class_name(self, relpath: str, name: str) -> Optional[ClassInfo]:
+        """`name` as visible from module `relpath` (local class or
+        imported symbol), falling back to a unique global name."""
+        mod_imports = self.imports.get(relpath, {})
+        head = name.split(".")[0]
+        tgt = mod_imports.get(head)
+        if tgt is not None:
+            if tgt[0] == "symbol":
+                ci = self.classes.get((tgt[1], tgt[2]))
+                if ci is not None:
+                    return ci
+            elif tgt[0] == "module" and "." in name:
+                ci = self.classes.get((tgt[1], name.split(".", 1)[1]))
+                if ci is not None:
+                    return ci
+            return None
+        ci = self.classes.get((relpath, head))
+        if ci is not None:
+            return ci
+        cands = self.class_by_name.get(head, [])
+        return cands[0] if len(cands) == 1 else None
+
+    # -- construction --------------------------------------------------------
+
+    def add_edge(self, src: str, dst: str, kind: str, line: int) -> None:
+        key = (src, dst, kind)
+        if src == dst or key in self._edge_seen:
+            return
+        self._edge_seen.add(key)
+        self.edges.setdefault(src, []).append(Edge(src, dst, kind, line))
+
+
+# ---------------------------------------------------------------------------
+# import resolution
+# ---------------------------------------------------------------------------
+
+def _module_relpath_candidates(dotted: str) -> List[str]:
+    p = dotted.replace(".", "/")
+    return [p + ".py", p + "/__init__.py"]
+
+
+def _resolve_imports(mods: Dict[str, ModuleInfo]) -> Dict[str, Dict[str, Tuple]]:
+    known = set(mods)
+    out: Dict[str, Dict[str, Tuple]] = {}
+    for rel, mod in mods.items():
+        table: Dict[str, Tuple] = {}
+        # package dirs of this module; for pkg/__init__.py the package
+        # IS the containing dir, so the same dirname expression holds
+        pkg_parts = rel.split("/")[:-1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    target = None
+                    for cand in _module_relpath_candidates(
+                        a.name if a.asname else a.name.split(".")[0]
+                    ):
+                        if cand in known:
+                            target = ("module", cand)
+                            break
+                    table[alias] = target or ("external", a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                else:
+                    base = []
+                stem = "/".join(base + (node.module or "").split("."))
+                stem = stem.strip("/").replace("//", "/")
+                mod_rel = None
+                for cand in (stem + ".py", stem + "/__init__.py"):
+                    if cand in known:
+                        mod_rel = cand
+                        break
+                for a in node.names:
+                    alias = a.asname or a.name
+                    if mod_rel is not None:
+                        # the symbol may itself be a submodule
+                        sub = None
+                        if mod_rel.endswith("/__init__.py"):
+                            subbase = mod_rel[: -len("__init__.py")] + a.name
+                            for cand in (subbase + ".py", subbase + "/__init__.py"):
+                                if cand in known:
+                                    sub = cand
+                                    break
+                        if sub is not None:
+                            table[alias] = ("module", sub)
+                        else:
+                            table[alias] = ("symbol", mod_rel, a.name)
+                    else:
+                        table[alias] = ("external", f"{node.module}.{a.name}")
+        out[rel] = table
+    return out
+
+
+# ---------------------------------------------------------------------------
+# type inference helpers
+# ---------------------------------------------------------------------------
+
+_LOCK_FACTORIES = {"audited_lock", "audited_rlock", "audited_condition"}
+
+
+def _annotation_class(graph: RepoGraph, relpath: str, ann: ast.AST) -> Optional[ClassInfo]:
+    """ClassInfo for a (possibly quoted / Optional-wrapped) annotation."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):
+        # Optional[X]/Union[X, ...] unwrap to their first class operand;
+        # container annotations (List[X], Dict[K, V], ...) deliberately
+        # resolve to nothing — the RECEIVER of a call is the container,
+        # not its element, so typing the attr as the element class would
+        # fabricate edges (x.append resolving to Worker.append, etc.)
+        head = dotted_name(ann.value) or ""
+        if head.split(".")[-1] in ("Optional", "Union"):
+            inner = ann.slice
+            if isinstance(inner, ast.Tuple):
+                inner = inner.elts[0] if inner.elts else None
+            return _annotation_class(graph, relpath, inner)
+        return None
+    nm = dotted_name(ann)
+    if nm is None:
+        return None
+    return graph.resolve_class_name(relpath, nm)
+
+
+class _TypeEnv:
+    """Per-function name → ClassInfo map: annotated params, one-step
+    local constructor/param assignments, and (through the closure chain)
+    the enclosing functions' locals."""
+
+    def __init__(self, graph: RepoGraph, fi: FuncInfo):
+        self.graph = graph
+        self.fi = fi
+        self.names: Dict[str, ClassInfo] = {}
+        chain = [fi.node] + [
+            f for f in fi.mod.enclosing_functions(fi.node)
+        ]
+        # outermost first so inner scopes override
+        for fn in reversed(chain):
+            self._fill_from(fn)
+
+    def _fill_from(self, fn) -> None:
+        graph, rel = self.graph, self.fi.relpath
+        args = list(fn.args.posonlyargs) + list(fn.args.args) + list(fn.args.kwonlyargs)
+        for a in args:
+            ci = _annotation_class(graph, rel, a.annotation)
+            if ci is not None:
+                self.names[a.arg] = ci
+        for node in ast.walk(fn):
+            if self.fi.mod.enclosing_function(node) is not fn and node is not fn:
+                continue
+            tgt_ci = None
+            if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                tgt_ci = _annotation_class(graph, rel, node.annotation)
+                if tgt_ci is not None:
+                    self.names[node.target.id] = tgt_ci
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                ci = _value_class(graph, rel, node.value, self.names)
+                if ci is not None:
+                    self.names[node.targets[0].id] = ci
+
+
+def _value_class(
+    graph: RepoGraph,
+    relpath: str,
+    value: ast.AST,
+    env_names: Optional[Dict[str, ClassInfo]] = None,
+) -> Optional[ClassInfo]:
+    """Inferred class of a simple rhs: ClassName(...), `x or ClassName(...)`,
+    or a name with a known type."""
+    if isinstance(value, ast.BoolOp):  # `param or Default()` idiom
+        for v in value.values:
+            ci = _value_class(graph, relpath, v, env_names)
+            if ci is not None:
+                return ci
+        return None
+    if isinstance(value, ast.Call):
+        nm = dotted_name(value.func)
+        if nm is not None:
+            return graph.resolve_class_name(relpath, nm)
+        return None
+    if isinstance(value, ast.Name) and env_names:
+        return env_names.get(value.id)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# graph construction
+# ---------------------------------------------------------------------------
+
+def _index_module(graph: RepoGraph, mod: ModuleInfo) -> None:
+    graph.modules[mod.relpath] = mod
+    # classes + functions
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            ci = ClassInfo(
+                relpath=mod.relpath,
+                name=node.name,
+                node=node,
+                mod=mod,
+                base_names=[dotted_name(b) or "" for b in node.bases],
+            )
+            graph.classes[ci.key] = ci
+            graph.class_by_name.setdefault(node.name, []).append(ci)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = mod.qualname(node)
+            uid = f"{mod.relpath}::{qual}"
+            fi = FuncInfo(
+                uid=uid, relpath=mod.relpath, qualname=qual,
+                name=node.name, node=node, mod=mod,
+            )
+            graph.functions[uid] = fi
+            graph.node_uid[id(node)] = uid
+
+
+def _link_classes(graph: RepoGraph) -> None:
+    for ci in graph.classes.values():
+        for bn in ci.base_names:
+            if not bn:
+                continue
+            base = graph.resolve_class_name(ci.relpath, bn)
+            if base is not None and base.key != ci.key:
+                ci.bases.append(base)
+                base.subclasses.append(ci)
+    # attach methods + module functions
+    for fi in graph.functions.values():
+        encl = fi.mod.parents.get(fi.node)
+        if isinstance(encl, ast.ClassDef):
+            ci = graph.classes.get((fi.relpath, encl.name))
+            if ci is not None:
+                fi.cls = ci
+                ci.methods[fi.name] = fi
+        elif isinstance(encl, ast.Module):
+            graph.module_funcs[(fi.relpath, fi.name)] = fi
+        owner = fi.mod.enclosing_class(fi.node)
+        if owner is not None:
+            fi.owner_cls = graph.classes.get((fi.relpath, owner.name))
+        graph.methods_by_name.setdefault(fi.name, []).append(fi)
+
+
+def _infer_attr_types(graph: RepoGraph) -> None:
+    """self.<attr> types + lock-role attrs, per class. Two passes so an
+    alias (`self._lock = stage._lock`) can read the source class's roles
+    regardless of scan order."""
+    env_cache: Dict[int, _TypeEnv] = {}  # per-function, not per-assignment
+
+    def env_for(fn) -> Optional[_TypeEnv]:
+        uid = graph.node_uid.get(id(fn))
+        if uid is None:
+            return None
+        env = env_cache.get(id(fn))
+        if env is None:
+            env = env_cache[id(fn)] = _TypeEnv(graph, graph.functions[uid])
+        return env
+
+    for ci in graph.classes.values():
+        for node in ast.walk(ci.node):
+            if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Attribute):
+                t = node.target
+                if isinstance(t.value, ast.Name) and t.value.id == "self":
+                    ann = _annotation_class(graph, ci.relpath, node.annotation)
+                    if ann is not None:
+                        ci.attr_types.setdefault(t.attr, ann)
+            if not isinstance(node, ast.Assign):
+                continue
+            fn = ci.mod.enclosing_function(node)
+            if fn is None or ci.mod.enclosing_class(node) is not ci.node:
+                continue
+            for tgt in node.targets:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                # lock construction: self.X = audited_lock("role")
+                v = node.value
+                if (
+                    isinstance(v, ast.Call)
+                    and (dotted_name(v.func) or "").split(".")[-1] in _LOCK_FACTORIES
+                    and v.args
+                    and isinstance(v.args[0], ast.Constant)
+                    and isinstance(v.args[0].value, str)
+                ):
+                    ci.lock_attrs.setdefault(tgt.attr, set()).add(v.args[0].value)
+                    continue
+                env = env_for(fn)
+                ann = None
+                if env is not None:
+                    ann = _value_class(graph, ci.relpath, v, env.names)
+                if ann is not None:
+                    ci.attr_types.setdefault(tgt.attr, ann)
+    # alias pass: self.X = <typed param>.<attr>
+    for ci in graph.classes.values():
+        for node in ast.walk(ci.node):
+            if not isinstance(node, ast.Assign) or ci.mod.enclosing_class(node) is not ci.node:
+                continue
+            v = node.value
+            if not (
+                isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name)
+            ):
+                continue
+            fn = ci.mod.enclosing_function(node)
+            if fn is None:
+                continue
+            env = env_for(fn)
+            if env is None:
+                continue
+            src_ci = env.names.get(v.value.id)
+            if src_ci is None:
+                continue
+            for tgt in node.targets:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                # lock alias: union roles assigned to the source attr
+                # anywhere in the source class's family (the declared
+                # type may be a base; subclasses rebind with other roles)
+                roles: Set[str] = set()
+                for c in src_ci.family():
+                    roles |= c.lock_attrs.get(v.attr, set())
+                if roles:
+                    ci.lock_attrs.setdefault(tgt.attr, set()).update(roles)
+                t = src_ci.attr_types.get(v.attr)
+                if t is not None:
+                    ci.attr_types.setdefault(tgt.attr, t)
+
+
+def _expr_class(
+    graph: RepoGraph, fi: FuncInfo, env: _TypeEnv, expr: ast.AST
+) -> Optional[ClassInfo]:
+    """Static class of a receiver expression, walking attribute chains
+    through the inferred attr-type maps."""
+    if isinstance(expr, ast.Name):
+        if expr.id == "self" and fi.owner_cls is not None:
+            return fi.owner_cls
+        return env.names.get(expr.id)
+    if isinstance(expr, ast.Attribute):
+        base = _expr_class(graph, fi, env, expr.value)
+        if base is not None:
+            for c in base.mro_like():
+                t = c.attr_types.get(expr.attr)
+                if t is not None:
+                    return t
+            return None
+        # module attribute: np.x / M.binding_duration
+        nm = dotted_name(expr.value)
+        if nm is not None:
+            tgt = graph.imports.get(fi.relpath, {}).get(nm.split(".")[0])
+            if tgt is not None and tgt[0] == "module":
+                return graph.module_var_types.get(tgt[1], {}).get(expr.attr)
+        return None
+    if isinstance(expr, ast.Call):
+        nm = dotted_name(expr.func)
+        if nm is not None:
+            return graph.resolve_class_name(fi.relpath, nm)
+    return None
+
+
+def _resolve_call(
+    graph: RepoGraph, fi: FuncInfo, env: _TypeEnv, call: ast.Call
+) -> List[Tuple[FuncInfo, str]]:
+    """(callee, kind) pairs for one Call node."""
+    out: List[Tuple[FuncInfo, str]] = []
+    f = call.func
+    if isinstance(f, ast.Name):
+        # nested def / sibling nested def in an enclosing function
+        for encl in [fi.node] + fi.mod.enclosing_functions(fi.node):
+            for sub in ast.walk(encl):
+                if (
+                    isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and sub.name == f.id
+                    and sub is not fi.node
+                ):
+                    uid = graph.node_uid.get(id(sub))
+                    if uid:
+                        out.append((graph.functions[uid], "direct"))
+            if out:
+                return out
+        tgt = graph.imports.get(fi.relpath, {}).get(f.id)
+        if tgt is not None and tgt[0] == "symbol":
+            mfi = graph.module_funcs.get((tgt[1], tgt[2]))
+            if mfi is not None:
+                return [(mfi, "direct")]
+            ci = graph.classes.get((tgt[1], tgt[2]))
+            if ci is not None:
+                return [(m, "direct") for m in ci.find_method("__init__")]
+            return []
+        mfi = graph.module_funcs.get((fi.relpath, f.id))
+        if mfi is not None:
+            return [(mfi, "direct")]
+        ci = graph.classes.get((fi.relpath, f.id))
+        if ci is not None:
+            return [(m, "direct") for m in ci.find_method("__init__")]
+        return []
+    if not isinstance(f, ast.Attribute):
+        return []
+    # receiver-typed resolution
+    recv_ci = _expr_class(graph, fi, env, f.value)
+    if recv_ci is not None:
+        hits = recv_ci.find_method(f.attr)
+        if hits:
+            return [(m, "direct") for m in hits]
+        return []
+    # module-function resolution: alias.func(...)
+    nm = dotted_name(f.value)
+    if nm is not None:
+        tgt = graph.imports.get(fi.relpath, {}).get(nm.split(".")[0])
+        if tgt is not None:
+            if tgt[0] == "module":
+                mfi = graph.module_funcs.get((tgt[1], f.attr))
+                if mfi is not None:
+                    return [(mfi, "direct")]
+                ci = graph.classes.get((tgt[1], f.attr))
+                if ci is not None:
+                    return [(m, "direct") for m in ci.find_method("__init__")]
+                return []
+            if tgt[0] == "external":
+                return []
+    # fuzzy: name-only, distinctive names with few candidates
+    if f.attr in _FUZZY_BLOCKLIST or f.attr.startswith("__"):
+        return []
+    cands = [m for m in graph.methods_by_name.get(f.attr, []) if m.cls is not None]
+    if 0 < len(cands) <= _FUZZY_MAX_TARGETS:
+        return [(m, "fuzzy") for m in cands]
+    return []
+
+
+def _build_edges(graph: RepoGraph) -> None:
+    for fi in graph.functions.values():
+        env = _TypeEnv(graph, fi)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            owner = graph.function_for_node(fi.mod, node)
+            if owner is None or owner.uid != fi.uid:
+                continue  # belongs to a nested def — attributed there
+            for callee, kind in _resolve_call(graph, fi, env, node):
+                graph.add_edge(fi.uid, callee.uid, kind, node.lineno)
+
+
+def _infer_module_var_types(graph: RepoGraph) -> None:
+    for rel, mod in graph.modules.items():
+        table: Dict[str, ClassInfo] = {}
+        for node in getattr(mod.tree, "body", []):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                ci = _value_class(graph, rel, node.value)
+                if ci is not None:
+                    table[node.targets[0].id] = ci
+        graph.module_var_types[rel] = table
+
+
+def build_graph(mods: Sequence[ModuleInfo]) -> RepoGraph:
+    graph = RepoGraph()
+    for mod in mods:
+        _index_module(graph, mod)
+    graph.imports = _resolve_imports(graph.modules)
+    _link_classes(graph)
+    _infer_module_var_types(graph)
+    _infer_attr_types(graph)
+    _build_edges(graph)
+    return graph
+
+
+#: one-build-per-process memo for the canonical tree graph: the source
+#: tree does not change mid-process, and three consumers (the tree-gate
+#: test, the perf_smoke role probes, repeated scans) would otherwise
+#: each pay the ~seconds-scale build. Keyed by the resolved path set.
+_GRAPH_CACHE: Dict[Tuple, "RepoGraph"] = {}
+
+
+def load_graph(
+    paths: Iterable[str], repo_root: str, cached: bool = True
+) -> RepoGraph:
+    """Parse every .py under `paths` and build the graph. The result is
+    memoized per (path set, root) — graphs are read-only after build;
+    pass cached=False when scanning files being rewritten in-process."""
+    from .core import iter_python_files
+
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(iter_python_files(p))
+        else:
+            files.append(p)
+    key = (tuple(sorted(os.path.abspath(f) for f in files)),
+           os.path.abspath(repo_root))
+    if cached and key in _GRAPH_CACHE:
+        return _GRAPH_CACHE[key]
+    mods = []
+    for f in files:
+        try:
+            mods.append(load_module(f, repo_root))
+        except SyntaxError:
+            continue  # not this analysis's job to gate parseability
+    graph = build_graph(mods)
+    if cached:
+        _GRAPH_CACHE[key] = graph
+    return graph

@@ -635,6 +635,18 @@ ALL_CHECKERS = (
     check_ktpu005,
 )
 
+# rule tags for per-rule wall-time attribution (core.run_checkers):
+# both KTPU002 passes aggregate under the one rule id they report
+for _chk, _rule in (
+    (check_ktpu001, "KTPU001"),
+    (check_ktpu002_donation, "KTPU002"),
+    (check_ktpu002_sync, "KTPU002"),
+    (check_ktpu003, "KTPU003"),
+    (check_ktpu004, "KTPU004"),
+    (check_ktpu005, "KTPU005"),
+):
+    _chk.rule = _rule
+
 
 def repo_config() -> AnalysisConfig:
     """The tree's canonical policy: where jit construction is the module's

@@ -15,6 +15,8 @@ independent:
       # ktpu: donates(0, 1)               def donates these positional args
       # ktpu: host-sync-ok <reason>       deliberate device→host sync point
       # ktpu: allow(KTPU001) <reason>     suppress a rule on this line
+      # ktpu: thread-entry(<role>)        def/spawn-site executed by that
+                                          thread role (seeds roles.py)
 
   Multiple markers may share a line, separated by ``;``.
 
@@ -39,11 +41,15 @@ RULES = {
     "KTPU003": "guarded-by",
     "KTPU004": "hot-path-host-sync",
     "KTPU005": "shadowed-module-import",
+    "KTPU006": "shared-attr-inference",
+    "KTPU007": "transitive-hot-path-sync",
+    "KTPU008": "confinement-reachability",
 }
 
 _MARKER_RE = re.compile(r"#\s*ktpu:\s*(.+?)\s*$")
 _ITEM_RE = re.compile(
-    r"(?P<kind>guarded-by|holds|confined|hot-path|admitted|donates|host-sync-ok|allow)"
+    r"(?P<kind>guarded-by|holds|confined|hot-path|admitted|donates"
+    r"|host-sync-ok|allow|thread-entry)"
     r"\s*(?:\((?P<args>[^)]*)\))?\s*(?P<trail>[^;]*)"
 )
 
@@ -130,6 +136,18 @@ class ModuleInfo:
     def marks(self, line: int, kind: str) -> List[Annotation]:
         return [a for a in self.annotations.get(line, []) if a.kind == kind]
 
+    def comment_block_lines(self, line: int) -> List[int]:
+        """`line` plus the contiguous comment block directly above it —
+        THE one definition of where a marker may sit relative to a
+        statement (node_marks, allowed, and roles._line_marks all build
+        on this; a tweak here keeps the grammar consistent everywhere)."""
+        out = [line]
+        ln = line - 1
+        while ln >= 1 and self.lines[ln - 1].lstrip().startswith("#"):
+            out.append(ln)
+            ln -= 1
+        return out
+
     def node_marks(self, node: ast.AST, kind: str) -> List[Annotation]:
         """Markers on any line the node's header spans (its lineno, plus —
         for defs — the decorator lines and the contiguous comment block
@@ -139,20 +157,18 @@ class ModuleInfo:
             for dec in node.decorator_list:
                 lines.add(dec.lineno)
             first = min(lines - {0}) if lines - {0} else 0
-            ln = first - 1
-            while ln >= 1 and self.lines[ln - 1].lstrip().startswith("#"):
-                lines.add(ln)
-                ln -= 1
+            if first:
+                lines.update(self.comment_block_lines(first)[1:])
         out: List[Annotation] = []
         for ln in lines:
             out.extend(self.annotations.get(ln, []) or [])
         return [a for a in out if a.kind == kind]
 
     def allowed(self, node: ast.AST, rule: str) -> bool:
-        """``# ktpu: allow(KTPUxxx)`` on the node's line (or the line
-        above, for statements too long to carry a trailing comment)."""
-        ln = getattr(node, "lineno", 0)
-        for probe in (ln, ln - 1):
+        """``# ktpu: allow(KTPUxxx)`` on the node's line or anywhere in
+        the contiguous comment block directly above it (multi-line
+        justifications read naturally that way, same as node_marks)."""
+        for probe in self.comment_block_lines(getattr(node, "lineno", 0)):
             for a in self.marks(probe, "allow"):
                 if rule in a.args or not a.args:
                     return True
@@ -290,10 +306,20 @@ def run_checkers(
     config: AnalysisConfig,
     checkers: Sequence[Checker],
     rules: Optional[Set[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Violation]:
+    import time as _time
+
     out: List[Violation] = []
     for chk in checkers:
-        for v in chk(mod, config):
+        t0 = _time.perf_counter()
+        found = chk(mod, config)
+        if timings is not None:
+            # checkers carry a `rule` tag (checkers.py); the wall of the
+            # two KTPU002 passes aggregates under one rule id
+            key = getattr(chk, "rule", chk.__name__)
+            timings[key] = timings.get(key, 0.0) + _time.perf_counter() - t0
+        for v in found:
             if rules and v.rule not in rules:
                 continue
             out.append(v)
@@ -306,6 +332,7 @@ def scan_paths(
     config: AnalysisConfig,
     checkers: Sequence[Checker],
     rules: Optional[Set[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Violation]:
     files: List[str] = []
     for p in paths:
@@ -315,7 +342,9 @@ def scan_paths(
             files.append(p)
     out: List[Violation] = []
     for f in files:
-        out.extend(run_checkers(load_module(f, repo_root), config, checkers, rules))
+        out.extend(
+            run_checkers(load_module(f, repo_root), config, checkers, rules, timings)
+        )
     return out
 
 

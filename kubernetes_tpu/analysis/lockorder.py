@@ -35,6 +35,12 @@ def audit_enabled() -> bool:
     return os.environ.get(ENV_FLAG, "") not in ("", "0", "false", "False")
 
 
+class RoleAuditViolation(AssertionError):
+    """Raised by assert_roles_subset(): the observed thread-role →
+    lock-role graph escaped the static inference (roles.py), or the
+    observed graph is empty (the role registrations were unwired)."""
+
+
 class LockOrderViolation(AssertionError):
     """Raised by assert_acyclic(): carries the offending cycle(s)."""
 
@@ -63,6 +69,82 @@ class LockOrderRegistry:
         self.edges: Dict[Tuple[str, str], Dict[str, str]] = {}
         self.threads_seen: set = set()
         self.acquisitions = 0
+        # thread-role audit (the runtime twin of analysis/roles.py):
+        # threads register their role at spawn; acquisitions from a
+        # registered thread record role -> lock-role observations. The
+        # role itself lives in a threading.local — per-thread state by
+        # construction, immune to OS thread-ident recycling (an
+        # ident-keyed dict would hand a dead bind worker's role to
+        # whichever new thread inherits its ident) and lock-free to
+        # read/re-stamp on the hot path.
+        self._role = threading.local()
+        self.roles_seen: set = set()  # ktpu: guarded-by(self._mu)
+        self.lock_roles: Dict[str, set] = {}  # ktpu: guarded-by(self._mu)
+
+    # -- thread-role registration (runtime twin of roles.py) -----------------
+
+    def register_role(self, role: str) -> None:
+        """Stamp the CURRENT thread's role. Spawn sites call this at the
+        top of their thread target (or a pool initializer); the driver
+        stamps itself at schedule/warmup entry. Idempotent re-stamps
+        (every schedule_batch, every submitted closure) are one
+        thread-local read — no global lock. Last registration wins (a
+        supervisor thread becomes the driver when it drives
+        schedule_batch)."""
+        if getattr(self._role, "value", None) == role:
+            return
+        self._role.value = role
+        with self._mu:
+            self.roles_seen.add(role)
+
+    def current_role(self) -> Optional[str]:
+        return getattr(self._role, "value", None)
+
+    def observed_roles(self) -> Dict[str, set]:
+        with self._mu:
+            return {k: set(v) for k, v in self.lock_roles.items()}
+
+    def assert_roles_subset(
+        self,
+        static: Dict[str, set],
+        min_distinct_roles: int = 2,
+    ) -> None:
+        """The soundness probe: every (lock role, thread role) pair the
+        audit OBSERVED must be contained in the STATIC inference
+        (roles.static_lock_roles) — `"*"` entries are role-universal by
+        declaration. Also fails on an empty/degenerate observed graph:
+        silently unwiring register_role must fail exactly like the
+        non-empty-edge assertion on the ordering audit."""
+        observed = self.observed_roles()
+        distinct = set()
+        for rs in observed.values():
+            distinct |= rs
+        if not observed or len(distinct) < min_distinct_roles:
+            raise RoleAuditViolation(
+                "observed role graph is empty or degenerate "
+                f"(locks={sorted(observed)}, roles={sorted(distinct)}) — "
+                "the register_role spawn-site stamps are no longer wired"
+            )
+        bad = []
+        for lock, rs in sorted(observed.items()):
+            allowed = static.get(lock, set())
+            if "*" in allowed:
+                continue
+            for role in sorted(rs):
+                if role not in allowed:
+                    bad.append((lock, role, sorted(allowed)))
+        if bad:
+            lines = [
+                "runtime thread-role observations escaped the static "
+                "role inference (static analysis is UNSOUND here — fix "
+                "the role seeds/resolution, not this assertion):"
+            ]
+            for lock, role, allowed in bad:
+                lines.append(
+                    f"  lock role '{lock}' touched by thread role "
+                    f"'{role}' but statically reachable only by {allowed}"
+                )
+            raise RoleAuditViolation("\n".join(lines))
 
     # -- held bookkeeping ----------------------------------------------------
 
@@ -81,9 +163,12 @@ class LockOrderRegistry:
     def note_acquired(self, name: str, inst_id: int) -> None:
         held = self._stack()
         tname = threading.current_thread().name
+        role = getattr(self._role, "value", None)  # this thread's own slot
         with self._mu:
             self.acquisitions += 1
             self.threads_seen.add(tname)
+            if role is not None:
+                self.lock_roles.setdefault(name, set()).add(role)
             if any(i == inst_id for _, i in held):
                 pass  # reentrant: no new edge, no new held entry depth
             else:
@@ -161,6 +246,10 @@ class LockOrderRegistry:
                 "threads": sorted(self.threads_seen),
                 "acquisitions": self.acquisitions,
                 "cycles": self.find_cycles(),
+                "roles": sorted(self.roles_seen),
+                "lock_roles": {
+                    k: sorted(v) for k, v in sorted(self.lock_roles.items())
+                },
             }
 
     def reset(self) -> None:
@@ -168,6 +257,10 @@ class LockOrderRegistry:
             self.edges.clear()
             self.threads_seen.clear()
             self.acquisitions = 0
+            self.roles_seen.clear()
+            self.lock_roles.clear()
+        # per-thread role slots persist (a live registered thread keeps
+        # its identity across a registry reset — only OBSERVATIONS reset)
 
 
 REGISTRY = LockOrderRegistry()
@@ -273,6 +366,13 @@ class AuditedCondition:
 # ---------------------------------------------------------------------------
 # construction-site factories (the package's lock sites call these)
 # ---------------------------------------------------------------------------
+
+def register_thread_role(role: str) -> None:
+    """Stamp the current thread's role for the runtime role audit. Every
+    spawn site calls this unconditionally (one dict write — audit-off
+    runs pay nothing else: plain locks never consult the registry)."""
+    REGISTRY.register_role(role)
+
 
 def audited_lock(name: str) -> threading.Lock:
     """A Lock, audited iff KTPU_LOCK_AUDIT is set at construction time."""

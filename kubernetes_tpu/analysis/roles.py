@@ -1,0 +1,701 @@
+"""Thread-role inference + the interprocedural KTPU006–008 rules.
+
+The scheduler runs ~10 concurrent thread roles (informer, the two bank
+uploaders, driver, commit-apply worker, bind pool, health monitor,
+compile-warmup worker, controller loops, serving muxes). Which role can
+execute which function decides whether an attribute is shared, whether a
+``confined(driver)`` claim is true, and whether a hot-path function can
+transitively stumble into a host sync. This module computes that:
+
+* **Seeds** — the ``# ktpu: thread-entry(<role>[, <role2>])`` grammar.
+  On a ``def``, the function is an entry point executed by that role's
+  thread (a thread target, a pool-submitted closure, an informer
+  callback). On a spawn line (``threading.Thread(target=...)`` or
+  ``pool.submit(...)``), the resolved target becomes the entry. A def
+  may carry several roles (``StageBank._drain`` runs as either bank
+  uploader depending on the subclass).
+* **Propagation** — BFS over the repo call graph (callgraph.py): the
+  role set of a function is every entry role that can reach it.
+  Functions reachable from no entry have the empty role set — they run
+  only on external callers (tests, __main__) and are exempt from the
+  multi-role rules by construction.
+* **KTPU006 shared-attribute inference** — a ``self.X`` attribute with
+  accessor methods spanning ≥2 roles and ≥1 post-construction write
+  must be declared ``guarded-by(...)`` or ``confined(...)`` (closing
+  KTPU003's unannotated-attribute hole).
+* **KTPU007 transitive hot-path sync** — no ``hot-path`` function may
+  REACH a device→host forcing call through the graph, outside the sync
+  allowlist (interprocedural KTPU004).
+* **KTPU008 confinement reachability** — a ``confined(<role>)``-marked
+  method reachable from any other role is a violation, and every thread
+  spawn/submit site must be rooted in the role graph (an annotated line
+  or an annotated resolved target) — unrooted spawns would silently
+  blind all three rules.
+
+The static inference is deliberately a superset (conservative dispatch,
+fuzzy last-resort edges); its soundness probe is the runtime twin in
+lockorder.py: threads register their role at spawn, audited locks record
+which roles actually touched each lock role, and ``assert_roles_subset``
+verifies observed ⊆ inferred (wired into the lock-audited perf_smoke
+drains — a run where reality escapes the inference fails the build).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import (
+    ClassInfo,
+    FuncInfo,
+    RepoGraph,
+    load_graph,
+)
+from .checkers import (
+    _declared_attrs,
+    _device_like_subtree,
+    _forcing_target,
+)
+from .core import AnalysisConfig, ModuleInfo, Violation, dotted_name
+
+#: lock roles every thread may touch by design: the metrics registry and
+#: its per-metric locks are process-global leaf primitives (kube's
+#: prometheus client has the same shape), the event recorder is a
+#: fire-and-forget sink, and the breaker board's own lock is — per its
+#: documented contract — "callable from any thread (may hold a plane
+#: lock — the board lock is a leaf)": every plane thread reports its own
+#: faults. Declaring these role-universal keeps the runtime subset
+#: assertion honest instead of vacuously failing on by-design
+#: omnidirectional leaf locks; every OTHER lock role must be reached by
+#: the static inference for the roles that really touch it.
+OMNI_LOCK_ROLES = frozenset({
+    "metric", "metrics-registry", "event-recorder", "faults",
+})
+
+#: escape hatch for lock roles reached only through indirection the call
+#: graph cannot see (each entry documents WHY). Additions are reviewed
+#: knowledge, not a dumping ground — the runtime audit fails loudly when
+#: an entry is missing, and an entry here is a TODO for better
+#: resolution, not a license to stop resolving.
+EXTRA_STATIC_ROLES: Dict[str, Set[str]] = {
+    # APIBinder.bind is reached from bind workers through the Binder's
+    # stored callback (`Binder(api_binder.bind)` — a function attribute
+    # the graph cannot type), and from there the apiserver store/persist
+    # locks; the informer's relist reaches them resolvably, the bind
+    # side does not.
+    "apiserver-store": {"bind", "driver"},
+    "apiserver-persist": {"bind", "driver"},
+    "apiserver-auth": {"bind", "driver"},
+    # enqueue-time encoding: PriorityQueue.add stages pod/term rows ON
+    # THE ADMITTING THREAD (the informer) through the plane-tuple
+    # indirection (_planes_locked yields (stage, row_attr, gen_attr)
+    # tuples), which erases the receiver type the graph would need to
+    # resolve `stage.acquire(...)`.
+    "stage": {"informer"},
+    # ... and the terms lock is ADDITIONALLY touched by the terms
+    # uploader: TermBankDevice inherits StageBank.__init__ whose
+    # `stage: PodStage` annotation cannot express the duck-typed
+    # TermStage it actually receives, so the `self._lock = stage._lock`
+    # alias resolves to the "stage" role only. (Caught live by
+    # assert_roles_subset the first time the probe ran — the soundness
+    # loop doing its job.)
+    "terms": {"informer", "terms-upload"},
+    "vocab-slots": {"informer"},
+    # plugin dispatch: Framework.run_permit/pre_bind/bind run REGISTERED
+    # plugin objects against the CycleState on the bind workers; the
+    # plugin list is runtime data the graph cannot enumerate.
+    "cycle-state": {"bind"},
+}
+
+#: attribute values that are themselves synchronization/thread-safe
+#: primitives — assigning one in the ctor exempts the attribute from
+#: KTPU006 (the primitive IS the discipline)
+_THREADSAFE_CTORS = frozenset({
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Queue", "SimpleQueue", "LifoQueue", "ThreadPoolExecutor",
+    "local", "audited_lock", "audited_rlock", "audited_condition",
+})
+
+_CTOR_NAMES = {"__init__", "__post_init__"}
+
+
+# ---------------------------------------------------------------------------
+# entry collection + propagation
+# ---------------------------------------------------------------------------
+
+def _spawn_sites(graph: RepoGraph) -> List[Tuple[FuncInfo, ast.Call, str]]:
+    """(enclosing function, call, kind) for every thread spawn or pool
+    submit in the graph's modules. kind: "thread" | "submit"."""
+    out: List[Tuple[FuncInfo, ast.Call, str]] = []
+    for fi in graph.functions.values():
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            owner = graph.function_for_node(fi.mod, node)
+            if owner is None or owner.uid != fi.uid:
+                continue
+            nm = dotted_name(node.func) or ""
+            last = nm.split(".")[-1]
+            if last == "Thread" and any(k.arg == "target" for k in node.keywords):
+                out.append((fi, node, "thread"))
+            elif isinstance(node.func, ast.Attribute) and node.func.attr == "submit":
+                out.append((fi, node, "submit"))
+    return out
+
+
+def _spawn_target_expr(call: ast.Call, kind: str) -> Optional[ast.AST]:
+    if kind == "thread":
+        for k in call.keywords:
+            if k.arg == "target":
+                return k.value
+        return None
+    return call.args[0] if call.args else None
+
+
+def _resolve_callable_ref(
+    graph: RepoGraph, fi: FuncInfo, expr: ast.AST
+) -> List[FuncInfo]:
+    """A callable REFERENCE (not a call): self._drain, a nested def's
+    name, a module function, an imported symbol."""
+    if expr is None:
+        return []
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self" and fi.owner_cls:
+            return fi.owner_cls.find_method(expr.attr)
+        nm = dotted_name(expr.value)
+        if nm is not None:
+            tgt = graph.imports.get(fi.relpath, {}).get(nm.split(".")[0])
+            if tgt is not None and tgt[0] == "module":
+                mfi = graph.module_funcs.get((tgt[1], expr.attr))
+                return [mfi] if mfi else []
+        return []
+    if isinstance(expr, ast.Name):
+        for encl in [fi.node] + fi.mod.enclosing_functions(fi.node):
+            for sub in ast.walk(encl):
+                if (
+                    isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and sub.name == expr.id
+                ):
+                    uid = graph.node_uid.get(id(sub))
+                    if uid:
+                        return [graph.functions[uid]]
+        mfi = graph.module_funcs.get((fi.relpath, expr.id))
+        if mfi is not None:
+            return [mfi]
+        tgt = graph.imports.get(fi.relpath, {}).get(expr.id)
+        if tgt is not None and tgt[0] == "symbol":
+            mfi = graph.module_funcs.get((tgt[1], tgt[2]))
+            return [mfi] if mfi else []
+    return []
+
+
+def _line_marks(mod: ModuleInfo, line: int, kind: str):
+    """Markers on `line` or the contiguous comment block above it (the
+    one shared definition: ModuleInfo.comment_block_lines)."""
+    out = []
+    for ln in mod.comment_block_lines(line):
+        out += mod.marks(ln, kind)
+    return out
+
+
+def collect_entries(graph: RepoGraph) -> Dict[str, Set[str]]:
+    """uid -> declared role set, from thread-entry def marks and
+    annotated spawn/submit lines."""
+    entries: Dict[str, Set[str]] = {}
+    for fi in graph.functions.values():
+        for mark in fi.mod.node_marks(fi.node, "thread-entry"):
+            entries.setdefault(fi.uid, set()).update(mark.args or ("unnamed",))
+    for fi, call, kind in _spawn_sites(graph):
+        marks = _line_marks(fi.mod, call.lineno, "thread-entry")
+        if not marks:
+            continue
+        roles: Set[str] = set()
+        for m in marks:
+            roles.update(m.args or ("unnamed",))
+        for target in _resolve_callable_ref(
+            graph, fi, _spawn_target_expr(call, kind)
+        ):
+            entries.setdefault(target.uid, set()).update(roles)
+    return entries
+
+
+def propagate_roles(
+    graph: RepoGraph, entries: Dict[str, Set[str]], fuzzy: bool = True
+) -> Dict[str, Set[str]]:
+    """Role set per function uid: every entry role that can reach it."""
+    roles: Dict[str, Set[str]] = {uid: set(rs) for uid, rs in entries.items()}
+    frontier = list(entries)
+    while frontier:
+        uid = frontier.pop()
+        src_roles = roles.get(uid, set())
+        for edge in graph.callees(uid, fuzzy=fuzzy):
+            dst = roles.setdefault(edge.dst, set())
+            if not src_roles <= dst:
+                dst.update(src_roles)
+                frontier.append(edge.dst)
+    return roles
+
+
+class RoleAnalysis:
+    """One pass over a graph: entries, propagated roles, and the
+    shared config — the object the KTPU006–008 checkers consume."""
+
+    def __init__(self, graph: RepoGraph, config: AnalysisConfig):
+        self.graph = graph
+        self.config = config
+        self.entries = collect_entries(graph)
+        self.roles = propagate_roles(graph, self.entries)
+
+    def roles_of(self, uid: str) -> Set[str]:
+        return self.roles.get(uid, set())
+
+
+# ---------------------------------------------------------------------------
+# KTPU006 — shared-attribute inference
+# ---------------------------------------------------------------------------
+
+def _ctor_threadsafe_attrs(ci: ClassInfo) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(ci.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if not isinstance(v, ast.Call):
+            continue
+        if (dotted_name(v.func) or "").split(".")[-1] not in _THREADSAFE_CTORS:
+            continue
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                out.add(tgt.attr)
+    return out
+
+
+def check_ktpu006(analysis: RoleAnalysis) -> List[Violation]:
+    graph, out = analysis.graph, []
+    for ci in graph.classes.values():
+        mod = ci.mod
+        # declarations/exemptions union over the CLASS HIERARCHY: a
+        # subclass method touching an attr its base declared guarded-by
+        # (the StageBank/TermBankDevice shape) must see the declaration
+        declared: Set[str] = set()
+        exempt: Set[str] = set()
+        # attrs holding dict literals: for these, element stores
+        # (self.stats["k"] += 1 — the classic lost-update counter) count
+        # as writes. Array-buffer attrs (np.zeros row slabs) are excluded:
+        # their row writes are the planes' externally-locked scatter
+        # idiom, and flagging every encoder bank row would drown the rule
+        dict_attrs: Set[str] = set()
+        # an `# ktpu: allow(KTPU006) <why>` on an attribute's ASSIGNMENT
+        # exempts the whole attribute — the honest annotation for
+        # externally-synchronized value objects (NodeInfo under the cache
+        # lock), idempotent memos, and driver→worker handoff objects
+        allow_attrs: Set[str] = set()
+        for anc in ci.mro_like():
+            declared.update(_declared_attrs(anc.mod, anc.node, "guarded-by"))
+            declared.update(_declared_attrs(anc.mod, anc.node, "confined"))
+            exempt |= _ctor_threadsafe_attrs(anc) | set(anc.lock_attrs)
+            for n in ast.walk(anc.node):
+                if isinstance(n, ast.Assign):
+                    tgts = n.targets
+                elif isinstance(n, ast.AnnAssign):
+                    tgts = [n.target]
+                else:
+                    continue
+                targets = [
+                    t.attr
+                    for t in tgts
+                    if isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ]
+                if not targets:
+                    continue
+                if isinstance(n.value, ast.Dict):
+                    dict_attrs.update(targets)
+                if anc.mod.allowed(n, "KTPU006"):
+                    allow_attrs.update(targets)
+        exempt |= allow_attrs
+        # attr -> (roles union, non-ctor write line, accessors sample)
+        attr_roles: Dict[str, Set[str]] = {}
+        attr_write: Dict[str, int] = {}
+        attr_fns: Dict[str, Set[str]] = {}
+        for node in ast.walk(ci.node):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not (isinstance(node.value, ast.Name) and node.value.id == "self"):
+                continue
+            if mod.enclosing_class(node) is not ci.node:
+                continue  # nested class: its own ClassInfo owns the access
+            fi = graph.function_for_node(mod, node)
+            if fi is None:
+                continue
+            roles = analysis.roles_of(fi.uid)
+            if mod.allowed(node, "KTPU006"):
+                continue
+            in_ctor = any(
+                f.name in _CTOR_NAMES
+                for f in [fi.node] + mod.enclosing_functions(fi.node)
+                if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            )
+            if in_ctor:
+                continue  # construction-time publication precedes spawn
+            if roles:
+                attr_roles.setdefault(node.attr, set()).update(roles)
+                attr_fns.setdefault(node.attr, set()).add(fi.qualname)
+            # a write is a rebind (self.X = ...) OR an element store
+            # through the attribute (self.X[k] = ... / += ...): the dict-
+            # counter idiom is exactly the cross-thread lost-update shape
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            if not is_write and node.attr in dict_attrs:
+                parent = mod.parents.get(node)
+                if (
+                    isinstance(parent, ast.Subscript)
+                    and parent.value is node
+                    and isinstance(parent.ctx, (ast.Store, ast.Del))
+                ):
+                    is_write = True
+            if is_write and roles:
+                attr_write.setdefault(node.attr, node.lineno)
+        for attr, roles in sorted(attr_roles.items()):
+            if len(roles) < 2 or attr in declared or attr in exempt:
+                continue
+            line = attr_write.get(attr)
+            if line is None:
+                continue  # read-only outside the ctor: safe publication
+            out.append(
+                Violation(
+                    rule="KTPU006",
+                    path=ci.relpath,
+                    line=line,
+                    scope=ci.name,
+                    detail=f"shared:{ci.name}.{attr}",
+                    message=(
+                        f"`self.{attr}` is written post-construction and is "
+                        f"reachable from {len(roles)} thread roles "
+                        f"({', '.join(sorted(roles))}; accessors: "
+                        f"{', '.join(sorted(attr_fns.get(attr, ()))[:4])}) "
+                        "but carries no `# ktpu: guarded-by(...)` or "
+                        "`confined(...)` declaration — the unannotated "
+                        "cross-thread attribute KTPU003 cannot see. Declare "
+                        "the discipline (and satisfy KTPU003), or confine "
+                        "the writes to one role."
+                    ),
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KTPU007 — transitive hot-path sync
+# ---------------------------------------------------------------------------
+
+def _fn_is_barrier(fi: FuncInfo, config: AnalysisConfig) -> bool:
+    """Designated sync points end traversal: their forcing is the
+    designed fetch, and everything under them runs at that sync."""
+    qn = fi.qualname
+    if qn in config.sync_allowlist or fi.name in config.sync_allowlist:
+        return True
+    if fi.mod.node_marks(fi.node, "host-sync-ok"):
+        return True
+    return False
+
+
+def _fn_forcings(
+    fi: FuncInfo, config: AnalysisConfig
+) -> List[Tuple[str, str, int]]:
+    """(callee, devname, line) for unexempted forcing calls owned by fi."""
+    mod, out = fi.mod, []
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if mod.enclosing_function(node) is not fi.node:
+            continue
+        hit = _forcing_target(node)
+        if hit is None:
+            continue
+        target, callee, always = hit
+        devname = _device_like_subtree(mod, config, target)
+        if devname is None and not always:
+            continue
+        if (
+            mod.allowed(node, "KTPU007")
+            or mod.allowed(node, "KTPU004")
+            or mod.marks(node.lineno, "host-sync-ok")
+        ):
+            continue
+        out.append((callee, devname or "...", node.lineno))
+    return out
+
+
+def check_ktpu007(analysis: RoleAnalysis) -> List[Violation]:
+    graph, config = analysis.graph, analysis.config
+    forcings = {
+        uid: _fn_forcings(fi, config) for uid, fi in graph.functions.items()
+    }
+    out: List[Violation] = []
+    for uid, fi in graph.functions.items():
+        if not fi.mod.node_marks(fi.node, "hot-path"):
+            continue
+        if fi.mod.allowed(fi.node, "KTPU007"):
+            continue
+        # BFS with parents for the reported chain; barriers not entered
+        parent: Dict[str, str] = {uid: ""}
+        frontier = [uid]
+        reported: Set[str] = set()
+        while frontier:
+            cur = frontier.pop(0)
+            for edge in graph.callees(cur):
+                dst = edge.dst
+                if dst in parent:
+                    continue
+                dfi = graph.functions.get(dst)
+                if dfi is None:
+                    continue
+                if _fn_is_barrier(dfi, config):
+                    continue
+                parent[dst] = cur
+                frontier.append(dst)
+                if forcings.get(dst) and dst not in reported:
+                    reported.add(dst)
+                    chain: List[str] = []
+                    walk = dst
+                    while walk:
+                        chain.append(graph.functions[walk].qualname)
+                        walk = parent[walk]
+                    callee, devname, fline = forcings[dst][0]
+                    out.append(
+                        Violation(
+                            rule="KTPU007",
+                            path=fi.relpath,
+                            line=fi.node.lineno,
+                            scope=fi.qualname,
+                            detail=f"hot-reach:{fi.qualname}->{dfi.qualname}",
+                            message=(
+                                f"hot-path `{fi.qualname}` reaches a device→"
+                                f"host forcing call `{callee}({devname})` at "
+                                f"{dfi.relpath}:{fline} through "
+                                f"{' -> '.join(reversed(chain))} — the "
+                                "transitive twin of KTPU004: one hidden sync "
+                                "one call deep serializes the whole drain. "
+                                "Route the fetch through a declared sync "
+                                "point (sync_allowlist / host-sync-ok) or "
+                                "break the call chain."
+                            ),
+                        )
+                    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KTPU008 — confinement reachability + rooted spawns
+# ---------------------------------------------------------------------------
+
+def check_ktpu008(analysis: RoleAnalysis) -> List[Violation]:
+    graph = analysis.graph
+    out: List[Violation] = []
+    for uid, fi in graph.functions.items():
+        marks = fi.mod.node_marks(fi.node, "confined")
+        if not marks:
+            continue
+        if fi.mod.allowed(fi.node, "KTPU008"):
+            continue
+        tags: Set[str] = set()
+        for m in marks:
+            tags.update(m.args)
+        if not tags:
+            continue
+        foreign = analysis.roles_of(uid) - tags
+        if foreign:
+            out.append(
+                Violation(
+                    rule="KTPU008",
+                    path=fi.relpath,
+                    line=fi.node.lineno,
+                    scope=fi.qualname,
+                    detail=f"confined-reach:{fi.qualname}",
+                    message=(
+                        f"`{fi.qualname}` is declared `# ktpu: confined("
+                        f"{','.join(sorted(tags))})` — lock-FREE single-"
+                        "thread state — but the role graph shows it "
+                        f"reachable from {', '.join(sorted(foreign))}. "
+                        "Either the reaching path is real (a race: add a "
+                        "lock or publish via a mailbox) or the confinement "
+                        "tag/role seeds are wrong — fix whichever is lying."
+                    ),
+                )
+            )
+    # rooted-spawn contract: every spawn/submit site must seed the role
+    # graph (an annotated line, or a resolved target whose def is
+    # annotated) — an unrooted spawn blinds KTPU006/007/008 silently
+    for fi, call, kind in _spawn_sites(graph):
+        if _line_marks(fi.mod, call.lineno, "thread-entry"):
+            continue
+        if fi.mod.allowed(call, "KTPU008"):
+            continue
+        targets = _resolve_callable_ref(
+            graph, fi, _spawn_target_expr(call, kind)
+        )
+        if targets and all(
+            t.mod.node_marks(t.node, "thread-entry") for t in targets
+        ):
+            continue
+        tgt_repr = ""
+        expr = _spawn_target_expr(call, kind)
+        if expr is not None:
+            try:
+                tgt_repr = ast.unparse(expr)
+            except Exception:
+                tgt_repr = "?"
+        out.append(
+            Violation(
+                rule="KTPU008",
+                path=fi.relpath,
+                line=call.lineno,
+                scope=fi.qualname,
+                detail=f"unrooted-spawn:{tgt_repr}",
+                message=(
+                    f"thread {'spawn' if kind == 'thread' else 'submit'} of "
+                    f"`{tgt_repr}` is not rooted in the role graph: mark "
+                    "the line (or the target def) `# ktpu: thread-entry("
+                    "<role>)` so role inference can see the code this "
+                    "thread executes — unannotated spawns silently blind "
+                    "KTPU006/007/008."
+                ),
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# running the repo-wide rules
+# ---------------------------------------------------------------------------
+
+REPO_RULES = ("KTPU006", "KTPU007", "KTPU008")
+
+_REPO_CHECKERS = {
+    "KTPU006": check_ktpu006,
+    "KTPU007": check_ktpu007,
+    "KTPU008": check_ktpu008,
+}
+
+
+def run_repo_checkers(
+    graph: RepoGraph,
+    config: AnalysisConfig,
+    rules: Optional[Set[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
+) -> List[Violation]:
+    import time as _time
+
+    analysis = RoleAnalysis(graph, config)
+    out: List[Violation] = []
+    for rule, chk in _REPO_CHECKERS.items():
+        if rules and rule not in rules:
+            continue
+        t0 = _time.perf_counter()
+        out.extend(chk(analysis))
+        if timings is not None:
+            timings[rule] = timings.get(rule, 0.0) + _time.perf_counter() - t0
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def scan_repo_rules(
+    paths: Sequence[str],
+    repo_root: str,
+    config: AnalysisConfig,
+    rules: Optional[Set[str]] = None,
+    timings: Optional[Dict[str, float]] = None,
+) -> List[Violation]:
+    graph = load_graph(paths, repo_root)
+    return run_repo_checkers(graph, config, rules, timings)
+
+
+# ---------------------------------------------------------------------------
+# static lock-role inference (the runtime twin's reference map)
+# ---------------------------------------------------------------------------
+
+def static_lock_roles(analysis: RoleAnalysis) -> Dict[str, Set[str]]:
+    """lock role -> set of thread roles statically able to touch it.
+
+    Conservative by construction: a lock constructed by class C is
+    credited with every role that reaches ANY method of C or its repo
+    subclasses (any method might acquire). OMNI_LOCK_ROLES map to the
+    universal set ("*"); EXTRA_STATIC_ROLES patches the documented
+    callback-indirection gaps."""
+    graph = analysis.graph
+    out: Dict[str, Set[str]] = {name: {"*"} for name in OMNI_LOCK_ROLES}
+    for ci in graph.classes.values():
+        lock_roles: Set[str] = set()
+        for rs in ci.lock_attrs.values():
+            lock_roles |= rs
+        if not lock_roles:
+            continue
+        method_roles: Set[str] = set()
+        seen: Set[Tuple[str, str]] = set()
+        frontier = [ci]
+        while frontier:
+            c = frontier.pop()
+            if c.key in seen:
+                continue
+            seen.add(c.key)
+            for m in c.methods.values():
+                method_roles |= analysis.roles_of(m.uid)
+            frontier.extend(c.subclasses)
+            frontier.extend(c.bases)  # inherited methods run as self=C
+        for name in lock_roles:
+            out.setdefault(name, set()).update(method_roles)
+    for name, extra in EXTRA_STATIC_ROLES.items():
+        out.setdefault(name, set()).update(extra)
+    return out
+
+
+_RUNTIME_STATIC_CACHE: Dict[str, Dict[str, Set[str]]] = {}
+
+
+def runtime_static_roles(
+    config: Optional[AnalysisConfig] = None,
+) -> Dict[str, Set[str]]:
+    """The installed package's static lock-role map — what the runtime
+    audit's observed roles must be a subset of. Memoized per package dir
+    (the source tree does not change mid-process; three audited smoke
+    tests in one pytest run should pay the graph build once)."""
+    from .checkers import repo_config
+
+    import kubernetes_tpu
+
+    pkg_dir = os.path.dirname(os.path.abspath(kubernetes_tpu.__file__))
+    # memoize ONLY the default-config map: an id(config)-keyed entry
+    # could silently alias a later config object allocated at a freed
+    # address, returning the wrong static map to the soundness probe
+    if config is None:
+        cached = _RUNTIME_STATIC_CACHE.get(pkg_dir)
+        if cached is not None:
+            return cached
+    repo_root = os.path.dirname(pkg_dir)
+    graph = load_graph([pkg_dir], repo_root)
+    analysis = RoleAnalysis(graph, config or repo_config())
+    out = static_lock_roles(analysis)
+    if config is None:
+        _RUNTIME_STATIC_CACHE[pkg_dir] = out
+    return out
+
+
+def assert_runtime_subset(registry=None) -> Dict[str, object]:
+    """The perf_smoke soundness probe: observed lock-touching roles must
+    be contained in the static inference, and the observed graph must be
+    non-empty (silent unwiring of the role registrations fails exactly
+    like the lock-audit's non-empty-edge assertion). Returns a report
+    dict for the caller's detail output."""
+    if registry is None:
+        from .lockorder import REGISTRY as registry  # noqa: N813
+    static = runtime_static_roles()
+    registry.assert_roles_subset(static)
+    return {
+        "observed": {k: sorted(v) for k, v in registry.observed_roles().items()},
+        "static_locks": len(static),
+    }

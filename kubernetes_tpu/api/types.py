@@ -296,6 +296,9 @@ class Pod:
                     total[name] = v
         for name, q in self.overhead.items():
             total[name] = total.get(name, 0) + _request_value(name, q)
+        # ktpu: allow(KTPU006) idempotent memo on an effectively-immutable
+        # pod: concurrent writers compute the identical dict (the Pod.key()
+        # memo precedent) — last-write-wins is a benign race by design
         self._req_cache = total
         return total
 

@@ -576,6 +576,8 @@ class APIServerHTTP:
         return f"http://{h}:{p}"
 
     def start(self) -> "APIServerHTTP":
+        # ktpu: thread-entry(apiserver-serve) stdlib mux: handlers run
+        # on socketserver threads the call graph cannot follow
         self._thread = threading.Thread(
             target=self._srv.serve_forever, name="apiserver-http", daemon=True
         )

@@ -39,7 +39,7 @@ import random
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
-from ..analysis.lockorder import audited_lock
+from ..analysis.lockorder import audited_lock, register_thread_role
 from ..apiserver.store import (
     ADDED,
     ConflictError,
@@ -161,7 +161,10 @@ class Informer:
         self._stop.wait(backoff * random.uniform(0.8, 1.2))
         return min(backoff * 2, RELIST_BACKOFF_MAX)
 
+    # ktpu: thread-entry(informer) the reflector loop: every handler
+    # dispatch (EventHandlers → cache/queue/slabs) runs on this thread
     def _run(self) -> None:
+        register_thread_role("informer")
         reason = "sync"  # first relist is the initial LIST
         backoff = RELIST_BACKOFF_INITIAL
         while not self._stop.is_set():

@@ -68,6 +68,7 @@ from ..api.types import (
     rolebinding_from_k8s,
     rolebinding_to_k8s,
 )
+from ..analysis.lockorder import register_thread_role
 from ..apiserver.admission import AdmissionError
 from ..apiserver.auth import ForbiddenError, UnauthorizedError
 from ..apiserver.http import _lease_from_k8s, _lease_to_k8s
@@ -116,7 +117,10 @@ class _RemoteWatcher:
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 
+    # ktpu: thread-entry(informer) the remote watch pump feeds the same
+    # informer stream the in-process reflector does — same role
     def _pump(self) -> None:
+        register_thread_role("informer")
         try:
             buf = b""
             while True:

@@ -28,7 +28,7 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional
 
-from ..analysis.lockorder import audited_lock
+from ..analysis.lockorder import audited_lock, register_thread_role
 
 
 class CommitPipeline:
@@ -45,6 +45,14 @@ class CommitPipeline:
             "drain_wait_s": 0.0,  # host time actually BLOCKED on an apply
             "apply_s": 0.0,  # worker wall inside submitted closures
         }
+        # worker→driver stat handoff: the submitted closure's counter
+        # contributions (apply seconds, reject counts) accumulate HERE
+        # under the lock and are merged into the scheduler's own stats
+        # dict by the DRIVER at drain — KTPU006 found the closure writing
+        # Scheduler.stats directly from the worker (a cross-thread
+        # read-modify-write the single-writer stats dict never signed
+        # up for)
+        self._worker_stats: Dict[str, float] = {}  # ktpu: guarded-by(self._lock)
 
     def submit(self, fn: Callable[[], None]) -> None:
         """Run `fn` on the worker; blocks first if a previous apply is
@@ -54,13 +62,30 @@ class CommitPipeline:
             self.stats["submitted"] += 1
             self._inflight = self._pool.submit(self._run, fn)
 
+    # ktpu: thread-entry(commit-apply) every submitted closure (the
+    # driver's apply_batch) runs inside this wrapper on the worker
     def _run(self, fn: Callable[[], None]) -> None:
+        register_thread_role("commit-apply")
         t0 = time.perf_counter()
         try:
             fn()
         finally:
             with self._lock:
                 self.stats["apply_s"] += time.perf_counter() - t0
+
+    def note_stat(self, key: str, val: float) -> None:
+        """Worker-side counter contribution (called from the submitted
+        closure): accumulated under the lock, merged into the driver's
+        stats at the next take_worker_stats()."""
+        with self._lock:
+            self._worker_stats[key] = self._worker_stats.get(key, 0) + val
+
+    def take_worker_stats(self) -> Dict[str, float]:
+        """Drain-and-clear the worker's pending stat contributions —
+        DRIVER-side half of the handoff (call after drain())."""
+        with self._lock:
+            out, self._worker_stats = self._worker_stats, {}
+            return out
 
     def drain(self) -> None:
         """Wait for the in-flight apply (no-op when idle). Re-raises the

@@ -41,8 +41,13 @@ class CompilePlan:
         self.cache = cache
         self._lock = audited_lock("compile-plan")
         # spec key -> {"spec", "compile_s", "source", "count"}
-        self._records: Dict[Tuple, Dict] = {}
+        self._records: Dict[Tuple, Dict] = {}  # ktpu: guarded-by(self._lock)
+        # ktpu: allow(KTPU006) monotone warm flag: single False->True
+        # transition under the lock (mark_warmed); racy readers see a
+        # stale False at worst (a miss counted as warmup-sourced), never
+        # a correctness fault — deliberately lock-free on the hot path
         self.warmed = False
+        # ktpu: guarded-by(self._lock)
         self.stats: Dict[str, float] = {
             "hits": 0,
             "misses": 0,
@@ -74,14 +79,16 @@ class CompilePlan:
             if rec is not None:
                 rec["count"] += 1
                 self.stats["hits"] += 1
-                self._metric_hit()
+                self._metric_hit(len(self._records))
                 return True
             self.stats["misses"] += 1
             after = self.warmed
             if after:
                 self.stats["misses_after_warmup"] += 1
             self._declare_locked(c, 0.0, SOURCE_INLINE)
-        self._metric_miss(after)
+            n_specs = len(self._records)
+            mis = int(self.stats["misses_after_warmup"])
+        self._metric_miss(after, n_specs, mis)
         if after:
             logger.warning(
                 "compile-plan MISS after warmup: %s — compiling inline "
@@ -256,21 +263,21 @@ class CompilePlan:
         except Exception:  # pragma: no cover
             return None
 
-    def _metric_hit(self) -> None:
+    def _metric_hit(self, n_specs: int) -> None:
+        """Pure metric emitter: plan-state values arrive as arguments so
+        the caller reads them under the lock (KTPU003 discipline)."""
         M = self._metrics()
         if M is not None:
             M.compile_plan_lookups.inc("hit")
-            M.compile_ladder_specs.set(len(self._records))
+            M.compile_ladder_specs.set(n_specs)
 
-    def _metric_miss(self, after_warmup: bool) -> None:
+    def _metric_miss(self, after_warmup: bool, n_specs: int, misses_after: int) -> None:
         M = self._metrics()
         if M is not None:
             M.compile_plan_lookups.inc("miss")
-            M.compile_ladder_specs.set(len(self._records))
+            M.compile_ladder_specs.set(n_specs)
             if after_warmup:
-                M.compile_spec_misses_after_warmup.set(
-                    self.stats["misses_after_warmup"]
-                )
+                M.compile_spec_misses_after_warmup.set(misses_after)
 
     def _metric_compile(self, seconds: float) -> None:
         M = self._metrics()

@@ -27,7 +27,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..analysis.lockorder import audited_lock
+from ..analysis.lockorder import audited_lock, register_thread_role
 from .ladder import (
     KIND_ARBITER,
     KIND_FILTER,
@@ -44,6 +44,44 @@ from .plan import CompilePlan, SOURCE_PERSISTED, SOURCE_WARMUP
 logger = logging.getLogger("kubernetes_tpu.compile")
 
 
+class _WarmContext:
+    """Everything a warm needs from the TensorMirror, captured at the
+    ROLE BOUNDARY on the driver thread (warm_specs / warm_async): the
+    warm pipeline below this point never touches the driver-confined
+    mirror, structurally — the old code read bank capacities/vocab/image
+    widths (and gated device_arrays on a main-thread check) from the
+    background worker, racing any concurrent rebuild (KTPU006/008).
+    `place` and `live_banks` are bound mirror methods invoked lazily;
+    `live_banks` is captured ONLY for foreground (driver-thread) use —
+    `place` consults just the set_mesh-time placement recipe, frozen
+    before any drain spawns workers."""
+
+    __slots__ = ("live_shape", "vocab", "img_w", "place", "fold_fns",
+                 "live_banks")
+
+    def __init__(self, mirror, specs: Sequence[SolveSpec], foreground: bool):
+        nodes = mirror.nodes
+        self.live_shape = (
+            nodes.capacity, nodes.key_capacity, nodes.alloc.shape[1],
+            mirror.eps.capacity, mirror.pats.capacity,
+        )
+        self.vocab = mirror.vocab
+        img = getattr(nodes, "image_scaled", None)
+        self.img_w = img.shape[1] if img is not None else 64
+        self.place = mirror._to_dev
+        # live banks only for foreground warms: device_arrays' dirty-row
+        # bookkeeping is driver-only, so a background ctx never gets it
+        self.live_banks = mirror.device_arrays if foreground else None
+        # sharded fold warms dispatch through the mirror's memoized
+        # mesh-bound kernels — capture them HERE (driver thread) so the
+        # worker never touches the _sharded_folds memo
+        self.fold_fns = (
+            mirror._fold_fns()
+            if any(s.kind == KIND_FOLD and s.shards for s in specs)
+            else None
+        )
+
+
 class WarmupService:
     """Owns no policy: the plan says WHAT to compile, this service does."""
 
@@ -52,7 +90,7 @@ class WarmupService:
         self.plan = plan if plan is not None else scheduler.compile_plan
         self._lock = audited_lock("warmup")
         self._done: set = set()
-        self._pending: List[Tuple[SolveSpec, Optional[Tuple]]] = []
+        self._pending: List[Tuple[SolveSpec, Optional[Tuple], _WarmContext]] = []
         self._worker: Optional[threading.Thread] = None
         # True from the moment a worker is started until it observes an
         # empty queue UNDER THE LOCK and exits. Checked instead of
@@ -60,7 +98,8 @@ class WarmupService:
         # for a moment, and an enqueue landing in that window would see
         # is_alive() and start nothing — specs stuck unwarmed (lost
         # wakeup).
-        self._worker_active = False
+        self._worker_active = False  # ktpu: guarded-by(self._lock)
+        # ktpu: guarded-by(self._lock) foreground AND worker warms count
         self.stats: Dict[str, float] = {"warmed": 0, "failures": 0, "warm_s": 0.0}
 
     # -- public entry points --------------------------------------------------
@@ -69,27 +108,31 @@ class WarmupService:
         self, specs: Sequence[SolveSpec], dev: Optional[Tuple] = None,
         source: str = SOURCE_WARMUP,
     ) -> int:
-        """Foreground warm (caller's thread — safe to read the mirror).
-        Returns the number of specs actually executed."""
+        """Foreground warm — the caller's thread is the driver, so the
+        ctx it captures here may carry the live-bank resolver. Returns
+        the number of specs actually executed."""
+        ctx = _WarmContext(self.sched.mirror, specs, foreground=True)
         n = 0
         for spec in specs:
-            if self._warm_one(spec, dev, source):
+            if self._warm_one(spec, dev, source, ctx=ctx):
                 n += 1
         return n
 
     def warm_async(self, specs: Sequence[SolveSpec], dev: Optional[Tuple] = None) -> None:
         """Queue specs for the background worker. `dev` is a (na, ea, xp)
         device-dict snapshot taken by the caller — background warms MUST
-        NOT call mirror.device_arrays() themselves (its dirty-row
-        bookkeeping is not thread-safe); without a snapshot the worker
-        builds synthetic banks."""
+        NOT touch the TensorMirror (device_arrays' dirty-row bookkeeping
+        is not thread-safe, and every bank attribute is driver-confined);
+        the shapes/vocab the worker needs travel in a _WarmContext
+        captured HERE, on the calling (driver) thread."""
+        ctx = _WarmContext(self.sched.mirror, specs, foreground=False)
         with self._lock:
-            queued = {s.key() for s, _ in self._pending}
+            queued = {s.key() for s, _, _ in self._pending}
             for s in specs:
                 c = self.plan.canonicalize(s)
                 if c.key() in self._done or c.key() in queued:
                     continue
-                self._pending.append((c, dev))
+                self._pending.append((c, dev, ctx))
                 queued.add(c.key())
             if self._pending and not self._worker_active:
                 self._worker_active = True
@@ -123,26 +166,31 @@ class WarmupService:
         if w is not None and w.is_alive():
             w.join(timeout)
 
+    # ktpu: thread-entry(warmup) the background compile worker
     def _drain(self) -> None:
+        register_thread_role("warmup")
         while True:
             with self._lock:
                 if not self._pending:
                     self._worker_active = False
                     return
-                spec, dev = self._pending.pop(0)
-            self._warm_one(spec, dev, SOURCE_WARMUP)
+                spec, dev, ctx = self._pending.pop(0)
+            self._warm_one(spec, dev, SOURCE_WARMUP, ctx=ctx)
 
     # -- the actual warm -------------------------------------------------------
 
-    def _warm_one(self, spec: SolveSpec, dev, source: str) -> bool:
+    def _warm_one(
+        self, spec: SolveSpec, dev, source: str, ctx: _WarmContext,
+    ) -> bool:
         c = self.plan.canonicalize(spec)
         with self._lock:
             if c.key() in self._done:
                 return False
         try:
-            secs = self.warm_spec(c, dev)
+            secs = self.warm_spec(c, dev, ctx=ctx)
         except Exception:
-            self.stats["failures"] += 1
+            with self._lock:  # foreground + worker both count here
+                self.stats["failures"] += 1
             logger.warning("warmup failed for %s", c.short(), exc_info=True)
             if source == SOURCE_PERSISTED:
                 # the spec was declared at LOAD time on the promise of this
@@ -158,16 +206,21 @@ class WarmupService:
             return False  # incompatible with the current deployment: skipped
         with self._lock:
             self._done.add(c.key())
-        self.stats["warmed"] += 1
-        self.stats["warm_s"] += secs
+            self.stats["warmed"] += 1
+            self.stats["warm_s"] += secs
         self.plan.declare(c, source=source)
         self.plan.note_compiled(c, secs, source)
         return True
 
-    def warm_spec(self, spec: SolveSpec, dev=None) -> Optional[float]:
+    def warm_spec(
+        self, spec: SolveSpec, dev=None, *, ctx: _WarmContext,
+    ) -> Optional[float]:
         """Execute one spec at its declared shapes; returns wall seconds,
         or None when the spec can't be realized here (a SolveConfig this
-        process can't reconstruct, zero-size axes)."""
+        process can't reconstruct, zero-size axes). `ctx` is the mirror
+        snapshot captured at the role boundary (warm_specs/warm_async,
+        both driver-thread) — nothing below this point touches the
+        driver-confined TensorMirror."""
         if spec.kind == KIND_PREEMPT:
             return self._warm_preempt(spec)  # no SolveConfig static
         if spec.kind == KIND_PATCH:
@@ -178,11 +231,11 @@ class WarmupService:
             # (undeclared for persisted sources, by design)
             return None
         if spec.kind == KIND_FOLD:
-            return self._warm_fold(spec)  # no SolveConfig static
+            return self._warm_fold(spec, ctx)  # no SolveConfig static
         if spec.kind == KIND_STAGE:
-            return self._warm_stage(spec)  # no SolveConfig static
+            return self._warm_stage(spec, ctx)  # no SolveConfig static
         if spec.kind == KIND_TERM:
-            return self._warm_term(spec)  # no SolveConfig static
+            return self._warm_term(spec, ctx)  # no SolveConfig static
         if spec.config_repr != repr(self.sched.solve_config):
             return None  # persisted ladder from a differently-policied run
         if not (spec.b and spec.u and spec.t and spec.n and spec.v):
@@ -201,9 +254,8 @@ class WarmupService:
         from ..state.terms import compile_batch_terms
         from ..state.tensors import PodBatch
 
-        mirror = self.sched.mirror
-        vocab = mirror.vocab
-        na, ea, xp = self._banks_for(spec, dev)
+        vocab = ctx.vocab
+        na, ea, xp = self._banks_for(spec, dev, ctx)
         if na is None:
             return None
         use_sharded = spec.shards > 0
@@ -215,7 +267,7 @@ class WarmupService:
             # program the drain never dispatches. This includes shards=0
             # specs on a MESH driver (the indivisible-bucket fallback):
             # the replicated pipeline still receives sharded banks there.
-            na, ea, xp = self._shard_banks(na, ea, xp)
+            na, ea, xp = self._shard_banks(na, ea, xp, ctx)
         batch = PodBatch(vocab, spec.u)
         tb, aux = compile_batch_terms(vocab, [], capacity=spec.t, b_capacity=spec.u)
         pb = {
@@ -297,67 +349,64 @@ class WarmupService:
 
     # -- templates -------------------------------------------------------------
 
-    def _shard_banks(self, na, ea, xp):
+    def _shard_banks(self, na, ea, xp, ctx: _WarmContext):
         """Place template banks exactly the way TensorMirror uploads the
         live ones on a mesh (node-major axes NamedSharding'd over "nodes",
         everything else plain) — the same `_to_dev` recipe, so the warmed
         executable's input shardings equal the dispatched ones."""
-        m = self.sched.mirror
-        na = {k: m._to_dev(v, True) for k, v in na.items()}
-        ea = {k: m._to_dev(v, k == "counts") for k, v in ea.items()}
-        xp = {k: m._to_dev(v, k == "counts") for k, v in xp.items()}
+        place = ctx.place
+        na = {k: place(v, True) for k, v in na.items()}
+        ea = {k: place(v, k == "counts") for k, v in ea.items()}
+        xp = {k: place(v, k == "counts") for k, v in xp.items()}
         return na, ea, xp
 
-    def _banks_for(self, spec: SolveSpec, dev):
+    def _banks_for(self, spec: SolveSpec, dev, ctx: _WarmContext):
         """(na, ea, xp) argument dicts at the spec's bank shapes. The live
-        snapshot (`dev`, or the mirror when called from the driver thread)
-        is used when every bank axis matches; otherwise synthetic banks are
-        built from the encoder classes — shape-exact for specs one growth
-        rung AHEAD of the live banks (sig/pattern/node growth warming)."""
-        mirror = self.sched.mirror
-        live_shape = (
-            mirror.nodes.capacity, mirror.nodes.key_capacity,
-            mirror.nodes.alloc.shape[1], mirror.eps.capacity,
-            mirror.pats.capacity,
-        )
-        if (spec.n, spec.k, spec.r, spec.s, spec.pt) == live_shape:
+        snapshot (`dev`, or — foreground only — the ctx's live-bank
+        resolver) is used when every bank axis matches; otherwise
+        synthetic banks are built from the encoder classes — shape-exact
+        for specs one growth rung AHEAD of the live banks (sig/pattern/
+        node growth warming). The shape comparison uses the ctx capture,
+        so a background call never reads the mirror's capacities racily
+        (the old current_thread() gate did)."""
+        if (spec.n, spec.k, spec.r, spec.s, spec.pt) == ctx.live_shape:
             if dev is not None:
                 return dev
-            if threading.current_thread() is threading.main_thread():
-                return mirror.device_arrays()
-            # background thread without a snapshot: fall through to synthetic
-        return self._synthetic_banks(spec)
+            if ctx.live_banks is not None:  # foreground: driver thread
+                return ctx.live_banks()
+            # background without a snapshot: fall through to synthetic
+        return self._synthetic_banks(spec, ctx)
 
-    def _synthetic_banks(self, spec: SolveSpec):
+    def _synthetic_banks(self, spec: SolveSpec, ctx: _WarmContext):
         import numpy as np
 
         from ..state.tensors import EncodingConfig, NodeBank, SigBank, Vocab
         from ..state.terms import PatternBank
 
-        mirror = self.sched.mirror
+        base_vocab = ctx.vocab
         if (spec.k, spec.r) != (
-            mirror.nodes.key_capacity, mirror.nodes.alloc.shape[1]
+            base_vocab.config.key_slots, base_vocab.config.resource_slots
         ):
             # a different key/resource width needs its own vocab config;
             # the ids the kernels consume are scalars, so a throwaway
             # vocab still yields the identical program signature
             vocab = Vocab(EncodingConfig(key_slots=spec.k, resource_slots=spec.r))
         else:
-            vocab = mirror.vocab
+            vocab = base_vocab
         if vocab.config.key_slots != spec.k or vocab.config.resource_slots != spec.r:
             return None, None, None  # config grew concurrently: skip
         nb = NodeBank(vocab, spec.n)
         # the live node dict carries image_scaled (ImageTable.apply runs on
         # every rebuild); mirror its CURRENT width — image-vocab growth is
         # its own (rare) recompile, not this spec's axis
-        img = getattr(mirror.nodes, "image_scaled", None)
-        img_w = img.shape[1] if img is not None else 64
-        nb.image_scaled = np.zeros((spec.n, img_w), np.int64)
+        nb.image_scaled = np.zeros((spec.n, ctx.img_w), np.int64)
         eb = SigBank(vocab, spec.s, spec.n)
         pb = PatternBank(vocab, spec.pt, spec.n)
         return nb.arrays(), eb.arrays(), pb.arrays()
 
-    def _warm_fold(self, spec: SolveSpec) -> Optional[float]:
+    def _warm_fold(
+        self, spec: SolveSpec, ctx: _WarmContext
+    ) -> Optional[float]:
         """ops/fold at the spec's shapes. Always synthetic zero banks —
         the LIVE resident banks must never be donated into a warm (the
         drain still needs them). Dtypes mirror the mirror's canonicalized
@@ -373,7 +422,6 @@ class WarmupService:
         import jax.numpy as jnp
         import numpy as np
 
-        mirror = self.sched.mirror
         sharded = spec.shards > 0
         if sharded:
             if (
@@ -381,7 +429,12 @@ class WarmupService:
                 or spec.n % spec.shards != 0
             ):
                 return None  # foreign mesh / indivisible: not realizable
-            fold_commit_banks, fold_usage = mirror._fold_fns()
+            if ctx.fold_fns is None:
+                # the ctx capture didn't include the mesh-bound kernels
+                # (no sharded fold spec was visible at the role boundary)
+                # — skip rather than touch the driver-confined memo here
+                return None
+            fold_commit_banks, fold_usage = ctx.fold_fns
 
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -427,7 +480,9 @@ class WarmupService:
         jax.block_until_ready(out[0])
         return time.perf_counter() - t0
 
-    def _warm_stage(self, spec: SolveSpec) -> Optional[float]:
+    def _warm_stage(
+        self, spec: SolveSpec, ctx: _WarmContext
+    ) -> Optional[float]:
         """ingest/gather.gather_stage at the spec's shapes (u = index
         rung, s = slab capacity, k/r = encoding widths). Synthetic slab —
         a PodBatch at the spec's capacity, placed through the mirror's
@@ -447,8 +502,7 @@ class WarmupService:
         from ..ingest.gather import gather_stage
         from ..state.tensors import EncodingConfig, PodBatch, Vocab
 
-        mirror = self.sched.mirror
-        vocab = mirror.vocab
+        vocab = ctx.vocab
         if (spec.k, spec.r) != (
             vocab.config.key_slots, vocab.config.resource_slots
         ):
@@ -458,7 +512,8 @@ class WarmupService:
                 or vocab.config.resource_slots != spec.r
             ):
                 return None
-        place = lambda v: mirror._to_dev(v, False)  # noqa: E731
+        _to_dev = ctx.place
+        place = lambda v: _to_dev(v, False)  # noqa: E731
         bank = {k: place(v) for k, v in PodBatch(vocab, spec.s).arrays().items()}
         empty = {k: place(v) for k, v in PodBatch(vocab, 1).arrays().items()}
         idx = np.zeros(spec.u, np.int32)
@@ -469,7 +524,9 @@ class WarmupService:
         jax.block_until_ready(out["valid"])
         return time.perf_counter() - t0
 
-    def _warm_term(self, spec: SolveSpec) -> Optional[float]:
+    def _warm_term(
+        self, spec: SolveSpec, ctx: _WarmContext
+    ) -> Optional[float]:
         """terms_plane/gather.gather_terms at the spec's shapes (t = term
         index rung, s = slab row capacity). Synthetic slab — a TermBank
         at the spec's capacity, placed through the mirror's
@@ -488,14 +545,15 @@ class WarmupService:
         from ..state.terms import TermBank
         from ..terms_plane.gather import gather_terms
 
-        mirror = self.sched.mirror
-        place = lambda v: mirror._to_dev(v, False)  # noqa: E731
+        vocab = ctx.vocab
+        _to_dev = ctx.place
+        place = lambda v: _to_dev(v, False)  # noqa: E731
         bank = {
             k: place(v)
-            for k, v in TermBank(mirror.vocab, spec.s).arrays().items()
+            for k, v in TermBank(vocab, spec.s).arrays().items()
         }
         empty = {
-            k: place(v) for k, v in TermBank(mirror.vocab, 1).arrays().items()
+            k: place(v) for k, v in TermBank(vocab, 1).arrays().items()
         }
         idx = np.zeros(spec.t, np.int32)
         owner = np.zeros(spec.t, np.int32)

@@ -15,6 +15,7 @@ import logging
 import threading
 from typing import Dict, List
 
+from ..analysis.lockorder import register_thread_role
 from ..client.informer import Informer
 from .cronjob import CronJobController
 from .daemonset import DaemonSetController
@@ -246,7 +247,9 @@ class ControllerManager:
             self._threads.append(t)
         return self
 
+    # ktpu: thread-entry(controller) the shared resync ticker
     def _tick_loop(self) -> None:
+        register_thread_role("controller")
         while not self._stop.wait(self._resync_period_s):
             for c in self._tickables:
                 try:
@@ -254,16 +257,20 @@ class ControllerManager:
                 except Exception:
                     logger.exception("resync tick failed for %s", type(c).__name__)
 
+    # ktpu: thread-entry(controller)
     def _monitor_loop(self, controller, period_s: float) -> None:
         """monitorNodeHealth's clock: staleness has no apiserver event,
         so every period each node re-syncs."""
+        register_thread_role("controller")
         while not self._stop.wait(period_s):
             try:
                 controller.resync_all()
             except Exception:
                 logger.exception("node monitor tick failed")
 
+    # ktpu: thread-entry(controller) one reconcile worker per controller
     def _worker(self, controller, queue: WorkQueue) -> None:
+        register_thread_role("controller")
         while not self._stop.is_set():
             key = queue.get(timeout=0.2)
             if key is None:

@@ -102,6 +102,8 @@ class ExtenderServer:
         return f"http://{h}:{p}"
 
     def start(self) -> "ExtenderServer":
+        # ktpu: thread-entry(extender-serve) stdlib mux: handlers run on
+        # socketserver threads the call graph cannot follow
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
         return self

@@ -97,18 +97,27 @@ class PlaneBreaker:
         #: hot-path gate — True iff state == CLOSED. Plain attribute so
         #: the covered dispatch pays one read, no lock (torn reads are
         #: benign: both paths are correct, only coverage shifts a batch).
+        # ktpu: allow(KTPU006) mirror of `state == CLOSED` kept as a
+        # plain bool ON PURPOSE: the covered dispatch reads it lock-free
+        # (one attribute read per batch; a torn/stale read routes one
+        # batch to the legacy path — benign). All WRITES happen under
+        # the board lock in the transition methods.
         self.closed = True
         self.state = CLOSED  # ktpu: guarded-by(self._lock)
-        self.failures = 0  # consecutive, while closed; ktpu: guarded-by(self._lock)
+        # ktpu: guarded-by(self._lock) consecutive failures while closed
+        self.failures = 0
         self.trips = 0  # ktpu: guarded-by(self._lock)
         self.probes_passed = 0  # ktpu: guarded-by(self._lock)
         self.probes_failed = 0  # ktpu: guarded-by(self._lock)
-        self.probing = False  # a probe batch is in flight; ktpu: guarded-by(self._lock)
+        # ktpu: guarded-by(self._lock) a probe batch is in flight
+        self.probing = False
         self.last_reason: Optional[str] = None  # ktpu: guarded-by(self._lock)
         self._last_failure_ts = 0.0  # ktpu: guarded-by(self._lock)
         self._open_until = 0.0  # ktpu: guarded-by(self._lock)
-        self._cooldown = float(cooldown_s)  # escalates on failed probes; ktpu: guarded-by(self._lock)
-        self.trip_log: List[Tuple[float, str]] = []  # bounded; ktpu: guarded-by(self._lock)
+        # ktpu: guarded-by(self._lock) escalates on failed probes
+        self._cooldown = float(cooldown_s)
+        # ktpu: guarded-by(self._lock) bounded (16 entries)
+        self.trip_log: List[Tuple[float, str]] = []
 
     # -- transitions (board lock held by callers or taken here) --------------
 
@@ -262,6 +271,10 @@ class BreakerBoard:
         }
         #: hot-path gate: True while every breaker is closed AND no
         #: recovery is pending — the healthy steady state. Plain bool.
+        # ktpu: allow(KTPU006) the board-wide fast-path bool (board.quiet
+        # is THE one-attribute-read hot-path gate): read lock-free by
+        # design, recomputed only under the lock (_recompute_quiet_locked);
+        # a stale read costs one extra/missed service pass, never safety.
         self.quiet = True
         self._pending_recovery: List[str] = []  # ktpu: guarded-by(self._lock)
         for p in PLANES:

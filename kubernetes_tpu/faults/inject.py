@@ -113,8 +113,8 @@ class FaultPlan:
         self.events: List[FaultEvent] = list(events)
         self.seed = seed
         # (site, arg) per-arg call counts + (site, None) site-wide totals
-        self._counts: Dict[Tuple[str, Optional[str]], int] = {}
-        self._fired: List[str] = []
+        self._counts: Dict[Tuple[str, Optional[str]], int] = {}  # ktpu: guarded-by(self._lock)
+        self._fired: List[str] = []  # ktpu: guarded-by(self._lock)
         self._lock = threading.Lock()
         # latched by the FIRST crash kill-point to fire (the stage name):
         # the supervisor polls it to detect deaths on worker threads, and
@@ -123,6 +123,10 @@ class FaultPlan:
         # the in-process equivalent. Never reset: a plan is one process
         # lifetime, the supervisor hands the next incarnation a fresh
         # view via `rearm()`.
+        # ktpu: allow(KTPU006) monotone crash latch: one None->site
+        # transition by whichever thread hits a kill-point; every other
+        # thread reads it racily ON PURPOSE (crash_gate fences outward
+        # writes even before the latch propagates)
         self.crashed: Optional[str] = None
 
     # -- construction --------------------------------------------------------
@@ -236,8 +240,9 @@ class FaultPlan:
         twin = FaultPlan.__new__(FaultPlan)
         twin.events = self.events
         twin.seed = self.seed
-        twin._counts = self._counts
-        twin._fired = self._fired
+        with self._lock:  # bookkeeping aliased under the shared lock
+            twin._counts = self._counts
+            twin._fired = self._fired
         twin._lock = self._lock
         twin.crashed = None
         return twin

@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..analysis.lockorder import register_thread_role
 from ..compile.ladder import KIND_STAGE, SolveSpec
 from ..obs import NOOP_SPAN as _NOOP, RECORDER as _REC
 from .stage import PodStage
@@ -125,7 +126,7 @@ class StageBank:
         # every flush is a synchronous dispatch-time one — correct, slower)
         self._wake = threading.Event()
         self._stop = threading.Event()
-        self._worker: Optional[threading.Thread] = None
+        self._worker: Optional[threading.Thread] = None  # ktpu: guarded-by(self._lock)
         # fault plane (kubernetes_tpu/faults): the driver attaches a
         # fault sink (breaker board) and, under injection, a FaultPlan —
         # both default None so a standalone bank costs one attribute read
@@ -261,16 +262,24 @@ class StageBank:
 
     def start(self) -> None:
         """Arm the off-thread uploader (idempotent). Driver calls this at
-        warmup so tests that never warm don't get surprise threads."""
-        if self._worker is not None and self._worker.is_alive():
-            return
-        self._stop.clear()
-        self._worker = threading.Thread(
-            target=self._drain, name=self.THREAD_NAME, daemon=True
-        )
-        self._worker.start()
+        warmup so tests that never warm don't get surprise threads. The
+        worker handle is written under the stage lock: recovery restarts
+        it from the driver while the health census reads its liveness."""
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._stop.clear()
+            worker = threading.Thread(
+                target=self._drain, name=self.THREAD_NAME, daemon=True
+            )
+            self._worker = worker
+        worker.start()
 
+    # ktpu: thread-entry(ingest-upload, terms-upload) the background
+    # uploader loop — one def, two roles: TermBankDevice inherits it, so
+    # the spawned thread runs as whichever bank's THREAD_NAME it carries
     def _drain(self) -> None:
+        register_thread_role(self.THREAD_NAME)
         try:
             while not self._stop.is_set():
                 self._wake.wait(timeout=0.05)
@@ -367,7 +376,8 @@ class StageBank:
         counted fault that must re-trip before anyone restarts again).
         The dirty backlog is flushed synchronously first so the new
         worker starts from a clean slate. Returns True if restarted."""
-        w = self._worker
+        with self._lock:
+            w = self._worker
         if w is None or self._stop.is_set():
             return False
         if w.is_alive():
@@ -452,7 +462,8 @@ class StageBank:
             pass  # a broken flush must not block shutdown
         self._stop.set()
         self._wake.set()
-        w = self._worker
+        with self._lock:
+            w = self._worker
         if w is not None and w.is_alive():
             w.join(timeout=5)
 
@@ -482,8 +493,8 @@ class StageBank:
         uploader's flush counters — shares the slab lock so the numbers
         are one consistent cut. Metadata only; never reads device
         buffers."""
-        w = self._worker
         with self._lock:
+            w = self._worker
             return {
                 "resident": self._dev is not None,
                 "device_generation": self._dev_generation,

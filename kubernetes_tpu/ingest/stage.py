@@ -52,16 +52,17 @@ class PodStage:
     def __init__(self, vocab, capacity: int = MIN_CAPACITY):
         self.vocab = vocab
         self._lock = audited_rlock("stage")
-        self._next_gen = 1
+        self._next_gen = 1  # ktpu: guarded-by(self._lock)
         # bank wake-up hook (StageBank sets it): called after a fresh row
         # is staged so the background uploader can batch it out
         self.on_dirty: Optional[callable] = None
         # bumped on every rebuild; the device twin (bank.StageBank) keys
         # its full-upload decision on it
-        self.generation = 0
+        self.generation = 0  # ktpu: guarded-by(self._lock)
         # staleness counters (stale rows seen, dispatch-time restages)
         # live on the DRIVER's stats (ingest_stale_rows/ingest_restaged)
         # — the slab only counts what it owns
+        # ktpu: guarded-by(self._lock)
         self.stats: Dict[str, int] = {
             "staged": 0,  # fresh rows encoded (once per distinct spec)
             "hits": 0,  # acquire served by an existing row
@@ -75,7 +76,7 @@ class PodStage:
     # ktpu: holds(self._lock) callers: __init__ (pre-concurrency) and the
     # locked acquire/ensure_current/_rebuild paths
     def _build(self, capacity: int) -> None:
-        self.capacity = capacity
+        self.capacity = capacity  # ktpu: guarded-by(self._lock)
         self.batch = PodBatch(self.vocab, capacity)  # ktpu: guarded-by(self._lock)
         self.key_capacity = self.batch.key_capacity
         self.resource_capacity = self.batch.req.shape[1]

@@ -53,6 +53,7 @@ class HollowKubelet:
 
     def start(self) -> "HollowKubelet":
         self._register()
+        # ktpu: thread-entry(kubelet) one heartbeat/ack agent per node
         self._thread = threading.Thread(
             target=self._run, name=f"hollow-{self.node_name}", daemon=True
         )

@@ -55,6 +55,8 @@ class MetricsServer:
         return f"http://{h}:{p}"
 
     def start(self) -> "MetricsServer":
+        # ktpu: thread-entry(metrics-serve) stdlib mux: handlers run on
+        # socketserver threads the call graph cannot follow
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
         return self

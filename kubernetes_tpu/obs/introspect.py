@@ -52,7 +52,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..analysis.lockorder import audited_lock
+from ..analysis.lockorder import audited_lock, register_thread_role
 from ..faults.breaker import STATE_VALUE as _BREAKER_STATE_VALUE
 from ..metrics import metrics as M
 
@@ -106,12 +106,12 @@ _REQUIRED_KEYS = {
 # ---------------------------------------------------------------------------
 
 # ktpu: hot-path
-def queue_census(queue) -> Dict:
+def queue_census(queue: "PriorityQueue") -> Dict:
     return queue.census()
 
 
 # ktpu: hot-path
-def ingest_census(stage, bank) -> Dict:
+def ingest_census(stage: "PodStage", bank: "StageBank") -> Dict:
     if stage is None:
         return {"enabled": False}
     out = stage.census()
@@ -120,7 +120,7 @@ def ingest_census(stage, bank) -> Dict:
 
 
 # ktpu: hot-path
-def terms_census(tstage, term_bank) -> Dict:
+def terms_census(tstage: "TermStage", term_bank: "TermBankDevice") -> Dict:
     if tstage is None:
         return {"enabled": False}
     out = tstage.census()
@@ -129,19 +129,19 @@ def terms_census(tstage, term_bank) -> Dict:
 
 
 # ktpu: hot-path
-def cache_census(cache) -> Dict:
+def cache_census(cache: "SchedulerCache") -> Dict:
     return cache.census()
 
 
 # ktpu: hot-path
-def compile_census(plan) -> Dict:
+def compile_census(plan: "CompilePlan") -> Dict:
     # health_census, not snapshot(): one short lock hold, no per-spec
     # list built and discarded at refresh cadence
     return plan.health_census()
 
 
 # ktpu: hot-path
-def commit_census(pipe) -> Dict:
+def commit_census(pipe: "CommitPipeline") -> Dict:
     out = pipe.census()
     # arbiter verdict totals ride the registry counter (process-global:
     # advisory when several schedulers share the process, exact in the
@@ -186,7 +186,12 @@ def faults_census(sched) -> Dict:
 
 def mirror_census(mirror) -> Dict:
     """The mirror block — DRIVER-THREAD ONLY (TensorMirror.census's
-    confinement contract). The monitor consumes it via the published
+    confinement contract). The parameter is deliberately untyped: the
+    health role never executes this path (census() consumes the
+    monitor's published mailbox when a monitor is attached), and typing
+    it would hand the role graph a reach the monitor never performs —
+    tripping KTPU008 on the very confinement boundary the mailbox
+    exists to keep. The monitor consumes it via the published
     mailbox; callers invoking ``census(sched)`` directly must be on the
     driver thread (tests, the drain loop) or accept an advisory read on
     an idle scheduler."""
@@ -419,7 +424,10 @@ class HealthMonitor:
         t = self._thread
         return t is not None and t.is_alive()
 
+    # ktpu: thread-entry(health) the monitor loop: censuses + gauges,
+    # never the driver-confined mirror (mailbox only)
     def _run(self) -> None:
+        register_thread_role("health")
         while not self._stop.wait(self.interval):
             try:
                 self.refresh()

@@ -55,12 +55,18 @@ class NodeInfo:
     def __post_init__(self):
         self._recount()
 
+    # NodeInfo is an EXTERNALLY-synchronized value object: live instances
+    # are mutated only under SchedulerCache._lock (the cache is the sole
+    # mutator — holder-side discipline checked in state/cache.py), and
+    # snapshot/lazy-view clones are thread-local. The running-sum attrs
+    # therefore carry allow(KTPU006) rather than a guarded-by they could
+    # not name (the lock lives on the owning cache, not on the object).
     def _recount(self) -> None:
-        self._aff_pods: List[Pod] = []
-        self._req: Dict[str, int] = {}
-        self._nz_cpu = 0
-        self._nz_mem = 0
-        self._ports: Dict[Tuple[str, str, int], int] = {}
+        self._aff_pods: List[Pod] = []  # ktpu: allow(KTPU006) cache-lock-held
+        self._req: Dict[str, int] = {}  # ktpu: allow(KTPU006) cache-lock-held
+        self._nz_cpu = 0  # ktpu: allow(KTPU006) cache-lock-held
+        self._nz_mem = 0  # ktpu: allow(KTPU006) cache-lock-held
+        self._ports: Dict[Tuple[str, str, int], int] = {}  # ktpu: allow(KTPU006) cache-lock-held
         # lazy-view generation tag (state/columns.py): when this NodeInfo
         # is a columnar cache's view, materialization stamps it with the
         # row's column generation — a reader comparing against
@@ -119,7 +125,7 @@ class NodeInfo:
         return None
 
     def set_pods(self, pods: List[Pod]) -> None:
-        self.pods = list(pods)
+        self.pods = list(pods)  # ktpu: allow(KTPU006) cache-lock-held
         self._recount()
 
     # -- aggregates ----------------------------------------------------------
@@ -239,6 +245,9 @@ class Snapshot:
     nodeNameToInfo maps passed through the reference algorithm."""
 
     def __init__(self, nodes: Optional[List[Node]] = None, pods: Optional[List[Pod]] = None):
+        # ktpu: allow(KTPU006) externally synchronized like NodeInfo: the
+        # live snapshot mutates only under SchedulerCache._lock; oracle/
+        # plugin copies are built and read on one thread
         self.node_infos: Dict[str, NodeInfo] = {}
         for n in nodes or []:
             self.add_node(n)

@@ -190,6 +190,9 @@ class Supervisor:
                 time.sleep(settle_s)  # binds/backoffs/informer lag settle
         return "timeout"
 
+    # ktpu: thread-entry(driver) the supervisor's thread IS each
+    # incarnation's driver: it cold-starts, drives schedule_batch, and
+    # buries — there is no separate supervisor thread to confine
     def run(self, budget_s: float = 120.0, max_restarts: int = 8) -> SupervisorReport:
         """The supervision loop: drive until the drain completes, the
         budget expires, or the restart bound trips (a runaway crash

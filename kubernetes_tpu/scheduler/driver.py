@@ -48,6 +48,7 @@ from ..compile.ladder import (
     KIND_SOLVE_GANG,
 )
 from ..compile.plan import SOURCE_INLINE, SOURCE_PERSISTED
+from ..analysis.lockorder import register_thread_role
 from ..framework.interface import CycleState, Framework
 from ..api.selectors import match_label_selector
 from ..oracle.predicates import (
@@ -292,9 +293,13 @@ class _BatchConflictIndex:
         # (key, value of commit node) → {spec: [committed pods]}
         self._commits_by_kv: Dict[Tuple[str, str], Dict] = {}
         self._rolled_back: set = set()
+        # handoff object: built by ONE thread (the driver's commit loop,
+        # or the commit-pipeline worker via LazyConflictIndex), then read
+        # after the pipeline drain's happens-before edge — never mutated
+        # concurrently, so the flags carry allow(KTPU006) not a lock
         self._match_memo: Dict[Tuple, bool] = {}
-        self.any_anti = False
-        self.any_ports = False
+        self.any_anti = False  # ktpu: allow(KTPU006) single-owner handoff
+        self.any_ports = False  # ktpu: allow(KTPU006) single-owner handoff
         self.commits: List[Pod] = []  # flat, in commit order
 
     def add_commit(self, pod: Pod, node) -> None:
@@ -366,6 +371,9 @@ class LazyConflictIndex:
 
     def __init__(self, pairs: List[Tuple[Pod, object]]):
         self._pairs = pairs
+        # ktpu: allow(KTPU006) idempotent memo: materializes on the commit
+        # worker or at first consume; callers are ordered by the pipeline
+        # drain, and a duplicate build from the same pairs is identical
         self._ix: Optional[_BatchConflictIndex] = None
 
     def materialize(self) -> "_BatchConflictIndex":
@@ -587,7 +595,10 @@ class Scheduler:
         self.solve_config = solve_config
         self._enabled_preds = solve_config.predicates if solve_config is not None else None
         self._bind_workers = bind_workers
-        self._bind_pool = ThreadPoolExecutor(max_workers=bind_workers, thread_name_prefix="bind")
+        self._bind_pool = ThreadPoolExecutor(
+            max_workers=bind_workers, thread_name_prefix="bind",
+            initializer=register_thread_role, initargs=("bind",),
+        )
         self._rng_seed = seed
         self._cycle = 0
         self._spread_selectors_fn: Optional[Callable[[Pod], list]] = None
@@ -801,8 +812,9 @@ class Scheduler:
         self._closed = False
         self.last_census: Optional[Dict] = None
         # black-box baseline: cumulative counters diffed per batch into
-        # the bounded cycle ring (ktpu: confined(driver))
-        self._bb_prev: Optional[Dict] = None
+        # the bounded cycle ring. (This annotation previously sat inside
+        # prose parentheses and NEVER PARSED — KTPU006-era rot cleanup.)
+        self._bb_prev: Optional[Dict] = None  # ktpu: confined(driver)
         # per-phase wall-clock accumulators (the utiltrace/LogIfLong
         # equivalent; bench.py and metrics read these)
         self.stats: Dict[str, float] = {
@@ -955,15 +967,27 @@ class Scheduler:
             mb.allow_probe()
         board.settle()
 
+    # ktpu: thread-entry(driver) fault recovery runs AS the driver at
+    # its safe sync point — never a thread of its own
     def service_faults(self) -> None:
         """Settle the fault plane at an explicit safe point (tests,
         drain tails, idle schedulers): drain the commit pipeline, sync
         the mirror, then run the same recovery/probe service the
         per-batch hook runs. Idempotent; cheap when the board is quiet."""
-        self._commit_pipe.drain()
+        self._drain_commit()
         self.mirror.sync()
         if not self.faults.quiet:
             self._fault_service()
+
+    def _drain_commit(self) -> None:
+        """Drain the commit pipeline, then merge the worker closure's
+        stat contributions into the driver-owned stats dict — the
+        driver-side half of the CommitPipeline stat handoff (the stats
+        dict stays single-writer; the worker writing it directly was a
+        KTPU006 cross-thread read-modify-write)."""
+        self._commit_pipe.drain()
+        for k, v in self._commit_pipe.take_worker_stats().items():
+            self.stats[k] = self.stats.get(k, 0) + v
 
     def _bb_counters(self) -> Dict:
         """Cumulative counters the black box diffs per batch."""
@@ -988,6 +1012,7 @@ class Scheduler:
             ),
         }
 
+    # ktpu: confined(driver) called only from schedule_batch's wrapper
     def _bb_record(self, res: "ScheduleResult", cycle: int, pods: int,
                    wall: float) -> None:
         """Append one black-box cycle record (counter deltas + verdicts)
@@ -2062,6 +2087,8 @@ class Scheduler:
             present_kinds=disp.get("present_kinds", frozenset()),
         )
 
+    # ktpu: thread-entry(driver) whichever thread warms and drives this
+    # scheduler IS the driver role (bench loop, supervisor, __main__)
     def warmup(self, max_pods: Optional[int] = None) -> int:
         """Pre-pay the one-time device costs BEFORE the first scheduling
         cycle: trace + XLA compile (or persistent-cache load) of the solve
@@ -2085,6 +2112,7 @@ class Scheduler:
         a scheduler warming its executables at boot before Run().
         Returns the number of pods warmed with (0 = empty queue or a
         warmup failure, both harmless)."""
+        register_thread_role("driver")
         infos = self.queue.peek_batch(max_pods or self.batch_size)
         saved = dict(self.stats)
         plan = self.compile_plan
@@ -2506,6 +2534,8 @@ class Scheduler:
             defer.append((info, assumed, node_name, state, t_decided))
             return
 
+        # ktpu: thread-entry(bind) submitted to the bind pool (directly
+        # or via a deferred chunk) — never runs on the driver
         def bind_async():
             if self.volume_binder is not None:
                 # bindVolumes first in the async path (scheduler.go:676)
@@ -2568,6 +2598,7 @@ class Scheduler:
         else:
             self._bind_pool.submit(bind_async)
 
+    # ktpu: thread-entry(bind)
     def _lean_bind_chunk(self, items: List[Tuple], cycle: int) -> None:
         """Plugin-free bind pipeline for a whole chunk: the per-pod
         bind_async closure + four individually-locked histogram observes
@@ -3125,7 +3156,7 @@ class Scheduler:
         if escalate or preempt_fails:
             # both read post-apply cluster state (oracle snapshot walks /
             # end-of-batch preemption) — settle the bulk apply first
-            self._commit_pipe.drain()
+            self._drain_commit()
         for i, info in escalate:
             self.stats["arbiter_escalated"] = (
                 self.stats.get("arbiter_escalated", 0) + 1
@@ -3167,7 +3198,11 @@ class Scheduler:
         columnar = self._columnar
         bind_pool = self._bind_pool
         workers = self._bind_workers
+        pipe = self._commit_pipe  # the closure's stat sink (worker side)
 
+        # ktpu: thread-entry(commit-apply) the pipelined bulk apply —
+        # runs on the CommitPipeline worker, overlapped with the next
+        # batch's solve fetch
         def apply_batch() -> None:
             # runs on the commit-pipeline worker: the "apply" span lands
             # in that thread's ring, so the timeline shows the overlap
@@ -3207,9 +3242,11 @@ class Scheduler:
             OBS.record("apply", t_apply, pods=len(place))
             M.commit_apply_duration.observe(result.seconds)
             M.scheduling_stage_duration.observe(result.seconds, "apply")
-            self.stats["apply_s"] = (
-                self.stats.get("apply_s", 0.0) + result.seconds
-            )
+            # stats handoff: this closure runs on the PIPELINE WORKER —
+            # contributions land in the pipe's locked sink and the
+            # driver merges them at drain (Scheduler.stats stays
+            # single-writer; KTPU006 caught the direct write)
+            pipe.note_stat("apply_s", result.seconds)
             t_decided = time.perf_counter()
             state = CycleState()  # shared: the lean pipeline never reads it
             items = [
@@ -3227,9 +3264,7 @@ class Scheduler:
                 # upstream; count loudly and fail it like assume_pod's
                 # ValueError path (the chain's mutation-count equality
                 # check self-corrects for the uncounted assume)
-                self.stats["apply_rejects"] = (
-                    self.stats.get("apply_rejects", 0) + 1
-                )
+                pipe.note_stat("apply_rejects", 1)
                 if folded:
                     # its fold lane landed on device with no host delta to
                     # match: queue the row for a host-wins re-ship (the
@@ -3300,12 +3335,14 @@ class Scheduler:
 
     # -- main loop -----------------------------------------------------------
 
+    # ktpu: thread-entry(driver)
     def schedule_batch(self, max_pods: Optional[int] = None) -> ScheduleResult:
         """One batch cycle, wrapped in the flight recorder's cycle span
         and black-box accounting: an exception escaping the cycle (a
         driver bug, not a per-pod failure — those are handled inside)
         dumps the last N cycle records before propagating, turning the
         invisible-mid-drain class of bug into a log artifact."""
+        register_thread_role("driver")
         if not OBS.enabled:
             return self._schedule_batch(max_pods)
         t0 = time.perf_counter()
@@ -3332,7 +3369,7 @@ class Scheduler:
         if not infos:
             # an apply may still be in flight (a reject re-queues its pod):
             # settle it before reporting the queue drained, then re-pop once
-            self._commit_pipe.drain()
+            self._drain_commit()
             infos = self.queue.pop_batch(max_pods or self.batch_size)
             if not infos:
                 return res
@@ -3348,7 +3385,7 @@ class Scheduler:
         out_pre: Optional[SolveOutput] = None
         if pending is not None and pending["disp"] is not None:
             out_pre = self._finish_solve(pending["disp"])
-        self._commit_pipe.drain()
+        self._drain_commit()
         trace.step("commit-pipeline drain")
         t_sync = time.perf_counter()
         self.mirror.sync()
@@ -4011,9 +4048,11 @@ class Scheduler:
                     )
             elif self.framework.has_plugins("permit"):
                 for f in bind_jobs:
+                    # ktpu: thread-entry(bind) per-pod bind_async closures
                     self._bind_pool.submit(f)
             else:
 
+                # ktpu: thread-entry(bind)
                 def _run_chunk(chunk):
                     for f in chunk:
                         try:
@@ -4118,6 +4157,7 @@ class Scheduler:
                 n += 1
         return n
 
+    # ktpu: thread-entry(driver) shutdown runs on the owning thread
     def close(self) -> None:
         """Orderly shutdown, in dependency order: re-queue speculatively
         parked pods, drain the commit pipeline (its worker SUBMITS bind
@@ -4139,7 +4179,7 @@ class Scheduler:
             # drain-then-shutdown, not wait_for_binds: that helper
             # recreates the pool for callers that keep scheduling;
             # close must not
-            self._commit_pipe.drain()
+            self._drain_commit()
         finally:
             # a raising drain (a worker exception — or a SimulatedCrash
             # — re-raised on this thread) must still stop every worker:
@@ -4168,6 +4208,7 @@ class Scheduler:
         except Exception:
             self.last_census = None  # forensics, never load-bearing
 
+    # ktpu: thread-entry(driver)
     def abort(self) -> None:
         """NON-graceful teardown for the crash-restart harness
         (kubernetes_tpu/restart): a dead process flushes nothing,
@@ -4210,8 +4251,9 @@ class Scheduler:
         No-op after close() (the pool must stay retired)."""
         if getattr(self, "_closed", False):
             return
-        self._commit_pipe.drain()
+        self._drain_commit()
         self._bind_pool.shutdown(wait=True)
         self._bind_pool = ThreadPoolExecutor(
-            max_workers=self._bind_workers, thread_name_prefix="bind"
+            max_workers=self._bind_workers, thread_name_prefix="bind",
+            initializer=register_thread_role, initargs=("bind",),
         )

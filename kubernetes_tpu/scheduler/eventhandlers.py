@@ -40,6 +40,7 @@ class EventHandlers:
 
     # -- pods ---------------------------------------------------------------
 
+    # ktpu: thread-entry(informer)
     def on_pod_add(self, pod: Pod) -> None:
         if _assigned(pod):
             self.cache.add_pod(pod)
@@ -47,6 +48,7 @@ class EventHandlers:
         elif _responsible(pod, self.scheduler_name):
             self.queue.add(pod)
 
+    # ktpu: thread-entry(informer)
     def on_pod_update(self, old: Pod, new: Pod) -> None:
         """The reference registers TWO filtered informers (eventhandlers.go:
         380-430): assigned pods feed the cache, pending ones the queue. An
@@ -66,6 +68,7 @@ class EventHandlers:
                 return
             self.queue.update(old, new)
 
+    # ktpu: thread-entry(informer)
     def on_pod_delete(self, pod: Pod) -> None:
         if _assigned(pod):
             self.cache.remove_pod(pod)
@@ -88,18 +91,22 @@ class EventHandlers:
 
     # -- nodes --------------------------------------------------------------
 
+    # ktpu: thread-entry(informer)
     def on_node_add(self, node: Node) -> None:
         self.cache.add_node(node)
         self.queue.move_all_to_active()
 
+    # ktpu: thread-entry(informer)
     def on_node_update(self, old: Optional[Node], new: Node) -> None:
         self.cache.update_node(new)
         self.queue.move_all_to_active()
 
+    # ktpu: thread-entry(informer)
     def on_node_delete(self, node: Node) -> None:
         self.cache.remove_node(node.name)
 
     # -- other cluster events (PV/PVC/Service/StorageClass) ------------------
 
+    # ktpu: thread-entry(informer)
     def on_cluster_event(self) -> None:
         self.queue.move_all_to_active()

@@ -147,23 +147,23 @@ class SchedulerCache:
         self._ttl = ttl
         self._now = now
         self.snapshot = Snapshot()
-        self._pod_states: Dict[str, _PodState] = {}
+        self._pod_states: Dict[str, _PodState] = {}  # ktpu: guarded-by(self._lock)
         self._assumed: Set[str] = set()
         # columnar plane (state/columns.py): attached by the driver under
         # KTPU_COLUMNAR_CACHE — bulk assume/forget become vectorized
         # column scatters and the NodeInfo objects a lazy journal-backed
         # view. None = every legacy path intact (the kill switch).
-        self._columns = None
+        self._columns = None  # ktpu: guarded-by(self._lock)
         # fault plane (kubernetes_tpu/faults): a broken columnar scatter
         # detaches the columns INLINE (object truth survives via the
         # journal) and reports here; None = one attribute read
         self.fault_sink = None
-        self._deadlines = None
+        self._deadlines = None  # ktpu: guarded-by(self._lock)
         self.dirty_nodes: Set[str] = set()  # generation-equivalent dirty set
         self.removed_nodes: Set[str] = set()
         # bumped on every snapshot mutation — the driver's speculative
         # pipeline uses it to detect state changes it did not account for
-        self.mutation_count = 0
+        self.mutation_count = 0  # ktpu: guarded-by(self._lock)
         # (node, pod, ±1, folded) single-pod changes (assume/confirm/
         # remove) — the overwhelmingly common event; consumed by
         # TensorMirror.sync. `folded` marks adds whose usage/count deltas
@@ -225,11 +225,14 @@ class SchedulerCache:
         """LazyNodeInfos resolver: replay the pending column journal into
         the named NodeInfo view (None = every stale row) before the
         object leaves the map. Raw dict access below — resolving through
-        the lazy map again would recurse."""
-        cols = self._columns
-        if cols is None or not cols._stale_rows:
-            return
+        the lazy map again would recurse. Runs on WHICHEVER thread first
+        reads the view, so even the columns-attached fast-path probe
+        takes the (reentrant) lock — the pre-lock read KTPU003 caught
+        could see a mid-detach columns object."""
         with self._lock:
+            cols = self._columns
+            if cols is None or not cols._stale_rows:
+                return
             raw = self.snapshot.node_infos
             if name is not None:
                 row = cols.row_of.get(name)
@@ -306,6 +309,8 @@ class SchedulerCache:
     def _node_info(self, name: str) -> Optional[NodeInfo]:
         return self.snapshot.get(name)
 
+    # ktpu: holds(self._lock) every caller is a locked cache mutator (the
+    # cols.*_locked calls below already require it)
     def _add_pod_to_node(self, pod: Pod, folded: bool = False) -> None:
         # snapshot.get resolves the lazy view first (columnar mode), so
         # the eager object update below lands in journal order
@@ -338,6 +343,7 @@ class SchedulerCache:
         # every pod on the node
         self._push_delta(pod.node_name, pod, 1, folded)
 
+    # ktpu: holds(self._lock)
     def _remove_pod_from_node(self, pod: Pod) -> None:
         ni = self.snapshot.get(pod.node_name)
         if ni is None:

@@ -166,7 +166,7 @@ class CacheColumns:
         self._lock = lock  # THE SchedulerCache RLock, shared
         self.vocab = vocab
         cap = _node_bucket(capacity)
-        self.capacity = cap
+        self.capacity = cap  # ktpu: guarded-by(self._lock)
         width = vocab.config.resource_slots
         # --- hot columns (node-major) -----------------------------------
         self.requested = np.zeros((cap, width), np.int64)  # ktpu: guarded-by(self._lock)

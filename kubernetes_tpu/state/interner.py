@@ -23,7 +23,7 @@ ABSENT = 0
 class StringInterner:
     def __init__(self) -> None:
         self._lock = audited_lock("interner")
-        self._to_id: Dict[str, int] = {}
+        self._to_id: Dict[str, int] = {}  # ktpu: guarded-by(self._lock)
         self._from_id: List[Optional[str]] = [None]  # index 0 = ABSENT
 
     def intern(self, s: str) -> int:
@@ -41,10 +41,12 @@ class StringInterner:
 
     def lookup(self, s: str) -> int:
         """Like intern but read-only: unknown string -> ABSENT."""
-        return self._to_id.get(s, ABSENT)
+        with self._lock:  # read path locked like the vocab slot maps (PR 6)
+            return self._to_id.get(s, ABSENT)
 
     def lookup_kv(self, key: str, value: str) -> int:
-        return self._to_id.get(key + "\x00" + value, ABSENT)
+        with self._lock:
+            return self._to_id.get(key + "\x00" + value, ABSENT)
 
     def intern_all(self, strs: Iterable[str]) -> List[int]:
         return [self.intern(s) for s in strs]

@@ -23,12 +23,12 @@ from ..oracle.nodeinfo import get_zone_key
 class NodeTree:
     def __init__(self):
         self._lock = audited_lock("node-tree")
-        self._tree: Dict[str, List[str]] = {}  # zone key -> node names
+        self._tree: Dict[str, List[str]] = {}  # ktpu: guarded-by(self._lock) zone key -> node names
         self._zones: List[str] = []  # insertion-ordered zone keys
         self._zone_index = 0
-        self._last_index: Dict[str, int] = {}
+        self._last_index: Dict[str, int] = {}  # ktpu: guarded-by(self._lock)
         self._rotation = 0  # order() starting offset (rotating tie de-bias)
-        self.num_nodes = 0
+        self.num_nodes = 0  # ktpu: guarded-by(self._lock)
 
     def add_node(self, node: Node) -> None:
         with self._lock:

@@ -110,8 +110,8 @@ class PriorityQueue:
         self._in_active: Set[str] = set()  # ktpu: guarded-by(self._lock)
         self._attempts: Dict[str, int] = {}  # ktpu: guarded-by(self._lock)
         self._last_failure: Dict[str, float] = {}  # ktpu: guarded-by(self._lock)
-        self._last_move_request_cycle = -1
-        self._scheduling_cycle = 0
+        self._last_move_request_cycle = -1  # ktpu: guarded-by(self._lock)
+        self._scheduling_cycle = 0  # ktpu: guarded-by(self._lock)
         self.nominated: Dict[str, str] = {}  # ktpu: guarded-by(self._lock)
         self._nominated_by_node: Dict[str, Set[str]] = {}  # ktpu: guarded-by(self._lock)
         # bumped whenever a NOMINATION IS ADDED (never on clears): the

@@ -84,6 +84,9 @@ class TermBank:
         self.ex_op = np.zeros((t, self.ex_cap), np.int32)
         self.ex_slot = np.full((t, self.ex_cap), -1, np.int32)
         self.ex_vals = np.full((t, self.ex_cap, self.val_cap), -1, np.int32)
+        # ktpu: allow(KTPU006) per-instance value object: batch tables are
+        # built and consumed on one thread; the terms_plane SLAB instance's
+        # mutations run under TermStage._lock (holder-side discipline)
         self.count = 0
         self.overflow_owners: set = set()
 

@@ -78,7 +78,7 @@ class TermStage:
     def __init__(self, vocab, capacity: int = MIN_CAPACITY):
         self.vocab = vocab
         self._lock = audited_rlock("terms")
-        self._next_gen = 1
+        self._next_gen = 1  # ktpu: guarded-by(self._lock)
         self._next_entry = 0
         # the SelectorSpread getSelectors hook (driver installs the same
         # fn it uses at dispatch): consulted at acquire time so the entry
@@ -88,7 +88,8 @@ class TermStage:
         self.on_dirty: Optional[Callable] = None
         # bumped on every rebuild; the device twin keys its full-upload
         # decision on it
-        self.generation = 0
+        self.generation = 0  # ktpu: guarded-by(self._lock)
+        # ktpu: guarded-by(self._lock)
         self.stats: Dict[str, int] = {
             "staged": 0,  # entries encoded (once per distinct term set)
             "hits": 0,  # acquire served by an existing entry
@@ -102,7 +103,7 @@ class TermStage:
     # ktpu: holds(self._lock) callers: __init__ (pre-concurrency) and the
     # locked acquire/ensure_current/_rebuild paths
     def _build(self, capacity: int) -> None:
-        self.capacity = capacity
+        self.capacity = capacity  # ktpu: guarded-by(self._lock)
         # encode-guard snapshot, the PodStage discipline: a vocab key-slot
         # growth means fresh encodes could name slots the node banks can't
         # index yet — rebuild (all entries stale) and re-encode at the new

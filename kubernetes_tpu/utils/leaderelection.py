@@ -151,7 +151,10 @@ class LeaderElector:
         if stop.is_set():
             return
         # client-go runs OnStartedLeading in a goroutine: the holder's
-        # (typically blocking) work must not starve lease renewal
+        # (typically blocking) work must not starve lease renewal. The
+        # callback is caller-supplied state the call graph cannot see —
+        # the holder's work registers its own role (typically driver).
+        # ktpu: thread-entry(leader)
         threading.Thread(
             target=self.on_started_leading, daemon=True, name="leading"
         ).start()

@@ -49,8 +49,8 @@ class VolumeBinder:
         self.bind_fn = bind_fn  # (namespace, claim, pv_name) -> None
         self._lock = audited_lock("volume-binder")
         # pod key -> [(namespace, claim, pv_name)] tentative matches
-        self._assumed: Dict[str, List[Tuple[str, str, str]]] = {}
-        self._assumed_pvs: Dict[str, str] = {}  # pv name -> claiming pod key
+        self._assumed: Dict[str, List[Tuple[str, str, str]]] = {}  # ktpu: guarded-by(self._lock)
+        self._assumed_pvs: Dict[str, str] = {}  # ktpu: guarded-by(self._lock) pv name -> claiming pod key
 
     # -- Filter --------------------------------------------------------------
 

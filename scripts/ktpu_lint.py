@@ -5,6 +5,8 @@
     python scripts/ktpu_lint.py --check           # gate: fail if the set GREW
     python scripts/ktpu_lint.py --update-baseline # re-pin the baseline
     python scripts/ktpu_lint.py --rule KTPU003 kubernetes_tpu/state
+    python scripts/ktpu_lint.py --check --json    # machine-readable report
+    python scripts/ktpu_lint.py --check --time-budget 60   # preflight gate
 
 The gate compares against kubernetes_tpu/analysis/baseline.txt: every
 baselined entry carries a human justification; violations not in the
@@ -12,24 +14,46 @@ baseline fail the run (preflight + tier-1 both call --check). Stale
 baseline entries (fixed violations) are reported so the file ratchets
 down — they never fail the gate.
 
-Rules: KTPU001 no-unplanned-jit, KTPU002 donation-safety, KTPU003
-guarded-by, KTPU004 hot-path-host-sync, KTPU005 shadowed-module-import.
-See INVARIANTS.md for the rule ↔ historical-bug cross-reference and the
-annotation grammar (# ktpu: guarded-by/holds/hot-path/admitted/allow/...).
+Rules: the module-local KTPU001 no-unplanned-jit, KTPU002
+donation-safety, KTPU003 guarded-by, KTPU004 hot-path-host-sync,
+KTPU005 shadowed-module-import — plus the interprocedural (repo-wide
+call graph + thread-role inference, analysis/callgraph.py + roles.py)
+KTPU006 shared-attr-inference, KTPU007 transitive-hot-path-sync and
+KTPU008 confinement-reachability. See INVARIANTS.md for the rule ↔
+historical-bug cross-reference and the annotation grammar
+(# ktpu: guarded-by/holds/hot-path/admitted/thread-entry/allow/...).
+
+``--json`` emits one object: ``violations`` (rule/file/line/scope/
+message/fingerprint), ``timings_s`` per rule (plus ``callgraph`` for
+the shared graph build) and ``total_s`` — the wall the ``--time-budget``
+gate asserts so the interprocedural pass can't silently make preflight
+crawl.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-from kubernetes_tpu.analysis import Baseline, scan_paths  # noqa: E402
+from kubernetes_tpu.analysis import Baseline  # noqa: E402
 from kubernetes_tpu.analysis.checkers import ALL_CHECKERS, repo_config  # noqa: E402
+from kubernetes_tpu.analysis.callgraph import build_graph  # noqa: E402
+from kubernetes_tpu.analysis.core import (  # noqa: E402
+    iter_python_files,
+    load_module,
+    run_checkers,
+)
+from kubernetes_tpu.analysis.roles import (  # noqa: E402
+    REPO_RULES,
+    run_repo_checkers,
+)
 
 BASELINE_PATH = os.path.join(_REPO, "kubernetes_tpu", "analysis", "baseline.txt")
 DEFAULT_SCAN = os.path.join(_REPO, "kubernetes_tpu")
@@ -45,37 +69,114 @@ def main(argv=None) -> int:
     ap.add_argument("--rule", action="append", default=None,
                     help="restrict to one or more rule ids (repeatable)")
     ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (one JSON object on stdout)")
+    ap.add_argument("--time-budget", type=float, default=None, metavar="SECONDS",
+                    help="exit 3 when the total lint wall exceeds this many "
+                         "seconds (preflight asserts the interprocedural "
+                         "pass stays fast)")
     args = ap.parse_args(argv)
 
     paths = args.paths or [DEFAULT_SCAN]
     rules = set(args.rule) if args.rule else None
-    violations = scan_paths(paths, _REPO, repo_config(), ALL_CHECKERS, rules)
+    if args.update_baseline and (rules or args.paths):
+        # a filtered scan sees a SUBSET of violations; saving it would
+        # silently drop every other baselined entry + justification —
+        # refuse BEFORE paying for the scan
+        print(
+            "--update-baseline requires a full default scan "
+            "(no --rule, no path arguments): the baseline is rewritten "
+            "from the scan's violation set."
+        )
+        return 2
+    timings: dict = {}
+    t0 = time.perf_counter()
+    # parse each module ONCE and share the ModuleInfo list between the
+    # module-local checkers and the call-graph build (the graph re-parsing
+    # the identical file set used to double the whole parse cost)
+    files: list = []
+    for p in paths:
+        files.extend(iter_python_files(p) if os.path.isdir(p) else [p])
+    config = repo_config()
+    mods, violations = [], []
+    for f in files:
+        try:
+            mod = load_module(f, _REPO)
+        except SyntaxError:
+            continue  # not this gate's job to police parseability
+        mods.append(mod)
+        violations.extend(run_checkers(mod, config, ALL_CHECKERS, rules, timings))
+    # interprocedural rules: one shared call graph over the SAME module
+    # set (a filtered graph is a smaller world — fine for spot checks;
+    # the gate and the baseline always run the full default scan). A
+    # --rule filter naming only module-local rules skips the graph.
+    if rules is None or rules & set(REPO_RULES):
+        t_graph = time.perf_counter()
+        graph = build_graph(mods)
+        timings["callgraph"] = time.perf_counter() - t_graph
+        violations.extend(run_repo_checkers(graph, config, rules, timings=timings))
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    total_s = time.perf_counter() - t0
+    over_budget = args.time_budget is not None and total_s > args.time_budget
+
+    def emit_json(extra: dict) -> None:
+        doc = {
+            "violations": [
+                {
+                    "rule": v.rule,
+                    "file": v.path,
+                    "line": v.line,
+                    "scope": v.scope,
+                    "message": v.message,
+                    "fingerprint": v.fingerprint(),
+                }
+                for v in violations
+            ],
+            "timings_s": {k: round(t, 4) for k, t in sorted(timings.items())},
+            "total_s": round(total_s, 4),
+            "time_budget_s": args.time_budget,
+            "budget_exceeded": over_budget,
+        }
+        doc.update(extra)
+        print(json.dumps(doc, indent=1))
 
     if args.update_baseline:
-        if rules or args.paths:
-            # a filtered scan sees a SUBSET of violations; saving it would
-            # silently drop every other baselined entry + justification
-            print(
-                "--update-baseline requires a full default scan "
-                "(no --rule, no path arguments): the baseline is rewritten "
-                "from the scan's violation set."
-            )
-            return 2
         base = Baseline.load(args.baseline)
         base.save(args.baseline, violations)
         print(f"baseline updated: {len(violations)} entries -> {args.baseline}")
         return 0
 
     if not args.check:
-        for v in violations:
-            print(v.render())
-        print(f"{len(violations)} violation(s)")
-        return 1 if violations else 0
+        if args.as_json:
+            emit_json({"mode": "report"})
+        else:
+            for v in violations:
+                print(v.render())
+            print(f"{len(violations)} violation(s)")
+            print(
+                "timings: "
+                + " ".join(f"{k}={t:.3f}s" for k, t in sorted(timings.items()))
+                + f" total={total_s:.3f}s"
+            )
+        if violations:
+            return 1
+        return 3 if over_budget else 0
 
     # --check: fail closed only when the set grows beyond the baseline
     base = Baseline.load(args.baseline)
     new = base.missing(violations)
     stale = base.stale(violations)
+    if args.as_json:
+        emit_json({
+            "mode": "check",
+            "new": [v.fingerprint() for v in new],
+            "stale": stale,
+            "baselined": len(violations) - len(new),
+            "ok": not new and not over_budget,
+        })
+        if new:
+            return 1
+        return 3 if over_budget else 0
     for fp in stale:
         print(f"stale baseline entry (violation fixed — remove the line): {fp}")
     if new:
@@ -85,16 +186,27 @@ def main(argv=None) -> int:
             print()
         print(
             "Fix the violation, annotate the deliberate exception "
-            "(# ktpu: allow/admitted/host-sync-ok/holds — see INVARIANTS.md), "
-            "or, for a pre-existing condition only, add the fingerprint to "
+            "(# ktpu: allow/admitted/host-sync-ok/holds/thread-entry — see "
+            "INVARIANTS.md), or, for a pre-existing condition only, add the "
+            "fingerprint to "
             f"{os.path.relpath(args.baseline, _REPO)} with a justification."
         )
         return 1
     n_base = len(violations) - len(new)
     print(
         f"ktpu-lint: OK — {len(violations)} violation(s), all baselined "
-        f"({n_base} baseline entries used, {len(stale)} stale)."
+        f"({n_base} baseline entries used, {len(stale)} stale); "
+        f"wall {total_s:.2f}s ("
+        + ", ".join(f"{k} {t:.2f}s" for k, t in sorted(timings.items()))
+        + ")."
     )
+    if over_budget:
+        print(
+            f"ktpu-lint: TIME BUDGET EXCEEDED — {total_s:.2f}s > "
+            f"{args.time_budget:.2f}s (the interprocedural pass is the "
+            "usual suspect: check callgraph build time above)"
+        )
+        return 3
     return 0
 
 

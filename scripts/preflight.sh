@@ -7,8 +7,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== preflight: ktpu-lint invariant gate =="
-python scripts/ktpu_lint.py --check
+echo "== preflight: ktpu-lint invariant gate (incl. lint-time budget) =="
+# --time-budget: the repo-wide call-graph pass (KTPU006-008) must not
+# silently make preflight crawl — ~12s today, 60s is the hard ceiling
+# (exit 3). --json variants of this line feed dashboards/CI annotators.
+python scripts/ktpu_lint.py --check --time-budget 60
 
 if command -v ruff >/dev/null 2>&1; then
   echo "== preflight: ruff (pyflakes/unused-import/shadowing) =="

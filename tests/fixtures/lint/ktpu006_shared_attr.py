@@ -1,0 +1,50 @@
+"""KTPU006 fixture pair: the unannotated uploader→driver attribute.
+
+Reproduces the hole KTPU003 cannot see: ``StageBank.fault_plan``-style
+state written on one thread role and read on another with NO
+``guarded-by``/``confined`` declaration — module-locally there is
+nothing to check, because nobody ever declared the attribute shared.
+The role graph (thread-entry seeds + call-graph propagation) infers the
+sharing instead.
+
+Must flag:     Bank.report_generation  (written by uploader, read by driver)
+Must not flag: Bank.declared_rows      (declared guarded-by + locked)
+               Bank.ctor_only          (written only in __init__)
+               Bank.handoff            (allow(KTPU006) with a reason)
+"""
+
+import threading
+
+
+class Bank:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ctor_only = {"frozen": True}  # published before any spawn
+        self.report_generation = 0  # <- shared, written, UNDECLARED
+        self.declared_rows = 0  # ktpu: guarded-by(self._lock)
+        # ktpu: allow(KTPU006) single-owner handoff: built by the driver,
+        # read by the uploader only after start() (Thread.start is the
+        # happens-before edge)
+        self.handoff = None
+
+    def start(self):
+        # ktpu: thread-entry(fixture-upload)
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    # ktpu: thread-entry(fixture-upload)
+    def _drain(self):
+        while True:
+            self.report_generation += 1  # uploader-side write
+            with self._lock:
+                self.declared_rows += 1
+            if self.handoff is None:
+                return
+
+    # ktpu: thread-entry(fixture-driver)
+    def dispatch(self):
+        gen = self.report_generation  # driver-side read of the same attr
+        cfg = self.ctor_only["frozen"]
+        self.handoff = {"batch": gen}  # allowed: documented handoff
+        with self._lock:
+            rows = self.declared_rows
+        return gen, rows, cfg

@@ -1,0 +1,46 @@
+"""KTPU007 fixture pair: the transitive hot-path → host-sync chain.
+
+Reproduces the hole in module-local KTPU004: the hot-path function
+itself contains no forcing call — it reaches ``np.asarray`` on a device
+value ONE CALL DEEP through an innocent-looking helper, which is
+exactly how every PERF round's silent round-trip hid.
+
+Must flag:     hot_dispatch      (hot-path → _summarize → np.asarray(dev))
+Must not flag: hot_via_syncpoint (the reached fetcher is allowlisted)
+               hot_host_only     (the helper forces a HOST value only)
+               cold_dispatch     (not hot-path-marked at all)
+"""
+
+import numpy as np
+
+
+def _summarize(dev_rows):
+    return np.asarray(dev_rows).sum()  # device→host sync, one call deep
+
+
+def _host_tally(rows):
+    return np.asarray(rows).sum()  # host list → host array: free
+
+
+def fetch_results(dev_rows):
+    """The designated sync point (fixture sync_allowlist entry)."""
+    return np.asarray(dev_rows)
+
+
+# ktpu: hot-path
+def hot_dispatch(dev_rows):
+    return _summarize(dev_rows)  # <- reaches a forcing call: must flag
+
+
+# ktpu: hot-path
+def hot_via_syncpoint(dev_rows):
+    return fetch_results(dev_rows)  # allowlisted barrier: clean
+
+
+# ktpu: hot-path
+def hot_host_only(rows):
+    return _host_tally(rows)  # host-only chain: clean
+
+
+def cold_dispatch(dev_rows):
+    return _summarize(dev_rows)  # not hot-marked: KTPU007 says nothing

@@ -1,0 +1,62 @@
+"""KTPU008 fixture pair: a confined(driver) method reached by the monitor.
+
+Before this rule, ``# ktpu: confined(driver)`` claims were purely
+syntactic — KTPU003 checks that confined ATTRS are touched only by
+confined-marked METHODS, but nothing checked that those methods really
+run on one thread. The role graph closes that: a confined method
+reachable from any other role's entry is a violation. The spawn-site
+contract rides along: every Thread/submit must be rooted in the role
+graph.
+
+Must flag:     Mirror.census          (confined(driver), reached by monitor)
+               Monitor.start_unrooted (spawn with no thread-entry anywhere)
+Must not flag: Mirror.fold_rows       (confined(driver), driver-only reach)
+               Monitor.read_mailbox   (reads the published copy instead)
+"""
+
+import threading
+
+
+class Mirror:
+    def __init__(self):
+        self.folded = set()  # ktpu: confined(fixture-driver)
+        self.mailbox = {}
+
+    # ktpu: confined(fixture-driver) the monitor must consume the mailbox
+    def census(self):
+        return {"folded": len(self.folded)}
+
+    # ktpu: confined(fixture-driver)
+    def fold_rows(self, rows):
+        self.folded.update(rows)
+        self.mailbox = dict(self.census())  # driver publishes
+
+
+class Monitor:
+    def __init__(self, mirror: Mirror):
+        self.mirror = mirror
+
+    def start(self):
+        # ktpu: thread-entry(fixture-health)
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def start_unrooted(self):
+        threading.Thread(target=self._tick, daemon=True).start()  # <- unrooted
+
+    # ktpu: thread-entry(fixture-health)
+    def _run(self):
+        while True:
+            self.mirror.census()  # <- crosses the confinement: must flag
+            self.read_mailbox()
+
+    def _tick(self):
+        pass
+
+    def read_mailbox(self):
+        return dict(self.mirror.mailbox)  # the sanctioned monitor read
+
+
+class Driver:
+    # ktpu: thread-entry(fixture-driver)
+    def cycle(self, mirror: Mirror):
+        mirror.fold_rows({1, 2})  # driver reaching confined state: clean

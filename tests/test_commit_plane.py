@@ -411,3 +411,40 @@ def test_queue_requeue_preserves_seq_no_backoff():
     again = q.pop_batch(2)
     # seq preserved → original order restored, no backoff delay
     assert [i.pod.name for i in again] == ["a", "b"]
+
+
+def test_commit_pipeline_worker_stat_handoff():
+    """KTPU006 regression (thread-role analysis): the submitted closure
+    used to write Scheduler.stats directly from the worker thread — a
+    cross-thread read-modify-write on the driver's single-writer dict.
+    Contributions now accumulate in the pipeline's locked sink and the
+    DRIVER merges them at drain (Scheduler._drain_commit)."""
+    pipe = CommitPipeline()
+    try:
+        pipe.submit(lambda: pipe.note_stat("apply_s", 0.25))
+        pipe.submit(lambda: pipe.note_stat("apply_rejects", 1))
+        pipe.drain()
+        got = pipe.take_worker_stats()
+        assert got == {"apply_s": 0.25, "apply_rejects": 1}
+        # drain-and-clear: the merge consumes the contributions exactly once
+        assert pipe.take_worker_stats() == {}
+    finally:
+        pipe.close()
+
+
+def test_driver_merges_worker_stats_at_drain():
+    """The driver-side half: _drain_commit folds the worker's pending
+    contributions into Scheduler.stats (which stays single-writer)."""
+    sched = Scheduler(cache=SchedulerCache(), queue=PriorityQueue())
+    try:
+        sched._commit_pipe.submit(
+            lambda: sched._commit_pipe.note_stat("apply_s", 0.5)
+        )
+        sched._drain_commit()
+        assert sched.stats.get("apply_s", 0.0) >= 0.5
+        # idempotent: a second drain merges nothing twice
+        before = sched.stats["apply_s"]
+        sched._drain_commit()
+        assert sched.stats["apply_s"] == before
+    finally:
+        sched.close()

@@ -403,3 +403,67 @@ def test_failed_persisted_warm_is_undeclared(tmp_path):
     assert not sched.compile_plan.is_declared(bogus)
     snap = sched.compile_plan.snapshot()
     assert all(e["spec"] != bogus.short() for e in snap["specs"])
+
+
+def test_warm_context_confines_mirror_to_role_boundary():
+    """KTPU006/008 regression (thread-role analysis): the background
+    warm worker used to read live mirror shapes/vocab — and gate
+    device_arrays on a current_thread() check — from its own thread,
+    racing any concurrent rebuild. The _WarmContext snapshot is now the
+    ONLY mirror touch, taken at the role boundary on the driver: a
+    background ctx never carries the live-bank resolver, and the fold
+    kernels are captured only when a sharded fold spec is visible."""
+    import numpy as np
+
+    from kubernetes_tpu.compile.ladder import KIND_FOLD, KIND_SOLVE, SolveSpec
+    from kubernetes_tpu.compile.warmup import _WarmContext
+
+    calls = []
+
+    class _Vocab:
+        class config:
+            key_slots = 8
+            resource_slots = 3
+
+    class _NodeBank:
+        capacity = 4
+        key_capacity = 8
+        alloc = np.zeros((4, 3), np.int64)
+        image_scaled = np.zeros((4, 16), np.int64)
+
+    class _Bank:
+        capacity = 4
+
+    class _Mirror:
+        nodes = _NodeBank()
+        eps = _Bank()
+        pats = _Bank()
+        vocab = _Vocab()
+
+        def _to_dev(self, v, node_major=False):
+            return v
+
+        def device_arrays(self):
+            calls.append("device_arrays")
+            return ({}, {}, {})
+
+        def _fold_fns(self):
+            calls.append("fold_fns")
+            return (lambda *a: a, lambda *a: a)
+
+    m = _Mirror()
+    solve = SolveSpec(kind=KIND_SOLVE, b=16, u=16, t=16, n=4, v=2)
+    bg = _WarmContext(m, [solve], foreground=False)
+    assert bg.live_banks is None          # worker can NEVER resolve live banks
+    assert bg.fold_fns is None            # no sharded fold spec visible
+    assert bg.live_shape == (4, 8, 3, 4, 4)
+    assert bg.img_w == 16 and bg.vocab is m.vocab
+    assert calls == []                    # capture itself touched neither
+
+    fg = _WarmContext(m, [solve], foreground=True)
+    assert fg.live_banks == m.device_arrays  # bound, invoked lazily
+    assert calls == []                    # still not CALLED at capture
+
+    fold = SolveSpec(kind=KIND_FOLD, b=16, n=4, r=3, shards=2)
+    ctx = _WarmContext(m, [fold], foreground=False)
+    assert ctx.fold_fns is not None and calls == ["fold_fns"]

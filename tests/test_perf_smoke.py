@@ -38,6 +38,15 @@ def test_perf_smoke_commit_plane(tmp_path, monkeypatch):
         "lock audit recorded nothing — the audited_* factories are no "
         "longer wired into the package's lock construction sites"
     )
+    # thread-role soundness probe (analysis/roles.py): every (lock role,
+    # thread role) observation from this drain must be contained in the
+    # static inference, and the observed graph must be NON-EMPTY — the
+    # register_thread_role spawn-site stamps unwiring silently fails
+    # here, same discipline as the non-empty-edge assertion above
+    from kubernetes_tpu.analysis import roles as roles_mod
+
+    role_report = roles_mod.assert_runtime_subset(REGISTRY)
+    assert role_report["observed"], "no role observations recorded"
     phase = detail["phase_split_s"]
     assert phase["arbiter_batches"] > 0
     assert phase["arbiter_place"] > 0
@@ -90,6 +99,12 @@ def test_perf_smoke_preemption_no_midrain_compiles(tmp_path, monkeypatch):
 
     detail = perf_smoke.main_preempt()
     REGISTRY.assert_acyclic()
+    # the preemption drain is the second lock-audited smoke: it must
+    # ALSO prove observed roles ⊆ static inference with a live graph
+    from kubernetes_tpu.analysis import roles as roles_mod
+
+    role_report = roles_mod.assert_runtime_subset(REGISTRY)
+    assert role_report["observed"], "no role observations recorded"
     assert detail["preempted"] > 0
     assert detail["compile"]["misses_after_warmup"] == 0
     assert detail["warm_stall_batches"] == 0

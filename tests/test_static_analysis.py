@@ -1,22 +1,30 @@
 """ktpu-lint + lock-order harness coverage (tier-1, CPU-only, no bench).
 
-Three layers:
+Four layers:
   * fixture corpus — each KTPU rule has a must-flag fixture reproducing
     the historical bug it is the static twin of, and a must-not-flag
-    twin exercising the sanctioned pattern/annotation;
-  * the tree gate — the full kubernetes_tpu/ scan must not grow beyond
-    the checked-in baseline (the same gate preflight runs), and the
-    PERF.md/README bench table must match BENCH_DETAILS.json
-    (gen_perf_table --check);
-  * the runtime lock-order harness — deliberate ABBA deadlock fixture
-    detected, clean ordering passes, reentrancy and condition-wait
-    bookkeeping correct. (The audited full smoke drains live in
-    test_perf_smoke with KTPU_LOCK_AUDIT=1.)
+    twin exercising the sanctioned pattern/annotation (KTPU006–008 ride
+    the repo-wide call graph: their fixtures build a one-file graph);
+  * the call graph + role inference — resolution units (cross-module
+    imports, self-method dispatch, typed attributes, Thread(target=...)
+    indirection) and lock-role inference;
+  * the tree gate — the full kubernetes_tpu/ scan (module rules AND the
+    interprocedural KTPU006–008) must not grow beyond the checked-in
+    baseline (the same gate preflight runs), and the PERF.md/README
+    bench table must match BENCH_DETAILS.json (gen_perf_table --check);
+  * the runtime lock-order + thread-role harness — deliberate ABBA
+    deadlock fixture detected, clean ordering passes, reentrancy and
+    condition-wait bookkeeping correct, and the role audit's
+    assert_roles_subset contract (observed ⊆ static, non-empty). (The
+    audited full smoke drains live in test_perf_smoke with
+    KTPU_LOCK_AUDIT=1.)
 """
 
+import json
 import os
 import subprocess
 import sys
+import textwrap
 import threading
 
 import pytest
@@ -33,6 +41,8 @@ from kubernetes_tpu.analysis import (  # noqa: E402
 )
 from kubernetes_tpu.analysis.checkers import ALL_CHECKERS, repo_config  # noqa: E402
 from kubernetes_tpu.analysis.core import Violation, parse_annotations  # noqa: E402
+from kubernetes_tpu.analysis import callgraph as cg  # noqa: E402
+from kubernetes_tpu.analysis import roles as roles_mod  # noqa: E402
 
 
 def fixture_config() -> AnalysisConfig:
@@ -51,6 +61,31 @@ def fixture_config() -> AnalysisConfig:
 def scan_fixture(name: str):
     mod = load_module(os.path.join(_FIXTURES, name), _REPO)
     return run_checkers(mod, fixture_config(), ALL_CHECKERS)
+
+
+def repo_fixture_config() -> AnalysisConfig:
+    """Config for the interprocedural fixtures (KTPU006–008)."""
+    return AnalysisConfig(
+        surface_prefixes=("tests/fixtures/lint/",),
+        sync_allowlist=("fetch_results",),
+    )
+
+
+def scan_repo_fixture(name: str):
+    """Run ONLY the repo-wide rules over a one-file graph."""
+    graph = cg.load_graph([os.path.join(_FIXTURES, name)], _REPO)
+    return roles_mod.run_repo_checkers(graph, repo_fixture_config())
+
+
+def graph_from_sources(tmp_path, files):
+    """Write {relpath: source} under tmp_path and build a RepoGraph."""
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return cg.load_graph(paths, str(tmp_path))
 
 
 def rules_by_scope(violations):
@@ -232,6 +267,307 @@ def test_ktpu005_flags_shadowed_bucket_import():
 
 
 # ---------------------------------------------------------------------------
+# interprocedural fixture corpus (KTPU006–008 over the call graph)
+# ---------------------------------------------------------------------------
+
+def test_ktpu006_flags_unannotated_shared_attr():
+    """The unannotated uploader→driver attribute KTPU003 cannot see:
+    written on one role, read on another, no guarded-by/confined."""
+    got = scan_repo_fixture("ktpu006_shared_attr.py")
+    details = {v.detail for v in got if v.rule == "KTPU006"}
+    assert "shared:Bank.report_generation" in details
+    # declared, ctor-only, and allow(KTPU006)-justified attrs stay clean
+    assert not {d for d in details if "declared_rows" in d}
+    assert not {d for d in details if "ctor_only" in d}
+    assert not {d for d in details if "handoff" in d}
+
+
+def test_ktpu007_flags_transitive_hot_sync():
+    """hot-path → helper → np.asarray(dev) one call deep (the KTPU004
+    hole); the allowlisted sync point is a traversal barrier and
+    host-only chains are free."""
+    got = scan_repo_fixture("ktpu007_hot_chain.py")
+    hits = {(v.scope, v.detail) for v in got if v.rule == "KTPU007"}
+    assert ("hot_dispatch", "hot-reach:hot_dispatch->_summarize") in hits
+    scopes = {v.scope for v in got if v.rule == "KTPU007"}
+    assert "hot_via_syncpoint" not in scopes
+    assert "hot_host_only" not in scopes
+    assert "cold_dispatch" not in scopes
+
+
+def test_ktpu008_flags_confined_reach_and_unrooted_spawn():
+    """A confined(driver) method reached by the monitor role flags (the
+    claim was purely syntactic before); a Thread spawn with no
+    thread-entry root flags; the driver-only confined method and the
+    mailbox read stay clean."""
+    got = scan_repo_fixture("ktpu008_confined_reach.py")
+    details = {v.detail for v in got if v.rule == "KTPU008"}
+    assert "confined-reach:Mirror.census" in details
+    assert any(d.startswith("unrooted-spawn:") for d in details)
+    assert "confined-reach:Mirror.fold_rows" not in details
+    scopes = {v.scope for v in got if "confined-reach" in v.detail}
+    assert "Monitor.read_mailbox" not in scopes
+
+
+# ---------------------------------------------------------------------------
+# call graph resolution + role propagation units
+# ---------------------------------------------------------------------------
+
+def test_callgraph_resolves_cross_module_imports(tmp_path):
+    graph = graph_from_sources(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": """
+            def helper():
+                return 1
+        """,
+        "pkg/b.py": """
+            from .a import helper
+
+            def caller():
+                return helper()
+        """,
+    })
+    caller = graph.functions["pkg/b.py::caller"]
+    dsts = {e.dst for e in graph.callees(caller.uid)}
+    assert "pkg/a.py::helper" in dsts
+
+
+def test_callgraph_resolves_self_method_dispatch_and_subclass(tmp_path):
+    """self.m() dispatches through the class family: the base's caller
+    links to the base method AND the subclass override (the receiver may
+    be either — StageBank/TermBankDevice)."""
+    graph = graph_from_sources(tmp_path, {
+        "m.py": """
+            class Base:
+                def run(self):
+                    return self.step()
+
+                def step(self):
+                    return 0
+
+            class Sub(Base):
+                def step(self):
+                    return 1
+        """,
+    })
+    run = graph.functions["m.py::Base.run"]
+    dsts = {e.dst for e in graph.callees(run.uid)}
+    assert "m.py::Base.step" in dsts and "m.py::Sub.step" in dsts
+
+
+def test_callgraph_resolves_typed_attribute_receiver(tmp_path):
+    """`self.queue.pop()`-style chains resolve through the inferred
+    attribute type (ctor `self.q = Queue()`), NOT by name over every
+    class defining the method."""
+    graph = graph_from_sources(tmp_path, {
+        "m.py": """
+            class Queue:
+                def pop_next(self):
+                    return None
+
+            class Decoy:
+                def pop_next(self):
+                    return "wrong"
+
+            class Driver:
+                def __init__(self):
+                    self.q = Queue()
+
+                def cycle(self):
+                    return self.q.pop_next()
+        """,
+    })
+    cyc = graph.functions["m.py::Driver.cycle"]
+    dsts = {e.dst for e in graph.callees(cyc.uid)}
+    assert "m.py::Queue.pop_next" in dsts
+    assert "m.py::Decoy.pop_next" not in dsts
+
+
+def test_role_propagation_through_thread_target(tmp_path):
+    """thread-entry on a Thread(target=self._loop) spawn line seeds the
+    RESOLVED target; roles then propagate through its call chain."""
+    graph = graph_from_sources(tmp_path, {
+        "m.py": """
+            import threading
+
+            class Worker:
+                def start(self):
+                    # ktpu: thread-entry(pump)
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self._step()
+
+                def _step(self):
+                    pass
+        """,
+    })
+    analysis = roles_mod.RoleAnalysis(graph, AnalysisConfig())
+    assert analysis.roles_of("m.py::Worker._loop") == {"pump"}
+    assert analysis.roles_of("m.py::Worker._step") == {"pump"}
+    assert analysis.roles_of("m.py::Worker.start") == set()
+
+
+def test_static_lock_roles_inference(tmp_path):
+    """audited_lock("q") constructed by a class credits the lock role
+    with every role reaching the class's methods — and the alias idiom
+    (`self._lock = stage._lock` through an annotated param) unions the
+    source's roles."""
+    graph = graph_from_sources(tmp_path, {
+        "m.py": """
+            from kubernetes_tpu.analysis.lockorder import audited_lock
+
+            class Stage:
+                def __init__(self):
+                    self._lock = audited_lock("q")
+
+                # ktpu: thread-entry(feeder)
+                def feed(self):
+                    with self._lock:
+                        pass
+
+            class Bank:
+                def __init__(self, stage: Stage):
+                    self._lock = stage._lock
+
+                # ktpu: thread-entry(shipper)
+                def ship(self):
+                    with self._lock:
+                        pass
+        """,
+    })
+    analysis = roles_mod.RoleAnalysis(graph, AnalysisConfig())
+    locks = roles_mod.static_lock_roles(analysis)
+    assert {"feeder", "shipper"} <= locks["q"]
+    # omni roles always present, role-universal
+    assert locks["metric"] == {"*"}
+
+
+# ---------------------------------------------------------------------------
+# runtime role audit (assert_roles_subset)
+# ---------------------------------------------------------------------------
+
+def test_roles_subset_pass_and_fail(audit_registry):
+    from kubernetes_tpu.analysis.lockorder import (
+        RoleAuditViolation,
+        audited_lock,
+        register_thread_role,
+    )
+
+    lk = audited_lock("roleQ")
+    mt = audited_lock("metric")
+
+    def as_role(role, lock):
+        def body():
+            register_thread_role(role)
+            with lock:
+                pass
+        th = threading.Thread(target=body)
+        th.start()
+        th.join()
+
+    as_role("driver", lk)
+    as_role("informer", lk)
+    as_role("health", mt)
+    obs = audit_registry.observed_roles()
+    assert obs["roleQ"] == {"driver", "informer"}
+    # subset holds (omni "*" covers the metric lock)
+    audit_registry.assert_roles_subset(
+        {"roleQ": {"driver", "informer", "bind"}, "metric": {"*"}}
+    )
+    # an observed role the static inference missed fails loudly
+    with pytest.raises(RoleAuditViolation) as exc:
+        audit_registry.assert_roles_subset(
+            {"roleQ": {"driver"}, "metric": {"*"}}
+        )
+    assert "informer" in str(exc.value)
+
+
+def test_roles_subset_requires_nonempty_graph(audit_registry):
+    """Silently unwiring register_thread_role must fail exactly like the
+    lock-audit's non-empty-edge assertion."""
+    from kubernetes_tpu.analysis.lockorder import (
+        RoleAuditViolation,
+        audited_lock,
+    )
+
+    lk = audited_lock("quietQ")
+    with lk:  # acquisitions happen, but no thread ever registered a role
+        pass
+    with pytest.raises(RoleAuditViolation):
+        audit_registry.assert_roles_subset({"quietQ": {"driver"}})
+
+
+def test_runtime_static_roles_covers_core_lock_roles():
+    """The installed tree's inferred lock-role map — what perf_smoke's
+    assert_roles_subset compares against — must credit the core plane
+    locks with the roles that really touch them (a regression here would
+    make the runtime probe fail on the next smoke drain)."""
+    static = roles_mod.runtime_static_roles()
+    assert "driver" in static.get("queue", set())
+    assert "bind" in static.get("queue", set())       # requeue_backoff
+    assert "driver" in static.get("cache", set())
+    assert "bind" in static.get("cache", set())       # finish_binding
+    assert {"driver", "ingest-upload"} <= static.get("stage", set())
+    assert "warmup" in static.get("compile-plan", set())
+    assert "health" in static.get("health", set())
+    assert "*" in static.get("metric", set())         # omni by declaration
+
+
+# ---------------------------------------------------------------------------
+# CLI: --json + per-rule timings + the lint-time budget
+# ---------------------------------------------------------------------------
+
+def test_cli_json_report_shape():
+    """--json emits one object with rule/file/line/message/fingerprint
+    per violation plus per-rule wall timings (all 8 rules + the shared
+    callgraph build) — the machine-readable face preflight's budget
+    gate and dashboards consume. Scans one small subtree: every rule's
+    timer still runs, and the full-tree gate has its own tests."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "ktpu_lint.py"),
+         "--check", "--json", "kubernetes_tpu/obs"],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True and doc["violations"] == []
+    for rule in ("KTPU001", "KTPU002", "KTPU003", "KTPU004", "KTPU005",
+                 "KTPU006", "KTPU007", "KTPU008", "callgraph"):
+        assert rule in doc["timings_s"], rule
+    assert doc["total_s"] > 0
+
+
+def test_cli_time_budget_exceeded_exits_3():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "ktpu_lint.py"),
+         "--check", "--json", "--time-budget", "0.000001",
+         "kubernetes_tpu/obs"],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["budget_exceeded"] is True and doc["ok"] is False
+
+
+def test_new_rule_fingerprints_ride_the_ratchet(tmp_path):
+    """KTPU006–008 violations integrate with the baseline exactly like
+    the module rules: line-free fingerprints, grow-fails, fixed
+    violations ratchet down as stale."""
+    got = scan_repo_fixture("ktpu006_shared_attr.py")
+    v = next(v for v in got if v.rule == "KTPU006")
+    moved = Violation(v.rule, v.path, v.line + 40, v.scope, v.detail, v.message)
+    assert v.fingerprint() == moved.fingerprint()
+    base_path = str(tmp_path / "baseline.txt")
+    Baseline({}).save(base_path, [v])
+    base = Baseline.load(base_path)
+    assert base.missing([v]) == []
+    extra = Violation("KTPU008", "x.py", 1, "S.m", "confined-reach:S.m", "m")
+    assert base.missing([v, extra]) == [extra]
+    assert base.stale([]) == [v.fingerprint()]
+
+
+# ---------------------------------------------------------------------------
 # annotations + baseline mechanics
 # ---------------------------------------------------------------------------
 
@@ -241,12 +577,16 @@ def test_annotation_grammar():
         "# ktpu: holds(self._lock) callers are locked",
         "y = 2  # ktpu: allow(KTPU003) reviewed 2026-08; hot-path",
         "plain = 3  # ordinary comment",
+        "# ktpu: thread-entry(ingest-upload, terms-upload) uploader loop",
     ])
     assert ann[1][0].kind == "guarded-by" and ann[1][0].args == ("self._lock",)
     assert ann[2][0].kind == "holds" and "locked" in ann[2][0].reason
     kinds = {a.kind for a in ann[3]}
     assert kinds == {"allow", "hot-path"}
     assert 4 not in ann
+    te = ann[5][0]
+    assert te.kind == "thread-entry"
+    assert te.args == ("ingest-upload", "terms-upload")
 
 
 def _vio(rule="KTPU001", path="a.py", scope="f", detail="jax.jit"):
@@ -277,8 +617,12 @@ def test_baseline_fingerprint_is_line_free():
 # ---------------------------------------------------------------------------
 
 def test_tree_scan_does_not_grow_beyond_baseline():
+    """Module rules AND the interprocedural KTPU006–008: the full tree
+    must stay at 0 violations with the baseline still empty."""
     violations = scan_paths(
         [os.path.join(_REPO, "kubernetes_tpu")], _REPO, repo_config(), ALL_CHECKERS
+    ) + roles_mod.scan_repo_rules(
+        [os.path.join(_REPO, "kubernetes_tpu")], _REPO, repo_config()
     )
     base = Baseline.load(
         os.path.join(_REPO, "kubernetes_tpu", "analysis", "baseline.txt")
@@ -290,8 +634,14 @@ def test_tree_scan_does_not_grow_beyond_baseline():
 
 
 def test_cli_check_exits_zero():
+    """CLI plumbing (arg parsing → scan → baseline → exit code) on a
+    small subtree; the FULL-tree gate runs in-process above
+    (test_tree_scan_does_not_grow_beyond_baseline) and as the first
+    preflight stage — duplicating the whole-tree scan in a subprocess
+    here cost ~10s of tier-1 wall for no extra coverage."""
     proc = subprocess.run(
-        [sys.executable, os.path.join(_REPO, "scripts", "ktpu_lint.py"), "--check"],
+        [sys.executable, os.path.join(_REPO, "scripts", "ktpu_lint.py"),
+         "--check", "kubernetes_tpu/analysis"],
         capture_output=True, text=True, cwd=_REPO,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
